@@ -1,0 +1,32 @@
+"""code2vec_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of tech-srl/code2vec.
+
+The reference implementation (mounted read-only at /root/reference) is a
+TensorFlow-1 graph-mode / tf.keras code2vec: a neural model that embeds a code
+snippet as a bag of AST path-contexts, aggregates them with single-query soft
+attention into a fixed-size code vector, and predicts the method name from it.
+
+This package is a ground-up redesign for TPU:
+
+- strings never touch the device: tokenization happens in the host input
+  pipeline (``code2vec_tpu.data``), the model consumes int32 arrays + float
+  masks (reference did in-graph ``tf.lookup.StaticHashTable`` lookups,
+  vocabularies.py:108-139);
+- one pure ``apply`` with flags instead of three separate graphs (reference:
+  tensorflow_model.py:197-234 / 267-309);
+- static shapes everywhere: invalid rows become zero-weight examples instead
+  of dynamically filtered rows (reference: path_context_reader.py:153-177);
+- sharding is config, not code: embedding tables / softmax get
+  ``PartitionSpec``s over a ``jax.sharding.Mesh`` (``code2vec_tpu.parallel``).
+"""
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.vocab import Vocab, Code2VecVocabs, VocabType, SpecialWords
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'Config',
+    'Vocab', 'Code2VecVocabs', 'VocabType', 'SpecialWords',
+    '__version__',
+]

@@ -1,0 +1,293 @@
+"""Typed configuration for code2vec_tpu.
+
+Replicates every knob of the reference ``Config`` (reference config.py:46-70)
+and its file-naming contract (config.py:173-230) so existing ``.c2v`` datasets
+and launch scripts drop in unchanged, and adds TPU-specific knobs (mesh shape,
+compute dtype, checkpointing) that have no reference counterpart.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+import sys
+from argparse import ArgumentParser
+from typing import Optional, Iterator, Tuple, Any
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- training schedule (reference config.py:47-57) ----
+    NUM_TRAIN_EPOCHS: int = 20
+    SAVE_EVERY_EPOCHS: int = 1
+    TRAIN_BATCH_SIZE: int = 1024
+    TEST_BATCH_SIZE: int = 1024
+    TOP_K_WORDS_CONSIDERED_DURING_PREDICTION: int = 10
+    NUM_BATCHES_TO_LOG_PROGRESS: int = 100
+    NUM_TRAIN_BATCHES_TO_EVALUATE: int = 1800
+    READER_NUM_PARALLEL_BATCHES: int = 6
+    SHUFFLE_BUFFER_SIZE: int = 10000
+    CSV_BUFFER_SIZE: int = 100 * 1024 * 1024
+    MAX_TO_KEEP: int = 10
+
+    # ---- model hyper-params (reference config.py:60-70) ----
+    MAX_CONTEXTS: int = 200
+    MAX_TOKEN_VOCAB_SIZE: int = 1301136
+    MAX_TARGET_VOCAB_SIZE: int = 261245
+    MAX_PATH_VOCAB_SIZE: int = 911417
+    DEFAULT_EMBEDDINGS_SIZE: int = 128
+    TOKEN_EMBEDDINGS_SIZE: int = 128
+    PATH_EMBEDDINGS_SIZE: int = 128
+    CODE_VECTOR_SIZE: int = 384          # = context_vector_size by default
+    TARGET_EMBEDDINGS_SIZE: int = 384    # = CODE_VECTOR_SIZE by default
+    DROPOUT_KEEP_RATE: float = 0.75
+    SEPARATE_OOV_AND_PAD: bool = False
+
+    # ---- TPU-native knobs (no reference counterpart) ----
+    # Compute dtype for the forward/backward pass. Params are always fp32;
+    # 'bfloat16' casts activations/matmuls for the MXU and keeps the loss in
+    # fp32. 'float32' matches reference numerics bit-closely for tests.
+    COMPUTE_DTYPE: str = 'bfloat16'
+    # Mesh shape: (data, model). data axis = DP (gradient psum over ICI);
+    # model axis = row-sharded embedding tables + column-sharded softmax.
+    MESH_DATA_AXIS_SIZE: int = -1   # -1: all devices on the data axis
+    MESH_MODEL_AXIS_SIZE: int = 1
+    # Learning rate for Adam (reference uses tf.train.AdamOptimizer defaults,
+    # tensorflow_model.py:232 -> lr=0.001).
+    LEARNING_RATE: float = 0.001
+    # Host input pipeline.
+    READER_PREFETCH_BATCHES: int = 8
+    READER_USE_NATIVE: bool = True  # use the C++ tokenizer when available
+    # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
+    # Mirrors the reference's two swappable backends (keras/tensorflow),
+    # selected at runtime (reference code2vec.py:7-13).
+    DL_FRAMEWORK: str = 'flax'
+
+    # ---- run-mode flags (filled from CLI; reference config.py:72-87) ----
+    PREDICT: bool = False
+    MODEL_SAVE_PATH: Optional[str] = None
+    MODEL_LOAD_PATH: Optional[str] = None
+    TRAIN_DATA_PATH_PREFIX: Optional[str] = None
+    TEST_DATA_PATH: str = ''
+    RELEASE: bool = False
+    EXPORT_CODE_VECTORS: bool = False
+    SAVE_W2V: Optional[str] = None
+    SAVE_T2V: Optional[str] = None
+    VERBOSE_MODE: int = 1
+    LOGS_PATH: Optional[str] = None
+    USE_TENSORBOARD: bool = False
+
+    # ---- filled by the model lifecycle (reference config.py:130-132) ----
+    NUM_TRAIN_EXAMPLES: int = 0
+    NUM_TEST_EXAMPLES: int = 0
+
+    _logger: Optional[logging.Logger] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    # ------------------------------------------------------------------ CLI
+    @classmethod
+    def arguments_parser(cls) -> ArgumentParser:
+        """CLI surface-compatible with the reference (config.py:11-44)."""
+        parser = ArgumentParser(prog='code2vec_tpu')
+        parser.add_argument('-d', '--data', dest='data_path', required=False,
+                            help='path prefix of the preprocessed dataset')
+        parser.add_argument('-te', '--test', dest='test_path', metavar='FILE',
+                            required=False, default='',
+                            help='path to the test/validation .c2v file')
+        parser.add_argument('-s', '--save', dest='save_path', metavar='FILE',
+                            required=False, help='path to save the model to')
+        parser.add_argument('-w2v', '--save_word2v', dest='save_w2v',
+                            metavar='FILE', required=False,
+                            help='save token embeddings in word2vec format')
+        parser.add_argument('-t2v', '--save_target2v', dest='save_t2v',
+                            metavar='FILE', required=False,
+                            help='save target embeddings in word2vec format')
+        parser.add_argument('-l', '--load', dest='load_path', metavar='FILE',
+                            required=False, help='path to load the model from')
+        parser.add_argument('--export_code_vectors', action='store_true',
+                            help='export code vectors for the given examples')
+        parser.add_argument('--release', action='store_true',
+                            help='strip optimizer state from a loaded model '
+                                 'for a smaller artifact')
+        parser.add_argument('--predict', action='store_true',
+                            help='run the interactive prediction shell')
+        parser.add_argument('-fw', '--framework', dest='dl_framework',
+                            choices=['flax', 'jax'], default='flax',
+                            help='model backend to use')
+        parser.add_argument('-v', '--verbose', dest='verbose_mode', type=int,
+                            default=1, help='verbosity in {0,1,2}')
+        parser.add_argument('-lp', '--logs-path', dest='logs_path',
+                            metavar='FILE', required=False,
+                            help='file to mirror logs into')
+        parser.add_argument('-tb', '--tensorboard', dest='use_tensorboard',
+                            action='store_true',
+                            help='write metric summaries during training')
+        parser.add_argument('--dtype', dest='compute_dtype',
+                            choices=['bfloat16', 'float32'], default=None,
+                            help='compute dtype for the forward/backward pass')
+        parser.add_argument('--mesh', dest='mesh', default=None,
+                            help='mesh shape as DATAxMODEL, e.g. 4x2')
+        parser.add_argument('--batch-size', dest='batch_size', type=int,
+                            default=None, help='override TRAIN_BATCH_SIZE')
+        parser.add_argument('--epochs', dest='epochs', type=int, default=None,
+                            help='override NUM_TRAIN_EPOCHS')
+        return parser
+
+    def load_from_args(self, args=None) -> 'Config':
+        parsed = self.arguments_parser().parse_args(args)
+        self.PREDICT = parsed.predict
+        self.MODEL_SAVE_PATH = parsed.save_path
+        self.MODEL_LOAD_PATH = parsed.load_path
+        self.TRAIN_DATA_PATH_PREFIX = parsed.data_path
+        self.TEST_DATA_PATH = parsed.test_path or ''
+        self.RELEASE = parsed.release
+        self.EXPORT_CODE_VECTORS = parsed.export_code_vectors
+        self.SAVE_W2V = parsed.save_w2v
+        self.SAVE_T2V = parsed.save_t2v
+        self.VERBOSE_MODE = parsed.verbose_mode
+        self.LOGS_PATH = parsed.logs_path
+        self.DL_FRAMEWORK = parsed.dl_framework or 'flax'
+        self.USE_TENSORBOARD = parsed.use_tensorboard
+        if parsed.compute_dtype:
+            self.COMPUTE_DTYPE = parsed.compute_dtype
+        if parsed.mesh:
+            data_sz, model_sz = parsed.mesh.lower().split('x')
+            self.MESH_DATA_AXIS_SIZE = int(data_sz)
+            self.MESH_MODEL_AXIS_SIZE = int(model_sz)
+        if parsed.batch_size:
+            self.TRAIN_BATCH_SIZE = parsed.batch_size
+            self.TEST_BATCH_SIZE = parsed.batch_size
+        if parsed.epochs:
+            self.NUM_TRAIN_EPOCHS = parsed.epochs
+        return self
+
+    # ------------------------------------------------------- derived props
+    @property
+    def context_vector_size(self) -> int:
+        """Concatenation of source-token, path and target-token embeddings
+        (reference config.py:143-147)."""
+        return self.PATH_EMBEDDINGS_SIZE + 2 * self.TOKEN_EMBEDDINGS_SIZE
+
+    @property
+    def is_training(self) -> bool:
+        return bool(self.TRAIN_DATA_PATH_PREFIX)
+
+    @property
+    def is_loading(self) -> bool:
+        return bool(self.MODEL_LOAD_PATH)
+
+    @property
+    def is_saving(self) -> bool:
+        return bool(self.MODEL_SAVE_PATH)
+
+    @property
+    def is_testing(self) -> bool:
+        return bool(self.TEST_DATA_PATH)
+
+    @property
+    def train_steps_per_epoch(self) -> int:
+        return (math.ceil(self.NUM_TRAIN_EXAMPLES / self.TRAIN_BATCH_SIZE)
+                if self.TRAIN_BATCH_SIZE else 0)
+
+    @property
+    def test_steps(self) -> int:
+        return (math.ceil(self.NUM_TEST_EXAMPLES / self.TEST_BATCH_SIZE)
+                if self.TEST_BATCH_SIZE else 0)
+
+    def data_path(self, is_evaluating: bool = False) -> Optional[str]:
+        return self.TEST_DATA_PATH if is_evaluating else self.train_data_path
+
+    def batch_size(self, is_evaluating: bool = False) -> int:
+        return self.TEST_BATCH_SIZE if is_evaluating else self.TRAIN_BATCH_SIZE
+
+    # -------------------------------------- file-naming contract (parity)
+    @property
+    def train_data_path(self) -> Optional[str]:
+        if not self.is_training:
+            return None
+        return '{}.train.c2v'.format(self.TRAIN_DATA_PATH_PREFIX)
+
+    @property
+    def word_freq_dict_path(self) -> Optional[str]:
+        if not self.is_training:
+            return None
+        return '{}.dict.c2v'.format(self.TRAIN_DATA_PATH_PREFIX)
+
+    @classmethod
+    def get_vocabularies_path_from_model_path(cls, model_file_path: str) -> str:
+        """``dictionaries.bin`` sidecar next to the model
+        (reference config.py:191-194)."""
+        return os.path.join(os.path.dirname(model_file_path), 'dictionaries.bin')
+
+    @classmethod
+    def get_entire_model_path(cls, model_path: str) -> str:
+        return model_path + '__entire-model'
+
+    @classmethod
+    def get_model_weights_path(cls, model_path: str) -> str:
+        return model_path + '__only-weights'
+
+    @property
+    def model_load_dir(self) -> str:
+        return os.path.dirname(self.MODEL_LOAD_PATH)
+
+    @property
+    def entire_model_load_path(self) -> Optional[str]:
+        return self.get_entire_model_path(self.MODEL_LOAD_PATH) if self.is_loading else None
+
+    @property
+    def model_weights_load_path(self) -> Optional[str]:
+        return self.get_model_weights_path(self.MODEL_LOAD_PATH) if self.is_loading else None
+
+    @property
+    def entire_model_save_path(self) -> Optional[str]:
+        return self.get_entire_model_path(self.MODEL_SAVE_PATH) if self.is_saving else None
+
+    @property
+    def model_weights_save_path(self) -> Optional[str]:
+        return self.get_model_weights_path(self.MODEL_SAVE_PATH) if self.is_saving else None
+
+    # ------------------------------------------------------------- verify
+    def verify(self) -> None:
+        """Startup sanity checks (reference config.py:232-239)."""
+        if not self.is_training and not self.is_loading:
+            raise ValueError('Must train or load a model.')
+        if self.is_loading and not os.path.isdir(self.model_load_dir):
+            raise ValueError('Model load dir `{}` does not exist.'.format(
+                self.model_load_dir))
+        if self.DL_FRAMEWORK not in {'flax', 'jax'}:
+            raise ValueError("config.DL_FRAMEWORK must be in {'flax', 'jax'}.")
+        if self.COMPUTE_DTYPE not in {'bfloat16', 'float32'}:
+            raise ValueError("config.COMPUTE_DTYPE must be in "
+                             "{'bfloat16', 'float32'}.")
+
+    def __iter__(self) -> Iterator[Tuple[str, Any]]:
+        for field in dataclasses.fields(self):
+            if field.name.startswith('_'):
+                continue
+            yield field.name, getattr(self, field.name)
+
+    # ------------------------------------------------------------ logging
+    def get_logger(self) -> logging.Logger:
+        if self._logger is None:
+            logger = logging.getLogger('code2vec_tpu')
+            logger.setLevel(logging.INFO)
+            logger.handlers = []
+            logger.propagate = False
+            formatter = logging.Formatter('%(asctime)s %(levelname)-8s %(message)s')
+            if self.VERBOSE_MODE >= 1:
+                handler = logging.StreamHandler(sys.stdout)
+                handler.setLevel(logging.INFO)
+                handler.setFormatter(formatter)
+                logger.addHandler(handler)
+            if self.LOGS_PATH:
+                file_handler = logging.FileHandler(self.LOGS_PATH)
+                file_handler.setLevel(logging.INFO)
+                file_handler.setFormatter(formatter)
+                logger.addHandler(file_handler)
+            self._logger = logger
+        return self._logger
+
+    def log(self, msg: str) -> None:
+        self.get_logger().info(msg)

@@ -1,0 +1,4 @@
+from code2vec_tpu.data.reader import (
+    Batch, EstimatorAction, PathContextReader, parse_c2v_line)
+
+__all__ = ['Batch', 'EstimatorAction', 'PathContextReader', 'parse_c2v_line']

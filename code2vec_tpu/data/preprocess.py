@@ -1,0 +1,222 @@
+"""Offline dataset production: raw extractor output → ``.c2v`` + ``.dict.c2v``.
+
+Replaces both the reference's awk histogram pass (preprocess.sh:55-58) and its
+``preprocess.py`` sampling/padding pass (:23-74) with one Python module (the
+histogram pass is plain counting; the native extractor can also emit
+histograms directly).
+
+Semantics preserved exactly:
+
+- per-split context truncation to ``max_contexts`` with vocab-aware sampling:
+  prefer contexts whose three parts are all in-vocab ('full found'), then
+  those with any part in-vocab ('partial found'), random-sampling within a
+  tier (reference preprocess.py:41-56);
+- rows with zero contexts are dropped (:58-60);
+- rows are padded with trailing spaces to exactly ``max_contexts`` fields
+  (:64-65) so files are byte-layout compatible with reference readers;
+- ``.dict.c2v`` = sequential pickles of word/path/target→count dicts +
+  train example count (:12-20).
+"""
+from __future__ import annotations
+
+import pickle
+import random
+from argparse import ArgumentParser
+from collections import Counter
+from typing import Dict, Iterable, Optional, Tuple
+
+from code2vec_tpu import common
+
+
+def build_histograms(raw_path: str) -> Tuple[Counter, Counter, Counter]:
+    """Count target names (field 1), origin tokens (ctx fields 1 and 3) and
+    paths (ctx field 2) over a raw extractor output file — the reference did
+    this with three awk one-liners (preprocess.sh:55-58)."""
+    target_count: Counter = Counter()
+    token_count: Counter = Counter()
+    path_count: Counter = Counter()
+    with open(raw_path, 'r') as file:
+        for line in file:
+            parts = line.rstrip('\n').split(' ')
+            if not parts or not parts[0]:
+                continue
+            target_count[parts[0]] += 1
+            for ctx in parts[1:]:
+                if not ctx:
+                    continue
+                pieces = ctx.split(',')
+                if len(pieces) != 3:
+                    continue
+                token_count[pieces[0]] += 1
+                path_count[pieces[1]] += 1
+                token_count[pieces[2]] += 1
+    return token_count, path_count, target_count
+
+
+def save_histogram(counter: Counter, path: str) -> None:
+    """``word count`` lines, most-common first (awk output is unsorted, but
+    readers don't depend on order — common.load_histogram re-sorts by count)."""
+    with open(path, 'w') as f:
+        for word, count in counter.most_common():
+            f.write('{} {}\n'.format(word, count))
+
+
+truncate_to_max_size = common.truncate_histogram_to_max_size
+
+
+def _context_full_found(parts, word_to_count, path_to_count) -> bool:
+    return (parts[0] in word_to_count and parts[1] in path_to_count
+            and parts[2] in word_to_count)
+
+
+def _context_partial_found(parts, word_to_count, path_to_count) -> bool:
+    return (parts[0] in word_to_count or parts[1] in path_to_count
+            or parts[2] in word_to_count)
+
+
+def process_file(file_path: str, data_file_role: str, dataset_name: str,
+                 word_to_count: Dict[str, int], path_to_count: Dict[str, int],
+                 max_contexts: int, rng: Optional[random.Random] = None) -> int:
+    """Vocab-aware truncation + space padding for one split
+    (reference preprocess.py:23-74). Returns the number of kept examples."""
+    rng = rng or random
+    sum_total = sum_sampled = total = empty = max_unfiltered = 0
+    output_path = '{}.{}.c2v'.format(dataset_name, data_file_role)
+    with open(output_path, 'w') as outfile, open(file_path, 'r') as file:
+        for line in file:
+            parts = line.rstrip('\n').split(' ')
+            target_name = parts[0]
+            contexts = parts[1:]
+            max_unfiltered = max(max_unfiltered, len(contexts))
+            sum_total += len(contexts)
+            if len(contexts) > max_contexts:
+                context_parts = [c.split(',') for c in contexts]
+                full = [c for i, c in enumerate(contexts)
+                        if _context_full_found(context_parts[i],
+                                               word_to_count, path_to_count)]
+                partial = [c for i, c in enumerate(contexts)
+                           if _context_partial_found(context_parts[i],
+                                                     word_to_count, path_to_count)
+                           and not _context_full_found(context_parts[i],
+                                                       word_to_count,
+                                                       path_to_count)]
+                if len(full) > max_contexts:
+                    contexts = rng.sample(full, max_contexts)
+                elif len(full) + len(partial) > max_contexts:
+                    contexts = full + rng.sample(partial,
+                                                 max_contexts - len(full))
+                else:
+                    contexts = full + partial
+            if len(contexts) == 0:
+                empty += 1
+                continue
+            sum_sampled += len(contexts)
+            csv_padding = ' ' * (max_contexts - len(contexts))
+            outfile.write(target_name + ' ' + ' '.join(contexts)
+                          + csv_padding + '\n')
+            total += 1
+    print('File: ' + file_path)
+    if total:
+        print('Average total contexts: ' + str(float(sum_total) / total))
+        print('Average final (after sampling) contexts: '
+              + str(float(sum_sampled) / total))
+    print('Total examples: ' + str(total))
+    print('Empty examples: ' + str(empty))
+    print('Max number of contexts per word: ' + str(max_unfiltered))
+    return total
+
+
+def save_dictionaries(dataset_name: str, word_to_count: Dict[str, int],
+                      path_to_count: Dict[str, int],
+                      target_to_count: Dict[str, int],
+                      num_training_examples: int) -> None:
+    """Sequential-pickle layout of ``.dict.c2v``
+    (reference preprocess.py:12-20)."""
+    save_path = '{}.dict.c2v'.format(dataset_name)
+    with open(save_path, 'wb') as file:
+        pickle.dump(word_to_count, file)
+        pickle.dump(path_to_count, file)
+        pickle.dump(target_to_count, file)
+        pickle.dump(num_training_examples, file)
+    print('Dictionaries saved to: {}'.format(save_path))
+
+
+def preprocess_dataset(train_raw: str, val_raw: str, test_raw: str,
+                       output_name: str, max_contexts: int = 200,
+                       word_vocab_size: int = 1301136,
+                       path_vocab_size: int = 911417,
+                       target_vocab_size: int = 261245,
+                       word_histogram: Optional[str] = None,
+                       path_histogram: Optional[str] = None,
+                       target_histogram: Optional[str] = None,
+                       seed: Optional[int] = None) -> None:
+    """End-to-end offline preprocessing. If histogram files aren't supplied,
+    they are built from the raw train split directly (replacing the awk
+    pass)."""
+    rng = random.Random(seed) if seed is not None else None
+    if word_histogram and path_histogram and target_histogram:
+        word_to_count = common.load_histogram(word_histogram,
+                                              max_size=word_vocab_size)
+        path_to_count = common.load_histogram(path_histogram,
+                                              max_size=path_vocab_size)
+        target_to_count = common.load_histogram(target_histogram,
+                                                max_size=target_vocab_size)
+    else:
+        token_count, path_count, target_count = build_histograms(train_raw)
+        word_to_count = truncate_to_max_size(token_count, word_vocab_size)
+        path_to_count = truncate_to_max_size(path_count, path_vocab_size)
+        target_to_count = truncate_to_max_size(target_count, target_vocab_size)
+
+    num_training_examples = 0
+    for raw_path, role in zip([test_raw, val_raw, train_raw],
+                              ['test', 'val', 'train']):
+        num_examples = process_file(
+            file_path=raw_path, data_file_role=role, dataset_name=output_name,
+            word_to_count=word_to_count, path_to_count=path_to_count,
+            max_contexts=max_contexts, rng=rng)
+        if role == 'train':
+            num_training_examples = num_examples
+    save_dictionaries(output_name, word_to_count, path_to_count,
+                      target_to_count, num_training_examples)
+
+
+def main(argv=None) -> None:
+    parser = ArgumentParser(prog='code2vec_tpu.data.preprocess')
+    parser.add_argument('-trd', '--train_data', dest='train_data_path',
+                        required=True)
+    parser.add_argument('-ted', '--test_data', dest='test_data_path',
+                        required=True)
+    parser.add_argument('-vd', '--val_data', dest='val_data_path',
+                        required=True)
+    parser.add_argument('-mc', '--max_contexts', dest='max_contexts',
+                        type=int, default=200)
+    parser.add_argument('-wvs', '--word_vocab_size', dest='word_vocab_size',
+                        type=int, default=1301136)
+    parser.add_argument('-pvs', '--path_vocab_size', dest='path_vocab_size',
+                        type=int, default=911417)
+    parser.add_argument('-tvs', '--target_vocab_size', dest='target_vocab_size',
+                        type=int, default=261245)
+    parser.add_argument('-wh', '--word_histogram', dest='word_histogram',
+                        default=None)
+    parser.add_argument('-ph', '--path_histogram', dest='path_histogram',
+                        default=None)
+    parser.add_argument('-th', '--target_histogram', dest='target_histogram',
+                        default=None)
+    parser.add_argument('-o', '--output_name', dest='output_name',
+                        required=True)
+    parser.add_argument('--seed', type=int, default=None)
+    args = parser.parse_args(argv)
+    preprocess_dataset(
+        train_raw=args.train_data_path, val_raw=args.val_data_path,
+        test_raw=args.test_data_path, output_name=args.output_name,
+        max_contexts=args.max_contexts,
+        word_vocab_size=args.word_vocab_size,
+        path_vocab_size=args.path_vocab_size,
+        target_vocab_size=args.target_vocab_size,
+        word_histogram=args.word_histogram,
+        path_histogram=args.path_histogram,
+        target_histogram=args.target_histogram, seed=args.seed)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,391 @@
+"""Host-side input pipeline: ``.c2v`` text → fixed-shape int32/float32 batches.
+
+TPU-first redesign of the reference's in-graph tf.data pipeline
+(reference path_context_reader.py:119-228):
+
+- **Strings never touch the device.** Vocabulary lookup happens here, on the
+  host, with plain dicts (the reference used in-graph
+  ``tf.lookup.StaticHashTable``, vocabularies.py:108-139 — impossible and
+  undesirable under XLA).
+- **Static shapes.** Every batch is exactly ``(batch_size, max_contexts)``;
+  row filtering happens host-side before batching, and a short final batch is
+  padded with zero-``weight`` rows instead of shrinking (the reference emitted
+  ragged final batches, path_context_reader.py:148).
+- **Same row semantics.** A context part that is missing or out-of-vocab maps
+  to PAD/OOV exactly as the reference's CSV-default + hashtable-default
+  pipeline did (path_context_reader.py:82-83, 184-214), including the joined
+  PAD==OOV policy subtlety: a context whose three parts all hash to index 0 is
+  masked out.
+- **Same filter semantics.** Train rows must have an in-vocab target and at
+  least one valid context; eval rows only the latter
+  (path_context_reader.py:153-177). Predict rows are never filtered (:100).
+
+A background thread parses and tokenizes ahead of the consumer
+(``READER_PREFETCH_BATCHES`` deep), mirroring the reference's
+``num_parallel_calls`` + ``prefetch`` (:141-150). When the native C++
+tokenizer is available (``code2vec_tpu.data.native``) it replaces the Python
+inner loop.
+"""
+from __future__ import annotations
+
+import queue
+import random
+import threading
+from enum import Enum
+from typing import Iterable, Iterator, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.vocab import Code2VecVocabs
+
+
+class EstimatorAction(Enum):
+    Train = 'train'
+    Evaluate = 'evaluate'
+    Predict = 'predict'
+
+    @property
+    def is_train(self) -> bool:
+        return self is EstimatorAction.Train
+
+    @property
+    def is_evaluate(self) -> bool:
+        return self is EstimatorAction.Evaluate
+
+    @property
+    def is_predict(self) -> bool:
+        return self is EstimatorAction.Predict
+
+    @property
+    def is_evaluate_or_predict(self) -> bool:
+        return self.is_evaluate or self.is_predict
+
+
+class Batch(NamedTuple):
+    """One device-ready batch. All arrays have static leading dimension
+    ``batch_size``; short final batches are padded with ``weight == 0`` rows."""
+    source: np.ndarray               # (B, C) int32 — source-token indices
+    path: np.ndarray                 # (B, C) int32 — path indices
+    target: np.ndarray               # (B, C) int32 — target-token indices
+    mask: np.ndarray                 # (B, C) float32 — context validity
+    label: np.ndarray                # (B,)  int32 — target-name index
+    weight: np.ndarray               # (B,)  float32 — example validity
+    # Host-only string fields (eval/predict; device code never sees these).
+    label_strings: Optional[np.ndarray] = None     # (B,) object
+    source_strings: Optional[np.ndarray] = None    # (B, C) object
+    path_strings: Optional[np.ndarray] = None      # (B, C) object
+    target_strings: Optional[np.ndarray] = None    # (B, C) object
+
+    @property
+    def num_valid_examples(self) -> int:
+        return int(self.weight.sum())
+
+    def device_arrays(self):
+        """The arrays the jitted step functions consume, in a fixed order."""
+        return (self.source, self.path, self.target, self.mask,
+                self.label, self.weight)
+
+
+class ParsedRow(NamedTuple):
+    label_str: str
+    source_strs: List[str]
+    path_strs: List[str]
+    target_strs: List[str]
+
+
+def parse_c2v_line(line: str, max_contexts: int) -> ParsedRow:
+    """Split one ``label ctx1 ctx2 …`` line; a ctx is ``src,path,tgt``.
+
+    Missing/short/empty contexts are padded with empty strings, which
+    tokenize to PAD — the host equivalent of the reference's CSV record
+    defaults (path_context_reader.py:82-83, 190-196).
+    """
+    parts = line.rstrip('\n').split(' ')
+    label = parts[0]
+    source_strs = [''] * max_contexts
+    path_strs = [''] * max_contexts
+    target_strs = [''] * max_contexts
+    n = min(len(parts) - 1, max_contexts)
+    for i in range(n):
+        ctx = parts[i + 1]
+        if not ctx:
+            continue
+        pieces = ctx.split(',')
+        if len(pieces) >= 1:
+            source_strs[i] = pieces[0]
+        if len(pieces) >= 2:
+            path_strs[i] = pieces[1]
+        if len(pieces) >= 3:
+            target_strs[i] = pieces[2]
+    return ParsedRow(label, source_strs, path_strs, target_strs)
+
+
+class PathContextReader:
+    def __init__(self, vocabs: Code2VecVocabs, config: Config,
+                 estimator_action: EstimatorAction,
+                 data_path: Optional[str] = None,
+                 keep_strings: Optional[bool] = None):
+        self.vocabs = vocabs
+        self.config = config
+        self.estimator_action = estimator_action
+        self.data_path = data_path if data_path is not None else \
+            config.data_path(is_evaluating=estimator_action.is_evaluate)
+        # Eval and predict keep the raw strings around for host-side metric
+        # computation / attention display (reference kept string tensors in
+        # the graph, path_context_reader.py:225-227).
+        self.keep_strings = (estimator_action.is_evaluate_or_predict
+                             if keep_strings is None else keep_strings)
+        self._native = None
+        if config.READER_USE_NATIVE and not self.keep_strings:
+            try:
+                from code2vec_tpu.data import native
+                if native.is_available():
+                    self._native = native.NativeTokenizer(vocabs, config)
+            except ImportError:
+                self._native = None
+
+    # ------------------------------------------------------------ tokenize
+    def tokenize_rows(self, rows: Sequence[ParsedRow]) -> Batch:
+        """Vocab-lookup a list of parsed rows into one dense batch of
+        exactly ``len(rows)`` examples (callers pad to batch size)."""
+        n = len(rows)
+        max_contexts = self.config.MAX_CONTEXTS
+        token_get = self.vocabs.token_vocab.word_to_index.get
+        path_get = self.vocabs.path_vocab.word_to_index.get
+        target_get = self.vocabs.target_vocab.word_to_index.get
+        token_oov = self.vocabs.token_vocab.oov_index
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_oov = self.vocabs.path_vocab.oov_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        target_oov = self.vocabs.target_vocab.oov_index
+        # Empty strings must map to PAD, not OOV: the reference's CSV default
+        # substitutes the PAD word *before* the hashtable lookup.
+        source = np.empty((n, max_contexts), dtype=np.int32)
+        path = np.empty((n, max_contexts), dtype=np.int32)
+        target = np.empty((n, max_contexts), dtype=np.int32)
+        label = np.empty((n,), dtype=np.int32)
+        for r, row in enumerate(rows):
+            label[r] = target_get(row.label_str, target_oov)
+            src_row, path_row, tgt_row = source[r], path[r], target[r]
+            for c in range(max_contexts):
+                s = row.source_strs[c]
+                src_row[c] = token_get(s, token_oov) if s else token_pad
+                p = row.path_strs[c]
+                path_row[c] = path_get(p, path_oov) if p else path_pad
+                t = row.target_strs[c]
+                tgt_row[c] = token_get(t, token_oov) if t else token_pad
+        mask = self._context_valid_mask(source, path, target)
+        weight = np.ones((n,), dtype=np.float32)
+        batch = Batch(source=source, path=path, target=target, mask=mask,
+                      label=label, weight=weight)
+        if self.keep_strings:
+            batch = batch._replace(
+                label_strings=np.array([row.label_str for row in rows], dtype=object),
+                source_strings=np.array([row.source_strs for row in rows], dtype=object),
+                path_strings=np.array([row.path_strs for row in rows], dtype=object),
+                target_strings=np.array([row.target_strs for row in rows], dtype=object))
+        return batch
+
+    def _context_valid_mask(self, source: np.ndarray, path: np.ndarray,
+                            target: np.ndarray) -> np.ndarray:
+        """A context is valid iff any of its three parts is non-PAD
+        (reference path_context_reader.py:209-214, including the joined
+        PAD==OOV subtlety)."""
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        return ((source != token_pad) | (target != token_pad)
+                | (path != path_pad)).astype(np.float32)
+
+    # ------------------------------------------------------------- batching
+    def _lines_from_file(self) -> Iterator[str]:
+        with open(self.data_path, 'r', buffering=self.config.CSV_BUFFER_SIZE) as f:
+            for line in f:
+                if line.strip():
+                    yield line
+
+    def _shuffled(self, lines: Iterable[str], rng: random.Random) -> Iterator[str]:
+        """Streaming shuffle buffer (reference used
+        ``dataset.shuffle(SHUFFLE_BUFFER_SIZE)``, path_context_reader.py:139)."""
+        buffer: List[str] = []
+        size = self.config.SHUFFLE_BUFFER_SIZE
+        for line in lines:
+            if len(buffer) < size:
+                buffer.append(line)
+                continue
+            idx = rng.randrange(size)
+            yield buffer[idx]
+            buffer[idx] = line
+        rng.shuffle(buffer)
+        yield from buffer
+
+    def tokenize_lines(self, lines: Sequence[str]) -> Batch:
+        """Parse + tokenize a chunk of raw lines into one dense batch.
+
+        This is the hot host loop; the native C++ tokenizer substitutes for
+        it when available."""
+        if self._native is not None:
+            return self._native.tokenize_lines(lines)
+        rows = [parse_c2v_line(line, self.config.MAX_CONTEXTS)
+                for line in lines]
+        return self.tokenize_rows(rows)
+
+    def _keep_mask(self, batch: Batch) -> np.ndarray:
+        """Vectorized row filter (reference path_context_reader.py:153-177):
+        train keeps rows with an in-vocab target AND ≥1 valid context; eval
+        keeps rows with ≥1 valid context."""
+        any_valid = batch.mask.any(axis=1)
+        if self.estimator_action.is_train:
+            return any_valid & (batch.label > self.vocabs.target_vocab.oov_index)
+        return any_valid
+
+    @staticmethod
+    def _take_rows(batch: Batch, keep: np.ndarray) -> Batch:
+        return Batch(*[None if field is None else field[keep]
+                       for field in batch])
+
+    @staticmethod
+    def _concat(parts: List[Batch]) -> Batch:
+        if len(parts) == 1:
+            return parts[0]
+        return Batch(*[None if parts[0][i] is None
+                       else np.concatenate([p[i] for p in parts])
+                       for i in range(len(parts[0]))])
+
+    def _filtered_batches(self, lines: Iterable[str],
+                          batch_size: int) -> Iterator[Batch]:
+        """Parse, tokenize, filter, and emit fixed-shape batches."""
+        pending: List[Batch] = []
+        pending_rows = 0
+        chunk: List[str] = []
+        chunk_size = max(batch_size, 256)
+
+        def flush_chunk():
+            nonlocal pending, pending_rows
+            batch = self.tokenize_lines(chunk)
+            kept = self._take_rows(batch, self._keep_mask(batch))
+            if kept.label.shape[0]:
+                pending.append(kept)
+                pending_rows += kept.label.shape[0]
+            while pending_rows >= batch_size:
+                merged = self._concat(pending)
+                # slice, not fancy-index: views, no copies in the hot loop
+                yield self._take_rows(merged, slice(None, batch_size))
+                rest = self._take_rows(merged, slice(batch_size, None))
+                pending = [rest] if rest.label.shape[0] else []
+                pending_rows = merged.label.shape[0] - batch_size
+
+        for line in lines:
+            chunk.append(line)
+            if len(chunk) >= chunk_size:
+                yield from flush_chunk()
+                chunk = []
+        if chunk:
+            yield from flush_chunk()
+        if pending_rows:
+            yield self._pad_batch(self._concat(pending), batch_size)
+
+    def _pad_batch(self, batch: Batch, batch_size: int) -> Batch:
+        """Pad a short batch up to the static batch size with zero-weight
+        rows (replaces the reference's ragged final batch)."""
+        n = batch.label.shape[0]
+        if n == batch_size:
+            return batch
+        pad = batch_size - n
+
+        def pad2(arr, fill):
+            return np.concatenate(
+                [arr, np.full((pad,) + arr.shape[1:], fill, dtype=arr.dtype)])
+
+        padded = Batch(
+            source=pad2(batch.source, self.vocabs.token_vocab.pad_index),
+            path=pad2(batch.path, self.vocabs.path_vocab.pad_index),
+            target=pad2(batch.target, self.vocabs.token_vocab.pad_index),
+            mask=pad2(batch.mask, 0.0),
+            label=pad2(batch.label, 0),
+            weight=np.concatenate([batch.weight,
+                                   np.zeros((pad,), dtype=np.float32)]))
+        if self.keep_strings:
+            empty_ctx = np.full((pad, self.config.MAX_CONTEXTS), '', dtype=object)
+            padded = padded._replace(
+                label_strings=np.concatenate(
+                    [batch.label_strings, np.full((pad,), '', dtype=object)]),
+                source_strings=np.concatenate([batch.source_strings, empty_ctx]),
+                path_strings=np.concatenate([batch.path_strings, empty_ctx]),
+                target_strings=np.concatenate([batch.target_strings, empty_ctx]))
+        return padded
+
+    # ----------------------------------------------------------- public API
+    def iter_epoch(self, shuffle: Optional[bool] = None,
+                   seed: Optional[int] = None) -> Iterator[Batch]:
+        """One pass over the data file as fixed-shape batches.
+
+        The trainer drives epochs explicitly (the reference baked
+        ``repeat(NUM_TRAIN_EPOCHS)`` into the dataset and trained until
+        ``OutOfRangeError``, tensorflow_model.py:74-102 — with JAX's explicit
+        stepping we keep the loop in charge).
+        """
+        if shuffle is None:
+            shuffle = self.estimator_action.is_train
+        lines: Iterable[str] = self._lines_from_file()
+        if shuffle:
+            lines = self._shuffled(lines, random.Random(seed))
+        batch_size = self.config.batch_size(
+            is_evaluating=self.estimator_action.is_evaluate)
+        yield from self._filtered_batches(lines, batch_size)
+
+    def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,
+                              seed: Optional[int] = None) -> Iterator[Batch]:
+        """``iter_epoch`` behind a background thread + bounded queue
+        (the reference's ``prefetch(40)``, path_context_reader.py:150).
+
+        Safe to abandon mid-epoch (e.g. a trainer breaking out to evaluate):
+        closing the generator cancels the producer thread instead of leaking
+        it blocked on the full queue."""
+        out: 'queue.Queue' = queue.Queue(self.config.READER_PREFETCH_BATCHES)
+        sentinel = object()
+        cancelled = threading.Event()
+        error: List[BaseException] = []
+
+        def produce():
+            try:
+                for batch in self.iter_epoch(shuffle=shuffle, seed=seed):
+                    while not cancelled.is_set():
+                        try:
+                            out.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancelled.is_set():
+                        return
+            except BaseException as exc:  # propagate to consumer
+                error.append(exc)
+            finally:
+                # must not drop the sentinel on a full queue, or the consumer
+                # blocks forever after draining it
+                while not cancelled.is_set():
+                    try:
+                        out.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            cancelled.set()
+            thread.join()
+        if error:
+            raise error[0]
+
+    def process_input_rows(self, input_lines: Iterable[str]) -> Batch:
+        """Tokenize raw extractor output lines for prediction — never
+        filtered (reference path_context_reader.py:96-107)."""
+        rows = [parse_c2v_line(line, self.config.MAX_CONTEXTS)
+                for line in input_lines]
+        return self.tokenize_rows(rows)

@@ -1,0 +1,248 @@
+"""Vocabularies for tokens / paths / targets.
+
+Disk-format compatible with the reference:
+
+- ``<data>.dict.c2v`` — sequential pickles of token/path/target frequency
+  dicts + train example count (reference preprocess.py:12-20,
+  vocabularies.py:220-230);
+- ``dictionaries.bin`` model sidecar — per-vocab sequential pickles of
+  ``word_to_index`` / ``index_to_word`` / ``size`` *without* special words, in
+  token → target → path order (reference vocabularies.py:57-97, 211-218).
+
+Device-facing difference from the reference: there are no in-graph lookup
+tables (JAX has no string tensors). ``Vocab.lookup_indices`` performs bulk
+host-side lookups producing int32 numpy arrays; index→word decoding for
+eval/predict also happens on host.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from enum import Enum
+from types import SimpleNamespace
+from typing import Dict, Iterable, List, NamedTuple, Optional
+
+import numpy as np
+
+from code2vec_tpu import common
+from code2vec_tpu.config import Config
+
+
+class VocabType(Enum):
+    Token = 1
+    Target = 2
+    Path = 3
+
+
+SpecialWords = SimpleNamespace
+
+# Special-word policies (reference vocabularies.py:22-35).
+SPECIAL_WORDS_ONLY_OOV = SimpleNamespace(OOV='<OOV>')
+SPECIAL_WORDS_SEPARATE_OOV_PAD = SimpleNamespace(PAD='<PAD>', OOV='<OOV>')
+SPECIAL_WORDS_JOINED_OOV_PAD = SimpleNamespace(
+    PAD_OR_OOV='<PAD_OR_OOV>', PAD='<PAD_OR_OOV>', OOV='<PAD_OR_OOV>')
+
+
+class Vocab:
+    def __init__(self, vocab_type: VocabType, words: Iterable[str],
+                 special_words: Optional[SpecialWords] = None):
+        if special_words is None:
+            special_words = SimpleNamespace()
+        self.vocab_type = vocab_type
+        self.special_words = special_words
+        self.word_to_index: Dict[str, int] = {}
+        self.index_to_word: Dict[int, str] = {}
+        for index, word in enumerate(
+                common.get_unique_list(special_words.__dict__.values())):
+            self.word_to_index[word] = index
+            self.index_to_word[index] = word
+        for word in words:
+            if word in self.word_to_index:
+                continue
+            index = len(self.word_to_index)
+            self.word_to_index[word] = index
+            self.index_to_word[index] = word
+        self.size = len(self.word_to_index)
+
+    # ------------------------------------------------------------ lookups
+    @property
+    def oov_index(self) -> int:
+        return self.word_to_index[self.special_words.OOV]
+
+    @property
+    def pad_index(self) -> int:
+        return self.word_to_index[self.special_words.PAD]
+
+    def lookup_index(self, word: str) -> int:
+        """word → index with OOV default (the host-side replacement of the
+        reference's in-graph StaticHashTable, vocabularies.py:123-127)."""
+        return self.word_to_index.get(word, self.oov_index)
+
+    def lookup_indices(self, words: Iterable[str]) -> np.ndarray:
+        get = self.word_to_index.get
+        oov = self.oov_index
+        return np.fromiter((get(w, oov) for w in words), dtype=np.int32)
+
+    def lookup_word(self, index: int) -> str:
+        return self.index_to_word.get(int(index), self.special_words.OOV)
+
+    def lookup_words(self, indices: Iterable[int]) -> List[str]:
+        get = self.index_to_word.get
+        oov = self.special_words.OOV
+        return [get(int(i), oov) for i in indices]
+
+    def index_to_word_array(self) -> np.ndarray:
+        """Dense object-array of words, index-addressable, for vectorized
+        host-side decoding of device top-k outputs."""
+        arr = np.empty(self.size, dtype=object)
+        for idx, word in self.index_to_word.items():
+            arr[idx] = word
+        return arr
+
+    # ----------------------------------------------------------- persistence
+    def save_to_file(self, file) -> None:
+        """Reference-layout save: special words stripped before pickling
+        (reference vocabularies.py:57-66)."""
+        specials = common.get_unique_list(self.special_words.__dict__.values())
+        nr_special = len(specials)
+        word_to_index = {w: i for w, i in self.word_to_index.items() if i >= nr_special}
+        index_to_word = {i: w for i, w in self.index_to_word.items() if i >= nr_special}
+        pickle.dump(word_to_index, file)
+        pickle.dump(index_to_word, file)
+        pickle.dump(self.size - nr_special, file)
+
+    @classmethod
+    def load_from_file(cls, vocab_type: VocabType, file,
+                       special_words: SpecialWords) -> 'Vocab':
+        """Reference-layout load: special words re-added at the low indices
+        (reference vocabularies.py:68-97)."""
+        specials = common.get_unique_list(special_words.__dict__.values())
+        word_to_index = pickle.load(file)
+        index_to_word = pickle.load(file)
+        size_wo_specials = pickle.load(file)
+        assert len(index_to_word) == len(word_to_index) == size_wo_specials
+        min_idx = min(index_to_word.keys())
+        if min_idx != len(specials):
+            raise ValueError(
+                'Stored vocabulary {} has minimum word index {}, expected {} '
+                'special words {}. Check config.SEPARATE_OOV_AND_PAD.'.format(
+                    vocab_type, min_idx, len(specials), specials))
+        vocab = cls(vocab_type, [], special_words)
+        vocab.word_to_index = {**word_to_index,
+                               **{w: i for i, w in enumerate(specials)}}
+        vocab.index_to_word = {**index_to_word,
+                               **{i: w for i, w in enumerate(specials)}}
+        vocab.size = size_wo_specials + len(specials)
+        return vocab
+
+    @classmethod
+    def create_from_freq_dict(cls, vocab_type: VocabType,
+                              word_to_count: Dict[str, int], max_size: int,
+                              special_words: Optional[SpecialWords] = None
+                              ) -> 'Vocab':
+        """Top-``max_size`` words by count (reference vocabularies.py:99-106;
+        ties broken by dict order like the reference's ``sorted``)."""
+        words = sorted(word_to_count, key=word_to_count.get, reverse=True)
+        return cls(vocab_type, words[:max_size], special_words)
+
+
+class WordFreqDicts(NamedTuple):
+    token_to_count: Dict[str, int]
+    path_to_count: Dict[str, int]
+    target_to_count: Dict[str, int]
+
+
+def load_word_freq_dict(path: str) -> WordFreqDicts:
+    """Load the ``.dict.c2v`` produced by preprocessing
+    (reference vocabularies.py:220-230)."""
+    with open(path, 'rb') as file:
+        token_to_count = pickle.load(file)
+        path_to_count = pickle.load(file)
+        target_to_count = pickle.load(file)
+    return WordFreqDicts(token_to_count=token_to_count,
+                         path_to_count=path_to_count,
+                         target_to_count=target_to_count)
+
+
+class Code2VecVocabs:
+    """The {token, path, target} vocabulary triple
+    (reference vocabularies.py:151-241)."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.token_vocab: Optional[Vocab] = None
+        self.path_vocab: Optional[Vocab] = None
+        self.target_vocab: Optional[Vocab] = None
+        self._already_saved_in_paths = set()
+        self._load_or_create()
+
+    def _load_or_create(self) -> None:
+        assert self.config.is_training or self.config.is_loading
+        if self.config.is_loading:
+            load_path = self.config.get_vocabularies_path_from_model_path(
+                self.config.MODEL_LOAD_PATH)
+            if not os.path.isfile(load_path):
+                raise ValueError(
+                    'Model dictionaries file not found: `{}`.'.format(load_path))
+            self._load_from_path(load_path)
+        else:
+            self._create_from_word_freq_dict()
+
+    def _load_from_path(self, load_path: str) -> None:
+        self.config.log('Loading model vocabularies from: `%s` ...' % load_path)
+        with open(load_path, 'rb') as file:
+            # Stored order is token → target → path (reference
+            # vocabularies.py:175-184, 211-218).
+            self.token_vocab = Vocab.load_from_file(
+                VocabType.Token, file, self._special_words_for(VocabType.Token))
+            self.target_vocab = Vocab.load_from_file(
+                VocabType.Target, file, self._special_words_for(VocabType.Target))
+            self.path_vocab = Vocab.load_from_file(
+                VocabType.Path, file, self._special_words_for(VocabType.Path))
+        self.config.log('Done loading model vocabularies.')
+        self._already_saved_in_paths.add(load_path)
+
+    def _create_from_word_freq_dict(self) -> None:
+        freq_dicts = load_word_freq_dict(self.config.word_freq_dict_path)
+        self.token_vocab = Vocab.create_from_freq_dict(
+            VocabType.Token, freq_dicts.token_to_count,
+            self.config.MAX_TOKEN_VOCAB_SIZE,
+            special_words=self._special_words_for(VocabType.Token))
+        self.path_vocab = Vocab.create_from_freq_dict(
+            VocabType.Path, freq_dicts.path_to_count,
+            self.config.MAX_PATH_VOCAB_SIZE,
+            special_words=self._special_words_for(VocabType.Path))
+        self.target_vocab = Vocab.create_from_freq_dict(
+            VocabType.Target, freq_dicts.target_to_count,
+            self.config.MAX_TARGET_VOCAB_SIZE,
+            special_words=self._special_words_for(VocabType.Target))
+        self.config.log(
+            'Created vocabularies: token %d, path %d, target %d' % (
+                self.token_vocab.size, self.path_vocab.size,
+                self.target_vocab.size))
+
+    def _special_words_for(self, vocab_type: VocabType) -> SpecialWords:
+        """Special-word policy (reference vocabularies.py:204-209)."""
+        if not self.config.SEPARATE_OOV_AND_PAD:
+            return SPECIAL_WORDS_JOINED_OOV_PAD
+        if vocab_type == VocabType.Target:
+            return SPECIAL_WORDS_ONLY_OOV
+        return SPECIAL_WORDS_SEPARATE_OOV_PAD
+
+    def save(self, save_path: str) -> None:
+        if save_path in self._already_saved_in_paths:
+            return
+        with open(save_path, 'wb') as file:
+            self.token_vocab.save_to_file(file)
+            self.target_vocab.save_to_file(file)
+            self.path_vocab.save_to_file(file)
+        self._already_saved_in_paths.add(save_path)
+
+    def get(self, vocab_type: VocabType) -> Vocab:
+        if vocab_type == VocabType.Token:
+            return self.token_vocab
+        if vocab_type == VocabType.Target:
+            return self.target_vocab
+        if vocab_type == VocabType.Path:
+            return self.path_vocab
+        raise ValueError('`vocab_type` should be a VocabType member.')

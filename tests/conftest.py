@@ -1,0 +1,13 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax imports.
+
+Multi-chip logic is tested without TPU hardware via XLA's virtual host
+devices (SURVEY.md §4) — the TPU answer to "multi-node tests without a
+cluster".
+"""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()

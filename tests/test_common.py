@@ -1,0 +1,79 @@
+import io
+
+import numpy as np
+
+from code2vec_tpu import common
+
+
+def test_normalize_word():
+    # reference common.py:12-18
+    assert common.normalize_word('FooBar') == 'foobar'
+    assert common.normalize_word('foo_bar2') == 'foobar'
+    assert common.normalize_word('123') == '123'      # fully non-alpha: lowercase as-is
+    assert common.normalize_word('<OOV>') == 'oov'
+
+
+def test_get_subtokens():
+    assert common.get_subtokens('get|name') == ['get', 'name']
+    assert common.get_subtokens('main') == ['main']
+
+
+def test_legal_method_name():
+    # reference common.py:122-124
+    assert common.legal_method_name('<OOV>', 'get|name')
+    assert not common.legal_method_name('<OOV>', '<OOV>')
+    assert not common.legal_method_name('<OOV>', 'get2')
+    assert not common.legal_method_name('<OOV>', '')
+
+
+def test_filter_impossible_names():
+    assert common.filter_impossible_names(
+        '<OOV>', ['<OOV>', 'a|b', 'x1', 'main']) == ['a|b', 'main']
+
+
+def test_first_match_rank_counts_only_legal_predictions():
+    # Rank is the index within the FILTERED list (reference common.py:180-187).
+    found = common.get_first_match_word_from_top_predictions(
+        '<OOV>', 'getName', ['<OOV>', 'bad1', 'other', 'get|name'])
+    assert found == (1, 'get|name')   # '<OOV>'/'bad1' skipped: rank 1, not 3
+    assert common.get_first_match_word_from_top_predictions(
+        '<OOV>', 'getName', ['foo', 'bar']) is None
+
+
+def test_load_histogram_cutoff(tmp_path):
+    # Cutoff is one plus the count of the max_size-th word (common.py:56-57).
+    hist = tmp_path / 'hist.txt'
+    hist.write_text('a 10\nb 8\nc 8\nd 5\ne 1\n')
+    full = common.load_histogram(str(hist))
+    assert full == {'a': 10, 'b': 8, 'c': 8, 'd': 5, 'e': 1}
+    limited = common.load_histogram(str(hist), max_size=2)
+    # sorted counts: [10, 8, 8, 5, 1]; counts[2]=8 -> cutoff 9 -> only 'a'
+    assert limited == {'a': 10}
+
+
+def test_count_lines(tmp_path):
+    path = tmp_path / 'f.txt'
+    path.write_bytes(b'a\nb\nc\n')
+    assert common.count_lines_in_file(str(path)) == 3
+
+
+def test_java_string_hashcode():
+    # Values from Java's String#hashCode (reference extractor.py:40-49).
+    assert common.java_string_hashcode('foo') == 101574
+    assert common.java_string_hashcode('') == 0
+    # Must reproduce 32-bit signed overflow behaviour.
+    assert common.java_string_hashcode('polygenelubricants') == -2147483648
+
+
+def test_save_word2vec_file():
+    buf = io.StringIO()
+    matrix = np.array([[1.0, 2.0], [3.0, 4.0]])
+    common.save_word2vec_file(buf, {0: 'w0', 1: 'w1'}, matrix)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == '2 2'
+    assert lines[1].startswith('w0 1.0')
+    assert lines[2].startswith('w1 3.0')
+
+
+def test_get_unique_list_preserves_order():
+    assert common.get_unique_list(['b', 'a', 'b', 'c']) == ['b', 'a', 'c']

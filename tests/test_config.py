@@ -1,0 +1,76 @@
+import os
+
+import pytest
+
+from code2vec_tpu.config import Config
+
+
+def test_defaults_match_reference():
+    # reference config.py:46-70
+    config = Config()
+    assert config.NUM_TRAIN_EPOCHS == 20
+    assert config.TRAIN_BATCH_SIZE == 1024
+    assert config.MAX_CONTEXTS == 200
+    assert config.MAX_TOKEN_VOCAB_SIZE == 1301136
+    assert config.MAX_TARGET_VOCAB_SIZE == 261245
+    assert config.MAX_PATH_VOCAB_SIZE == 911417
+    assert config.TOKEN_EMBEDDINGS_SIZE == 128
+    assert config.PATH_EMBEDDINGS_SIZE == 128
+    assert config.DROPOUT_KEEP_RATE == 0.75
+    assert config.SEPARATE_OOV_AND_PAD is False
+    assert config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION == 10
+    assert config.MAX_TO_KEEP == 10
+
+
+def test_context_vector_size():
+    config = Config()
+    # reference config.py:143-147
+    assert config.context_vector_size == 2 * 128 + 128 == 384
+    assert config.CODE_VECTOR_SIZE == config.context_vector_size
+    assert config.TARGET_EMBEDDINGS_SIZE == config.CODE_VECTOR_SIZE
+
+
+def test_file_naming_contract():
+    # reference config.py:179-230
+    config = Config(TRAIN_DATA_PATH_PREFIX='data/java14m/java14m')
+    assert config.train_data_path == 'data/java14m/java14m.train.c2v'
+    assert config.word_freq_dict_path == 'data/java14m/java14m.dict.c2v'
+    assert Config.get_vocabularies_path_from_model_path(
+        'models/java14m/saved_model_iter8') == 'models/java14m/dictionaries.bin'
+    assert Config.get_entire_model_path('m/p') == 'm/p__entire-model'
+    assert Config.get_model_weights_path('m/p') == 'm/p__only-weights'
+
+
+def test_steps_per_epoch():
+    config = Config(TRAIN_DATA_PATH_PREFIX='x', NUM_TRAIN_EXAMPLES=2500)
+    assert config.train_steps_per_epoch == 3  # ceil(2500/1024)
+
+
+def test_verify_requires_train_or_load():
+    with pytest.raises(ValueError):
+        Config().verify()
+
+
+def test_verify_passes_for_training():
+    Config(TRAIN_DATA_PATH_PREFIX='x').verify()
+
+
+def test_cli_parsing(tmp_path):
+    config = Config().load_from_args([
+        '--data', 'd/prefix', '--test', 'd/prefix.val.c2v',
+        '--save', str(tmp_path / 'model'), '--framework', 'jax',
+        '--mesh', '4x2', '--dtype', 'float32', '--batch-size', '256'])
+    assert config.TRAIN_DATA_PATH_PREFIX == 'd/prefix'
+    assert config.TEST_DATA_PATH == 'd/prefix.val.c2v'
+    assert config.DL_FRAMEWORK == 'jax'
+    assert config.MESH_DATA_AXIS_SIZE == 4
+    assert config.MESH_MODEL_AXIS_SIZE == 2
+    assert config.COMPUTE_DTYPE == 'float32'
+    assert config.TRAIN_BATCH_SIZE == 256
+    config.verify()
+
+
+def test_iter_yields_fields():
+    names = dict(Config())
+    assert 'MAX_CONTEXTS' in names
+    assert not any(name.startswith('_') for name in names)

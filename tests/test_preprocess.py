@@ -1,0 +1,71 @@
+import pickle
+import random
+
+from code2vec_tpu.data import preprocess
+
+
+def test_build_histograms(tmp_path):
+    raw = tmp_path / 'raw.txt'
+    raw.write_text('lbl1 a,p1,b a,p2,c\nlbl2 a,p1,b\n')
+    token_count, path_count, target_count = preprocess.build_histograms(str(raw))
+    assert token_count == {'a': 3, 'b': 2, 'c': 1}
+    assert path_count == {'p1': 2, 'p2': 1}
+    assert target_count == {'lbl1': 1, 'lbl2': 1}
+
+
+def test_truncate_to_max_size():
+    counts = {'a': 10, 'b': 8, 'c': 8, 'd': 5}
+    # sorted desc: [10,8,8,5]; counts[2]=8 -> cutoff 9 -> only a
+    assert preprocess.truncate_to_max_size(counts, 2) == {'a': 10}
+    assert preprocess.truncate_to_max_size(counts, 4) == counts
+
+
+def test_process_file_pads_and_drops_empty(tmp_path):
+    raw = tmp_path / 'raw.txt'
+    raw.write_text('lbl1 a,p1,b\nlbl2\n')
+    total = preprocess.process_file(
+        str(raw), 'train', str(tmp_path / 'out'),
+        word_to_count={'a': 1, 'b': 1}, path_to_count={'p1': 1},
+        max_contexts=3)
+    assert total == 1
+    lines = (tmp_path / 'out.train.c2v').read_text().splitlines()
+    assert len(lines) == 1
+    # padded with trailing spaces to exactly max_contexts fields
+    assert lines[0] == 'lbl1 a,p1,b  '
+    assert len(lines[0].split(' ')) == 1 + 3
+
+
+def test_process_file_prefers_full_found_contexts(tmp_path):
+    raw = tmp_path / 'raw.txt'
+    # 3 contexts, max 2: two are fully in-vocab, one isn't -> the full ones win
+    raw.write_text('lbl a,p1,b zz,zz,zz b,p1,a\n')
+    preprocess.process_file(
+        str(raw), 'train', str(tmp_path / 'out'),
+        word_to_count={'a': 1, 'b': 1}, path_to_count={'p1': 1},
+        max_contexts=2, rng=random.Random(0))
+    line = (tmp_path / 'out.train.c2v').read_text().splitlines()[0]
+    contexts = [c for c in line.split(' ')[1:] if c]
+    assert set(contexts) == {'a,p1,b', 'b,p1,a'}
+
+
+def test_end_to_end_preprocess_and_dict(tmp_path):
+    for role in ['train', 'val', 'test']:
+        (tmp_path / f'{role}.raw').write_text(
+            'lbl1 a,p1,b a,p2,c\nlbl2 a,p1,b\n')
+    out = tmp_path / 'ds'
+    preprocess.preprocess_dataset(
+        train_raw=str(tmp_path / 'train.raw'),
+        val_raw=str(tmp_path / 'val.raw'),
+        test_raw=str(tmp_path / 'test.raw'),
+        output_name=str(out), max_contexts=4, seed=0)
+    for role in ['train', 'val', 'test']:
+        assert (tmp_path / f'ds.{role}.c2v').exists()
+    with open(str(out) + '.dict.c2v', 'rb') as f:
+        word_to_count = pickle.load(f)
+        path_to_count = pickle.load(f)
+        target_to_count = pickle.load(f)
+        num_examples = pickle.load(f)
+    assert word_to_count == {'a': 3, 'b': 2, 'c': 1}
+    assert path_to_count == {'p1': 2, 'p2': 1}
+    assert target_to_count == {'lbl1': 1, 'lbl2': 1}
+    assert num_examples == 2

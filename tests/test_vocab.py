@@ -1,0 +1,130 @@
+import io
+import pickle
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.vocab import (
+    SPECIAL_WORDS_JOINED_OOV_PAD, SPECIAL_WORDS_ONLY_OOV,
+    SPECIAL_WORDS_SEPARATE_OOV_PAD, Code2VecVocabs, Vocab, VocabType)
+
+
+def test_joined_policy_single_special_index():
+    # <PAD_OR_OOV> occupies one index even though it's three names
+    # (reference vocabularies.py:31-35, 51).
+    vocab = Vocab(VocabType.Token, ['a', 'b'], SPECIAL_WORDS_JOINED_OOV_PAD)
+    assert vocab.size == 3
+    assert vocab.pad_index == vocab.oov_index == 0
+    assert vocab.word_to_index == {'<PAD_OR_OOV>': 0, 'a': 1, 'b': 2}
+
+
+def test_separate_policy():
+    vocab = Vocab(VocabType.Token, ['a'], SPECIAL_WORDS_SEPARATE_OOV_PAD)
+    assert vocab.size == 3
+    assert vocab.pad_index == 0
+    assert vocab.oov_index == 1
+
+
+def test_lookup_with_oov_default():
+    vocab = Vocab(VocabType.Token, ['a', 'b'], SPECIAL_WORDS_JOINED_OOV_PAD)
+    assert vocab.lookup_index('a') == 1
+    assert vocab.lookup_index('unknown') == vocab.oov_index
+    np.testing.assert_array_equal(
+        vocab.lookup_indices(['a', 'zzz', 'b']), np.array([1, 0, 2]))
+    assert vocab.lookup_word(2) == 'b'
+    assert vocab.lookup_word(999) == vocab.special_words.OOV
+
+
+def test_create_from_freq_dict_truncation():
+    # Top-max_size words by count (reference vocabularies.py:99-106).
+    vocab = Vocab.create_from_freq_dict(
+        VocabType.Token, {'rare': 1, 'common': 100, 'mid': 10}, 2,
+        SPECIAL_WORDS_JOINED_OOV_PAD)
+    assert vocab.size == 3  # 1 special + 2 kept
+    assert 'common' in vocab.word_to_index
+    assert 'mid' in vocab.word_to_index
+    assert 'rare' not in vocab.word_to_index
+
+
+def test_save_load_roundtrip():
+    vocab = Vocab(VocabType.Target, ['x', 'y', 'z'], SPECIAL_WORDS_ONLY_OOV)
+    buf = io.BytesIO()
+    vocab.save_to_file(buf)
+    buf.seek(0)
+    loaded = Vocab.load_from_file(VocabType.Target, buf, SPECIAL_WORDS_ONLY_OOV)
+    assert loaded.word_to_index == vocab.word_to_index
+    assert loaded.index_to_word == vocab.index_to_word
+    assert loaded.size == vocab.size
+
+
+def test_save_strips_specials_reference_layout():
+    # The on-disk layout must match the reference exactly: three pickles,
+    # specials stripped (reference vocabularies.py:57-66).
+    vocab = Vocab(VocabType.Token, ['a', 'b'], SPECIAL_WORDS_JOINED_OOV_PAD)
+    buf = io.BytesIO()
+    vocab.save_to_file(buf)
+    buf.seek(0)
+    word_to_index = pickle.load(buf)
+    index_to_word = pickle.load(buf)
+    size = pickle.load(buf)
+    assert word_to_index == {'a': 1, 'b': 2}
+    assert index_to_word == {1: 'a', 2: 'b'}
+    assert size == 2
+
+
+def test_load_wrong_policy_raises():
+    vocab = Vocab(VocabType.Token, ['a'], SPECIAL_WORDS_SEPARATE_OOV_PAD)
+    buf = io.BytesIO()
+    vocab.save_to_file(buf)
+    buf.seek(0)
+    with pytest.raises(ValueError):
+        Vocab.load_from_file(VocabType.Token, buf, SPECIAL_WORDS_JOINED_OOV_PAD)
+
+
+def _write_dict_c2v(path, token_counts, path_counts, target_counts, n=7):
+    with open(path, 'wb') as f:
+        pickle.dump(token_counts, f)
+        pickle.dump(path_counts, f)
+        pickle.dump(target_counts, f)
+        pickle.dump(n, f)
+
+
+def test_code2vec_vocabs_from_freq_dicts(tmp_path):
+    prefix = tmp_path / 'data'
+    _write_dict_c2v(str(prefix) + '.dict.c2v',
+                    {'tok1': 5, 'tok2': 3}, {'p1': 4}, {'t1': 9, 't2': 2})
+    config = Config(TRAIN_DATA_PATH_PREFIX=str(prefix), VERBOSE_MODE=0)
+    vocabs = Code2VecVocabs(config)
+    assert vocabs.token_vocab.size == 3   # 1 special + 2
+    assert vocabs.path_vocab.size == 2
+    assert vocabs.target_vocab.size == 3
+    # joined policy by default: PAD == OOV == index 0 for all three
+    assert vocabs.token_vocab.pad_index == 0
+    assert vocabs.target_vocab.oov_index == 0
+
+
+def test_code2vec_vocabs_save_and_reload(tmp_path):
+    prefix = tmp_path / 'data'
+    _write_dict_c2v(str(prefix) + '.dict.c2v',
+                    {'tok1': 5}, {'p1': 4}, {'t1': 9})
+    config = Config(TRAIN_DATA_PATH_PREFIX=str(prefix), VERBOSE_MODE=0)
+    vocabs = Code2VecVocabs(config)
+    model_dir = tmp_path / 'model'
+    model_dir.mkdir()
+    sidecar = Config.get_vocabularies_path_from_model_path(
+        str(model_dir / 'saved_model'))
+    vocabs.save(sidecar)
+
+    config2 = Config(MODEL_LOAD_PATH=str(model_dir / 'saved_model'),
+                     VERBOSE_MODE=0)
+    vocabs2 = Code2VecVocabs(config2)
+    assert vocabs2.token_vocab.word_to_index == vocabs.token_vocab.word_to_index
+    assert vocabs2.path_vocab.word_to_index == vocabs.path_vocab.word_to_index
+    assert vocabs2.target_vocab.word_to_index == vocabs.target_vocab.word_to_index
+
+
+def test_index_to_word_array():
+    vocab = Vocab(VocabType.Token, ['a', 'b'], SPECIAL_WORDS_JOINED_OOV_PAD)
+    arr = vocab.index_to_word_array()
+    assert list(arr) == ['<PAD_OR_OOV>', 'a', 'b']
