@@ -1,0 +1,141 @@
+"""Checkpoint / resume / release via orbax.
+
+Reference parity (tensorflow_model.py:370-377, keras_model.py:230-296,
+SURVEY.md §5 'Checkpoint / resume'):
+
+- per-epoch saves, ``max_to_keep=10`` (reference config.py:57);
+- the vocab sidecar ``dictionaries.bin`` lives next to the checkpoints
+  (model_base.py:102-109) — written by the caller;
+- **release** = params-only strip (the reference re-saves without optimizer
+  state for a ~3× smaller artifact, tensorflow_model.py:132-136,
+  README.md:212-219): params go under ``<path>__only-weights``;
+- full state (params + Adam moments + step + epoch) goes under
+  ``<path>__entire-model`` (the Keras backend's naming, config.py:196-202);
+- the epoch number is stored explicitly in the checkpoint metadata — the
+  reference recovered it by parsing checkpoint filenames and left a TODO
+  for doing it properly (keras_model.py:274, 285-287).
+
+Orbax writes sharded arrays natively: on a mesh, each host saves its own
+shards (async-capable), and restore re-shards to the current mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from code2vec_tpu.config import Config
+
+
+class RestoredTraining(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: int
+    epoch: int
+
+
+class CheckpointStore:
+    """Orbax-backed store for one model path prefix."""
+
+    def __init__(self, model_path: str, max_to_keep: int = 10):
+        self.model_path = model_path
+        self.entire_dir = os.path.abspath(
+            Config.get_entire_model_path(model_path))
+        self.weights_dir = os.path.abspath(
+            Config.get_model_weights_path(model_path))
+        self._manager: Optional[ocp.CheckpointManager] = None
+        self.max_to_keep = max_to_keep
+
+    # ------------------------------------------------------------- manager
+    def manager(self) -> ocp.CheckpointManager:
+        if self._manager is None:
+            self._manager = ocp.CheckpointManager(
+                self.entire_dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.max_to_keep, create=True))
+        return self._manager
+
+    def close(self) -> None:
+        if self._manager is not None:
+            self._manager.close()
+            self._manager = None
+
+    # ---------------------------------------------------------------- save
+    def save_training(self, *, params, opt_state, step: int,
+                      epoch: int) -> None:
+        state = {'params': params, 'opt_state': opt_state,
+                 'step': np.asarray(step, np.int32),
+                 'epoch': np.asarray(epoch, np.int32)}
+        self.manager().save(epoch, args=ocp.args.StandardSave(state))
+        self.manager().wait_until_finished()
+
+    def save_release(self, params) -> None:
+        """Params-only artifact (the reference's ``--release``)."""
+        checkpointer = ocp.StandardCheckpointer()
+        path = self.weights_dir
+        if os.path.exists(path):
+            import shutil
+            shutil.rmtree(path)
+        checkpointer.save(path, {'params': params})
+        checkpointer.wait_until_finished()
+        checkpointer.close()
+
+    # ------------------------------------------------------------- restore
+    def latest_epoch(self) -> Optional[int]:
+        if not os.path.isdir(self.entire_dir):
+            return None
+        return self.manager().latest_step()
+
+    def restore_training(self, abstract_params, abstract_opt_state
+                         ) -> Optional[RestoredTraining]:
+        """Restore the newest full training state, re-sharded to match the
+        abstract target (shapes + shardings)."""
+        latest = self.latest_epoch()
+        if latest is None:
+            return None
+        target = {'params': abstract_params, 'opt_state': abstract_opt_state,
+                  'step': np.asarray(0, np.int32),
+                  'epoch': np.asarray(0, np.int32)}
+        restored = self.manager().restore(
+            latest, args=ocp.args.StandardRestore(target))
+        return RestoredTraining(
+            params=restored['params'], opt_state=restored['opt_state'],
+            step=int(restored['step']), epoch=int(restored['epoch']))
+
+    def restore_params(self, abstract_params) -> Optional[Any]:
+        """Restore params only: prefer the released weights-only artifact,
+        fall back to the newest full checkpoint (reference load order:
+        whatever exists under the load path)."""
+        if os.path.isdir(self.weights_dir):
+            checkpointer = ocp.StandardCheckpointer()
+            restored = checkpointer.restore(
+                self.weights_dir, {'params': abstract_params})
+            checkpointer.close()
+            return restored['params']
+        latest = self.latest_epoch()
+        if latest is None:
+            return None
+        # partial restore: pull only the params subtree out of a full
+        # training checkpoint (the reference's load-for-eval path similarly
+        # ignores optimizer slots)
+        restored = self.manager().restore(
+            latest, args=ocp.args.PyTreeRestore(
+                item={'params': abstract_params},
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    {'params': abstract_params}),
+                partial_restore=True))
+        return restored['params']
+
+
+def abstract_like(tree, shardings=None):
+    """ShapeDtypeStruct pytree matching ``tree`` (optionally with shardings)
+    for orbax's StandardRestore target."""
+    def make(leaf, sharding=None):
+        return jax.ShapeDtypeStruct(np.shape(leaf), leaf.dtype,
+                                    sharding=sharding)
+    if shardings is None:
+        return jax.tree_util.tree_map(make, tree)
+    return jax.tree_util.tree_map(make, tree, shardings)

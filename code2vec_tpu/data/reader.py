@@ -285,9 +285,13 @@ class PathContextReader:
         if pending_rows:
             yield self._pad_batch(self._concat(pending), batch_size)
 
+    def pad_batch_to(self, batch: Batch, batch_size: int) -> Batch:
+        """Pad a batch up to ``batch_size`` rows with zero-weight rows
+        (replaces the reference's ragged final batch; also used to make
+        predict batches divisible by the mesh data axis)."""
+        return self._pad_batch(batch, batch_size)
+
     def _pad_batch(self, batch: Batch, batch_size: int) -> Batch:
-        """Pad a short batch up to the static batch size with zero-weight
-        rows (replaces the reference's ragged final batch)."""
         n = batch.label.shape[0]
         if n == batch_size:
             return batch

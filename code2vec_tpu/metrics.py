@@ -1,0 +1,111 @@
+"""Evaluation metrics with exact reference semantics.
+
+The device computes top-k indices/scores per batch (a (B, k) int32 transfer —
+tiny); the host decodes words and updates streaming accumulators. This mirrors
+the reference's split (tensorflow_model.py:156-183 runs top_k in-graph and the
+Counter math in Python) while keeping everything string-shaped off the device.
+
+Metric definitions (parity-critical — they define the headline F1):
+
+- **Top-k accuracy** (tensorflow_model.py:499-516 + common.py:180-187):
+  an example scores a hit at ranks ≥ r where r is the index of the first
+  *legal* prediction whose normalized form equals the normalized original
+  name; rank counts only legal predictions.
+- **Subtoken precision/recall/F1** (tensorflow_model.py:450-496): per example
+  take the FIRST legal prediction of the top-k, split both it and the
+  original name on ``|``, and accumulate multiset TP/FP/FN counts.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu import common
+
+
+class SubtokensEvaluationMetric:
+    """Streaming subtoken TP/FP/FN (reference tensorflow_model.py:450-496).
+
+    Deviation from the reference: when none of the top-k predictions is
+    legal, the reference crashes with IndexError (:460); here the prediction
+    is treated as empty — one false positive plus all-original-subtokens
+    false negatives — so early/tiny models evaluate cleanly.
+    """
+
+    def __init__(self, oov_word: str):
+        self.oov_word = oov_word
+        self.nr_true_positives = 0
+        self.nr_false_positives = 0
+        self.nr_false_negatives = 0
+        self.nr_predictions = 0
+
+    def update_batch(self, results: Iterable[Tuple[str, Sequence[str]]]) -> None:
+        for original_name, top_words in results:
+            legal = common.filter_impossible_names(self.oov_word, top_words)
+            prediction = legal[0] if legal else ''
+            original_subtokens = Counter(common.get_subtokens(original_name))
+            predicted_subtokens = Counter(common.get_subtokens(prediction))
+            self.nr_true_positives += sum(
+                count for element, count in predicted_subtokens.items()
+                if element in original_subtokens)
+            self.nr_false_positives += sum(
+                count for element, count in predicted_subtokens.items()
+                if element not in original_subtokens)
+            self.nr_false_negatives += sum(
+                count for element, count in original_subtokens.items()
+                if element not in predicted_subtokens)
+            self.nr_predictions += 1
+
+    @property
+    def precision(self) -> float:
+        denom = self.nr_true_positives + self.nr_false_positives
+        return self.nr_true_positives / denom if denom else 0.0
+
+    @property
+    def recall(self) -> float:
+        denom = self.nr_true_positives + self.nr_false_negatives
+        return self.nr_true_positives / denom if denom else 0.0
+
+    @property
+    def f1(self) -> float:
+        if self.precision + self.recall == 0:
+            return 0.0
+        return 2 * self.precision * self.recall / (self.precision + self.recall)
+
+
+class TopKAccuracyEvaluationMetric:
+    """Normalized first-match rank accuracy
+    (reference tensorflow_model.py:499-516)."""
+
+    def __init__(self, top_k: int, oov_word: str):
+        self.top_k = top_k
+        self.oov_word = oov_word
+        self.nr_correct_predictions = np.zeros(top_k)
+        self.nr_predictions = 0
+
+    def update_batch(self, results: Iterable[Tuple[str, Sequence[str]]]) -> None:
+        for original_name, top_predicted_words in results:
+            self.nr_predictions += 1
+            found_match = common.get_first_match_word_from_top_predictions(
+                self.oov_word, original_name, top_predicted_words)
+            if found_match is not None:
+                suggestion_idx, _ = found_match
+                self.nr_correct_predictions[suggestion_idx:self.top_k] += 1
+
+    @property
+    def topk_correct_predictions(self) -> np.ndarray:
+        if self.nr_predictions == 0:
+            return np.zeros(self.top_k)
+        return self.nr_correct_predictions / self.nr_predictions
+
+
+def decode_topk_batch(topk_indices: np.ndarray, index_to_word: np.ndarray,
+                      label_strings: Sequence[str],
+                      weights: np.ndarray) -> List[Tuple[str, List[str]]]:
+    """Device (B, k) top-k indices + host label strings →
+    [(original_name, [top words...])] for valid rows only."""
+    words = index_to_word[topk_indices]          # (B, k) object array
+    return [(label_strings[r], list(words[r]))
+            for r in range(topk_indices.shape[0]) if weights[r] > 0]

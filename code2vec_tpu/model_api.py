@@ -1,0 +1,344 @@
+"""Model lifecycle: the user-facing ``Code2VecModel``.
+
+TPU-native equivalent of the reference's ``Code2VecModelBase`` lifecycle
+(model_base.py:37-182) fused with the per-backend train/evaluate/predict
+logic (tensorflow_model.py:40-195, 311-368; keras_model.py:166-228): one
+class, because the backends here share the trainer — only parameter
+containers differ (models/backends.py).
+
+Lifecycle on construction (reference model_base.py:38-50): verify config →
+count examples (with ``.num_examples`` sidecar cache) → build vocabs →
+load-or-create params.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from code2vec_tpu import common
+from code2vec_tpu.checkpoints import CheckpointStore
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import Batch, EstimatorAction, PathContextReader
+from code2vec_tpu.metrics import (SubtokensEvaluationMetric,
+                                  TopKAccuracyEvaluationMetric,
+                                  decode_topk_batch)
+from code2vec_tpu.models.backends import create_backend
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.training.trainer import Trainer, TrainerState, as_numpy
+from code2vec_tpu.vocab import Code2VecVocabs, VocabType
+
+
+class ModelEvaluationResults(NamedTuple):
+    """(reference model_base.py:11-26)"""
+    topk_acc: np.ndarray
+    subtoken_precision: float
+    subtoken_recall: float
+    subtoken_f1: float
+    loss: Optional[float] = None
+
+    def __str__(self) -> str:
+        res = 'topk_acc: {}, precision: {}, recall: {}, F1: {}'.format(
+            self.topk_acc, self.subtoken_precision, self.subtoken_recall,
+            self.subtoken_f1)
+        if self.loss is not None:
+            res = 'loss: {}, '.format(self.loss) + res
+        return res
+
+
+class ModelPredictionResults(NamedTuple):
+    """(reference model_base.py:29-34)"""
+    original_name: str
+    topk_predicted_words: List[str]
+    topk_predicted_words_scores: np.ndarray
+    attention_per_context: Dict[Tuple[str, str, str], float]
+    code_vector: Optional[np.ndarray] = None
+
+
+class Code2VecModel:
+    def __init__(self, config: Config):
+        self.config = config
+        config.verify()
+        self.log = config.log
+        self.log('Creating code2vec TPU model (backend=%s, dtype=%s)'
+                 % (config.DL_FRAMEWORK, config.COMPUTE_DTYPE))
+        if not config.RELEASE:
+            self._init_num_of_examples()
+        self.vocabs = Code2VecVocabs(config)
+        self._target_index_to_word = self.vocabs.target_vocab.index_to_word_array()
+        self.backend = create_backend(config, self.vocabs)
+        self.mesh = mesh_lib.create_mesh(config)
+        self.trainer = Trainer(config, self.backend, mesh=self.mesh)
+        self.state: Optional[TrainerState] = None
+        self.params: Optional[Any] = None
+        self._load_or_create()
+
+    # ----------------------------------------------------------- lifecycle
+    def _init_num_of_examples(self) -> None:
+        """(reference model_base.py:77-96)"""
+        if self.config.is_training:
+            self.config.NUM_TRAIN_EXAMPLES = self._count_examples(
+                self.config.train_data_path)
+            self.log('Number of train examples: %d'
+                     % self.config.NUM_TRAIN_EXAMPLES)
+        if self.config.is_testing:
+            self.config.NUM_TEST_EXAMPLES = self._count_examples(
+                self.config.TEST_DATA_PATH)
+            self.log('Number of test examples: %d'
+                     % self.config.NUM_TEST_EXAMPLES)
+
+    @staticmethod
+    def _count_examples(dataset_path: str) -> int:
+        sidecar = dataset_path + '.num_examples'
+        if os.path.isfile(sidecar):
+            with open(sidecar, 'r') as f:
+                return int(f.readline())
+        num = common.count_lines_in_file(dataset_path)
+        with open(sidecar, 'w') as f:
+            f.write(str(num))
+        return num
+
+    def _store_for(self, path: str) -> CheckpointStore:
+        return CheckpointStore(path, max_to_keep=self.config.MAX_TO_KEEP)
+
+    def _load_or_create(self) -> None:
+        if self.config.is_loading:
+            store = self._store_for(self.config.MODEL_LOAD_PATH)
+            # abstract targets carry *current-mesh* shardings so orbax
+            # re-shards onto this topology instead of trusting the (possibly
+            # different) topology recorded in the checkpoint
+            abstract_params, abstract_opt = self.trainer.abstract_state()
+            if self.config.is_training:
+                restored = store.restore_training(abstract_params,
+                                                  abstract_opt)
+                if restored is None:
+                    raise ValueError('No checkpoint found under `%s`.'
+                                     % self.config.MODEL_LOAD_PATH)
+                self.state = TrainerState(
+                    params=restored.params, opt_state=restored.opt_state,
+                    step=jnp.asarray(restored.step, jnp.int32),
+                    rng=jax.random.PRNGKey(42))
+                self.params = self.state.params
+                self._start_epoch = restored.epoch + 1
+                self.log('Resumed from `%s` at epoch %d (step %d)' % (
+                    self.config.MODEL_LOAD_PATH, restored.epoch,
+                    restored.step))
+            else:
+                params = store.restore_params(abstract_params)
+                if params is None:
+                    raise ValueError('No checkpoint found under `%s`.'
+                                     % self.config.MODEL_LOAD_PATH)
+                self.params = params
+                self._start_epoch = 0
+            store.close()
+        else:
+            self.state = self.trainer.init_state()
+            self.params = self.state.params
+            self._start_epoch = 0
+
+    # --------------------------------------------------------------- train
+    def train(self) -> None:
+        config = self.config
+        assert config.is_training
+        reader = PathContextReader(self.vocabs, config, EstimatorAction.Train)
+        save_store = (self._store_for(config.MODEL_SAVE_PATH)
+                      if config.is_saving else None)
+        self.log('Starting training (%d epochs, batch %d, steps/epoch ~%d)'
+                 % (config.NUM_TRAIN_EPOCHS, config.TRAIN_BATCH_SIZE,
+                    config.train_steps_per_epoch))
+
+        def epoch_batches(epoch: int):
+            return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)
+
+        def on_epoch_end(epoch: int, state: TrainerState) -> None:
+            self.params = state.params
+            if save_store is not None and \
+                    (epoch + 1) % config.SAVE_EVERY_EPOCHS == 0:
+                self.save(state=state, epoch=epoch)
+            if config.is_testing:
+                results = self.evaluate()
+                self.log('After epoch %d: %s' % (epoch + 1, results))
+
+        start = getattr(self, '_start_epoch', 0)
+        self.state = self.trainer.fit(self.state, epoch_batches,
+                                      start_epoch=start,
+                                      on_epoch_end=on_epoch_end)
+        self.params = self.state.params
+        if save_store is not None:
+            save_store.close()
+
+    # ---------------------------------------------------------------- save
+    def save(self, model_save_path: Optional[str] = None,
+             state: Optional[TrainerState] = None,
+             epoch: int = 0) -> None:
+        """vocab sidecar + full training state
+        (reference model_base.py:102-109)."""
+        path = model_save_path or self.config.MODEL_SAVE_PATH
+        save_dir = os.path.dirname(path)
+        if save_dir and not os.path.isdir(save_dir):
+            os.makedirs(save_dir, exist_ok=True)
+        self.vocabs.save(Config.get_vocabularies_path_from_model_path(path))
+        state = state if state is not None else self.state
+        store = self._store_for(path)
+        store.save_training(params=state.params, opt_state=state.opt_state,
+                            step=int(state.step), epoch=epoch)
+        store.close()
+
+    def release_model(self) -> None:
+        """Strip optimizer state (reference tensorflow_model.py:132-136)."""
+        assert self.config.is_loading
+        store = self._store_for(self.config.MODEL_LOAD_PATH)
+        store.save_release(self.params)
+        store.close()
+        self.log('Released model saved under `%s__only-weights`.'
+                 % self.config.MODEL_LOAD_PATH)
+
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self) -> ModelEvaluationResults:
+        config = self.config
+        assert config.is_testing
+        reader = PathContextReader(self.vocabs, config,
+                                   EstimatorAction.Evaluate)
+        oov = self.vocabs.target_vocab.special_words.OOV
+        topk_metric = TopKAccuracyEvaluationMetric(
+            config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION, oov)
+        subtoken_metric = SubtokensEvaluationMetric(oov)
+        # per-example prediction log lives next to the model artifacts
+        # (the reference wrote a bare 'log.txt' into the CWD,
+        # tensorflow_model.py:138 — polluting wherever you ran from)
+        if config.is_saving:
+            log_dir = os.path.dirname(config.MODEL_SAVE_PATH)
+        elif config.is_loading:
+            log_dir = config.model_load_dir
+        else:
+            log_dir = '.'
+        if log_dir and log_dir != '.':
+            os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, 'log.txt')
+        vectors_path = config.TEST_DATA_PATH + '.vectors'
+        vectors_file = (open(vectors_path, 'w')
+                        if config.EXPORT_CODE_VECTORS else None)
+        total = 0
+        start_time = time.time()
+        with open(log_path, 'w') as log_file:
+            for batch in reader.iter_epoch_prefetched(shuffle=False):
+                out = as_numpy(self.trainer.eval_step(self.params, batch))
+                results = decode_topk_batch(
+                    out['topk_indices'], self._target_index_to_word,
+                    batch.label_strings, batch.weight)
+                topk_metric.update_batch(results)
+                subtoken_metric.update_batch(results)
+                self._log_predictions_during_evaluation(results, log_file)
+                if vectors_file is not None:
+                    valid = batch.weight > 0
+                    for vec in out['code_vectors'][valid]:
+                        vectors_file.write(' '.join(map(str, vec)) + '\n')
+                total += len(results)
+                if total and total % (
+                        config.NUM_BATCHES_TO_LOG_PROGRESS
+                        * config.TEST_BATCH_SIZE) < config.TEST_BATCH_SIZE:
+                    elapsed = time.time() - start_time
+                    self.log('Evaluated %d examples... (%d samples/sec)'
+                             % (total, int(total / max(elapsed, 1e-9))))
+        if vectors_file is not None:
+            vectors_file.close()
+            self.log('Code vectors written to `%s`.' % vectors_path)
+        return ModelEvaluationResults(
+            topk_acc=topk_metric.topk_correct_predictions,
+            subtoken_precision=subtoken_metric.precision,
+            subtoken_recall=subtoken_metric.recall,
+            subtoken_f1=subtoken_metric.f1)
+
+    def _log_predictions_during_evaluation(self, results, output_file) -> None:
+        """Per-example prediction log (reference
+        tensorflow_model.py:411-422)."""
+        oov = self.vocabs.target_vocab.special_words.OOV
+        for original_name, top_words in results:
+            found_match = common.get_first_match_word_from_top_predictions(
+                oov, original_name, top_words)
+            if found_match is not None:
+                prediction_idx, predicted_word = found_match
+                if prediction_idx == 0:
+                    output_file.write('Original: ' + original_name
+                                      + ', predicted 1st: ' + predicted_word
+                                      + '\n')
+                else:
+                    output_file.write('\t\t predicted correctly at rank: '
+                                      + str(prediction_idx + 1) + '\n')
+            else:
+                output_file.write('No results for predicting: '
+                                  + original_name + '\n')
+
+    # -------------------------------------------------------------- predict
+    def predict(self, predict_data_lines: Iterable[str]
+                ) -> List[ModelPredictionResults]:
+        """(reference tensorflow_model.py:311-368; per-line in the
+        reference, batched here — the REPL passes a handful of lines)"""
+        lines = list(predict_data_lines)
+        if not lines:
+            return []
+        reader = PathContextReader(self.vocabs, self.config,
+                                   EstimatorAction.Predict)
+        batch = reader.process_input_rows(lines)
+        # pad to a multiple of the mesh data axis so the batch shards evenly
+        data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
+        padded_size = -(-len(lines) // data_axis) * data_axis
+        batch = reader.pad_batch_to(batch, padded_size)
+        out = as_numpy(self.trainer.predict_step(self.params, batch))
+        results: List[ModelPredictionResults] = []
+        for r in range(len(lines)):
+            top_words = list(
+                self._target_index_to_word[out['topk_indices'][r]])
+            attention_per_context = self._get_attention_weight_per_context(
+                batch.source_strings[r], batch.path_strings[r],
+                batch.target_strings[r], out['attention'][r])
+            results.append(ModelPredictionResults(
+                original_name=str(batch.label_strings[r]),
+                topk_predicted_words=top_words,
+                topk_predicted_words_scores=out['topk_scores'][r],
+                attention_per_context=attention_per_context,
+                code_vector=(out['code_vectors'][r]
+                             if self.config.EXPORT_CODE_VECTORS else None)))
+        return results
+
+    @staticmethod
+    def _get_attention_weight_per_context(
+            source_strings, path_strings, target_strings, attention_weights
+    ) -> Dict[Tuple[str, str, str], float]:
+        """(reference model_base.py:115-129)"""
+        attention_per_context: Dict[Tuple[str, str, str], float] = {}
+        for source, path, target, weight in zip(
+                source_strings, path_strings, target_strings,
+                attention_weights):
+            if not source and not path and not target:
+                continue  # padding context
+            attention_per_context[(str(source), str(path), str(target))] = \
+                float(weight)
+        return attention_per_context
+
+    # ----------------------------------------------------- embedding export
+    def get_vocab_embedding_as_np_array(self, vocab_type: VocabType
+                                        ) -> np.ndarray:
+        """(reference tensorflow_model.py:379-403 — here a direct fetch)"""
+        named = self.backend.named_params(self.params)
+        if vocab_type == VocabType.Token:
+            return np.asarray(named.token_embedding)
+        if vocab_type == VocabType.Target:
+            return np.asarray(named.target_embedding)
+        if vocab_type == VocabType.Path:
+            return np.asarray(named.path_embedding)
+        raise ValueError('vocab_type must be a VocabType member.')
+
+    def save_word2vec_format(self, dest_save_path: str,
+                             vocab_type: VocabType) -> None:
+        """(reference model_base.py:176-182)"""
+        matrix = self.get_vocab_embedding_as_np_array(vocab_type)
+        index_to_word = self.vocabs.get(vocab_type).index_to_word
+        with open(dest_save_path, 'w') as words_file:
+            common.save_word2vec_file(words_file, index_to_word, matrix)
+        self.log('Saved %s embeddings to `%s`.'
+                 % (vocab_type.name, dest_save_path))

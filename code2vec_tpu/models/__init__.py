@@ -1,0 +1,6 @@
+from code2vec_tpu.models.functional import (
+    Code2VecParams, init_params, encode, compute_logits, loss_and_aux,
+    param_shapes)
+
+__all__ = ['Code2VecParams', 'init_params', 'encode', 'compute_logits',
+           'loss_and_aux', 'param_shapes']

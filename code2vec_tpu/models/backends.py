@@ -1,0 +1,133 @@
+"""The two swappable model backends: 'jax' (raw pytree) and 'flax' (linen).
+
+Mirrors the reference's runtime-selected dual backends (TF1 graph vs
+tf.keras, reference code2vec.py:7-13) in a TPU-native way: both call the
+same pure math in :mod:`code2vec_tpu.models.functional`; they differ only in
+how parameters are created and stored. The trainer and serving layers are
+backend-agnostic — a backend exposes:
+
+- ``init(rng) -> params``                   (pytree of fp32 arrays)
+- ``loss_fn(params, arrays, dropout_rng)``  → (loss, aux)
+- ``forward(params, arrays)``               → (code_vectors, attention, logits)
+- ``named_params(params) -> Code2VecParams`` (for export / sharding)
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models import functional
+from code2vec_tpu.models.flax_model import Code2VecModule
+from code2vec_tpu.vocab import Code2VecVocabs
+
+# arrays order produced by Batch.device_arrays()
+# (source, path, target, mask, label, weight)
+
+
+def compute_dtype(config: Config) -> jnp.dtype:
+    return jnp.bfloat16 if config.COMPUTE_DTYPE == 'bfloat16' else jnp.float32
+
+
+class JaxBackend:
+    """Raw functional backend: params are a ``Code2VecParams`` NamedTuple."""
+
+    name = 'jax'
+
+    def __init__(self, config: Config, vocabs: Code2VecVocabs):
+        self.config = config
+        self.sizes = dict(
+            token_vocab_size=vocabs.token_vocab.size,
+            path_vocab_size=vocabs.path_vocab.size,
+            target_vocab_size=vocabs.target_vocab.size,
+            token_dim=config.TOKEN_EMBEDDINGS_SIZE,
+            path_dim=config.PATH_EMBEDDINGS_SIZE,
+            code_dim=config.CODE_VECTOR_SIZE)
+        self.dtype = compute_dtype(config)
+
+    def init(self, rng: jax.Array) -> functional.Code2VecParams:
+        return functional.init_params(rng, **self.sizes)
+
+    def param_shapes(self) -> functional.Code2VecParams:
+        return functional.param_shapes(**self.sizes)
+
+    def loss_fn(self, params, arrays, dropout_rng) -> Tuple[jax.Array, Any]:
+        source, path, target, mask, label, weight = arrays
+        return functional.loss_and_aux(
+            params, source, path, target, mask, label, weight,
+            dropout_rng=dropout_rng,
+            dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
+            dtype=self.dtype)
+
+    def forward(self, params, arrays):
+        source, path, target, mask = arrays[:4]
+        code_vectors, attention = functional.encode(
+            params, source, path, target, mask, dtype=self.dtype)
+        logits = functional.compute_logits(params, code_vectors,
+                                           dtype=self.dtype)
+        return code_vectors, attention, logits
+
+    def named_params(self, params) -> functional.Code2VecParams:
+        return params
+
+
+class FlaxBackend:
+    """flax.linen backend: params are the module's ``{'params': {...}}``
+    dict."""
+
+    name = 'flax'
+
+    def __init__(self, config: Config, vocabs: Code2VecVocabs):
+        self.config = config
+        self.dtype = compute_dtype(config)
+        self.module = Code2VecModule(
+            token_vocab_size=vocabs.token_vocab.size,
+            path_vocab_size=vocabs.path_vocab.size,
+            target_vocab_size=vocabs.target_vocab.size,
+            token_dim=config.TOKEN_EMBEDDINGS_SIZE,
+            path_dim=config.PATH_EMBEDDINGS_SIZE,
+            code_dim=config.CODE_VECTOR_SIZE,
+            dropout_keep_rate=config.DROPOUT_KEEP_RATE,
+            compute_dtype=self.dtype)
+        self._jax_twin = JaxBackend(config, vocabs)
+
+    def init(self, rng: jax.Array):
+        dummy = jnp.zeros((1, self.config.MAX_CONTEXTS), dtype=jnp.int32)
+        dummy_mask = jnp.zeros((1, self.config.MAX_CONTEXTS),
+                               dtype=jnp.float32)
+        return self.module.init(rng, dummy, dummy, dummy, dummy_mask)
+
+    def param_shapes(self):
+        shapes = self._jax_twin.param_shapes()
+        return {'params': shapes._asdict()}
+
+    def loss_fn(self, params, arrays, dropout_rng) -> Tuple[jax.Array, Any]:
+        # Delegate the loss math to functional via the extracted params so
+        # both backends are numerically identical.
+        return self._jax_twin.loss_fn(self.named_params(params), arrays,
+                                      dropout_rng)
+
+    def forward(self, params, arrays):
+        source, path, target, mask = arrays[:4]
+        return self.module.apply(params, source, path, target, mask,
+                                 deterministic=True)
+
+    def named_params(self, params) -> functional.Code2VecParams:
+        inner = params['params']
+        return functional.Code2VecParams(
+            token_embedding=inner['token_embedding'],
+            path_embedding=inner['path_embedding'],
+            target_embedding=inner['target_embedding'],
+            transform=inner['transform'],
+            attention=inner['attention'])
+
+
+def create_backend(config: Config, vocabs: Code2VecVocabs):
+    """Runtime backend selection (reference code2vec.py:7-13)."""
+    if config.DL_FRAMEWORK == 'flax':
+        return FlaxBackend(config, vocabs)
+    if config.DL_FRAMEWORK == 'jax':
+        return JaxBackend(config, vocabs)
+    raise ValueError('Unknown DL_FRAMEWORK: {!r}'.format(config.DL_FRAMEWORK))

@@ -1,0 +1,68 @@
+"""flax.linen backend: the code2vec model as an ``nn.Module``.
+
+One of the two swappable backends (the reference similarly shipped a TF1
+graph backend and a tf.keras backend, selected at runtime by ``--framework``,
+code2vec.py:7-13). The module owns parameter definition/initialization only;
+the math is delegated to :mod:`code2vec_tpu.models.functional` so both
+backends share one implementation — and unlike the reference
+(README.md:210), checkpoints ARE cross-compatible because the parameter
+pytrees are structurally identical.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from code2vec_tpu.models import functional
+
+
+class Code2VecModule(nn.Module):
+    token_vocab_size: int
+    path_vocab_size: int
+    target_vocab_size: int
+    token_dim: int = 128
+    path_dim: int = 128
+    code_dim: int = 384
+    dropout_keep_rate: float = 0.75
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def _params(self) -> functional.Code2VecParams:
+        fan_out_uniform = jax.nn.initializers.variance_scaling(
+            1.0, 'fan_out', 'uniform')
+        glorot = jax.nn.initializers.glorot_uniform()
+        context_dim = 2 * self.token_dim + self.path_dim
+        return functional.Code2VecParams(
+            token_embedding=self.param(
+                'token_embedding', fan_out_uniform,
+                (self.token_vocab_size, self.token_dim), jnp.float32),
+            path_embedding=self.param(
+                'path_embedding', fan_out_uniform,
+                (self.path_vocab_size, self.path_dim), jnp.float32),
+            target_embedding=self.param(
+                'target_embedding', fan_out_uniform,
+                (self.target_vocab_size, self.code_dim), jnp.float32),
+            transform=self.param(
+                'transform', glorot, (context_dim, self.code_dim),
+                jnp.float32),
+            attention=self.param(
+                'attention', glorot, (self.code_dim, 1), jnp.float32),
+        )
+
+    @nn.compact
+    def __call__(self, source, path, target, mask, *,
+                 deterministic: bool = True):
+        """Returns (code_vectors, attention_weights, logits)."""
+        params = self._params()
+        dropout_rng: Optional[jax.Array] = None
+        if not deterministic and self.dropout_keep_rate < 1.0:
+            dropout_rng = self.make_rng('dropout')
+        code_vectors, attention_weights = functional.encode(
+            params, source, path, target, mask, dropout_rng=dropout_rng,
+            dropout_keep_rate=self.dropout_keep_rate,
+            dtype=self.compute_dtype)
+        logits = functional.compute_logits(params, code_vectors,
+                                           dtype=self.compute_dtype)
+        return code_vectors, attention_weights, logits

@@ -1,0 +1,175 @@
+"""The code2vec model as pure functions over an explicit parameter pytree.
+
+This is the single source of truth for the model math; both backends (the
+raw-pytree 'jax' backend and the flax.linen module) call into it. The
+reference implemented this math three times — train graph, test graph and
+predict graph (tensorflow_model.py:197-234, 267-309) plus a second full copy
+in Keras (keras_model.py:37-95); here it is one pure ``encode`` traced by XLA
+once per entry point.
+
+Forward pass (mirrors ``_calculate_weighted_contexts``,
+tensorflow_model.py:236-265):
+
+    ctx   = concat(tok[source], path[path], tok[target])      (B, C, 3d)
+    ctx   = dropout(ctx)                                      train only
+    x     = tanh(ctx @ TRANSFORM)                             (B, C, D)
+    score = x @ ATTENTION + log(mask)                         (B, C)
+    attn  = softmax(score, axis=contexts)
+    code  = sum(attn * x, axis=contexts)                      (B, D)
+    logit = code @ TARGET_EMB.T                               (B, Vy)
+
+TPU-first details with no reference counterpart:
+
+- optional bfloat16 compute: the gathered embeddings and both matmuls run in
+  bf16 for the MXU; attention softmax and the final cross-entropy stay fp32;
+- rows with zero valid contexts (static-shape padding) produce a *finite*
+  uniform attention instead of NaN, and are excluded from the loss via the
+  per-example ``weight`` (the reference filtered such rows dynamically,
+  path_context_reader.py:153-177 — dynamic shapes don't fly under XLA).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Floor for the additive log-mask so fully-masked rows stay finite (vs the
+# reference's log(0) = -inf which NaNs an all-invalid row,
+# tensorflow_model.py:257). Must be a NORMAL fp32 (XLA flushes denormals to
+# zero, turning log back into -inf); log(1e-30) ~ -69, giving invalid
+# contexts attention ~e-30 — zero at fp32 resolution.
+_MASK_MIN = 1e-30
+
+
+class Code2VecParams(NamedTuple):
+    """The five trainable arrays (reference tensorflow_model.py:206-220,
+    249-250). ``attention`` keeps the reference's (D, 1) shape."""
+    token_embedding: jax.Array    # (Vt, d_tok)  WORDS_VOCAB
+    path_embedding: jax.Array     # (Vp, d_path) PATHS_VOCAB
+    target_embedding: jax.Array   # (Vy, D)      TARGET_WORDS_VOCAB
+    transform: jax.Array          # (2*d_tok+d_path, D) TRANSFORM
+    attention: jax.Array          # (D, 1)       ATTENTION
+
+
+def param_shapes(*, token_vocab_size: int, path_vocab_size: int,
+                 target_vocab_size: int, token_dim: int, path_dim: int,
+                 code_dim: int) -> Code2VecParams:
+    """Shapes-only pytree (for sharding specs / checkpoint restore)."""
+    context_dim = 2 * token_dim + path_dim
+    return Code2VecParams(
+        token_embedding=jax.ShapeDtypeStruct((token_vocab_size, token_dim),
+                                             jnp.float32),
+        path_embedding=jax.ShapeDtypeStruct((path_vocab_size, path_dim),
+                                            jnp.float32),
+        target_embedding=jax.ShapeDtypeStruct((target_vocab_size, code_dim),
+                                              jnp.float32),
+        transform=jax.ShapeDtypeStruct((context_dim, code_dim), jnp.float32),
+        attention=jax.ShapeDtypeStruct((code_dim, 1), jnp.float32),
+    )
+
+
+def init_params(rng: jax.Array, *, token_vocab_size: int,
+                path_vocab_size: int, target_vocab_size: int,
+                token_dim: int, path_dim: int, code_dim: int
+                ) -> Code2VecParams:
+    """Reference initialization: embeddings use
+    variance_scaling(1.0, fan_out, uniform) (tensorflow_model.py:209-220);
+    TRANSFORM and ATTENTION use TF1's default glorot_uniform (:214-216,
+    249-250)."""
+    k_tok, k_path, k_tgt, k_trans, k_attn = jax.random.split(rng, 5)
+    context_dim = 2 * token_dim + path_dim
+    fan_out_uniform = jax.nn.initializers.variance_scaling(
+        1.0, 'fan_out', 'uniform')
+    glorot = jax.nn.initializers.glorot_uniform()
+    return Code2VecParams(
+        token_embedding=fan_out_uniform(
+            k_tok, (token_vocab_size, token_dim), jnp.float32),
+        path_embedding=fan_out_uniform(
+            k_path, (path_vocab_size, path_dim), jnp.float32),
+        target_embedding=fan_out_uniform(
+            k_tgt, (target_vocab_size, code_dim), jnp.float32),
+        transform=glorot(k_trans, (context_dim, code_dim), jnp.float32),
+        attention=glorot(k_attn, (code_dim, 1), jnp.float32),
+    )
+
+
+def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
+           target: jax.Array, mask: jax.Array, *,
+           dropout_rng: Optional[jax.Array] = None,
+           dropout_keep_rate: float = 1.0,
+           dtype: jnp.dtype = jnp.float32
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Bag-of-contexts → (code_vectors (B, D) fp32, attention (B, C) fp32).
+
+    ``dtype`` is the MXU compute dtype; attention softmax runs fp32.
+    Dropout is applied iff ``dropout_rng`` is given and keep < 1
+    (reference applies it only in the train graph,
+    tensorflow_model.py:245-246).
+    """
+    source_embed = jnp.take(params.token_embedding, source,
+                            axis=0).astype(dtype)       # (B, C, d)
+    path_embed = jnp.take(params.path_embedding, path,
+                          axis=0).astype(dtype)          # (B, C, d)
+    target_embed = jnp.take(params.token_embedding, target,
+                            axis=0).astype(dtype)        # (B, C, d)
+    context_embed = jnp.concatenate(
+        [source_embed, path_embed, target_embed], axis=-1)  # (B, C, 3d)
+
+    if dropout_rng is not None and dropout_keep_rate < 1.0:
+        keep_mask = jax.random.bernoulli(
+            dropout_rng, dropout_keep_rate, context_embed.shape)
+        context_embed = jnp.where(
+            keep_mask, context_embed / dropout_keep_rate,
+            jnp.zeros_like(context_embed))
+
+    # fp32 compute asks for true-fp32 MXU passes (TPU fp32 matmuls default
+    # to lower precision); bf16 compute uses the native fast path.
+    precision = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    x = jnp.tanh(jnp.matmul(context_embed, params.transform.astype(dtype),
+                            precision=precision))                 # (B, C, D)
+
+    scores = jnp.matmul(x, params.attention.astype(dtype),
+                        precision=precision)[..., 0]              # (B, C)
+    scores = scores.astype(jnp.float32) + jnp.log(
+        jnp.maximum(mask.astype(jnp.float32), _MASK_MIN))
+    attention_weights = jax.nn.softmax(scores, axis=1)            # (B, C)
+
+    code_vectors = jnp.einsum(
+        'bc,bcd->bd', attention_weights, x.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST)                      # (B, D)
+    return code_vectors, attention_weights
+
+
+def compute_logits(params: Code2VecParams, code_vectors: jax.Array,
+                   dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """code vectors → target-vocab logits, fp32 out
+    (reference tensorflow_model.py:226, 297)."""
+    precision = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    logits = jnp.matmul(code_vectors.astype(dtype),
+                        params.target_embedding.astype(dtype).T,
+                        precision=precision)
+    return logits.astype(jnp.float32)
+
+
+def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
+                 target: jax.Array, mask: jax.Array, label: jax.Array,
+                 weight: jax.Array, *,
+                 dropout_rng: Optional[jax.Array] = None,
+                 dropout_keep_rate: float = 1.0,
+                 dtype: jnp.dtype = jnp.float32):
+    """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
+    divides the CE sum by the dynamic batch size; with static shapes the
+    per-example weight plays that role: padded rows have weight 0)."""
+    code_vectors, _ = encode(
+        params, source, path, target, mask, dropout_rng=dropout_rng,
+        dropout_keep_rate=dropout_keep_rate, dtype=dtype)
+    logits = compute_logits(params, code_vectors, dtype=dtype)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(log_probs, label[:, None], axis=1)[:, 0]
+    denom = jnp.maximum(weight.sum(), 1.0)
+    loss = (ce * weight).sum() / denom
+    return loss, {'code_vectors': code_vectors,
+                  'num_valid': weight.sum()}

@@ -1,0 +1,6 @@
+from code2vec_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, batch_sharding, create_mesh, param_sharding,
+    param_specs, shard_batch, shard_params)
+
+__all__ = ['DATA_AXIS', 'MODEL_AXIS', 'batch_sharding', 'create_mesh',
+           'param_sharding', 'param_specs', 'shard_batch', 'shard_params']

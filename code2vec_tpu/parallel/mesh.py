@@ -1,0 +1,135 @@
+"""Device mesh + sharding layout: parallelism as configuration.
+
+The reference is strictly single-device — one ``tf.Session``, no
+``tf.distribute``, no collectives anywhere (SURVEY.md §2.3). Here
+parallelism is a first-class component, expressed the TPU way: a 2-D
+``jax.sharding.Mesh`` with axes
+
+- ``data``  — batch (DP). Gradients are psum-reduced over ICI by XLA because
+  params are replicated along this axis.
+- ``model`` — parameter sharding (TP). The three embedding tables
+  (1.3M/911K/261K rows at full java14m scale, config.py:61-63) are
+  row-sharded; the target-embedding sharding also column-shards the final
+  softmax logits, so the 261K-way softmax + top-k is computed shard-wise
+  with an XLA-inserted collective merge.
+
+Nothing in the model code mentions devices: arrays are *placed* with a
+``NamedSharding`` and ``jit`` propagates layouts / inserts collectives
+(psum for the DP gradient reduction, all-gather / reduce-scatter around the
+sharded gathers and the logits matmul). Multi-host follows the same code
+path — ``jax.devices()`` spans hosts and ICI/DCN routing is XLA's job.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.models.functional import Code2VecParams
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+def create_mesh(config: Optional[Config] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the (data, model) mesh. ``MESH_DATA_AXIS_SIZE == -1`` means
+    'all devices not used by the model axis'."""
+    devices = list(devices if devices is not None else jax.devices())
+    model_size = config.MESH_MODEL_AXIS_SIZE if config else 1
+    data_size = config.MESH_DATA_AXIS_SIZE if config else -1
+    if model_size <= 0:
+        model_size = 1
+    if data_size <= 0:
+        data_size = len(devices) // model_size
+    if data_size * model_size != len(devices):
+        raise ValueError(
+            'Mesh {}x{} does not match {} visible devices.'.format(
+                data_size, model_size, len(devices)))
+    device_grid = np.asarray(devices).reshape(data_size, model_size)
+    return Mesh(device_grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def param_specs() -> Code2VecParams:
+    """PartitionSpecs for the five parameter arrays: embedding tables
+    row-sharded over ``model``; the small dense/attention params replicated
+    (SURVEY.md §2.3 'TPU-native equivalent to build')."""
+    return Code2VecParams(
+        token_embedding=P(MODEL_AXIS, None),
+        path_embedding=P(MODEL_AXIS, None),
+        target_embedding=P(MODEL_AXIS, None),
+        transform=P(None, None),
+        attention=P(None, None),
+    )
+
+
+def batch_spec() -> P:
+    """Every per-example array is sharded over the batch (data) axis."""
+    return P(DATA_AXIS)
+
+
+def param_sharding(mesh: Mesh) -> Code2VecParams:
+    specs = param_specs()
+    return Code2VecParams(*[NamedSharding(mesh, spec) for spec in specs])
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def shard_params(params, mesh: Mesh):
+    """Place a (possibly host-local) parameter pytree onto the mesh.
+
+    Works for both backends: leaves are matched to their PartitionSpec by
+    *name* (the last path component), so the flax ``{'params': {...}}`` dict
+    and the raw ``Code2VecParams`` NamedTuple both work regardless of
+    flatten order."""
+    shardings_by_name = param_sharding(mesh)._asdict()
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed = []
+    for path, leaf in path_leaves:
+        name = _leaf_name(path)
+        if name not in shardings_by_name:
+            raise ValueError('Unknown parameter leaf {!r}; expected one of '
+                             '{}'.format(name, sorted(shardings_by_name)))
+        placed.append(jax.device_put(leaf, shardings_by_name[name]))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return getattr(last, 'key', None) or getattr(last, 'name', str(last))
+
+
+def sharding_for_tree(tree, mesh: Mesh):
+    """Shardings for an arbitrary pytree whose leaves either *are* model
+    parameters (matched by leaf name, wherever they sit — e.g. inside Adam's
+    ``mu``/``nu`` moment trees) or are small scalars/state (replicated).
+
+    This is how optimizer state inherits the parameter layout without any
+    per-optimizer code."""
+    shardings_by_name = param_sharding(mesh)._asdict()
+    replicated = NamedSharding(mesh, P())
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = [shardings_by_name.get(_leaf_name(path), replicated)
+           for path, _leaf in path_leaves]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def attach_shardings(abstract_tree, mesh: Mesh):
+    """ShapeDtypeStruct pytree → same pytree with mesh shardings attached
+    (the restore target orbax needs to re-shard onto the *current* mesh)."""
+    shardings = sharding_for_tree(abstract_tree, mesh)
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                             sharding=s),
+        abstract_tree, shardings)
+
+
+def shard_batch(arrays, mesh: Mesh):
+    """Place a tuple of per-example numpy arrays onto the data axis."""
+    sharding = batch_sharding(mesh)
+    return tuple(jax.device_put(a, sharding) for a in arrays)

@@ -1,0 +1,3 @@
+from code2vec_tpu.training.trainer import Trainer, TrainerState
+
+__all__ = ['Trainer', 'TrainerState']

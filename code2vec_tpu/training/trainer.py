@@ -1,0 +1,181 @@
+"""Backend-agnostic training/eval/predict engine.
+
+Replaces the reference's session-based hot loop (tensorflow_model.py:40-112)
+and the Keras fit wrapper (keras_model.py:166-193) with three jitted pure
+step functions over a device mesh:
+
+- ``train_step``  — loss + grads + Adam update, params donated;
+- ``eval_step``   — deterministic forward + device-side top-k;
+- ``predict_step``— eval plus attention weights and softmax-normalized
+  top-k scores (reference ``normalize_scores=True``,
+  tensorflow_model.py:305-306).
+
+Everything under jit is traced once and reused for every batch; the mesh
+placement of params/batches drives XLA's partitioner (DP gradient psum,
+sharded-table gathers, sharded softmax) with no collective written by hand.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import Batch
+from code2vec_tpu.parallel import mesh as mesh_lib
+
+
+class TrainerState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array     # () int32
+    rng: jax.Array      # dropout PRNG root
+
+
+class Trainer:
+    def __init__(self, config: Config, backend,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        self.config = config
+        self.backend = backend
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(config)
+        data_size = self.mesh.shape[mesh_lib.DATA_AXIS]
+        for attr in ('TRAIN_BATCH_SIZE', 'TEST_BATCH_SIZE'):
+            if getattr(config, attr) % data_size:
+                raise ValueError(
+                    '%s=%d must be divisible by the mesh data axis (%d).'
+                    % (attr, getattr(config, attr), data_size))
+        # Reference uses tf.train.AdamOptimizer() defaults
+        # (tensorflow_model.py:232): lr=1e-3, b1=0.9, b2=0.999, eps=1e-8.
+        self.optimizer = optax.adam(config.LEARNING_RATE)
+        self._build_steps()
+
+    # ----------------------------------------------------------- jit steps
+    def _build_steps(self) -> None:
+        backend = self.backend
+        optimizer = self.optimizer
+        top_k = self.config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
+
+        def train_step(state: TrainerState, arrays) -> Tuple[TrainerState, jax.Array]:
+            dropout_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                loss, _aux = backend.loss_fn(params, arrays, dropout_rng)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            updates, new_opt_state = optimizer.update(grads, state.opt_state,
+                                                      state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = TrainerState(params=new_params,
+                                     opt_state=new_opt_state,
+                                     step=state.step + 1, rng=state.rng)
+            return new_state, loss
+
+        def eval_step(params, arrays):
+            code_vectors, attention, logits = backend.forward(params, arrays)
+            k = min(top_k, logits.shape[-1])
+            topk_scores, topk_indices = jax.lax.top_k(logits, k)
+            return {'topk_indices': topk_indices,
+                    'topk_scores': topk_scores,
+                    'code_vectors': code_vectors}
+
+        def predict_step(params, arrays):
+            code_vectors, attention, logits = backend.forward(params, arrays)
+            k = min(top_k, logits.shape[-1])
+            topk_scores, topk_indices = jax.lax.top_k(logits, k)
+            return {'topk_indices': topk_indices,
+                    'topk_scores': jax.nn.softmax(topk_scores, axis=-1),
+                    'attention': attention,
+                    'code_vectors': code_vectors}
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        self._eval_step = jax.jit(eval_step)
+        self._predict_step = jax.jit(predict_step)
+
+    # --------------------------------------------------------------- state
+    def init_state(self, seed: int = 42) -> TrainerState:
+        init_rng, train_rng = jax.random.split(jax.random.PRNGKey(seed))
+        params = self.backend.init(init_rng)
+        params = mesh_lib.shard_params(params, self.mesh)
+        opt_state = jax.jit(self.optimizer.init)(params)
+        return TrainerState(params=params, opt_state=opt_state,
+                            step=jnp.zeros((), jnp.int32), rng=train_rng)
+
+    def abstract_state(self) -> Tuple[Any, Any]:
+        """(abstract_params, abstract_opt_state) with *current-mesh*
+        shardings attached, for checkpoint restore targets — nothing is
+        materialized on device (no throwaway init at 384M-param scale)."""
+        abstract_params = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
+            self.backend.param_shapes())
+        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+        return (mesh_lib.attach_shardings(abstract_params, self.mesh),
+                mesh_lib.attach_shardings(abstract_opt, self.mesh))
+
+    def state_from_params(self, params, step: int = 0,
+                          seed: int = 42) -> TrainerState:
+        params = mesh_lib.shard_params(params, self.mesh)
+        opt_state = jax.jit(self.optimizer.init)(params)
+        return TrainerState(params=params, opt_state=opt_state,
+                            step=jnp.asarray(step, jnp.int32),
+                            rng=jax.random.PRNGKey(seed))
+
+    # --------------------------------------------------------------- steps
+    def train_step(self, state: TrainerState, batch: Batch
+                   ) -> Tuple[TrainerState, jax.Array]:
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        return self._train_step(state, arrays)
+
+    def eval_step(self, params, batch: Batch) -> dict:
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        return self._eval_step(params, arrays)
+
+    def predict_step(self, params, batch: Batch) -> dict:
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        return self._predict_step(params, arrays)
+
+    # ----------------------------------------------------------- main loop
+    def fit(self, state: TrainerState,
+            epoch_batches: Callable[[int], Iterable[Batch]],
+            start_epoch: int = 0,
+            on_epoch_end: Optional[Callable[[int, TrainerState], None]] = None
+            ) -> TrainerState:
+        """Epoch-driven loop with the reference's windowed throughput trace
+        (tensorflow_model.py:74-101, 424-430)."""
+        config = self.config
+        log_every = config.NUM_BATCHES_TO_LOG_PROGRESS
+        batch_num = 0
+        window_losses = []  # device arrays: no per-step host sync, the
+        window_examples = 0  # host only blocks once per log window
+        window_start = time.time()
+        for epoch in range(start_epoch, config.NUM_TRAIN_EPOCHS):
+            for batch in epoch_batches(epoch):
+                state, loss = self.train_step(state, batch)
+                batch_num += 1
+                window_losses.append(loss)
+                window_examples += batch.num_valid_examples
+                if batch_num % log_every == 0:
+                    sum_loss = float(jnp.stack(window_losses).sum())
+                    elapsed = time.time() - window_start
+                    throughput = window_examples / max(elapsed, 1e-9)
+                    config.log(
+                        'Average loss at batch %d: %f, \tthroughput: %d '
+                        'samples/sec' % (batch_num,
+                                         sum_loss / len(window_losses),
+                                         throughput))
+                    window_losses = []
+                    window_examples = 0
+                    window_start = time.time()
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, state)
+                window_start = time.time()  # don't bill eval/save time
+        return state
+
+
+def as_numpy(tree):
+    """Fetch a pytree of device arrays to host numpy."""
+    return jax.tree_util.tree_map(np.asarray, tree)

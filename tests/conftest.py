@@ -1,13 +1,21 @@
-"""Test harness: force an 8-virtual-device CPU platform BEFORE jax imports.
+"""Test harness: force an 8-virtual-device CPU platform.
 
 Multi-chip logic is tested without TPU hardware via XLA's virtual host
 devices (SURVEY.md §4) — the TPU answer to "multi-node tests without a
 cluster".
+
+Note: this environment pre-imports jax at interpreter startup
+(sitecustomize), so setting JAX_PLATFORMS in os.environ here is too late;
+``jax.config.update`` still works because backends initialize lazily.
+XLA_FLAGS must be set before the first backend init, which also still holds.
 """
 import os
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,105 @@
+"""Checkpoint / resume / release round-trips (reference parity:
+tensorflow_model.py:370-377, keras_model.py:230-296)."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.model_api import Code2VecModel
+from tests.test_train_overfit import make_dataset
+
+
+def _train_config(tmp_path, prefix, **overrides):
+    defaults = dict(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_EPOCHS=1,
+        SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        MODEL_SAVE_PATH=str(tmp_path / 'models' / 'saved_model'))
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+def test_save_creates_sidecar_and_checkpoints(tmp_path):
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix)
+    model = Code2VecModel(config)
+    model.train()
+    model_dir = tmp_path / 'models'
+    assert (model_dir / 'dictionaries.bin').exists()
+    assert (model_dir / 'saved_model__entire-model').is_dir()
+
+
+@pytest.mark.parametrize('framework', ['jax', 'flax'])
+def test_load_params_reproduces_predictions(tmp_path, framework):
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, DL_FRAMEWORK=framework)
+    model = Code2VecModel(config)
+    model.train()
+    line = 'get|a toka0,pA,toka1 toka1,pB,toka2    '
+    before = model.predict([line])[0]
+
+    config2 = Config(
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'),
+        DL_FRAMEWORK=framework, COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False)
+    model2 = Code2VecModel(config2)
+    after = model2.predict([line])[0]
+    assert before.topk_predicted_words == after.topk_predicted_words
+    np.testing.assert_allclose(before.topk_predicted_words_scores,
+                               after.topk_predicted_words_scores, rtol=1e-5)
+
+
+def test_resume_training_continues_from_epoch(tmp_path):
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=2)
+    model = Code2VecModel(config)
+    model.train()
+
+    # resume with --load and --data: starts at epoch 2
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=4,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert model2._start_epoch == 2
+    assert int(model2.state.step) > 0
+    model2.train()  # runs epochs 2..3 without error
+
+
+def test_release_params_only(tmp_path):
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix)
+    model = Code2VecModel(config)
+    model.train()
+
+    load_path = str(tmp_path / 'models' / 'saved_model')
+    config_release = Config(
+        MODEL_LOAD_PATH=load_path, RELEASE=True, DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False)
+    model_r = Code2VecModel(config_release)
+    model_r.release_model()
+    weights_dir = tmp_path / 'models' / 'saved_model__only-weights'
+    assert weights_dir.is_dir()
+
+    # a released model loads (params-only path preferred) and predicts
+    config3 = Config(
+        MODEL_LOAD_PATH=load_path, DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False)
+    model3 = Code2VecModel(config3)
+    result = model3.predict(['get|a toka0,pA,toka1    '])[0]
+    assert len(result.topk_predicted_words) > 0
+
+
+def test_word2vec_export(tmp_path):
+    from code2vec_tpu.vocab import VocabType
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=1)
+    model = Code2VecModel(config)
+    dest = tmp_path / 'tokens.w2v'
+    model.save_word2vec_format(str(dest), VocabType.Token)
+    lines = dest.read_text().splitlines()
+    vocab_size, dim = map(int, lines[0].split())
+    assert vocab_size == model.vocabs.token_vocab.size
+    assert dim == config.TOKEN_EMBEDDINGS_SIZE
+    assert len(lines) == vocab_size + 1

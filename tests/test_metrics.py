@@ -1,0 +1,66 @@
+import numpy as np
+
+from code2vec_tpu.metrics import (SubtokensEvaluationMetric,
+                                  TopKAccuracyEvaluationMetric,
+                                  decode_topk_batch)
+
+OOV = '<PAD_OR_OOV>'
+
+
+def test_topk_accuracy_rank_semantics():
+    # Hit at rank r counts toward all k >= r (reference
+    # tensorflow_model.py:506-512); rank counts only legal predictions.
+    metric = TopKAccuracyEvaluationMetric(top_k=3, oov_word=OOV)
+    metric.update_batch([
+        ('getName', ['get|name', 'x', 'y']),          # hit at rank 0
+        ('setValue', [OOV, 'badword', 'set|value']),  # legal-filtered rank 1
+        ('foo', ['bar', 'baz', 'qux']),               # miss
+    ])
+    np.testing.assert_allclose(metric.topk_correct_predictions,
+                               [1 / 3, 2 / 3, 2 / 3])
+
+
+def test_topk_match_uses_normalization():
+    metric = TopKAccuracyEvaluationMetric(top_k=1, oov_word=OOV)
+    # normalize_word('get|name') == 'getname' == normalize_word('getName')
+    metric.update_batch([('getName', ['get|name'])])
+    assert metric.topk_correct_predictions[0] == 1.0
+
+
+def test_subtoken_metric_counter_semantics():
+    # Exact Counter overlap semantics (reference tensorflow_model.py:458-469):
+    # prediction 'get|name|name' vs original 'get|value':
+    #   predicted Counter: get:1, name:2 ; original Counter: get:1, value:1
+    #   TP = 1 (get), FP = 2 (name x2), FN = 1 (value)
+    metric = SubtokensEvaluationMetric(oov_word=OOV)
+    metric.update_batch([('get|value', ['get|name|name'])])
+    assert metric.nr_true_positives == 1
+    assert metric.nr_false_positives == 2
+    assert metric.nr_false_negatives == 1
+    assert metric.precision == 1 / 3
+    assert metric.recall == 1 / 2
+    np.testing.assert_allclose(metric.f1, 2 * (1 / 3) * (1 / 2) / (1 / 3 + 1 / 2))
+
+
+def test_subtoken_metric_takes_first_legal_prediction():
+    metric = SubtokensEvaluationMetric(oov_word=OOV)
+    metric.update_batch([('get|value', [OOV, 'bad2', 'get|value', 'other'])])
+    assert metric.precision == 1.0
+    assert metric.recall == 1.0
+
+
+def test_subtoken_metric_no_legal_predictions_counts_all_misses():
+    # Deviation from reference (which crashes, :460): empty prediction.
+    metric = SubtokensEvaluationMetric(oov_word=OOV)
+    metric.update_batch([('get|value', [OOV, 'x9'])])
+    assert metric.nr_true_positives == 0
+    assert metric.nr_false_negatives == 2
+    assert metric.nr_false_positives == 1  # the empty-string token
+
+
+def test_decode_topk_batch_skips_padding_rows():
+    index_to_word = np.array(['<PAD_OR_OOV>', 'alpha', 'beta'], dtype=object)
+    topk = np.array([[1, 2], [2, 0]], dtype=np.int32)
+    results = decode_topk_batch(topk, index_to_word,
+                                ['origA', ''], np.array([1.0, 0.0]))
+    assert results == [('origA', ['alpha', 'beta'])]

@@ -1,0 +1,137 @@
+"""Forward-pass parity: functional.encode vs a straight NumPy transcription
+of the reference math (tensorflow_model.py:236-265)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.models import functional
+
+
+def numpy_reference_forward(params, source, path, target, mask):
+    """Literal NumPy rendering of _calculate_weighted_contexts + logits
+    (reference tensorflow_model.py:236-265, 226, is_evaluating=True)."""
+    tok, pth, tgt_emb = (np.asarray(params.token_embedding),
+                         np.asarray(params.path_embedding),
+                         np.asarray(params.target_embedding))
+    transform = np.asarray(params.transform)
+    attention = np.asarray(params.attention)
+    ctx = np.concatenate([tok[source], pth[path], tok[target]], axis=-1)
+    x = np.tanh(ctx @ transform)                      # (B, C, D)
+    scores = (x @ attention)[..., 0]                  # (B, C)
+    with np.errstate(divide='ignore'):
+        scores = scores + np.log(mask)                # log(0) = -inf
+    scores -= scores.max(axis=1, keepdims=True)
+    e = np.exp(scores)
+    attn = e / e.sum(axis=1, keepdims=True)
+    code = (x * attn[..., None]).sum(axis=1)          # (B, D)
+    logits = code @ tgt_emb.T
+    return code, attn, logits
+
+
+@pytest.fixture
+def tiny_params():
+    return functional.init_params(
+        jax.random.PRNGKey(0), token_vocab_size=11, path_vocab_size=7,
+        target_vocab_size=5, token_dim=6, path_dim=4, code_dim=8)
+
+
+def _random_batch(rng, B=3, C=5, Vt=11, Vp=7):
+    source = rng.integers(0, Vt, (B, C)).astype(np.int32)
+    path = rng.integers(0, Vp, (B, C)).astype(np.int32)
+    target = rng.integers(0, Vt, (B, C)).astype(np.int32)
+    mask = (rng.random((B, C)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0  # at least one valid context per row
+    return source, path, target, mask
+
+
+def test_encode_matches_numpy_reference(tiny_params):
+    rng = np.random.default_rng(1)
+    source, path, target, mask = _random_batch(rng)
+    code, attn = functional.encode(tiny_params, source, path, target, mask)
+    logits = functional.compute_logits(tiny_params, code)
+    ref_code, ref_attn, ref_logits = numpy_reference_forward(
+        tiny_params, source, path, target, mask)
+    np.testing.assert_allclose(np.asarray(code), ref_code, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(attn), ref_attn, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_masked_contexts_get_zero_attention(tiny_params):
+    rng = np.random.default_rng(2)
+    source, path, target, mask = _random_batch(rng)
+    mask[:, 2:] = 0.0
+    _, attn = functional.encode(tiny_params, source, path, target, mask)
+    attn = np.asarray(attn)
+    assert attn[:, 2:].max() < 1e-25  # zero at fp32 resolution
+    np.testing.assert_allclose(attn.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_all_invalid_row_is_finite(tiny_params):
+    # Static-shape padding rows must not NaN (the reference never sees such
+    # rows; we mask them out of the loss instead).
+    B, C = 2, 5
+    source = np.zeros((B, C), np.int32)
+    path = np.zeros((B, C), np.int32)
+    target = np.zeros((B, C), np.int32)
+    mask = np.zeros((B, C), np.float32)
+    code, attn = functional.encode(tiny_params, source, path, target, mask)
+    assert np.isfinite(np.asarray(code)).all()
+    assert np.isfinite(np.asarray(attn)).all()
+
+
+def test_loss_ignores_zero_weight_rows(tiny_params):
+    rng = np.random.default_rng(3)
+    source, path, target, mask = _random_batch(rng, B=4)
+    label = rng.integers(0, 5, (4,)).astype(np.int32)
+    weight = np.array([1, 1, 0, 0], np.float32)
+    loss_full, _ = functional.loss_and_aux(
+        tiny_params, source, path, target, mask, label, weight)
+    # corrupt the zero-weight rows: loss must not change
+    source2 = source.copy()
+    source2[2:] = 0
+    mask2 = mask.copy()
+    mask2[2:] = 0
+    label2 = label.copy()
+    label2[2:] = 0
+    loss_corrupted, _ = functional.loss_and_aux(
+        tiny_params, source2, path, target, mask2, label2, weight)
+    np.testing.assert_allclose(float(loss_full), float(loss_corrupted),
+                               rtol=1e-6)
+
+
+def test_dropout_train_vs_eval(tiny_params):
+    rng = np.random.default_rng(4)
+    source, path, target, mask = _random_batch(rng)
+    code_eval, _ = functional.encode(tiny_params, source, path, target, mask)
+    code_train, _ = functional.encode(
+        tiny_params, source, path, target, mask,
+        dropout_rng=jax.random.PRNGKey(0), dropout_keep_rate=0.5)
+    assert not np.allclose(np.asarray(code_eval), np.asarray(code_train))
+    # keep=1.0 disables dropout even with an rng
+    code_keep1, _ = functional.encode(
+        tiny_params, source, path, target, mask,
+        dropout_rng=jax.random.PRNGKey(0), dropout_keep_rate=1.0)
+    np.testing.assert_allclose(np.asarray(code_eval), np.asarray(code_keep1))
+
+
+def test_bfloat16_compute_close_to_fp32(tiny_params):
+    rng = np.random.default_rng(5)
+    source, path, target, mask = _random_batch(rng)
+    code32, _ = functional.encode(tiny_params, source, path, target, mask)
+    code16, _ = functional.encode(tiny_params, source, path, target, mask,
+                                  dtype=jnp.bfloat16)
+    assert code16.dtype == jnp.float32  # outputs promoted back
+    np.testing.assert_allclose(np.asarray(code32), np.asarray(code16),
+                               rtol=0.05, atol=0.05)
+
+
+def test_init_matches_reference_initializer_stats(tiny_params):
+    # variance_scaling(1.0, fan_out, uniform): limit = sqrt(3/fan_out)
+    tok = np.asarray(tiny_params.token_embedding)
+    limit = np.sqrt(3.0 / tok.shape[1])
+    assert tok.max() <= limit and tok.min() >= -limit
+    tgt = np.asarray(tiny_params.target_embedding)
+    limit_t = np.sqrt(3.0 / tgt.shape[1])
+    assert tgt.max() <= limit_t and tgt.min() >= -limit_t
