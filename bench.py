@@ -1,0 +1,103 @@
+"""Benchmark: training throughput at java14m scale on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology mirrors the reference's throughput trace (windowed average over
+train steps, tensorflow_model.py:424-430) at the reference's headline
+configuration (config.py:47-70): batch 1024, 200 contexts/example, dims
+128/128/384, full java14m vocabularies (1.3M token / 911K path / 261K
+target). Baseline: ~4,700 examples/sec on a Tesla V100 (README.md:69,127 —
+14M examples / 50 min per epoch; BASELINE.md).
+
+Data is synthetic (uniform random indices): this measures the device compute
+path the way the reference's numbers measure theirs — the host input
+pipeline is benchmarked separately (it is overlap-hidden behind the step in
+training).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V100_BASELINE_EXAMPLES_PER_SEC = 4700.0
+
+TOKEN_VOCAB = 1301136
+PATH_VOCAB = 911417
+TARGET_VOCAB = 261245
+BATCH_SIZE = 1024
+MAX_CONTEXTS = 200
+WARMUP_STEPS = 10
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+    import jax
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data.reader import Batch
+    from code2vec_tpu.models.backends import create_backend
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    from code2vec_tpu.training.trainer import Trainer
+
+    n_devices = len(jax.devices())
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX='bench', DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='bfloat16', VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        TRAIN_BATCH_SIZE=BATCH_SIZE, TEST_BATCH_SIZE=BATCH_SIZE,
+        MAX_CONTEXTS=MAX_CONTEXTS,
+        MAX_TOKEN_VOCAB_SIZE=TOKEN_VOCAB, MAX_PATH_VOCAB_SIZE=PATH_VOCAB,
+        MAX_TARGET_VOCAB_SIZE=TARGET_VOCAB)
+
+    class _SizedVocab:
+        def __init__(self, size):
+            self.size = size
+
+    class _SizedVocabs:
+        token_vocab = _SizedVocab(TOKEN_VOCAB)
+        path_vocab = _SizedVocab(PATH_VOCAB)
+        target_vocab = _SizedVocab(TARGET_VOCAB)
+
+    backend = create_backend(config, _SizedVocabs())
+    trainer = Trainer(config, backend)
+    state = trainer.init_state(seed=0)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return Batch(
+            source=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            path=rng.integers(1, PATH_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            target=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            mask=np.ones((BATCH_SIZE, MAX_CONTEXTS), np.float32),
+            label=rng.integers(1, TARGET_VOCAB, (BATCH_SIZE,)).astype(np.int32),
+            weight=np.ones((BATCH_SIZE,), np.float32))
+
+    batches = [make_batch() for _ in range(4)]
+
+    # Per-step hard sync: honest under async dispatch (block_until_ready on
+    # the final loss under-reports through the device tunnel), and it is
+    # what the reference's per-step sess.run([optimizer, loss]) did
+    # (tensorflow_model.py:74-80).
+    for i in range(WARMUP_STEPS):
+        state, loss = trainer.train_step(state, batches[i % len(batches)])
+        float(loss)
+
+    start = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, loss = trainer.train_step(state, batches[i % len(batches)])
+        float(loss)
+    elapsed = time.perf_counter() - start
+
+    examples_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
+    per_chip = examples_per_sec / n_devices
+    print(json.dumps({
+        'metric': 'train_examples_per_sec_per_chip_java14m',
+        'value': round(per_chip, 1),
+        'unit': 'examples/sec/chip',
+        'vs_baseline': round(per_chip / V100_BASELINE_EXAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -20,8 +20,9 @@ shards (async-capable), and restore re-shards to the current mesh.
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -40,7 +41,8 @@ class RestoredTraining(NamedTuple):
 class CheckpointStore:
     """Orbax-backed store for one model path prefix."""
 
-    def __init__(self, model_path: str, max_to_keep: int = 10):
+    def __init__(self, model_path: str, max_to_keep: int = 10,
+                 metadata: Optional[Dict[str, Any]] = None):
         self.model_path = model_path
         self.entire_dir = os.path.abspath(
             Config.get_entire_model_path(model_path))
@@ -48,6 +50,29 @@ class CheckpointStore:
             Config.get_model_weights_path(model_path))
         self._manager: Optional[ocp.CheckpointManager] = None
         self.max_to_keep = max_to_keep
+        # shape-determining settings (e.g. PARAM_ROW_ALIGNMENT): written at
+        # save, verified before restore so a mismatch is a clear config
+        # error instead of an opaque orbax shape mismatch
+        self.metadata = metadata or {}
+        self.meta_path = os.path.abspath(model_path) + '.meta.json'
+
+    def _write_metadata(self) -> None:
+        if self.metadata:
+            with open(self.meta_path, 'w') as f:
+                json.dump(self.metadata, f)
+
+    def verify_metadata(self) -> None:
+        if not self.metadata or not os.path.isfile(self.meta_path):
+            return
+        with open(self.meta_path, 'r') as f:
+            stored = json.load(f)
+        for key, value in self.metadata.items():
+            if key in stored and stored[key] != value:
+                raise ValueError(
+                    'Checkpoint at `%s` was saved with %s=%r but the current '
+                    'config has %s=%r; these settings determine parameter '
+                    'shapes and must match.' % (self.model_path, key,
+                                                stored[key], key, value))
 
     # ------------------------------------------------------------- manager
     def manager(self) -> ocp.CheckpointManager:
@@ -71,6 +96,7 @@ class CheckpointStore:
                  'epoch': np.asarray(epoch, np.int32)}
         self.manager().save(epoch, args=ocp.args.StandardSave(state))
         self.manager().wait_until_finished()
+        self._write_metadata()
 
     def save_release(self, params) -> None:
         """Params-only artifact (the reference's ``--release``)."""
@@ -82,6 +108,7 @@ class CheckpointStore:
         checkpointer.save(path, {'params': params})
         checkpointer.wait_until_finished()
         checkpointer.close()
+        self._write_metadata()
 
     # ------------------------------------------------------------- restore
     def latest_epoch(self) -> Optional[int]:
@@ -96,6 +123,7 @@ class CheckpointStore:
         latest = self.latest_epoch()
         if latest is None:
             return None
+        self.verify_metadata()
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
@@ -109,6 +137,7 @@ class CheckpointStore:
         """Restore params only: prefer the released weights-only artifact,
         fall back to the newest full checkpoint (reference load order:
         whatever exists under the load path)."""
+        self.verify_metadata()
         if os.path.isdir(self.weights_dir):
             checkpointer = ocp.StandardCheckpointer()
             restored = checkpointer.restore(

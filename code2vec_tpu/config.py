@@ -56,6 +56,18 @@ class Config:
     # Learning rate for Adam (reference uses tf.train.AdamOptimizer defaults,
     # tensorflow_model.py:232 -> lr=0.001).
     LEARNING_RATE: float = 0.001
+    # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
+    # model mesh axis — order-free sequence parallelism for large bags: the
+    # attention softmax reductions become XLA collectives (SURVEY.md §5
+    # 'long-context'). Off by default (MAX_CONTEXTS=200 fits comfortably).
+    SHARD_CONTEXTS: bool = False
+    # Embedding tables are padded to a multiple of this many rows so they
+    # shard evenly over any model axis that DIVIDES this value (validated at
+    # Trainer construction), keeping checkpoint shapes topology-independent.
+    # Padded target rows are masked out of the softmax/top-k. Changing this
+    # changes checkpoint shapes — it is recorded in a checkpoint sidecar and
+    # verified on restore.
+    PARAM_ROW_ALIGNMENT: int = 128
     # Host input pipeline.
     READER_PREFETCH_BATCHES: int = 8
     READER_USE_NATIVE: bool = True  # use the C++ tokenizer when available

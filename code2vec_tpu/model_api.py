@@ -69,8 +69,15 @@ class Code2VecModel:
         if not config.RELEASE:
             self._init_num_of_examples()
         self.vocabs = Code2VecVocabs(config)
-        self._target_index_to_word = self.vocabs.target_vocab.index_to_word_array()
         self.backend = create_backend(config, self.vocabs)
+        # decode table padded to the (sharding-aligned) table size: padded
+        # indices can only surface when vocab_size < top_k, decode as OOV
+        true_decode = self.vocabs.target_vocab.index_to_word_array()
+        padded_size = self.backend.sizes['target_vocab_size']
+        self._target_index_to_word = np.full(
+            padded_size, self.vocabs.target_vocab.special_words.OOV,
+            dtype=object)
+        self._target_index_to_word[:true_decode.shape[0]] = true_decode
         self.mesh = mesh_lib.create_mesh(config)
         self.trainer = Trainer(config, self.backend, mesh=self.mesh)
         self.state: Optional[TrainerState] = None
@@ -103,7 +110,12 @@ class Code2VecModel:
         return num
 
     def _store_for(self, path: str) -> CheckpointStore:
-        return CheckpointStore(path, max_to_keep=self.config.MAX_TO_KEEP)
+        return CheckpointStore(
+            path, max_to_keep=self.config.MAX_TO_KEEP,
+            metadata={'param_row_alignment': self.config.PARAM_ROW_ALIGNMENT,
+                      'token_dim': self.config.TOKEN_EMBEDDINGS_SIZE,
+                      'path_dim': self.config.PATH_EMBEDDINGS_SIZE,
+                      'code_dim': self.config.CODE_VECTOR_SIZE})
 
     def _load_or_create(self) -> None:
         if self.config.is_loading:
@@ -325,12 +337,17 @@ class Code2VecModel:
                                         ) -> np.ndarray:
         """(reference tensorflow_model.py:379-403 — here a direct fetch)"""
         named = self.backend.named_params(self.params)
+        # slice off sharding-alignment padding rows: exports carry exactly
+        # vocab.size rows like the reference
         if vocab_type == VocabType.Token:
-            return np.asarray(named.token_embedding)
+            return np.asarray(named.token_embedding)[
+                :self.vocabs.token_vocab.size]
         if vocab_type == VocabType.Target:
-            return np.asarray(named.target_embedding)
+            return np.asarray(named.target_embedding)[
+                :self.vocabs.target_vocab.size]
         if vocab_type == VocabType.Path:
-            return np.asarray(named.path_embedding)
+            return np.asarray(named.path_embedding)[
+                :self.vocabs.path_vocab.size]
         raise ValueError('vocab_type must be a VocabType member.')
 
     def save_word2vec_format(self, dest_save_path: str,

@@ -31,6 +31,10 @@ def compute_dtype(config: Config) -> jnp.dtype:
     return jnp.bfloat16 if config.COMPUTE_DTYPE == 'bfloat16' else jnp.float32
 
 
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
 class JaxBackend:
     """Raw functional backend: params are a ``Code2VecParams`` NamedTuple."""
 
@@ -38,10 +42,15 @@ class JaxBackend:
 
     def __init__(self, config: Config, vocabs: Code2VecVocabs):
         self.config = config
+        align = max(config.PARAM_ROW_ALIGNMENT, 1)
+        # tables padded for even row-sharding over the model axis; padded
+        # token/path rows are never gathered, padded target columns are
+        # masked out of the softmax via num_valid_targets
+        self.num_valid_targets = vocabs.target_vocab.size
         self.sizes = dict(
-            token_vocab_size=vocabs.token_vocab.size,
-            path_vocab_size=vocabs.path_vocab.size,
-            target_vocab_size=vocabs.target_vocab.size,
+            token_vocab_size=_round_up(vocabs.token_vocab.size, align),
+            path_vocab_size=_round_up(vocabs.path_vocab.size, align),
+            target_vocab_size=_round_up(vocabs.target_vocab.size, align),
             token_dim=config.TOKEN_EMBEDDINGS_SIZE,
             path_dim=config.PATH_EMBEDDINGS_SIZE,
             code_dim=config.CODE_VECTOR_SIZE)
@@ -59,14 +68,15 @@ class JaxBackend:
             params, source, path, target, mask, label, weight,
             dropout_rng=dropout_rng,
             dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
-            dtype=self.dtype)
+            dtype=self.dtype, num_valid_targets=self.num_valid_targets)
 
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]
         code_vectors, attention = functional.encode(
             params, source, path, target, mask, dtype=self.dtype)
-        logits = functional.compute_logits(params, code_vectors,
-                                           dtype=self.dtype)
+        logits = functional.compute_logits(
+            params, code_vectors, dtype=self.dtype,
+            num_valid_targets=self.num_valid_targets)
         return code_vectors, attention, logits
 
     def named_params(self, params) -> functional.Code2VecParams:
@@ -82,16 +92,19 @@ class FlaxBackend:
     def __init__(self, config: Config, vocabs: Code2VecVocabs):
         self.config = config
         self.dtype = compute_dtype(config)
+        self._jax_twin = JaxBackend(config, vocabs)
+        sizes = self.sizes = self._jax_twin.sizes
+        self.num_valid_targets = self._jax_twin.num_valid_targets
         self.module = Code2VecModule(
-            token_vocab_size=vocabs.token_vocab.size,
-            path_vocab_size=vocabs.path_vocab.size,
-            target_vocab_size=vocabs.target_vocab.size,
+            token_vocab_size=sizes['token_vocab_size'],
+            path_vocab_size=sizes['path_vocab_size'],
+            target_vocab_size=sizes['target_vocab_size'],
             token_dim=config.TOKEN_EMBEDDINGS_SIZE,
             path_dim=config.PATH_EMBEDDINGS_SIZE,
             code_dim=config.CODE_VECTOR_SIZE,
             dropout_keep_rate=config.DROPOUT_KEEP_RATE,
-            compute_dtype=self.dtype)
-        self._jax_twin = JaxBackend(config, vocabs)
+            compute_dtype=self.dtype,
+            num_valid_targets=self.num_valid_targets)
 
     def init(self, rng: jax.Array):
         dummy = jnp.zeros((1, self.config.MAX_CONTEXTS), dtype=jnp.int32)

@@ -28,6 +28,8 @@ class Code2VecModule(nn.Module):
     code_dim: int = 384
     dropout_keep_rate: float = 0.75
     compute_dtype: jnp.dtype = jnp.float32
+    # true target-vocab size when target_vocab_size is padded for sharding
+    num_valid_targets: Optional[int] = None
 
     def _params(self) -> functional.Code2VecParams:
         fan_out_uniform = jax.nn.initializers.variance_scaling(
@@ -63,6 +65,7 @@ class Code2VecModule(nn.Module):
             params, source, path, target, mask, dropout_rng=dropout_rng,
             dropout_keep_rate=self.dropout_keep_rate,
             dtype=self.compute_dtype)
-        logits = functional.compute_logits(params, code_vectors,
-                                           dtype=self.compute_dtype)
+        logits = functional.compute_logits(
+            params, code_vectors, dtype=self.compute_dtype,
+            num_valid_targets=self.num_valid_targets)
         return code_vectors, attention_weights, logits

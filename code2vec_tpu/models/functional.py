@@ -143,15 +143,26 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
 
 
 def compute_logits(params: Code2VecParams, code_vectors: jax.Array,
-                   dtype: jnp.dtype = jnp.float32) -> jax.Array:
+                   dtype: jnp.dtype = jnp.float32,
+                   num_valid_targets: Optional[int] = None) -> jax.Array:
     """code vectors → target-vocab logits, fp32 out
-    (reference tensorflow_model.py:226, 297)."""
+    (reference tensorflow_model.py:226, 297).
+
+    ``num_valid_targets``: true target-vocab size when the table is padded
+    for even sharding — padded columns are masked to a large negative so
+    they drop out of both the softmax partition function and top-k."""
     precision = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
                  else jax.lax.Precision.DEFAULT)
     logits = jnp.matmul(code_vectors.astype(dtype),
                         params.target_embedding.astype(dtype).T,
                         precision=precision)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    padded = params.target_embedding.shape[0]
+    if num_valid_targets is not None and num_valid_targets < padded:
+        col = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                       logits.ndim - 1)
+        logits = jnp.where(col < num_valid_targets, logits, -1e9)
+    return logits
 
 
 def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
@@ -159,14 +170,16 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  weight: jax.Array, *,
                  dropout_rng: Optional[jax.Array] = None,
                  dropout_keep_rate: float = 1.0,
-                 dtype: jnp.dtype = jnp.float32):
+                 dtype: jnp.dtype = jnp.float32,
+                 num_valid_targets: Optional[int] = None):
     """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
     divides the CE sum by the dynamic batch size; with static shapes the
     per-example weight plays that role: padded rows have weight 0)."""
     code_vectors, _ = encode(
         params, source, path, target, mask, dropout_rng=dropout_rng,
         dropout_keep_rate=dropout_keep_rate, dtype=dtype)
-    logits = compute_logits(params, code_vectors, dtype=dtype)
+    logits = compute_logits(params, code_vectors, dtype=dtype,
+                            num_valid_targets=num_valid_targets)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     ce = -jnp.take_along_axis(log_probs, label[:, None], axis=1)[:, 0]
     denom = jnp.maximum(weight.sum(), 1.0)

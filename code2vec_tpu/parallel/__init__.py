@@ -1,6 +1,8 @@
 from code2vec_tpu.parallel.mesh import (
-    DATA_AXIS, MODEL_AXIS, batch_sharding, create_mesh, param_sharding,
-    param_specs, shard_batch, shard_params)
+    DATA_AXIS, MODEL_AXIS, attach_shardings, batch_spec, create_mesh,
+    param_sharding, param_specs, shard_batch, shard_params,
+    sharding_for_tree)
 
-__all__ = ['DATA_AXIS', 'MODEL_AXIS', 'batch_sharding', 'create_mesh',
-           'param_sharding', 'param_specs', 'shard_batch', 'shard_params']
+__all__ = ['DATA_AXIS', 'MODEL_AXIS', 'attach_shardings', 'batch_spec',
+           'create_mesh', 'param_sharding', 'param_specs', 'shard_batch',
+           'shard_params', 'sharding_for_tree']

@@ -66,18 +66,19 @@ def param_specs() -> Code2VecParams:
     )
 
 
-def batch_spec() -> P:
-    """Every per-example array is sharded over the batch (data) axis."""
+def batch_spec(ndim: int = 1, shard_contexts: bool = False) -> P:
+    """Per-example arrays shard over the batch (data) axis; with
+    ``shard_contexts``, 2-D (batch, contexts) arrays additionally shard the
+    contexts axis over the model axis — order-free sequence parallelism for
+    large bags (the attention reductions compile to XLA collectives)."""
+    if ndim >= 2 and shard_contexts:
+        return P(DATA_AXIS, MODEL_AXIS)
     return P(DATA_AXIS)
 
 
 def param_sharding(mesh: Mesh) -> Code2VecParams:
     specs = param_specs()
     return Code2VecParams(*[NamedSharding(mesh, spec) for spec in specs])
-
-
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec())
 
 
 def shard_params(params, mesh: Mesh):
@@ -129,7 +130,10 @@ def attach_shardings(abstract_tree, mesh: Mesh):
         abstract_tree, shardings)
 
 
-def shard_batch(arrays, mesh: Mesh):
-    """Place a tuple of per-example numpy arrays onto the data axis."""
-    sharding = batch_sharding(mesh)
-    return tuple(jax.device_put(a, sharding) for a in arrays)
+def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False):
+    """Place a tuple of per-example numpy arrays onto the mesh: batch over
+    ``data``; optionally contexts over ``model`` for 2-D arrays."""
+    return tuple(
+        jax.device_put(a, NamedSharding(
+            mesh, batch_spec(np.ndim(a), shard_contexts)))
+        for a in arrays)

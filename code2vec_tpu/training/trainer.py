@@ -43,11 +43,21 @@ class Trainer:
         self.backend = backend
         self.mesh = mesh if mesh is not None else mesh_lib.create_mesh(config)
         data_size = self.mesh.shape[mesh_lib.DATA_AXIS]
+        model_size = self.mesh.shape[mesh_lib.MODEL_AXIS]
         for attr in ('TRAIN_BATCH_SIZE', 'TEST_BATCH_SIZE'):
             if getattr(config, attr) % data_size:
                 raise ValueError(
                     '%s=%d must be divisible by the mesh data axis (%d).'
                     % (attr, getattr(config, attr), data_size))
+        if config.SHARD_CONTEXTS and config.MAX_CONTEXTS % model_size:
+            raise ValueError(
+                'SHARD_CONTEXTS requires MAX_CONTEXTS=%d divisible by the '
+                'mesh model axis (%d).' % (config.MAX_CONTEXTS, model_size))
+        if config.PARAM_ROW_ALIGNMENT % model_size:
+            raise ValueError(
+                'PARAM_ROW_ALIGNMENT=%d must be divisible by the mesh model '
+                'axis (%d) for even table sharding.'
+                % (config.PARAM_ROW_ALIGNMENT, model_size))
         # Reference uses tf.train.AdamOptimizer() defaults
         # (tensorflow_model.py:232): lr=1e-3, b1=0.9, b2=0.999, eps=1e-8.
         self.optimizer = optax.adam(config.LEARNING_RATE)
@@ -101,9 +111,18 @@ class Trainer:
         init_rng, train_rng = jax.random.split(jax.random.PRNGKey(seed))
         params = self.backend.init(init_rng)
         params = mesh_lib.shard_params(params, self.mesh)
-        opt_state = jax.jit(self.optimizer.init)(params)
+        opt_state = self._init_opt_state(params)
         return TrainerState(params=params, opt_state=opt_state,
                             step=jnp.zeros((), jnp.int32), rng=train_rng)
+
+    def _init_opt_state(self, params):
+        # explicit out_shardings: Adam moments must mirror the (row-sharded)
+        # parameter layout — jit alone does not propagate input shardings
+        # to the opt-state outputs
+        abstract_opt = jax.eval_shape(self.optimizer.init, params)
+        opt_shardings = mesh_lib.sharding_for_tree(abstract_opt, self.mesh)
+        return jax.jit(self.optimizer.init,
+                       out_shardings=opt_shardings)(params)
 
     def abstract_state(self) -> Tuple[Any, Any]:
         """(abstract_params, abstract_opt_state) with *current-mesh*
@@ -119,7 +138,7 @@ class Trainer:
     def state_from_params(self, params, step: int = 0,
                           seed: int = 42) -> TrainerState:
         params = mesh_lib.shard_params(params, self.mesh)
-        opt_state = jax.jit(self.optimizer.init)(params)
+        opt_state = self._init_opt_state(params)
         return TrainerState(params=params, opt_state=opt_state,
                             step=jnp.asarray(step, jnp.int32),
                             rng=jax.random.PRNGKey(seed))
@@ -127,15 +146,18 @@ class Trainer:
     # --------------------------------------------------------------- steps
     def train_step(self, state: TrainerState, batch: Batch
                    ) -> Tuple[TrainerState, jax.Array]:
-        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
+                                      self.config.SHARD_CONTEXTS)
         return self._train_step(state, arrays)
 
     def eval_step(self, params, batch: Batch) -> dict:
-        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
+                                      self.config.SHARD_CONTEXTS)
         return self._eval_step(params, arrays)
 
     def predict_step(self, params, batch: Batch) -> dict:
-        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh)
+        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
+                                      self.config.SHARD_CONTEXTS)
         return self._predict_step(params, arrays)
 
     # ----------------------------------------------------------- main loop
@@ -159,7 +181,9 @@ class Trainer:
                 window_losses.append(loss)
                 window_examples += batch.num_valid_examples
                 if batch_num % log_every == 0:
-                    sum_loss = float(jnp.stack(window_losses).sum())
+                    # device_get, not eager jnp ops: stacking mesh-sharded
+                    # scalars eagerly aborts in jaxlib on CPU meshes
+                    sum_loss = float(np.sum(jax.device_get(window_losses)))
                     elapsed = time.time() - window_start
                     throughput = window_examples / max(elapsed, 1e-9)
                     config.log(

@@ -1,0 +1,180 @@
+"""Multi-chip semantics on the 8-virtual-device CPU mesh (SURVEY.md §4):
+DP-only, TP-only and mixed meshes must produce the same numbers as a
+single-device run — sharding is configuration, not semantics."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import Batch
+from code2vec_tpu.models.backends import create_backend
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.training.trainer import Trainer
+from code2vec_tpu.vocab import Code2VecVocabs
+
+
+def _make_batch(rng, B=16, C=8, Vt=40, Vp=12):
+    source = rng.integers(1, Vt, (B, C)).astype(np.int32)
+    path = rng.integers(1, Vp, (B, C)).astype(np.int32)
+    target = rng.integers(1, Vt, (B, C)).astype(np.int32)
+    mask = np.ones((B, C), np.float32)
+    label = rng.integers(1, 20, (B,)).astype(np.int32)
+    weight = np.ones((B,), np.float32)
+    return Batch(source=source, path=path, target=target, mask=mask,
+                 label=label, weight=weight)
+
+
+def _config(data_axis, model_axis, framework='jax'):
+    return Config(
+        TRAIN_DATA_PATH_PREFIX='unused', DL_FRAMEWORK=framework,
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=8, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        MESH_DATA_AXIS_SIZE=data_axis, MESH_MODEL_AXIS_SIZE=model_axis,
+        MAX_TOKEN_VOCAB_SIZE=40, MAX_PATH_VOCAB_SIZE=12,
+        MAX_TARGET_VOCAB_SIZE=24, TOKEN_EMBEDDINGS_SIZE=8,
+        PATH_EMBEDDINGS_SIZE=8, CODE_VECTOR_SIZE=24,
+        TARGET_EMBEDDINGS_SIZE=24, LEARNING_RATE=0.01)
+
+
+class _FakeVocab:
+    def __init__(self, size):
+        self.size = size
+
+
+class _FakeVocabs:
+    def __init__(self, vt, vp, vy):
+        self.token_vocab = _FakeVocab(vt)
+        self.path_vocab = _FakeVocab(vp)
+        self.target_vocab = _FakeVocab(vy)
+
+
+def _trainer(data_axis, model_axis, framework='jax'):
+    config = _config(data_axis, model_axis, framework)
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend = create_backend(config, vocabs)
+    return Trainer(config, backend)
+
+
+def _run_steps(trainer, n=3, seed=0):
+    state = trainer.init_state(seed=123)
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(n):
+        batch = _make_batch(rng)
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    return state, losses
+
+
+def test_mesh_shapes():
+    assert mesh_lib.create_mesh(_config(8, 1)).shape == {'data': 8, 'model': 1}
+    assert mesh_lib.create_mesh(_config(4, 2)).shape == {'data': 4, 'model': 2}
+    assert mesh_lib.create_mesh(_config(-1, 2)).shape == {'data': 4, 'model': 2}
+    with pytest.raises(ValueError):
+        mesh_lib.create_mesh(_config(3, 2))
+
+
+def test_param_placement_on_mixed_mesh():
+    trainer = _trainer(4, 2)
+    state = trainer.init_state()
+    named = trainer.backend.named_params(state.params)
+    # embeddings row-sharded over model axis
+    assert named.token_embedding.sharding.spec == P('model', None)
+    assert named.target_embedding.sharding.spec == P('model', None)
+    # dense params replicated
+    assert named.transform.sharding.spec in (P(), P(None, None))
+    # Adam moments inherit the table sharding (name-based mapping)
+    mu = state.opt_state[0].mu
+    leaf = mu.token_embedding if hasattr(mu, 'token_embedding') \
+        else mu['token_embedding']
+    assert leaf.sharding.spec == P('model', None)
+
+
+@pytest.mark.parametrize('mesh_shape', [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_training_matches_single_device(mesh_shape):
+    # ground truth: 1x1 mesh on device 0
+    config1 = _config(1, 1)
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend1 = create_backend(config1, vocabs)
+    mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
+    trainer1 = Trainer(config1, backend1, mesh=mesh1)
+    _, losses1 = _run_steps(trainer1)
+
+    trainerN = _trainer(*mesh_shape)
+    _, lossesN = _run_steps(trainerN)
+    np.testing.assert_allclose(losses1, lossesN, rtol=2e-4, atol=1e-5)
+
+
+def test_eval_step_on_sharded_mesh_matches_single_device():
+    config1 = _config(1, 1)
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend1 = create_backend(config1, vocabs)
+    mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
+    trainer1 = Trainer(config1, backend1, mesh=mesh1)
+    state1, _ = _run_steps(trainer1)
+
+    trainerN = _trainer(2, 4)
+    stateN, _ = _run_steps(trainerN)
+
+    rng = np.random.default_rng(7)
+    batch = _make_batch(rng)
+    out1 = trainer1.eval_step(state1.params, batch)
+    outN = trainerN.eval_step(stateN.params, batch)
+    np.testing.assert_array_equal(np.asarray(out1['topk_indices']),
+                                  np.asarray(outN['topk_indices']))
+    np.testing.assert_allclose(np.asarray(out1['topk_scores']),
+                               np.asarray(outN['topk_scores']),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_shard_contexts_divisibility_validated_upfront():
+    config = _config(2, 4)
+    config.SHARD_CONTEXTS = True
+    config.MAX_CONTEXTS = 6  # not divisible by model axis 4
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend = create_backend(config, vocabs)
+    with pytest.raises(ValueError, match='SHARD_CONTEXTS'):
+        Trainer(config, backend)
+
+
+def test_row_alignment_divisibility_validated_upfront():
+    config = _config(2, 4)
+    config.PARAM_ROW_ALIGNMENT = 6  # not divisible by model axis 4
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend = create_backend(config, vocabs)
+    with pytest.raises(ValueError, match='PARAM_ROW_ALIGNMENT'):
+        Trainer(config, backend)
+
+
+def test_shard_contexts_training_matches_unsharded():
+    config = _config(2, 4)
+    config.SHARD_CONTEXTS = True  # MAX_CONTEXTS=8 divisible by 4
+    vocabs = _FakeVocabs(40, 12, 24)
+    backend = create_backend(config, vocabs)
+    trainer_sp = Trainer(config, backend)
+    _, losses_sp = _run_steps(trainer_sp)
+
+    config1 = _config(1, 1)
+    backend1 = create_backend(config1, _FakeVocabs(40, 12, 24))
+    mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
+    trainer1 = Trainer(config1, backend1, mesh=mesh1)
+    _, losses1 = _run_steps(trainer1)
+    np.testing.assert_allclose(losses1, losses_sp, rtol=2e-4, atol=1e-5)
+
+
+def test_checkpoint_metadata_mismatch_is_clear_error(tmp_path):
+    from code2vec_tpu.checkpoints import CheckpointStore
+    store = CheckpointStore(str(tmp_path / 'm'),
+                            metadata={'param_row_alignment': 128})
+    store._write_metadata()
+    store2 = CheckpointStore(str(tmp_path / 'm'),
+                             metadata={'param_row_alignment': 256})
+    with pytest.raises(ValueError, match='param_row_alignment'):
+        store2.verify_metadata()
+
+
+def test_flax_backend_shards_too():
+    trainer = _trainer(4, 2, framework='flax')
+    _, losses = _run_steps(trainer, n=2)
+    assert all(np.isfinite(losses))
