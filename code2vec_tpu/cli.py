@@ -1,0 +1,53 @@
+"""CLI entry point (reference code2vec.py:16-38 dispatch).
+
+    python -m code2vec_tpu.cli --data ds --test ds.val.c2v --save models/m/s
+    python -m code2vec_tpu.cli --load models/m/s --test ds.test.c2v
+    python -m code2vec_tpu.cli --load models/m/s --predict
+    python -m code2vec_tpu.cli --load models/m/s --release
+    python -m code2vec_tpu.cli --load models/m/s --save_word2v tokens.txt
+
+The backend ('flax' | 'jax') is selected at runtime with ``--framework``
+(the reference selected 'tensorflow' | 'keras' the same way,
+code2vec.py:7-13).
+"""
+from __future__ import annotations
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.vocab import VocabType
+
+
+def main(args=None) -> None:
+    config = Config().load_from_args(args)
+    config.verify()
+
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+    config.log('Done creating code2vec model')
+
+    if config.is_training:
+        model.train()
+    if config.SAVE_W2V is not None:
+        model.save_word2vec_format(config.SAVE_W2V, VocabType.Token)
+        config.log('Origin word vectors saved in word2vec text format in: %s'
+                   % config.SAVE_W2V)
+    if config.SAVE_T2V is not None:
+        model.save_word2vec_format(config.SAVE_T2V, VocabType.Target)
+        config.log('Target word vectors saved in word2vec text format in: %s'
+                   % config.SAVE_T2V)
+    # evaluate standalone only: training already evaluates per epoch
+    # (reference code2vec.py:28-33)
+    if config.is_testing and not config.is_training:
+        eval_results = model.evaluate()
+        if eval_results is not None:
+            config.log(str(eval_results).replace('topk', 'top%d' % (
+                config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)))
+    if config.PREDICT:
+        from code2vec_tpu.serving.predict import InteractivePredictor
+        predictor = InteractivePredictor(config, model)
+        predictor.predict()
+    if config.RELEASE and config.is_loading:
+        model.release_model()
+
+
+if __name__ == '__main__':
+    main()

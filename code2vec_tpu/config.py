@@ -164,9 +164,14 @@ class Config:
         if parsed.compute_dtype:
             self.COMPUTE_DTYPE = parsed.compute_dtype
         if parsed.mesh:
-            data_sz, model_sz = parsed.mesh.lower().split('x')
-            self.MESH_DATA_AXIS_SIZE = int(data_sz)
-            self.MESH_MODEL_AXIS_SIZE = int(model_sz)
+            try:
+                data_sz, model_sz = parsed.mesh.lower().split('x')
+                self.MESH_DATA_AXIS_SIZE = int(data_sz)
+                self.MESH_MODEL_AXIS_SIZE = int(model_sz)
+            except ValueError:
+                raise ValueError(
+                    "--mesh must look like DATAxMODEL (e.g. '4x2'), got %r"
+                    % parsed.mesh)
         if parsed.batch_size:
             self.TRAIN_BATCH_SIZE = parsed.batch_size
             self.TEST_BATCH_SIZE = parsed.batch_size

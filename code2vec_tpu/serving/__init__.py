@@ -1,0 +1,4 @@
+from code2vec_tpu.serving.extractor_bridge import Extractor
+from code2vec_tpu.serving.predict import InteractivePredictor
+
+__all__ = ['Extractor', 'InteractivePredictor']

@@ -1,0 +1,107 @@
+"""Bridge to an out-of-process path-context extractor.
+
+TPU-native equivalent of the reference's ``extractor.py``: shells out to an
+extractor CLI per request (reference ran
+``java -cp JAR JavaExtractor.App --no_hash`` per REPL turn, extractor.py:12-19),
+truncates to MAX_CONTEXTS (head-truncation at predict time, :27), and
+re-hashes path strings with a Java ``String#hashCode`` clone to build the
+hash→string dict used to display attention paths (:40-49).
+
+The extractor command is pluggable: the native C++ extractor shipped with
+this framework (``extractor/build/c2v-extract``), a reference-compatible JAR,
+or anything flag-compatible with them.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+from code2vec_tpu import common
+from code2vec_tpu.config import Config
+
+_NATIVE_EXTRACTOR_CANDIDATES = (
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'extractor', 'build', 'c2v-extract'),
+    'c2v-extract',
+)
+
+
+def find_default_extractor() -> Optional[List[str]]:
+    """Locate the native extractor binary (preferred) or a reference JAR."""
+    for candidate in _NATIVE_EXTRACTOR_CANDIDATES:
+        path = shutil.which(candidate) or (
+            candidate if os.path.isfile(candidate)
+            and os.access(candidate, os.X_OK) else None)
+        if path:
+            return [path]
+    jar = os.environ.get('CODE2VEC_EXTRACTOR_JAR')
+    if jar and os.path.isfile(jar):
+        return ['java', '-cp', jar, 'JavaExtractor.App']
+    return None
+
+
+class Extractor:
+    def __init__(self, config: Config,
+                 extractor_command: Optional[List[str]] = None,
+                 max_path_length: int = 8, max_path_width: int = 2):
+        self.config = config
+        self.max_path_length = max_path_length
+        self.max_path_width = max_path_width
+        self.command = extractor_command or find_default_extractor()
+        if self.command is None:
+            raise RuntimeError(
+                'No path-context extractor found. Build the native one '
+                '(extractor/README.md) or set CODE2VEC_EXTRACTOR_JAR.')
+
+    def extract_paths(self, input_path: str
+                      ) -> Tuple[List[str], Dict[str, str]]:
+        """Run the extractor on one source file.
+
+        Returns (prediction-ready context lines with hashed paths,
+        hash→path-string dict for display) — reference extractor.py:12-49.
+        """
+        command = self.command + [
+            '--max_path_length', str(self.max_path_length),
+            '--max_path_width', str(self.max_path_width),
+            '--file', input_path, '--no_hash']
+        try:
+            proc = subprocess.run(command, capture_output=True, text=True)
+        except OSError as e:
+            # surfaced as ValueError so the REPL loop reports and continues
+            raise ValueError('failed to run extractor %r: %s'
+                             % (self.command, e))
+        if proc.returncode != 0:
+            raise ValueError(proc.stderr.strip()
+                             or 'extractor failed with code %d'
+                             % proc.returncode)
+        output_lines = [line for line in proc.stdout.splitlines()
+                        if line.strip()]
+        if not output_lines:
+            raise ValueError('cannot extract any paths from the input file'
+                             + (': ' + proc.stderr.strip()
+                                if proc.stderr.strip() else ''))
+
+        # keyed by the DECIMAL STRING of the hash: attention contexts come
+        # back from the model as strings (reference extractor.py:32-33)
+        hash_to_string: Dict[str, str] = {}
+        result: List[str] = []
+        for line in output_lines:
+            parts = line.rstrip().split(' ')
+            method_name = parts[0]
+            contexts = parts[1:self.config.MAX_CONTEXTS + 1]  # head-truncate
+            hashed_contexts = []
+            for context in contexts:
+                pieces = context.split(',')
+                if len(pieces) != 3:
+                    continue
+                source, path_string, target = pieces
+                hashed_path = str(common.java_string_hashcode(path_string))
+                hash_to_string[hashed_path] = path_string
+                hashed_contexts.append(
+                    '%s,%s,%s' % (source, hashed_path, target))
+            padding = ' ' * (self.config.MAX_CONTEXTS - len(hashed_contexts))
+            result.append(method_name + ' ' + ' '.join(hashed_contexts)
+                          + padding)
+        return result, hash_to_string

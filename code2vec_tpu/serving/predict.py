@@ -1,0 +1,63 @@
+"""Interactive prediction REPL (reference interactive_predict.py:28-57).
+
+Loop: user edits ``Input.java`` → extractor subprocess produces path
+contexts → model predicts → print top-k names with probabilities,
+per-context attention (paths un-hashed for display), and optionally the
+code vector.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from code2vec_tpu import common
+from code2vec_tpu.config import Config
+from code2vec_tpu.serving.extractor_bridge import Extractor
+
+SHOW_TOP_CONTEXTS = 10           # reference interactive_predict.py:6
+DEFAULT_INPUT_FILENAME = 'Input.java'
+EXIT_KEYWORDS = ['exit', 'quit', 'q']
+
+
+class InteractivePredictor:
+    def __init__(self, config: Config, model,
+                 extractor: Optional[Extractor] = None,
+                 input_filename: str = DEFAULT_INPUT_FILENAME):
+        self.config = config
+        self.model = model
+        self.path_extractor = extractor or Extractor(config)
+        self.input_filename = input_filename
+
+    def predict(self) -> None:
+        print('Starting interactive prediction...')
+        while True:
+            print('Modify the file: "%s" and press any key when ready, or '
+                  '"q" / "quit" / "exit" to exit' % self.input_filename)
+            user_input = input()
+            if user_input.lower() in EXIT_KEYWORDS:
+                print('Exiting...')
+                return
+            try:
+                predict_lines, hash_to_string_dict = \
+                    self.path_extractor.extract_paths(self.input_filename)
+            except ValueError as e:
+                print(e)
+                continue
+            raw_results = self.model.predict(predict_lines)
+            results = common.parse_prediction_results(
+                raw_results, hash_to_string_dict,
+                self.model.vocabs.target_vocab.special_words.OOV,
+                topk=SHOW_TOP_CONTEXTS)
+            for raw_result, method_result in zip(raw_results, results):
+                print('Original name:\t' + method_result.original_name)
+                for name_prob_pair in method_result.predictions:
+                    print('\t(%f) predicted: %s' % (
+                        name_prob_pair['probability'],
+                        name_prob_pair['name']))
+                print('Attention:')
+                for attention in method_result.attention_paths:
+                    print('%f\tcontext: %s,%s,%s' % (
+                        attention['score'], attention['token1'],
+                        attention['path'], attention['token2']))
+                if self.config.EXPORT_CODE_VECTORS:
+                    print('Code vector:')
+                    print(' '.join(map(str, raw_result.code_vector)))

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+###########################################################
+# train.sh — canonical training invocation
+# (role of the reference's train.sh:9-18)
+# Change the following values to train a new model.
+# type: the name of the new model.
+# dataset_name: the name of the dataset, as was preprocessed.
+# data_dir: directory containing the preprocessed data.
+type=${TYPE:-code2vec_tpu_model}
+dataset_name=${DATASET_NAME:-java14m}
+data_dir=${DATA_DIR:-data/${dataset_name}}
+data=${data_dir}/${dataset_name}
+test_data=${data_dir}/${dataset_name}.val.c2v
+model_dir=${MODEL_DIR:-models/${type}}
+
+set -e
+mkdir -p "${model_dir}"
+exec python -u -m code2vec_tpu.cli \
+  --data "${data}" \
+  --test "${test_data}" \
+  --save "${model_dir}/saved_model" \
+  "$@"

@@ -1,0 +1,70 @@
+"""End-to-end CLI dispatch tests (reference code2vec.py:16-38 flows).
+
+One model is trained once per module and shared by the eval/export/release
+tests (training is the slow part: jit compile + 2 epochs).
+"""
+import pytest
+
+from code2vec_tpu.cli import main
+from tests.test_train_overfit import make_dataset
+
+
+@pytest.fixture(scope='module')
+def trained_model(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp('cli')
+    prefix = make_dataset(tmp_path)
+    save = tmp_path / 'models' / 'm' / 'saved_model'
+    main(['--data', str(prefix), '--test', str(tmp_path / 'tiny.val.c2v'),
+          '--framework', 'jax', '--dtype', 'float32', '--batch-size', '16',
+          '--epochs', '2', '--save', str(save), '-v', '0'])
+    return tmp_path, save
+
+
+def test_cli_train_eval_save(trained_model):
+    tmp_path, save = trained_model
+    assert (tmp_path / 'models' / 'm' / 'dictionaries.bin').exists()
+    assert (tmp_path / 'models' / 'm' / 'saved_model__entire-model').is_dir()
+
+
+def test_cli_eval_only_and_release(trained_model):
+    tmp_path, save = trained_model
+    main(['--load', str(save), '--test', str(tmp_path / 'tiny.val.c2v'),
+          '--framework', 'jax', '--dtype', 'float32', '--batch-size', '16',
+          '-v', '0'])
+    main(['--load', str(save), '--release', '--framework', 'jax',
+          '--dtype', 'float32', '-v', '0'])
+    assert (tmp_path / 'models' / 'm' / 'saved_model__only-weights').is_dir()
+
+
+def test_cli_w2v_export(trained_model):
+    tmp_path, save = trained_model
+    w2v = tmp_path / 'tokens.w2v'
+    t2v = tmp_path / 'targets.w2v'
+    main(['--load', str(save), '--save_word2v', str(w2v),
+          '--save_target2v', str(t2v), '--framework', 'jax',
+          '--dtype', 'float32', '-v', '0'])
+    assert w2v.exists() and t2v.exists()
+    header = w2v.read_text().splitlines()[0].split()
+    assert int(header[1]) == 128  # token embedding dim (default)
+
+
+def test_cli_export_code_vectors(trained_model):
+    tmp_path, save = trained_model
+    main(['--load', str(save), '--test', str(tmp_path / 'tiny.val.c2v'),
+          '--export_code_vectors', '--framework', 'jax', '--dtype', 'float32',
+          '--batch-size', '16', '-v', '0'])
+    vectors = tmp_path / 'tiny.val.c2v.vectors'
+    assert vectors.exists()
+    lines = vectors.read_text().splitlines()
+    assert len(lines) == 16  # val examples
+    assert len(lines[0].split()) == 384  # code vector size
+
+
+def test_cli_requires_train_or_load():
+    with pytest.raises(ValueError):
+        main(['-v', '0'])
+
+
+def test_cli_bad_mesh_is_clear_error():
+    with pytest.raises(ValueError, match='DATAxMODEL'):
+        main(['--data', 'x', '--mesh', 'bogus', '-v', '0'])

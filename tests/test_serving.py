@@ -1,0 +1,101 @@
+"""Extractor bridge + interactive REPL tests with a scripted fake
+extractor (the real native extractor has its own golden tests)."""
+import sys
+
+import pytest
+
+from code2vec_tpu import common
+from code2vec_tpu.config import Config
+from code2vec_tpu.serving.extractor_bridge import Extractor
+from code2vec_tpu.serving.predict import InteractivePredictor
+from tests.test_train_overfit import make_dataset
+
+FAKE_OUTPUT = ('get|a toka0,pA,toka1 toka1,pB,toka2\n'
+               'set|b tokb0,pA,tokb1\n')
+
+
+@pytest.fixture
+def fake_extractor(tmp_path):
+    """A stand-in extractor CLI that emits fixed context lines."""
+    script = tmp_path / 'fake_extract.py'
+    script.write_text(
+        'import sys\n'
+        'args = sys.argv[1:]\n'
+        'assert "--no_hash" in args\n'
+        'assert "--file" in args\n'
+        'path = args[args.index("--file") + 1]\n'
+        'open(path)\n'  # must exist
+        'sys.stdout.write(%r)\n' % FAKE_OUTPUT)
+    return [sys.executable, str(script)]
+
+
+def test_extractor_hashes_paths_and_builds_unhash_dict(tmp_path,
+                                                       fake_extractor):
+    config = Config(TRAIN_DATA_PATH_PREFIX='x', MAX_CONTEXTS=4,
+                    VERBOSE_MODE=0)
+    input_file = tmp_path / 'Input.java'
+    input_file.write_text('class X {}')
+    extractor = Extractor(config, extractor_command=fake_extractor)
+    lines, unhash = extractor.extract_paths(str(input_file))
+    assert len(lines) == 2
+    first = lines[0].split(' ')
+    assert first[0] == 'get|a'
+    src, hashed, tgt = first[1].split(',')
+    assert src == 'toka0' and tgt == 'toka1'
+    assert hashed == str(common.java_string_hashcode('pA'))
+    assert unhash[hashed] == 'pA'
+    # padded to MAX_CONTEXTS fields
+    assert len(lines[0].rstrip('\n').split(' ')) - 1 == 4
+
+
+def test_extractor_missing_input_raises(tmp_path, fake_extractor):
+    config = Config(TRAIN_DATA_PATH_PREFIX='x', MAX_CONTEXTS=4,
+                    VERBOSE_MODE=0)
+    extractor = Extractor(config, extractor_command=fake_extractor)
+    with pytest.raises(ValueError):
+        extractor.extract_paths(str(tmp_path / 'missing.java'))
+
+
+def test_extractor_head_truncates(tmp_path):
+    config = Config(TRAIN_DATA_PATH_PREFIX='x', MAX_CONTEXTS=1,
+                    VERBOSE_MODE=0)
+    script = tmp_path / 'many.py'
+    script.write_text(
+        "import sys\n"
+        "sys.stdout.write('m a,p1,b c,p2,d e,p3,f\\n')\n")
+    input_file = tmp_path / 'Input.java'
+    input_file.write_text('x')
+    extractor = Extractor(config,
+                          extractor_command=[sys.executable, str(script)])
+    lines, unhash = extractor.extract_paths(str(input_file))
+    contexts = [c for c in lines[0].split(' ')[1:] if c]
+    assert len(contexts) == 1  # head-truncation (reference extractor.py:27)
+    assert str(common.java_string_hashcode('p1')) in unhash
+
+
+def test_interactive_repl_end_to_end(tmp_path, fake_extractor, monkeypatch,
+                                     capsys):
+    prefix = make_dataset(tmp_path)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False)
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+
+    input_file = tmp_path / 'Input.java'
+    input_file.write_text('class X {}')
+    extractor = Extractor(config, extractor_command=fake_extractor)
+    predictor = InteractivePredictor(config, model, extractor=extractor,
+                                     input_filename=str(input_file))
+
+    answers = iter(['', 'q'])
+    monkeypatch.setattr('builtins.input', lambda: next(answers))
+    predictor.predict()
+    out = capsys.readouterr().out
+    assert 'Original name:\tget|a' in out
+    assert 'predicted:' in out
+    assert 'Attention:' in out
+    assert 'context: toka0,pA,toka1' in out  # un-hashed path displayed
+    assert 'Exiting...' in out
