@@ -101,7 +101,7 @@ def parse_c2v_line(line: str, max_contexts: int) -> ParsedRow:
     tokenize to PAD — the host equivalent of the reference's CSV record
     defaults (path_context_reader.py:82-83, 190-196).
     """
-    parts = line.rstrip('\n').split(' ')
+    parts = line.rstrip('\r\n').split(' ')  # matches the native tokenizer
     label = parts[0]
     source_strs = [''] * max_contexts
     path_strs = [''] * max_contexts
@@ -141,8 +141,8 @@ class PathContextReader:
             try:
                 from code2vec_tpu.data import native
                 if native.is_available():
-                    self._native = native.NativeTokenizer(vocabs, config)
-            except ImportError:
+                    self._native = native.get_tokenizer(vocabs, config)
+            except (ImportError, RuntimeError):
                 self._native = None
 
     # ------------------------------------------------------------ tokenize

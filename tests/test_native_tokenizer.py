@@ -1,0 +1,71 @@
+"""Native C++ tokenizer: byte-identical semantics with the Python path."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.data import native
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+
+from tests.test_reader import small_setup  # noqa: F401  (fixture)
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason='native toolchain unavailable')
+
+
+def _readers(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    py_reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    py_reader._native = None
+    config_native = config
+    native_reader = PathContextReader(vocabs, config_native,
+                                      EstimatorAction.Train)
+    native_reader._native = native.get_tokenizer(vocabs, config_native)
+    return py_reader, native_reader
+
+
+LINES = [
+    'lbl1 s1,p1,t1 zzz,p2,t1 s2,qqq,qq  ',
+    'unknownlbl s1,p1,t1',
+    'lbl2 zz,zz,zz',
+    'lbl2 s2,p2,t1 s1,p1',      # malformed 2-part context
+    'lbl1 ,, s1,p1,t1',         # empty parts
+    'onlylabel',
+    'lbl1 s1',                  # single-part context
+]
+
+
+def test_native_matches_python(small_setup):  # noqa: F811
+    py_reader, native_reader = _readers(small_setup)
+    py_batch = py_reader.tokenize_lines(LINES)
+    native_batch = native_reader.tokenize_lines(LINES)
+    np.testing.assert_array_equal(py_batch.source, native_batch.source)
+    np.testing.assert_array_equal(py_batch.path, native_batch.path)
+    np.testing.assert_array_equal(py_batch.target, native_batch.target)
+    np.testing.assert_array_equal(py_batch.mask, native_batch.mask)
+    np.testing.assert_array_equal(py_batch.label, native_batch.label)
+
+
+def test_native_used_in_full_epoch(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    with open(str(prefix) + '.train.c2v', 'w') as f:
+        f.write('lbl1 s1,p1,t1\nlbl2 s2,p2,t1\nunknown s1,p1,t1\n' * 10)
+    py_reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    py_reader._native = None
+    native_reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    native_reader._native = native.get_tokenizer(vocabs, config)
+    py_batches = list(py_reader.iter_epoch(shuffle=False))
+    native_batches = list(native_reader.iter_epoch(shuffle=False))
+    assert len(py_batches) == len(native_batches)
+    for a, b in zip(py_batches, native_batches):
+        np.testing.assert_array_equal(a.source, b.source)
+        np.testing.assert_array_equal(a.label, b.label)
+        np.testing.assert_array_equal(a.weight, b.weight)
+
+
+def test_native_multithreaded_large_batch(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    tokenizer = native.get_tokenizer(vocabs, config)
+    lines = ['lbl1 s1,p1,t1 s2,p2,t1'] * 500  # > threading threshold
+    batch = tokenizer.tokenize_lines(lines)
+    assert batch.source.shape == (500, config.MAX_CONTEXTS)
+    assert (batch.mask[:, :2] == 1.0).all()
+    assert (batch.mask[:, 2:] == 0.0).all()
