@@ -1,0 +1,60 @@
+// AST for the native Java path-context extractor.
+//
+// Node `type` names follow javaparser's class simple names (NameExpr,
+// MethodCallExpr, BlockStmt, ...) so the emitted path vocabulary lines up
+// with the reference extractor's (reference Property.java:28-31 uses the
+// class simple name as the node type).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace c2v {
+
+struct Node {
+  std::string type;       // e.g. "BinaryExpr:PLUS" (operator-augmented)
+  std::string raw_type;   // e.g. "BinaryExpr" (no operator suffix)
+  std::string code;       // source text (leaf naming / normalization)
+  Node* parent = nullptr;
+  std::vector<Node*> children;
+  int child_id = 0;       // index among parent's children
+  bool is_statement = false;  // statements are never leaves
+                              // (reference LeavesCollectorVisitor.java:50-52)
+  size_t src_begin = 0;       // source span (set for method body blocks,
+  size_t src_end = 0;         // used for the method-length filter)
+
+  void add(Node* child) {
+    if (child == nullptr) return;
+    child->parent = this;
+    child->child_id = static_cast<int>(children.size());
+    children.push_back(child);
+  }
+};
+
+// Bump allocator: nodes live exactly as long as one file's extraction.
+class Arena {
+ public:
+  Node* make(std::string type, std::string code = std::string(),
+             bool is_statement = false) {
+    nodes_.push_back(std::make_unique<Node>());
+    Node* node = nodes_.back().get();
+    node->raw_type = type;
+    node->type = std::move(type);
+    node->code = std::move(code);
+    node->is_statement = is_statement;
+    return node;
+  }
+
+  Node* make_op(const std::string& type, const std::string& op,
+                std::string code = std::string()) {
+    Node* node = make(type, std::move(code));
+    node->type = type + ":" + op;
+    return node;
+  }
+
+ private:
+  std::vector<std::unique_ptr<Node>> nodes_;
+};
+
+}  // namespace c2v

@@ -1,0 +1,211 @@
+// Hand-written Java lexer for the native extractor. Comments are consumed
+// here and never reach the parser (the reference's visitor likewise drops
+// Comment nodes, LeavesCollectorVisitor.java:21-23).
+#pragma once
+
+#include <cctype>
+#include <stdexcept>
+#include <string>
+#include <string_view>
+#include <vector>
+
+namespace c2v {
+
+enum class Tok {
+  kEnd,
+  kIdent,       // identifiers and keywords
+  kIntLit,      // 123, 0x1F, 10L
+  kFloatLit,    // 1.5, 2e3, 1.5f
+  kCharLit,     // 'a'
+  kStringLit,   // "abc"
+  kPunct,       // operators and punctuation, longest-match
+};
+
+struct Token {
+  Tok kind = Tok::kEnd;
+  std::string text;   // raw text (string/char literals keep quotes)
+  size_t pos = 0;
+};
+
+struct LexError : std::runtime_error {
+  explicit LexError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Lexer {
+ public:
+  explicit Lexer(std::string_view src) : src_(src) {}
+
+  std::vector<Token> run() {
+    std::vector<Token> out;
+    while (true) {
+      Token token = next();
+      bool end = token.kind == Tok::kEnd;
+      out.push_back(std::move(token));
+      if (end) break;
+    }
+    return out;
+  }
+
+ private:
+  std::string_view src_;
+  size_t pos_ = 0;
+
+  char peek(size_t ahead = 0) const {
+    return pos_ + ahead < src_.size() ? src_[pos_ + ahead] : '\0';
+  }
+
+  void skip_space_and_comments() {
+    while (pos_ < src_.size()) {
+      char c = src_[pos_];
+      if (std::isspace(static_cast<unsigned char>(c))) {
+        ++pos_;
+      } else if (c == '/' && peek(1) == '/') {
+        while (pos_ < src_.size() && src_[pos_] != '\n') ++pos_;
+      } else if (c == '/' && peek(1) == '*') {
+        pos_ += 2;
+        while (pos_ + 1 < src_.size() &&
+               !(src_[pos_] == '*' && src_[pos_ + 1] == '/'))
+          ++pos_;
+        pos_ = pos_ + 2 <= src_.size() ? pos_ + 2 : src_.size();
+      } else {
+        return;
+      }
+    }
+  }
+
+  Token next() {
+    skip_space_and_comments();
+    Token token;
+    token.pos = pos_;
+    if (pos_ >= src_.size()) return token;
+
+    char c = src_[pos_];
+    if (std::isalpha(static_cast<unsigned char>(c)) || c == '_' || c == '$') {
+      size_t start = pos_;
+      while (pos_ < src_.size() &&
+             (std::isalnum(static_cast<unsigned char>(src_[pos_])) ||
+              src_[pos_] == '_' || src_[pos_] == '$'))
+        ++pos_;
+      token.kind = Tok::kIdent;
+      token.text = std::string(src_.substr(start, pos_ - start));
+      return token;
+    }
+    if (std::isdigit(static_cast<unsigned char>(c)) ||
+        (c == '.' && std::isdigit(static_cast<unsigned char>(peek(1))))) {
+      return lex_number();
+    }
+    if (c == '"') return lex_string();
+    if (c == '\'') return lex_char();
+    return lex_punct();
+  }
+
+  Token lex_number() {
+    Token token;
+    token.pos = pos_;
+    size_t start = pos_;
+    bool is_float = false;
+    if (peek() == '0' && (peek(1) == 'x' || peek(1) == 'X')) {
+      pos_ += 2;
+      while (std::isxdigit(static_cast<unsigned char>(peek())) ||
+             peek() == '_')
+        ++pos_;
+    } else if (peek() == '0' && (peek(1) == 'b' || peek(1) == 'B')) {
+      pos_ += 2;
+      while (peek() == '0' || peek() == '1' || peek() == '_') ++pos_;
+    } else {
+      while (std::isdigit(static_cast<unsigned char>(peek())) ||
+             peek() == '_')
+        ++pos_;
+      if (peek() == '.' &&
+          std::isdigit(static_cast<unsigned char>(peek(1)))) {
+        is_float = true;
+        ++pos_;
+        while (std::isdigit(static_cast<unsigned char>(peek())) ||
+               peek() == '_')
+          ++pos_;
+      }
+      if (peek() == 'e' || peek() == 'E') {
+        is_float = true;
+        ++pos_;
+        if (peek() == '+' || peek() == '-') ++pos_;
+        while (std::isdigit(static_cast<unsigned char>(peek()))) ++pos_;
+      }
+    }
+    if (peek() == 'f' || peek() == 'F' || peek() == 'd' || peek() == 'D') {
+      is_float = true;
+      ++pos_;
+    } else if (peek() == 'l' || peek() == 'L') {
+      ++pos_;
+    }
+    token.kind = is_float ? Tok::kFloatLit : Tok::kIntLit;
+    token.text = std::string(src_.substr(start, pos_ - start));
+    return token;
+  }
+
+  Token lex_string() {
+    Token token;
+    token.pos = pos_;
+    size_t start = pos_;
+    ++pos_;  // opening quote
+    while (pos_ < src_.size() && src_[pos_] != '"') {
+      if (src_[pos_] == '\\') ++pos_;
+      ++pos_;
+    }
+    if (pos_ >= src_.size()) throw LexError("unterminated string literal");
+    ++pos_;  // closing quote
+    token.kind = Tok::kStringLit;
+    token.text = std::string(src_.substr(start, pos_ - start));
+    return token;
+  }
+
+  Token lex_char() {
+    Token token;
+    token.pos = pos_;
+    size_t start = pos_;
+    ++pos_;
+    while (pos_ < src_.size() && src_[pos_] != '\'') {
+      if (src_[pos_] == '\\') ++pos_;
+      ++pos_;
+    }
+    if (pos_ >= src_.size()) throw LexError("unterminated char literal");
+    ++pos_;
+    token.kind = Tok::kCharLit;
+    token.text = std::string(src_.substr(start, pos_ - start));
+    return token;
+  }
+
+  Token lex_punct() {
+    static const char* three[] = {">>>", "<<=", ">>=", "..."};
+    static const char* two[] = {"==", "!=", "<=", ">=", "&&", "||", "++",
+                                "--", "+=", "-=", "*=", "/=", "%=", "&=",
+                                "|=", "^=", "<<", ">>", "->", "::"};
+    Token token;
+    token.pos = pos_;
+    token.kind = Tok::kPunct;
+    std::string_view rest = src_.substr(pos_);
+    if (rest.size() >= 4 && rest.substr(0, 4) == ">>>=") {
+      token.text = ">>>=";
+      pos_ += 4;
+      return token;
+    }
+    for (const char* op : three) {
+      if (rest.size() >= 3 && rest.substr(0, 3) == op) {
+        token.text = op;
+        pos_ += 3;
+        return token;
+      }
+    }
+    for (const char* op : two) {
+      if (rest.size() >= 2 && rest.substr(0, 2) == op) {
+        token.text = op;
+        pos_ += 2;
+        return token;
+      }
+    }
+    token.text = std::string(1, src_[pos_]);
+    ++pos_;
+    return token;
+  }
+};
+
+}  // namespace c2v

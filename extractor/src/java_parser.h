@@ -1,0 +1,1187 @@
+// Recursive-descent Java parser for the native extractor.
+//
+// This is NOT a full Java compiler frontend: it parses the constructs that
+// dominate real-world method bodies (declarations, statements, the full
+// expression grammar with precedence, generics, annotations, lambdas,
+// method references, switch, try/catch) and produces an AST whose node
+// types/structure mirror javaparser's, so paths line up with the reference
+// extractor's vocabulary. Unparseable members are skipped (the reference
+// skips whole files on parse failure after its wrap-retries,
+// FeatureExtractor.java:51-75; per-member recovery is strictly better).
+//
+// Operator spellings use javaparser 3.x enum names (PLUS, ASSIGN,
+// PREFIX_INCREMENT, ...) — reference Property.java:33-42 appends them to the
+// node type as "BinaryExpr:PLUS".
+#pragma once
+
+#include <optional>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "java_ast.h"
+#include "java_lexer.h"
+
+namespace c2v {
+
+struct ParseError : std::runtime_error {
+  explicit ParseError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Parser {
+ public:
+  Parser(std::vector<Token> tokens, Arena* arena)
+      : toks_(std::move(tokens)), arena_(arena) {}
+
+  // Parse a compilation unit; returns the root node.
+  Node* parse_compilation_unit() {
+    Node* root = arena_->make("CompilationUnit");
+    skip_package_and_imports();
+    while (!at_end()) {
+      if (accept_punct(";")) continue;
+      Node* type_decl = parse_type_declaration();
+      if (type_decl) root->add(type_decl);
+    }
+    return root;
+  }
+
+ private:
+  std::vector<Token> toks_;
+  Arena* arena_;
+  size_t i_ = 0;
+  std::vector<std::pair<size_t, std::string>> mutations_;
+
+  static const std::set<std::string>& modifiers() {
+    static const std::set<std::string> kMods = {
+        "public", "protected", "private", "static",   "final",
+        "abstract", "native",  "synchronized", "transient", "volatile",
+        "strictfp", "default"};
+    return kMods;
+  }
+
+  static const std::set<std::string>& primitive_types() {
+    static const std::set<std::string> kPrims = {
+        "boolean", "byte", "char", "short", "int", "long", "float",
+        "double"};
+    return kPrims;
+  }
+
+  // ----------------------------------------------------------- token utils
+  const Token& cur() const { return toks_[i_]; }
+  const Token& ahead(size_t n) const {
+    size_t j = i_ + n;
+    return j < toks_.size() ? toks_[j] : toks_.back();
+  }
+  bool at_end() const { return cur().kind == Tok::kEnd; }
+  void advance() {
+    if (!at_end()) ++i_;
+  }
+  size_t mark() const { return i_; }
+  void rewind(size_t m) {
+    // undo any token mutations (the '>>' split in parse_type_arguments)
+    // made past the mark — a tentative parse must leave no trace
+    while (!mutations_.empty() && mutations_.back().first >= m) {
+      toks_[mutations_.back().first].text = mutations_.back().second;
+      mutations_.pop_back();
+    }
+    i_ = m;
+  }
+  void mutate_token(const std::string& new_text) {
+    mutations_.emplace_back(i_, toks_[i_].text);
+    toks_[i_].text = new_text;
+  }
+
+  bool is_punct(const std::string& p, size_t n = 0) const {
+    return ahead(n).kind == Tok::kPunct && ahead(n).text == p;
+  }
+  bool is_ident(const std::string& word, size_t n = 0) const {
+    return ahead(n).kind == Tok::kIdent && ahead(n).text == word;
+  }
+  bool accept_punct(const std::string& p) {
+    if (is_punct(p)) {
+      advance();
+      return true;
+    }
+    return false;
+  }
+  bool accept_ident(const std::string& word) {
+    if (is_ident(word)) {
+      advance();
+      return true;
+    }
+    return false;
+  }
+  void expect_punct(const std::string& p) {
+    if (!accept_punct(p))
+      throw ParseError("expected '" + p + "' got '" + cur().text + "'");
+  }
+  std::string expect_ident() {
+    if (cur().kind != Tok::kIdent)
+      throw ParseError("expected identifier, got '" + cur().text + "'");
+    std::string name = cur().text;
+    advance();
+    return name;
+  }
+
+  void skip_balanced(const std::string& open, const std::string& close) {
+    int depth = 0;
+    while (!at_end()) {
+      if (is_punct(open)) ++depth;
+      if (is_punct(close)) {
+        --depth;
+        if (depth == 0) {
+          advance();
+          return;
+        }
+      }
+      advance();
+    }
+  }
+
+  void skip_annotations() {
+    while (is_punct("@")) {
+      advance();
+      expect_ident();
+      while (accept_punct(".")) expect_ident();
+      if (is_punct("(")) skip_balanced("(", ")");
+    }
+  }
+
+  void skip_modifiers() {
+    while (true) {
+      skip_annotations();
+      if (cur().kind == Tok::kIdent && modifiers().count(cur().text)) {
+        advance();
+        continue;
+      }
+      break;
+    }
+  }
+
+  void skip_package_and_imports() {
+    skip_annotations();
+    if (accept_ident("package")) {
+      while (!at_end() && !accept_punct(";")) advance();
+    }
+    while (is_ident("import")) {
+      while (!at_end() && !accept_punct(";")) advance();
+    }
+  }
+
+  void skip_type_parameters() {
+    if (!is_punct("<")) return;
+    int depth = 0;
+    while (!at_end()) {
+      if (is_punct("<")) ++depth;
+      else if (is_punct(">")) --depth;
+      else if (is_punct(">>")) depth -= 2;
+      else if (is_punct(">>>")) depth -= 3;
+      advance();
+      if (depth <= 0) return;
+    }
+  }
+
+  // -------------------------------------------------------- declarations
+  Node* parse_type_declaration() {
+    skip_modifiers();
+    if (at_end()) return nullptr;
+    if (is_ident("class") || is_ident("interface")) {
+      return parse_class_or_interface();
+    }
+    if (is_ident("enum")) return parse_enum();
+    if (is_punct("@") || is_ident("record")) {
+      // annotation decl / record: skip body
+      while (!at_end() && !is_punct("{")) advance();
+      if (is_punct("{")) skip_balanced("{", "}");
+      return nullptr;
+    }
+    // unknown top-level construct: skip one token to make progress
+    advance();
+    return nullptr;
+  }
+
+  Node* parse_class_or_interface() {
+    bool is_interface = is_ident("interface");
+    advance();  // class/interface
+    std::string name = expect_ident();
+    Node* decl = arena_->make("ClassOrInterfaceDeclaration", name);
+    decl->add(arena_->make("NameExpr", name));
+    skip_type_parameters();
+    while (is_ident("extends") || is_ident("implements")) {
+      advance();
+      parse_type();  // discard
+      while (accept_punct(",")) parse_type();
+    }
+    if (accept_ident("permits")) {
+      parse_type();
+      while (accept_punct(",")) parse_type();
+    }
+    expect_punct("{");
+    parse_class_body(decl, is_interface);
+    return decl;
+  }
+
+  Node* parse_enum() {
+    advance();  // enum
+    std::string name = expect_ident();
+    Node* decl = arena_->make("EnumDeclaration", name);
+    decl->add(arena_->make("NameExpr", name));
+    while (is_ident("implements")) {
+      advance();
+      parse_type();
+      while (accept_punct(",")) parse_type();
+    }
+    expect_punct("{");
+    // enum constants: Ident [(args)] [{body}] separated by ','
+    while (!at_end() && !is_punct(";") && !is_punct("}")) {
+      skip_annotations();
+      if (cur().kind == Tok::kIdent) {
+        Node* constant =
+            arena_->make("EnumConstantDeclaration", cur().text);
+        advance();
+        if (is_punct("(")) skip_balanced("(", ")");
+        if (is_punct("{")) skip_balanced("{", "}");
+        decl->add(constant);
+      }
+      if (!accept_punct(",")) break;
+    }
+    if (accept_punct(";")) parse_class_body(decl, false);
+    else expect_punct("}");
+    return decl;
+  }
+
+  void parse_class_body(Node* decl, bool is_interface) {
+    while (!at_end() && !is_punct("}")) {
+      size_t member_start = mark();
+      try {
+        parse_member(decl, is_interface);
+      } catch (const ParseError&) {
+        // recovery: skip this member — to the next ';' at depth 0 or past
+        // one balanced '{...}' block
+        rewind(member_start);
+        skip_member();
+      }
+      if (mark() == member_start) skip_member();  // ensure progress
+    }
+    accept_punct("}");
+  }
+
+  void skip_member() {
+    while (!at_end() && !is_punct("}")) {
+      if (is_punct(";")) {
+        advance();
+        return;
+      }
+      if (is_punct("{")) {
+        skip_balanced("{", "}");
+        return;
+      }
+      advance();
+    }
+  }
+
+  void parse_member(Node* decl, bool /*is_interface*/) {
+    skip_modifiers();
+    if (accept_punct(";")) return;
+    if (is_punct("{")) {  // instance/static initializer
+      Node* init = arena_->make("InitializerDeclaration");
+      init->add(parse_block());
+      decl->add(init);
+      return;
+    }
+    if (is_ident("class") || is_ident("interface")) {
+      decl->add(parse_class_or_interface());
+      return;
+    }
+    if (is_ident("enum")) {
+      decl->add(parse_enum());
+      return;
+    }
+    skip_type_parameters();
+    skip_annotations();
+
+    // constructor: Ident '('  (same name as class, but name match isn't
+    // required for parsing)
+    if (cur().kind == Tok::kIdent && is_punct("(", 1)) {
+      decl->add(parse_constructor());
+      return;
+    }
+
+    // method or field: Type Ident ...
+    Node* type = parse_type();
+    if (is_ident("void", 0)) advance();  // defensive; handled in parse_type
+    std::string name = expect_ident();
+    if (is_punct("(")) {
+      decl->add(parse_method_rest(type, name));
+    } else {
+      decl->add(parse_field_rest(type, name));
+    }
+  }
+
+  Node* parse_constructor() {
+    std::string name = expect_ident();
+    Node* ctor = arena_->make("ConstructorDeclaration", name);
+    ctor->add(arena_->make("NameExpr", name));
+    parse_parameters(ctor);
+    if (accept_ident("throws")) {
+      parse_type();
+      while (accept_punct(",")) parse_type();
+    }
+    if (is_punct("{")) ctor->add(parse_block());
+    else expect_punct(";");
+    return ctor;
+  }
+
+  // MethodDeclaration children mirror javaparser: return type, NameExpr
+  // (the method-name leaf the reference renames to METHOD_NAME,
+  // Common.java:69-75), parameters, body block.
+  Node* parse_method_rest(Node* return_type, const std::string& name) {
+    Node* method = arena_->make("MethodDeclaration", name);
+    method->add(return_type);
+    method->add(arena_->make("NameExpr", name));
+    parse_parameters(method);
+    while (accept_punct("[")) expect_punct("]");  // archaic int f()[] {}
+    if (accept_ident("throws")) {
+      parse_type();
+      while (accept_punct(",")) parse_type();
+    }
+    if (is_punct("{")) {
+      method->add(parse_block());
+    } else {
+      expect_punct(";");  // abstract/interface method: no body
+    }
+    return method;
+  }
+
+  Node* parse_field_rest(Node* type, const std::string& first_name) {
+    Node* field = arena_->make("FieldDeclaration");
+    field->add(type);
+    field->add(parse_variable_declarator(first_name));
+    while (accept_punct(",")) {
+      std::string name = expect_ident();
+      field->add(parse_variable_declarator(name));
+    }
+    expect_punct(";");
+    return field;
+  }
+
+  Node* parse_variable_declarator(const std::string& name) {
+    Node* declarator = arena_->make("VariableDeclarator", name);
+    declarator->add(arena_->make("VariableDeclaratorId", name));
+    while (accept_punct("[")) expect_punct("]");
+    if (accept_punct("=")) {
+      declarator->add(is_punct("{") ? parse_array_initializer()
+                                    : parse_expression());
+    }
+    return declarator;
+  }
+
+  void parse_parameters(Node* owner) {
+    expect_punct("(");
+    if (accept_punct(")")) return;
+    do {
+      skip_modifiers();  // final, annotations
+      Node* parameter = arena_->make("Parameter");
+      Node* type = parse_type();
+      parameter->add(type);
+      accept_punct("...");  // varargs
+      if (cur().kind == Tok::kIdent) {
+        std::string name = expect_ident();
+        parameter->add(arena_->make("VariableDeclaratorId", name));
+        while (accept_punct("[")) expect_punct("]");
+      }
+      owner->add(parameter);
+    } while (accept_punct(","));
+    expect_punct(")");
+  }
+
+  // --------------------------------------------------------------- types
+  Node* parse_type() {
+    skip_annotations();
+    if (is_ident("void")) {
+      advance();
+      Node* type = arena_->make("VoidType", "void");
+      return maybe_array(type);
+    }
+    if (cur().kind == Tok::kIdent && primitive_types().count(cur().text)) {
+      Node* type = arena_->make("PrimitiveType", cur().text);
+      advance();
+      return maybe_array(type);
+    }
+    if (cur().kind != Tok::kIdent)
+      throw ParseError("expected type, got '" + cur().text + "'");
+    return maybe_array(parse_class_type());
+  }
+
+  Node* parse_class_type() {
+    std::string name = expect_ident();
+    while (is_punct(".") && ahead(1).kind == Tok::kIdent &&
+           !is_ident("class", 1)) {
+      advance();
+      name += "." + expect_ident();
+    }
+    Node* type = arena_->make("ClassOrInterfaceType", name);
+    if (is_punct("<")) parse_type_arguments(type);
+    return type;
+  }
+
+  void parse_type_arguments(Node* owner) {
+    expect_punct("<");
+    if (accept_punct(">")) return;  // diamond <>
+    while (true) {
+      if (is_punct("?")) {
+        advance();
+        Node* wildcard = arena_->make("WildcardType", "?");
+        if (accept_ident("extends") || accept_ident("super"))
+          wildcard->add(parse_type());
+        owner->add(wildcard);
+      } else {
+        owner->add(parse_type());
+      }
+      if (accept_punct(",")) continue;
+      if (accept_punct(">")) return;
+      // '>>' / '>>>' closing nested generics: split them (journaled so a
+      // rewound tentative parse restores the original token)
+      if (is_punct(">>")) {
+        mutate_token(">");
+        return;
+      }
+      if (is_punct(">>>")) {
+        mutate_token(">>");
+        return;
+      }
+      throw ParseError("bad type arguments near '" + cur().text + "'");
+    }
+  }
+
+  Node* maybe_array(Node* type) {
+    while (is_punct("[") && is_punct("]", 1)) {
+      advance();
+      advance();
+      Node* array = arena_->make("ArrayType");
+      array->add(type);
+      type = array;
+    }
+    return type;
+  }
+
+  // ---------------------------------------------------------- statements
+  Node* parse_block() {
+    size_t begin = cur().pos;
+    expect_punct("{");
+    Node* block = arena_->make("BlockStmt", "", /*is_statement=*/true);
+    block->src_begin = begin;
+    while (!at_end() && !is_punct("}")) {
+      block->add(parse_statement());
+    }
+    block->src_end = cur().pos;
+    expect_punct("}");
+    return block;
+  }
+
+  Node* parse_statement() {
+    skip_annotations();
+    if (is_punct("{")) return parse_block();
+    if (accept_punct(";"))
+      return arena_->make("EmptyStmt", "", true);
+    if (is_ident("if")) return parse_if();
+    if (is_ident("while")) return parse_while();
+    if (is_ident("do")) return parse_do();
+    if (is_ident("for")) return parse_for();
+    if (is_ident("return")) return parse_return();
+    if (is_ident("throw")) return parse_throw();
+    if (is_ident("try")) return parse_try();
+    if (is_ident("switch")) return parse_switch();
+    if (is_ident("break")) {
+      advance();
+      Node* stmt = arena_->make("BreakStmt", "", true);
+      if (cur().kind == Tok::kIdent) advance();  // label
+      expect_punct(";");
+      return stmt;
+    }
+    if (is_ident("continue")) {
+      advance();
+      Node* stmt = arena_->make("ContinueStmt", "", true);
+      if (cur().kind == Tok::kIdent) advance();
+      expect_punct(";");
+      return stmt;
+    }
+    if (is_ident("synchronized")) {
+      advance();
+      Node* stmt = arena_->make("SynchronizedStmt", "", true);
+      expect_punct("(");
+      stmt->add(parse_expression());
+      expect_punct(")");
+      stmt->add(parse_block());
+      return stmt;
+    }
+    if (is_ident("assert")) {
+      advance();
+      Node* stmt = arena_->make("AssertStmt", "", true);
+      stmt->add(parse_expression());
+      if (accept_punct(":")) stmt->add(parse_expression());
+      expect_punct(";");
+      return stmt;
+    }
+    if ((is_ident("class") || is_ident("final") || is_ident("abstract")) &&
+        !is_punct(".", 1)) {
+      // local class
+      Node* stmt =
+          arena_->make("LocalClassDeclarationStmt", "", true);
+      skip_modifiers();
+      stmt->add(parse_class_or_interface());
+      return stmt;
+    }
+    // labeled statement: Ident ':'
+    if (cur().kind == Tok::kIdent && is_punct(":", 1) &&
+        !is_ident("default")) {
+      Node* stmt = arena_->make("LabeledStmt", cur().text, true);
+      advance();
+      advance();
+      stmt->add(parse_statement());
+      return stmt;
+    }
+    // local variable declaration?
+    {
+      size_t m = mark();
+      Node* decl = try_parse_local_variable_declaration();
+      if (decl) {
+        expect_punct(";");
+        Node* stmt = arena_->make("ExpressionStmt", "", true);
+        stmt->add(decl);
+        return stmt;
+      }
+      rewind(m);
+    }
+    Node* stmt = arena_->make("ExpressionStmt", "", true);
+    stmt->add(parse_expression());
+    expect_punct(";");
+    return stmt;
+  }
+
+  // VariableDeclarationExpr: [type, VariableDeclarator...]
+  Node* try_parse_local_variable_declaration() {
+    try {
+      skip_modifiers();  // final / annotations
+      if (cur().kind != Tok::kIdent) return nullptr;
+      Node* type;
+      if (is_ident("var") && ahead(1).kind == Tok::kIdent) {
+        advance();
+        type = arena_->make("VarType", "var");
+      } else {
+        type = parse_type();
+      }
+      if (cur().kind != Tok::kIdent) return nullptr;
+      // next after name must be one of = ; , [ to be a declaration
+      const Token& after = ahead(1);
+      if (!(after.kind == Tok::kPunct &&
+            (after.text == "=" || after.text == ";" || after.text == "," ||
+             after.text == "[" || after.text == ":")))
+        return nullptr;
+      if (after.text == ":") return nullptr;  // foreach handled in for
+      Node* decl = arena_->make("VariableDeclarationExpr");
+      decl->add(type);
+      std::string name = expect_ident();
+      decl->add(parse_variable_declarator(name));
+      while (accept_punct(",")) {
+        std::string next_name = expect_ident();
+        decl->add(parse_variable_declarator(next_name));
+      }
+      return decl;
+    } catch (const ParseError&) {
+      return nullptr;
+    }
+  }
+
+  Node* parse_if() {
+    advance();
+    Node* stmt = arena_->make("IfStmt", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    stmt->add(parse_statement());
+    if (accept_ident("else")) stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_while() {
+    advance();
+    Node* stmt = arena_->make("WhileStmt", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_do() {
+    advance();
+    Node* stmt = arena_->make("DoStmt", "", true);
+    stmt->add(parse_statement());
+    if (!accept_ident("while")) throw ParseError("expected while after do");
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    expect_punct(";");
+    return stmt;
+  }
+
+  Node* parse_for() {
+    advance();
+    expect_punct("(");
+    // foreach? "[final] Type Ident :"
+    size_t m = mark();
+    {
+      skip_modifiers();
+      try {
+        if (cur().kind == Tok::kIdent) {
+          Node* type = (is_ident("var") && ahead(1).kind == Tok::kIdent)
+                           ? (advance(), arena_->make("VarType", "var"))
+                           : parse_type();
+          if (cur().kind == Tok::kIdent && is_punct(":", 1)) {
+            Node* stmt = arena_->make("ForeachStmt", "", true);
+            Node* decl = arena_->make("VariableDeclarationExpr");
+            decl->add(type);
+            std::string name = expect_ident();
+            decl->add(parse_variable_declarator(name));
+            stmt->add(decl);
+            expect_punct(":");
+            stmt->add(parse_expression());
+            expect_punct(")");
+            stmt->add(parse_statement());
+            return stmt;
+          }
+        }
+      } catch (const ParseError&) {
+      }
+      rewind(m);
+    }
+    Node* stmt = arena_->make("ForStmt", "", true);
+    if (!is_punct(";")) {
+      Node* init = try_parse_local_variable_declaration();
+      if (init) {
+        stmt->add(init);
+      } else {
+        stmt->add(parse_expression());
+        while (accept_punct(",")) stmt->add(parse_expression());
+      }
+    }
+    expect_punct(";");
+    if (!is_punct(";")) stmt->add(parse_expression());
+    expect_punct(";");
+    if (!is_punct(")")) {
+      stmt->add(parse_expression());
+      while (accept_punct(",")) stmt->add(parse_expression());
+    }
+    expect_punct(")");
+    stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_return() {
+    advance();
+    Node* stmt = arena_->make("ReturnStmt", "", true);
+    if (!is_punct(";")) stmt->add(parse_expression());
+    expect_punct(";");
+    return stmt;
+  }
+
+  Node* parse_throw() {
+    advance();
+    Node* stmt = arena_->make("ThrowStmt", "", true);
+    stmt->add(parse_expression());
+    expect_punct(";");
+    return stmt;
+  }
+
+  Node* parse_try() {
+    advance();
+    Node* stmt = arena_->make("TryStmt", "", true);
+    if (is_punct("(")) {  // try-with-resources
+      advance();
+      while (!is_punct(")") && !at_end()) {
+        Node* resource = try_parse_local_variable_declaration();
+        stmt->add(resource ? resource : parse_expression());
+        if (!accept_punct(";")) break;
+      }
+      expect_punct(")");
+    }
+    stmt->add(parse_block());
+    while (is_ident("catch")) {
+      advance();
+      Node* clause = arena_->make("CatchClause");
+      expect_punct("(");
+      skip_modifiers();
+      Node* parameter = arena_->make("Parameter");
+      parameter->add(parse_type());
+      while (accept_punct("|")) parse_type();  // multi-catch: keep first
+      if (cur().kind == Tok::kIdent) {
+        parameter->add(
+            arena_->make("VariableDeclaratorId", expect_ident()));
+      }
+      clause->add(parameter);
+      expect_punct(")");
+      clause->add(parse_block());
+      stmt->add(clause);
+    }
+    if (accept_ident("finally")) stmt->add(parse_block());
+    return stmt;
+  }
+
+  Node* parse_switch() {
+    advance();
+    Node* stmt = arena_->make("SwitchStmt", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    expect_punct("{");
+    while (!at_end() && !is_punct("}")) {
+      Node* entry = arena_->make("SwitchEntryStmt", "", true);
+      if (accept_ident("case")) {
+        entry->add(parse_expression());
+        while (accept_punct(",")) entry->add(parse_expression());
+      } else if (!accept_ident("default")) {
+        throw ParseError("expected case/default in switch");
+      }
+      if (accept_punct("->")) {  // arrow form
+        if (is_punct("{")) entry->add(parse_block());
+        else {
+          entry->add(parse_statement());
+        }
+      } else {
+        expect_punct(":");
+        while (!at_end() && !is_punct("}") && !is_ident("case") &&
+               !is_ident("default")) {
+          entry->add(parse_statement());
+        }
+      }
+      stmt->add(entry);
+    }
+    expect_punct("}");
+    return stmt;
+  }
+
+  Node* parse_array_initializer() {
+    expect_punct("{");
+    Node* init = arena_->make("ArrayInitializerExpr");
+    while (!at_end() && !is_punct("}")) {
+      init->add(is_punct("{") ? parse_array_initializer()
+                              : parse_expression());
+      if (!accept_punct(",")) break;
+    }
+    expect_punct("}");
+    return init;
+  }
+
+  // --------------------------------------------------------- expressions
+  Node* parse_expression() { return parse_assignment(); }
+
+  Node* parse_assignment() {
+    Node* left = parse_ternary();
+    static const std::pair<const char*, const char*> kAssignOps[] = {
+        {"=", "ASSIGN"},       {"+=", "PLUS"},
+        {"-=", "MINUS"},       {"*=", "MULTIPLY"},
+        {"/=", "DIVIDE"},      {"%=", "REMAINDER"},
+        {"&=", "AND"},         {"|=", "OR"},
+        {"^=", "XOR"},         {"<<=", "LEFT_SHIFT"},
+        {">>=", "SIGNED_RIGHT_SHIFT"}, {">>>=", "UNSIGNED_RIGHT_SHIFT"}};
+    for (const auto& [text, name] : kAssignOps) {
+      if (is_punct(text)) {
+        advance();
+        Node* assign = arena_->make_op("AssignExpr", name);
+        assign->add(left);
+        assign->add(is_punct("{") ? parse_array_initializer()
+                                  : parse_assignment());
+        return assign;
+      }
+    }
+    return left;
+  }
+
+  Node* parse_ternary() {
+    Node* condition = parse_binary(0);
+    if (is_punct("?")) {
+      advance();
+      Node* ternary = arena_->make("ConditionalExpr");
+      ternary->add(condition);
+      ternary->add(parse_expression());
+      expect_punct(":");
+      ternary->add(parse_expression());
+      return ternary;
+    }
+    return condition;
+  }
+
+  struct BinOp {
+    const char* text;
+    const char* name;
+    int prec;
+  };
+
+  static const std::vector<BinOp>& binary_ops() {
+    static const std::vector<BinOp> kOps = {
+        {"||", "OR", 1},           {"&&", "AND", 2},
+        {"|", "BINARY_OR", 3},     {"^", "XOR", 4},
+        {"&", "BINARY_AND", 5},    {"==", "EQUALS", 6},
+        {"!=", "NOT_EQUALS", 6},   {"<", "LESS", 7},
+        {">", "GREATER", 7},       {"<=", "LESS_EQUALS", 7},
+        {">=", "GREATER_EQUALS", 7},
+        {"<<", "LEFT_SHIFT", 8},   {">>", "SIGNED_RIGHT_SHIFT", 8},
+        {">>>", "UNSIGNED_RIGHT_SHIFT", 8},
+        {"+", "PLUS", 9},          {"-", "MINUS", 9},
+        {"*", "MULTIPLY", 10},     {"/", "DIVIDE", 10},
+        {"%", "REMAINDER", 10}};
+    return kOps;
+  }
+
+  const BinOp* current_binop(int min_prec) {
+    if (cur().kind != Tok::kPunct) return nullptr;
+    for (const auto& op : binary_ops()) {
+      if (cur().text == op.text && op.prec >= min_prec) return &op;
+    }
+    return nullptr;
+  }
+
+  Node* parse_binary(int min_prec) {
+    Node* left = parse_unary();
+    while (true) {
+      if (is_ident("instanceof")) {
+        advance();
+        Node* check = arena_->make("InstanceOfExpr");
+        check->add(left);
+        check->add(parse_type());
+        if (cur().kind == Tok::kIdent) advance();  // pattern variable
+        left = check;
+        continue;
+      }
+      const BinOp* op = current_binop(min_prec + 1);
+      if (!op) return left;
+      advance();
+      Node* right = parse_binary(op->prec);
+      Node* binary = arena_->make_op("BinaryExpr", op->name);
+      binary->add(left);
+      binary->add(right);
+      left = binary;
+    }
+  }
+
+  Node* parse_unary() {
+    static const std::pair<const char*, const char*> kPrefix[] = {
+        {"+", "PLUS"},
+        {"-", "MINUS"},
+        {"!", "LOGICAL_COMPLEMENT"},
+        {"~", "BITWISE_COMPLEMENT"},
+        {"++", "PREFIX_INCREMENT"},
+        {"--", "PREFIX_DECREMENT"}};
+    for (const auto& [text, name] : kPrefix) {
+      if (is_punct(text)) {
+        advance();
+        // negative literal folding like javaparser: -5 is an
+        // IntegerLiteralExpr("-5")? javaparser keeps UnaryExpr(minus);
+        // we do the same.
+        Node* unary = arena_->make_op("UnaryExpr", name);
+        unary->add(parse_unary());
+        return unary;
+      }
+    }
+    // cast: '(' Type ')' unary  — tentative
+    if (is_punct("(")) {
+      size_t m = mark();
+      advance();
+      try {
+        Node* type = parse_type();
+        if (accept_punct(")")) {
+          bool cast_target = cur().kind == Tok::kIdent ||
+                             cur().kind == Tok::kIntLit ||
+                             cur().kind == Tok::kFloatLit ||
+                             cur().kind == Tok::kCharLit ||
+                             cur().kind == Tok::kStringLit ||
+                             is_punct("(") || is_punct("!") ||
+                             is_punct("~");
+          if (cast_target) {
+            Node* cast = arena_->make("CastExpr");
+            cast->add(type);
+            cast->add(parse_unary());
+            return parse_postfix_ops(cast);
+          }
+        }
+      } catch (const ParseError&) {
+      }
+      rewind(m);
+    }
+    return parse_postfix();
+  }
+
+  Node* parse_postfix() {
+    Node* expr = parse_primary();
+    expr = parse_postfix_ops(expr);
+    if (is_punct("++")) {
+      advance();
+      Node* unary = arena_->make_op("UnaryExpr", "POSTFIX_INCREMENT");
+      unary->add(expr);
+      return unary;
+    }
+    if (is_punct("--")) {
+      advance();
+      Node* unary = arena_->make_op("UnaryExpr", "POSTFIX_DECREMENT");
+      unary->add(expr);
+      return unary;
+    }
+    return expr;
+  }
+
+  // selectors: .name, .name(args), [index], ::ref
+  Node* parse_postfix_ops(Node* expr) {
+    while (true) {
+      if (is_punct(".")) {
+        advance();
+        if (accept_ident("new")) {  // inner class creation: treat as call
+          Node* creation = parse_object_creation(expr);
+          expr = creation;
+          continue;
+        }
+        if (is_punct("<")) skip_type_parameters();  // explicit type args
+        if (is_ident("class")) {
+          advance();
+          Node* access = arena_->make("ClassExpr");
+          access->add(expr);
+          expr = access;
+          continue;
+        }
+        if (is_ident("this")) {
+          advance();
+          Node* access = arena_->make("FieldAccessExpr");
+          access->add(expr);
+          access->add(arena_->make("ThisExpr", "this"));
+          expr = access;
+          continue;
+        }
+        std::string name = expect_ident();
+        if (is_punct("(")) {
+          Node* call = arena_->make("MethodCallExpr", name);
+          call->add(expr);  // scope
+          call->add(arena_->make("NameExpr", name));
+          parse_arguments(call);
+          expr = call;
+        } else {
+          Node* access = arena_->make("FieldAccessExpr", name);
+          access->add(expr);
+          access->add(arena_->make("NameExpr", name));
+          expr = access;
+        }
+        continue;
+      }
+      if (is_punct("[") && !is_punct("]", 1)) {
+        advance();
+        Node* index = parse_expression();
+        expect_punct("]");
+        Node* access = arena_->make("ArrayAccessExpr");
+        access->add(expr);
+        access->add(index);
+        expr = access;
+        continue;
+      }
+      if (is_punct("::")) {
+        advance();
+        std::string name =
+            is_ident("new") ? (advance(), "new") : expect_ident();
+        Node* ref = arena_->make("MethodReferenceExpr", name);
+        ref->add(expr);
+        ref->add(arena_->make("NameExpr", name));
+        expr = ref;
+        continue;
+      }
+      return expr;
+    }
+  }
+
+  void parse_arguments(Node* call) {
+    expect_punct("(");
+    if (accept_punct(")")) return;
+    do {
+      call->add(parse_expression());
+    } while (accept_punct(","));
+    expect_punct(")");
+  }
+
+  Node* parse_object_creation(Node* scope) {
+    // after 'new'
+    Node* creation = arena_->make("ObjectCreationExpr");
+    if (scope) creation->add(scope);
+    if (cur().kind == Tok::kIdent &&
+        primitive_types().count(cur().text)) {
+      // new int[...]
+      Node* type = arena_->make("PrimitiveType", cur().text);
+      advance();
+      return parse_array_creation(type);
+    }
+    Node* type = parse_class_type();
+    if (is_punct("[")) return parse_array_creation(type);
+    creation->add(type);
+    parse_arguments(creation);
+    if (is_punct("{")) {  // anonymous class body
+      Node* body = arena_->make("ClassOrInterfaceDeclaration");
+      advance();  // consume '{'
+      parse_class_body(body, false);
+      creation->add(body);
+    }
+    return creation;
+  }
+
+  Node* parse_array_creation(Node* element_type) {
+    Node* creation = arena_->make("ArrayCreationExpr");
+    creation->add(element_type);
+    while (is_punct("[")) {
+      advance();
+      if (!is_punct("]")) creation->add(parse_expression());
+      expect_punct("]");
+    }
+    if (is_punct("{")) creation->add(parse_array_initializer());
+    return creation;
+  }
+
+  bool lambda_ahead() {
+    // Ident '->'  or  '(' params ')' '->'
+    if (cur().kind == Tok::kIdent && is_punct("->", 1)) return true;
+    if (!is_punct("(")) return false;
+    int depth = 0;
+    size_t j = 0;
+    while (ahead(j).kind != Tok::kEnd) {
+      if (ahead(j).kind == Tok::kPunct) {
+        if (ahead(j).text == "(") ++depth;
+        if (ahead(j).text == ")") {
+          --depth;
+          if (depth == 0) return ahead(j + 1).kind == Tok::kPunct &&
+                                 ahead(j + 1).text == "->";
+        }
+      }
+      ++j;
+    }
+    return false;
+  }
+
+  Node* parse_lambda() {
+    Node* lambda = arena_->make("LambdaExpr");
+    if (cur().kind == Tok::kIdent) {
+      Node* parameter = arena_->make("Parameter");
+      parameter->add(
+          arena_->make("VariableDeclaratorId", expect_ident()));
+      lambda->add(parameter);
+    } else {
+      expect_punct("(");
+      while (!is_punct(")") && !at_end()) {
+        skip_modifiers();
+        Node* parameter = arena_->make("Parameter");
+        size_t m = mark();
+        // typed param?
+        try {
+          Node* type = parse_type();
+          if (cur().kind == Tok::kIdent) {
+            parameter->add(type);
+            parameter->add(
+                arena_->make("VariableDeclaratorId", expect_ident()));
+          } else {
+            throw ParseError("untyped");
+          }
+        } catch (const ParseError&) {
+          rewind(m);
+          parameter->add(
+              arena_->make("VariableDeclaratorId", expect_ident()));
+        }
+        lambda->add(parameter);
+        if (!accept_punct(",")) break;
+      }
+      expect_punct(")");
+    }
+    expect_punct("->");
+    lambda->add(is_punct("{") ? parse_block() : parse_expression());
+    return lambda;
+  }
+
+  Node* parse_primary() {
+    if (lambda_ahead()) return parse_lambda();
+    const Token& token = cur();
+    switch (token.kind) {
+      case Tok::kIntLit: {
+        advance();
+        return arena_->make("IntegerLiteralExpr", token.text);
+      }
+      case Tok::kFloatLit: {
+        advance();
+        return arena_->make("DoubleLiteralExpr", token.text);
+      }
+      case Tok::kCharLit: {
+        advance();
+        return arena_->make("CharLiteralExpr", token.text);
+      }
+      case Tok::kStringLit: {
+        advance();
+        return arena_->make("StringLiteralExpr", token.text);
+      }
+      case Tok::kIdent:
+        break;
+      case Tok::kPunct:
+        if (is_punct("(")) {
+          advance();
+          Node* enclosed = arena_->make("EnclosedExpr");
+          enclosed->add(parse_expression());
+          expect_punct(")");
+          return enclosed;
+        }
+        throw ParseError("unexpected token '" + token.text + "'");
+      default:
+        throw ParseError("unexpected end of input");
+    }
+    // identifier-led primaries
+    if (is_ident("new")) {
+      advance();
+      return parse_object_creation(nullptr);
+    }
+    if (is_ident("true") || is_ident("false")) {
+      Node* literal = arena_->make("BooleanLiteralExpr", token.text);
+      advance();
+      return literal;
+    }
+    if (is_ident("null")) {
+      advance();
+      return arena_->make("NullLiteralExpr", "null");
+    }
+    if (is_ident("this")) {
+      advance();
+      if (is_punct("(")) {  // this(...) constructor call
+        Node* call = arena_->make("ExplicitConstructorInvocationStmt");
+        parse_arguments(call);
+        return call;
+      }
+      return arena_->make("ThisExpr", "this");
+    }
+    if (is_ident("super")) {
+      advance();
+      if (is_punct("(")) {
+        Node* call = arena_->make("ExplicitConstructorInvocationStmt");
+        parse_arguments(call);
+        return call;
+      }
+      return arena_->make("SuperExpr", "super");
+    }
+    if (cur().kind == Tok::kIdent &&
+        primitive_types().count(cur().text)) {
+      // int.class / int[]::new etc: treat as type expression
+      Node* type = arena_->make("PrimitiveType", cur().text);
+      advance();
+      return maybe_array(type);
+    }
+    // plain name or unqualified call
+    std::string name = expect_ident();
+    if (is_punct("(")) {
+      Node* call = arena_->make("MethodCallExpr", name);
+      call->add(arena_->make("NameExpr", name));
+      parse_arguments(call);
+      return call;
+    }
+    return arena_->make("NameExpr", name);
+  }
+};
+
+}  // namespace c2v

@@ -1,0 +1,228 @@
+// c2v-extract — native Java path-context extractor CLI.
+//
+// Flag-compatible with the reference JavaExtractor
+// (Common/CommandLineValues.java:12-40): --file | --dir, --max_path_length,
+// --max_path_width, --no_hash, --num_threads, --min_code_len,
+// --max_code_len, --max_child_id. Output: one "label ctx ctx ..." line per
+// method on stdout (App.java / ExtractFeaturesTask.java), with the
+// reference's 3-stage parse retry (plain → class+method wrap → class wrap,
+// FeatureExtractor.java:51-75). Per-file failures go to stderr and are
+// skipped; lines are printed atomically under a mutex.
+#include <atomic>
+#include <filesystem>
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "java_ast.h"
+#include "java_lexer.h"
+#include "java_parser.h"
+#include "pathctx.h"
+
+namespace fs = std::filesystem;
+
+namespace {
+
+struct CliOptions {
+  std::string file;
+  std::string dir;
+  int num_threads = 32;
+  c2v::ExtractorOptions extractor;
+};
+
+bool parse_int_flag(const std::string& value, int* out) {
+  try {
+    *out = std::stoi(value);
+    return true;
+  } catch (...) {
+    return false;
+  }
+}
+
+bool parse_cli(int argc, char** argv, CliOptions* options) {
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) return nullptr;
+      return argv[++i];
+    };
+    if (arg == "--file") {
+      const char* v = next();
+      if (!v) return false;
+      options->file = v;
+    } else if (arg == "--dir") {
+      const char* v = next();
+      if (!v) return false;
+      options->dir = v;
+    } else if (arg == "--max_path_length") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.max_path_length))
+        return false;
+    } else if (arg == "--max_path_width") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.max_path_width))
+        return false;
+    } else if (arg == "--max_child_id") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.max_child_id))
+        return false;
+    } else if (arg == "--min_code_len") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.min_code_len))
+        return false;
+    } else if (arg == "--max_code_len") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.max_code_len))
+        return false;
+    } else if (arg == "--num_threads") {
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->num_threads)) return false;
+    } else if (arg == "--no_hash") {
+      options->extractor.no_hash = true;
+    } else if (arg == "--pretty_print") {
+      // accepted for flag compatibility; no-op
+    } else {
+      std::cerr << "unknown flag: " << arg << "\n";
+      return false;
+    }
+  }
+  if (options->file.empty() == options->dir.empty()) {
+    std::cerr << "exactly one of --file or --dir is required\n";
+    return false;
+  }
+  return true;
+}
+
+c2v::Node* parse_with_retries(const std::string& code, c2v::Arena* arena,
+                              std::string* parsed_source) {
+  // reference FeatureExtractor.java:51-75
+  const std::string class_prefix = "public class Test {";
+  const std::string class_suffix = "}";
+  const std::string method_prefix = "SomeUnknownReturnType f() {";
+  const std::string method_suffix = "return noSuchReturnValue; }";
+  const std::string candidates[3] = {
+      code,
+      class_prefix + method_prefix + code + method_suffix + class_suffix,
+      class_prefix + code + class_suffix,
+  };
+  for (const std::string& candidate : candidates) {
+    try {
+      c2v::Lexer lexer(candidate);
+      c2v::Parser parser(lexer.run(), arena);
+      c2v::Node* root = parser.parse_compilation_unit();
+      // a parse that found no methods is treated as failed so the wrapped
+      // retries get their chance
+      std::vector<c2v::Node*> methods;
+      c2v::find_methods(root, &methods);
+      if (!methods.empty()) {
+        *parsed_source = candidate;
+        return root;
+      }
+    } catch (const std::exception&) {
+      // fall through to the next wrapping
+    }
+  }
+  return nullptr;
+}
+
+std::string extract_file_to_string(const std::string& path,
+                                   const c2v::ExtractorOptions& options,
+                                   std::string* error) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) {
+    *error = "cannot open file: " + path;
+    return std::string();
+  }
+  std::ostringstream buffer;
+  buffer << in.rdbuf();
+  std::string code = buffer.str();
+
+  c2v::Arena arena;
+  std::string parsed_source;
+  c2v::Node* root = parse_with_retries(code, &arena, &parsed_source);
+  if (root == nullptr) {
+    *error = "could not parse: " + path;
+    return std::string();
+  }
+  std::vector<c2v::MethodFeatures> methods =
+      c2v::extract_all(root, parsed_source, options);
+  std::string out;
+  for (const auto& method : methods) {
+    out += method.label;
+    for (const auto& context : method.contexts) {
+      out += ' ';
+      out += context;
+    }
+    out += '\n';
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::ios::sync_with_stdio(false);
+  CliOptions options;
+  if (!parse_cli(argc, argv, &options)) return 2;
+
+  if (!options.file.empty()) {
+    std::string error;
+    std::string out =
+        extract_file_to_string(options.file, options.extractor, &error);
+    if (!error.empty()) {
+      std::cerr << error << "\n";
+      return 1;
+    }
+    std::cout << out;
+    return 0;
+  }
+
+  // --dir: recursive walk over .java files with a worker pool
+  // (reference App.java:39-59 used a ThreadPoolExecutor the same way)
+  std::vector<std::string> files;
+  std::error_code ec;
+  for (auto it = fs::recursive_directory_iterator(
+           options.dir, fs::directory_options::skip_permission_denied, ec);
+       it != fs::recursive_directory_iterator(); it.increment(ec)) {
+    if (ec) break;
+    if (it->is_regular_file(ec) && it->path().extension() == ".java") {
+      files.push_back(it->path().string());
+    }
+  }
+  if (ec) {
+    std::cerr << "error walking directory " << options.dir << ": "
+              << ec.message() << "\n";
+    return 1;
+  }
+
+  std::atomic<size_t> next_file{0};
+  std::mutex out_mutex;
+  int num_threads =
+      std::max(1, std::min<int>(options.num_threads,
+                                static_cast<int>(files.size())));
+  std::vector<std::thread> workers;
+  for (int t = 0; t < num_threads; ++t) {
+    workers.emplace_back([&]() {
+      while (true) {
+        size_t index = next_file.fetch_add(1);
+        if (index >= files.size()) return;
+        std::string error;
+        std::string out = extract_file_to_string(
+            files[index], options.extractor, &error);
+        std::lock_guard<std::mutex> lock(out_mutex);
+        if (!error.empty()) {
+          std::cerr << error << "\n";
+        } else {
+          std::cout << out;
+        }
+      }
+    });
+  }
+  for (auto& worker : workers) worker.join();
+  std::cout.flush();
+  return 0;
+}

@@ -1,0 +1,376 @@
+// Path-context extraction over the parsed AST.
+//
+// Reference semantics implemented here:
+// - leaf predicate, per-node childId (LeavesCollectorVisitor.java:20-37,
+//   57-68);
+// - node properties: type (+ :OP), normalized name, METHOD_NAME
+//   substitution, boxed-type renaming, 50-char truncation
+//   (Property.java:28-76, Common.java:36-76);
+// - all-pairs i<j path generation with MaxPathLength prune on node count
+//   and MaxPathWidth prune on LCA child-index delta, and the exact childId
+//   rendering rules — including the reference's asymmetric set-membership
+//   check (parent type on the way up, own type on the way down)
+//   (FeatureExtractor.java:95-195);
+// - output: "label src,path,tgt ..." with Java String#hashCode path hashing
+//   unless --no_hash (ProgramRelation.java:18-33).
+#pragma once
+
+#include <algorithm>
+#include <cctype>
+#include <cstdint>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "java_ast.h"
+
+namespace c2v {
+
+struct ExtractorOptions {
+  int max_path_length = 8;
+  int max_path_width = 2;
+  int max_child_id = 2147483647;  // reference default: Integer.MAX_VALUE
+  int min_code_len = 1;
+  int max_code_len = 10000;
+  bool no_hash = false;
+};
+
+// ---------------------------------------------------------- normalization
+// reference Common.java:36-53
+inline std::string normalize_name(const std::string& original,
+                                  const std::string& fallback) {
+  std::string cleaned;
+  cleaned.reserve(original.size());
+  for (size_t i = 0; i < original.size(); ++i) {
+    char c = original[i];
+    if (c == '\\' && i + 1 < original.size() && original[i + 1] == 'n') {
+      ++i;  // escaped newline
+      continue;
+    }
+    if (c == '"' || c == '\'' || c == ',') continue;
+    unsigned char uc = static_cast<unsigned char>(c);
+    if (uc < 0x20 || uc >= 0x7F) continue;  // non-printables / non-ascii
+    cleaned.push_back(
+        static_cast<char>(std::tolower(static_cast<unsigned char>(c))));
+  }
+  std::string stripped;
+  for (char c : cleaned)
+    if (std::isalpha(static_cast<unsigned char>(c))) stripped.push_back(c);
+  if (!stripped.empty()) return stripped;
+  std::string careful;
+  for (char c : cleaned) careful.push_back(c == ' ' ? '_' : c);
+  if (!careful.empty()) return careful;
+  return fallback;
+}
+
+// reference Common.java:71-76: split on aA boundaries, '_', digits,
+// AAb boundaries and whitespace; normalize parts; drop empties.
+inline std::vector<std::string> split_subtokens(const std::string& input) {
+  std::string trimmed = input;
+  while (!trimmed.empty() &&
+         std::isspace(static_cast<unsigned char>(trimmed.front())))
+    trimmed.erase(trimmed.begin());
+  while (!trimmed.empty() &&
+         std::isspace(static_cast<unsigned char>(trimmed.back())))
+    trimmed.pop_back();
+
+  std::vector<std::string> parts;
+  std::string current;
+  auto flush = [&]() {
+    if (!current.empty()) {
+      std::string normalized = normalize_name(current, "");
+      if (!normalized.empty()) parts.push_back(normalized);
+      current.clear();
+    }
+  };
+  for (size_t i = 0; i < trimmed.size(); ++i) {
+    char c = trimmed[i];
+    if (c == '_' || std::isdigit(static_cast<unsigned char>(c)) ||
+        std::isspace(static_cast<unsigned char>(c))) {
+      flush();  // separator chars are dropped
+      continue;
+    }
+    bool lower_to_upper =
+        i > 0 && std::islower(static_cast<unsigned char>(trimmed[i - 1])) &&
+        std::isupper(static_cast<unsigned char>(c));
+    bool acronym_end = i + 1 < trimmed.size() &&
+                       std::isupper(static_cast<unsigned char>(c)) &&
+                       i > 0 &&
+                       std::isupper(static_cast<unsigned char>(trimmed[i - 1])) &&
+                       std::islower(static_cast<unsigned char>(trimmed[i + 1]));
+    if (lower_to_upper || acronym_end) flush();
+    current.push_back(c);
+  }
+  flush();
+  return parts;
+}
+
+inline std::string join(const std::vector<std::string>& parts,
+                        const std::string& sep) {
+  std::string out;
+  for (size_t i = 0; i < parts.size(); ++i) {
+    if (i) out += sep;
+    out += parts[i];
+  }
+  return out;
+}
+
+// Java String#hashCode (reference ProgramRelation.java:25 uses
+// String.hashCode via Integer.toString).
+inline int32_t java_hash(const std::string& s) {
+  uint32_t h = 0;
+  for (unsigned char c : s) h = 31u * h + c;
+  return static_cast<int32_t>(h);
+}
+
+// ------------------------------------------------------------- properties
+inline bool is_boxed_type(const Node* node) {
+  static const std::set<std::string> kBoxed = {
+      "Boolean", "Byte", "Character", "Double",
+      "Float",   "Integer", "Long",   "Short"};
+  return node->raw_type == "ClassOrInterfaceType" && kBoxed.count(node->code);
+}
+
+inline std::string unboxed_name(const std::string& boxed) {
+  if (boxed == "Integer") return "int";
+  if (boxed == "Character") return "char";
+  std::string lower = boxed;
+  for (char& c : lower)
+    c = static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+  return lower;  // boolean byte double float long short
+}
+
+struct Property {
+  std::string type;  // path-rendering type (may be rewritten)
+  std::string name;  // emitted terminal token
+};
+
+constexpr int kMaxLabelLength = 50;  // reference Common.java:32
+
+// reference Property.java:28-76
+inline Property compute_property(const Node* node, bool is_leaf) {
+  Property property;
+  property.type = node->type;
+  bool boxed = is_boxed_type(node);
+  if (boxed) property.type = "PrimitiveType";
+
+  bool generic_parent = node->raw_type == "ClassOrInterfaceType" &&
+                        !node->children.empty();
+  if (generic_parent && is_leaf) property.type = "GenericClass";
+
+  property.name = normalize_name(node->code, "BLANK");
+  if (static_cast<int>(property.name.size()) > kMaxLabelLength) {
+    property.name = property.name.substr(0, kMaxLabelLength);
+  } else if (boxed) {
+    property.name = unboxed_name(node->code);
+  }
+  // METHOD_NAME substitution (Common.java:69-75)
+  if (node->raw_type == "NameExpr" && node->parent != nullptr &&
+      node->parent->raw_type == "MethodDeclaration") {
+    property.name = "METHOD_NAME";
+  }
+  return property;
+}
+
+// ---------------------------------------------------------------- leaves
+// reference LeavesCollectorVisitor.java:20-37
+inline bool is_leaf(const Node* node) {
+  if (!node->children.empty()) return false;
+  if (node->is_statement) return false;
+  if (node->code.empty()) return false;
+  if (node->code == "null" && node->raw_type != "NullLiteralExpr")
+    return false;
+  return true;
+}
+
+inline void collect_leaves(Node* node, std::vector<Node*>* leaves) {
+  if (is_leaf(node)) leaves->push_back(node);
+  for (Node* child : node->children) collect_leaves(child, leaves);
+}
+
+// ----------------------------------------------------------------- paths
+inline const std::set<std::string>& child_id_parent_types() {
+  // reference FeatureExtractor.java:26-28
+  static const std::set<std::string> kTypes = {
+      "AssignExpr", "ArrayAccessExpr", "FieldAccessExpr", "MethodCallExpr"};
+  return kTypes;
+}
+
+inline std::vector<const Node*> tree_stack(const Node* node) {
+  std::vector<const Node*> stack;
+  for (const Node* current = node; current != nullptr;
+       current = current->parent)
+    stack.push_back(current);
+  return stack;
+}
+
+// reference FeatureExtractor.java:120-191. Empty string = pruned.
+inline std::string generate_path(const Node* source, const Node* target,
+                                 const ExtractorOptions& options) {
+  std::vector<const Node*> source_stack = tree_stack(source);
+  std::vector<const Node*> target_stack = tree_stack(target);
+
+  int common_prefix = 0;
+  int si = static_cast<int>(source_stack.size()) - 1;
+  int ti = static_cast<int>(target_stack.size()) - 1;
+  while (si >= 0 && ti >= 0 && source_stack[si] == target_stack[ti]) {
+    ++common_prefix;
+    --si;
+    --ti;
+  }
+  int path_length = static_cast<int>(source_stack.size()) +
+                    static_cast<int>(target_stack.size()) -
+                    2 * common_prefix;
+  if (path_length > options.max_path_length) return std::string();
+  if (si >= 0 && ti >= 0) {
+    int path_width =
+        target_stack[ti]->child_id - source_stack[si]->child_id;
+    if (path_width > options.max_path_width) return std::string();
+  }
+
+  auto saturate = [&](int child_id) {
+    return std::min(child_id, options.max_child_id);
+  };
+
+  std::string out;
+  int source_nodes = static_cast<int>(source_stack.size()) - common_prefix;
+  for (int i = 0; i < source_nodes; ++i) {
+    const Node* current = source_stack[i];
+    std::string child_id;
+    // up-walk: childId appended for the leaf itself or when the PARENT's
+    // raw type is in the set (FeatureExtractor.java:157-161)
+    const std::string& parent_raw =
+        current->parent ? current->parent->raw_type : std::string();
+    if (i == 0 || child_id_parent_types().count(parent_raw)) {
+      child_id = std::to_string(saturate(current->child_id));
+    }
+    out += '(';
+    out += compute_property(current, i == 0 && is_leaf(current)).type;
+    out += child_id;
+    out += ')';
+    out += '^';
+  }
+
+  const Node* common_node = source_stack[source_nodes];
+  std::string common_child_id;
+  const std::string common_parent_raw =
+      common_node->parent ? common_node->parent->raw_type : std::string();
+  if (child_id_parent_types().count(common_parent_raw)) {
+    common_child_id = std::to_string(saturate(common_node->child_id));
+  }
+  out += '(';
+  out += compute_property(common_node, false).type;
+  out += common_child_id;
+  out += ')';
+
+  for (int i = static_cast<int>(target_stack.size()) - common_prefix - 1;
+       i >= 0; --i) {
+    const Node* current = target_stack[i];
+    std::string child_id;
+    // down-walk: the reference checks the CURRENT node's own raw type here
+    // (FeatureExtractor.java:182) — asymmetric with the up-walk; kept
+    // verbatim for parity
+    if (i == 0 || child_id_parent_types().count(current->raw_type)) {
+      child_id = std::to_string(saturate(current->child_id));
+    }
+    out += '_';
+    out += '(';
+    out += compute_property(current, i == 0 && is_leaf(current)).type;
+    out += child_id;
+    out += ')';
+  }
+  return out;
+}
+
+// ------------------------------------------------------------ per method
+struct MethodFeatures {
+  std::string label;
+  std::vector<std::string> contexts;  // "src,path-or-hash,tgt"
+};
+
+inline void find_methods(Node* node, std::vector<Node*>* methods) {
+  if (node->raw_type == "MethodDeclaration") methods->push_back(node);
+  for (Node* child : node->children) find_methods(child, methods);
+}
+
+inline long method_length_lines(const Node* method,
+                                const std::string& source) {
+  // reference FunctionVisitor.java:44-57: count body source lines minus
+  // comment-only lines; its brace/blank filters are no-ops (string
+  // reference comparison), so only the comment filter is effective.
+  const Node* body = nullptr;
+  for (const Node* child : method->children)
+    if (child->raw_type == "BlockStmt") body = child;
+  if (body == nullptr || body->children.empty()) return 0;
+  size_t begin = body->src_begin, end = body->src_end;
+  if (end <= begin || end > source.size()) return 1;
+  long lines = 0;
+  size_t line_start = begin;
+  auto count_line = [&](size_t line_end) {
+    std::string_view line(source.data() + line_start,
+                          line_end - line_start);
+    size_t first = line.find_first_not_of(" \t\r");
+    if (first == std::string_view::npos) {
+      ++lines;  // blank lines ARE counted (the reference's blank filter
+                // never fires)
+      return;
+    }
+    if (line[first] == '/' || line[first] == '*') return;  // comment line
+    ++lines;
+  };
+  for (size_t i = begin; i < end; ++i) {
+    if (source[i] == '\n') {
+      count_line(i);
+      line_start = i + 1;
+    }
+  }
+  if (line_start < end) count_line(end);
+  return lines;
+}
+
+inline MethodFeatures extract_method(Node* method,
+                                     const ExtractorOptions& options) {
+  MethodFeatures features;
+  // label: subtoken-split method name (FunctionVisitor.java:30-38)
+  std::vector<std::string> parts = split_subtokens(method->code);
+  features.label = parts.empty() ? normalize_name(method->code, "BLANK")
+                                 : join(parts, "|");
+
+  std::vector<Node*> leaves;
+  collect_leaves(method, &leaves);
+  // properties computed once per leaf, not once per pair (the reference
+  // similarly computes Property once per node in its visitor)
+  std::vector<std::string> leaf_names;
+  leaf_names.reserve(leaves.size());
+  for (const Node* leaf : leaves)
+    leaf_names.push_back(compute_property(leaf, true).name);
+  for (size_t i = 0; i < leaves.size(); ++i) {
+    for (size_t j = i + 1; j < leaves.size(); ++j) {
+      std::string path = generate_path(leaves[i], leaves[j], options);
+      if (path.empty()) continue;
+      const std::string path_out =
+          options.no_hash ? path : std::to_string(java_hash(path));
+      features.contexts.push_back(leaf_names[i] + ',' + path_out + ',' +
+                                  leaf_names[j]);
+    }
+  }
+  return features;
+}
+
+inline std::vector<MethodFeatures> extract_all(
+    Node* root, const std::string& source, const ExtractorOptions& options) {
+  std::vector<Node*> methods;
+  find_methods(root, &methods);
+  std::vector<MethodFeatures> all;
+  for (Node* method : methods) {
+    long length = method_length_lines(method, source);
+    if (length < options.min_code_len || length > options.max_code_len)
+      continue;
+    MethodFeatures features = extract_method(method, options);
+    if (!features.contexts.empty()) all.push_back(std::move(features));
+  }
+  return all;
+}
+
+}  // namespace c2v

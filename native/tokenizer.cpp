@@ -12,6 +12,7 @@
 //
 // Exposed as a C API for ctypes (no pybind11 in this image).
 #include <cstdint>
+#include <deque>
 #include <cstring>
 #include <string>
 #include <string_view>
@@ -22,13 +23,26 @@
 namespace {
 
 struct Vocab {
-  std::unordered_map<std::string, int32_t> word_to_index;
+  // keys are views into `storage` (one retained copy of the words blob):
+  // lookups take a string_view with NO per-token heap allocation
+  std::unordered_map<std::string_view, int32_t> word_to_index;
+  std::deque<std::string> storage;  // deque: elements never move
   int32_t oov = 0;
   int32_t pad = 0;
 
+  // context parts: the reference's CSV default substitutes the PAD word
+  // for empty fields before the hashtable lookup
   int32_t lookup(std::string_view word) const {
     if (word.empty()) return pad;
-    auto it = word_to_index.find(std::string(word));
+    auto it = word_to_index.find(word);
+    return it == word_to_index.end() ? oov : it->second;
+  }
+
+  // labels: the reference's CSV default for the label column is the OOV
+  // word (path_context_reader.py:82), so an empty label is OOV, not PAD
+  int32_t lookup_label(std::string_view word) const {
+    if (word.empty()) return oov;
+    auto it = word_to_index.find(word);
     return it == word_to_index.end() ? oov : it->second;
   }
 };
@@ -61,7 +75,7 @@ void tokenize_range(const Tokenizer* tok, const char* buf,
     size_t pos = line.find(' ');
     std::string_view label_sv =
         pos == std::string_view::npos ? line : line.substr(0, pos);
-    label[r] = tok->target.lookup(label_sv);
+    label[r] = tok->target.lookup_label(label_sv);
 
     int32_t c = 0;
     size_t start = pos == std::string_view::npos ? line.size() : pos + 1;
@@ -134,12 +148,14 @@ void c2v_tok_add_words(void* handle, int32_t vocab_id, const char* words,
   Vocab* vocab = vocab_by_id(static_cast<Tokenizer*>(handle), vocab_id);
   if (!vocab) return;
   vocab->word_to_index.reserve(static_cast<size_t>(n_words) * 2);
-  std::string_view buf(words, static_cast<size_t>(words_len));
+  // retain one copy of the blob; map keys are views into it
+  vocab->storage.emplace_back(words, static_cast<size_t>(words_len));
+  std::string_view buf(vocab->storage.back());
   size_t start = 0;
   for (int32_t i = 0; i < n_words; ++i) {
     size_t end = buf.find('\n', start);
     if (end == std::string_view::npos) end = buf.size();
-    vocab->word_to_index.emplace(std::string(buf.substr(start, end - start)),
+    vocab->word_to_index.emplace(buf.substr(start, end - start),
                                  indices[i]);
     start = end + 1;
   }

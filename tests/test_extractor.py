@@ -1,0 +1,196 @@
+"""Golden tests for the native C++ Java extractor (extractor/).
+
+The reference JAR can't run here (no JVM in the image), so goldens are
+hand-derived from the reference's documented semantics
+(FeatureExtractor.java / Property.java / Common.java — see
+extractor/src/pathctx.h)."""
+import os
+import subprocess
+
+import pytest
+
+from code2vec_tpu import common
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+
+
+def _build():
+    if os.path.isfile(BINARY):
+        return True
+    proc = subprocess.run(['make'], cwd=os.path.join(REPO, 'extractor'),
+                          capture_output=True, text=True)
+    return proc.returncode == 0
+
+
+pytestmark = pytest.mark.skipif(not _build(),
+                                reason='extractor build unavailable')
+
+
+def run_extractor(*args):
+    return subprocess.run([BINARY, '--max_path_length', '8',
+                           '--max_path_width', '2', *args],
+                          capture_output=True, text=True)
+
+
+def extract_file(path, no_hash=True):
+    args = ['--file', path] + (['--no_hash'] if no_hash else [])
+    proc = run_extractor(*args)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout.splitlines()
+
+
+def test_simple_method_golden(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('public class T {\n'
+                   '    int getSquare(int x) {\n'
+                   '        return x * x;\n'
+                   '    }\n'
+                   '}\n')
+    lines = extract_file(str(src))
+    assert len(lines) == 1
+    parts = lines[0].split(' ')
+    assert parts[0] == 'get|square'   # subtoken label
+    contexts = parts[1:]
+    # the x*x pair: both leaves under the BinaryExpr, childIds 0 and 1
+    assert 'x,(NameExpr0)^(BinaryExpr:MULTIPLY)_(NameExpr1),x' in contexts
+    # METHOD_NAME substitution for the name leaf
+    assert any(',METHOD_NAME' in c or c.startswith('METHOD_NAME,')
+               for c in contexts)
+    # all-pairs count: leaves are [int, METHOD_NAME, int, x, x, x] = 6
+    # -> 15 pairs, minus prunes; every context has 3 comma parts
+    assert all(len(c.split(',')) == 3 for c in contexts)
+
+
+def test_path_length_pruning(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('class T { void f(int a) { g(h(i(j(k(a)))));'
+                   ' int z = a; } }')
+    lines = extract_file(str(src))
+    for ctx in lines[0].split(' ')[1:]:
+        path = ctx.split(',')[1]
+        # reference pathLength = stack nodes excluding the LCA = number of
+        # arrows (FeatureExtractor.java:140-143)
+        assert path.count('^') + path.count('_') <= 8, path
+
+
+def test_snippet_wrap_retry(tmp_path):
+    # bare method body: parses only via the reference's class-wrap retry
+    src = tmp_path / 'snippet.java'
+    src.write_text('int add(int a, int b) { return a + b; }')
+    lines = extract_file(str(src))
+    assert lines[0].startswith('add ')
+    assert '(BinaryExpr:PLUS)' in lines[0]
+
+
+def test_hash_mode_matches_java_hashcode(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('class T { int id(int x) { return x; } }')
+    no_hash_lines = extract_file(str(src), no_hash=True)
+    hashed_lines = extract_file(str(src), no_hash=False)
+    raw_ctxs = no_hash_lines[0].split(' ')[1:]
+    hashed_ctxs = hashed_lines[0].split(' ')[1:]
+    assert len(raw_ctxs) == len(hashed_ctxs)
+    for raw, hashed in zip(raw_ctxs, hashed_ctxs):
+        raw_source, raw_path, raw_target = raw.split(',')
+        hashed_source, hashed_path, hashed_target = hashed.split(',')
+        assert (raw_source, raw_target) == (hashed_source, hashed_target)
+        assert int(hashed_path) == common.java_string_hashcode(raw_path)
+
+
+def test_normalization_rules(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('class T { void f() {\n'
+                   '  String s = "Hello, World!";\n'
+                   '  int n = 123;\n'
+                   '  callIt(s, n);\n'
+                   '} }')
+    line = extract_file(str(src))[0]
+    # string literal: lowercase, strip quotes/commas/non-alpha
+    assert 'helloworld' in line
+    # integer literal name: digits survive normalize (no alpha)
+    assert ',123' in line or '123,' in line
+
+
+def test_method_name_is_label_not_leaf_token(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('class T { void setFooBar(int v) { this.v = v; } }')
+    line = extract_file(str(src))[0]
+    assert line.split(' ')[0] == 'set|foo|bar'
+
+
+def test_empty_method_skipped(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('class T { void empty() { } int one() { return 1; } }')
+    lines = extract_file(str(src))
+    labels = [line.split(' ')[0] for line in lines]
+    assert labels == ['one']  # empty body -> length 0 < min_code_len
+
+
+def test_dir_mode_with_broken_file(tmp_path):
+    (tmp_path / 'a').mkdir()
+    (tmp_path / 'a' / 'Good.java').write_text(
+        'class G { int f(int x) { return x; } }')
+    (tmp_path / 'Broken.java').write_text('not java at all {{{')
+    proc = run_extractor('--dir', str(tmp_path), '--num_threads', '2',
+                         '--no_hash')
+    assert proc.returncode == 0
+    labels = [line.split(' ')[0] for line in proc.stdout.splitlines()]
+    assert labels == ['f']
+    assert 'could not parse' in proc.stderr
+
+
+def test_operators_and_constructs(tmp_path):
+    src = tmp_path / 'T.java'
+    src.write_text('''
+class T {
+  int compute(int[] arr, boolean flag) {
+    int total = 0;
+    for (int i = 0; i < arr.length; i++) {
+      if (flag && arr[i] % 2 == 0) { total += arr[i]; }
+      else { total -= 1; }
+    }
+    while (total > 100) { total /= 2; }
+    return flag ? total : -total;
+  }
+}
+''')
+    line = extract_file(str(src))[0]
+    assert line.split(' ')[0] == 'compute'
+    for expected in ['BinaryExpr:LESS', 'UnaryExpr:POSTFIX_INCREMENT',
+                     'AssignExpr:PLUS', 'ArrayAccessExpr', 'ConditionalExpr',
+                     'FieldAccessExpr', 'ForStmt', 'WhileStmt', 'IfStmt']:
+        assert expected in line, expected
+
+
+def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
+    """End-to-end: real binary feeds the REPL (reference flow:
+    interactive_predict.py + extractor.py + JAR)."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.serving.extractor_bridge import Extractor
+    from code2vec_tpu.serving.predict import InteractivePredictor
+    from tests.test_train_overfit import make_dataset
+
+    prefix = make_dataset(tmp_path)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False)
+    model = Code2VecModel(config)
+
+    input_file = tmp_path / 'Input.java'
+    input_file.write_text('class X { int getSquare(int x) '
+                          '{ return x * x; } }')
+    extractor = Extractor(config, extractor_command=[BINARY])
+    predictor = InteractivePredictor(config, model, extractor=extractor,
+                                     input_filename=str(input_file))
+    answers = iter(['', 'q'])
+    monkeypatch.setattr('builtins.input', lambda: next(answers))
+    predictor.predict()
+    out = capsys.readouterr().out
+    assert 'Original name:\tget|square' in out
+    assert 'Attention:' in out
+    # attention paths are displayed un-hashed
+    assert '(BinaryExpr:MULTIPLY)' in out

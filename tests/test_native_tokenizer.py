@@ -24,6 +24,7 @@ def _readers(small_setup):  # noqa: F811
 
 LINES = [
     'lbl1 s1,p1,t1 zzz,p2,t1 s2,qqq,qq  ',
+    ' s1,p1,t1',                # empty label -> OOV (CSV default is OOV)
     'unknownlbl s1,p1,t1',
     'lbl2 zz,zz,zz',
     'lbl2 s2,p2,t1 s1,p1',      # malformed 2-part context
