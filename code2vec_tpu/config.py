@@ -71,6 +71,10 @@ class Config:
     # Host input pipeline.
     READER_PREFETCH_BATCHES: int = 8
     READER_USE_NATIVE: bool = True  # use the C++ tokenizer when available
+    # Tokenize the train split once into a binary cache
+    # (<data>.train.c2v.tokcache/, ~12 bytes/context on disk) and stream
+    # int32 tensors for every later epoch.
+    TRAIN_DATA_CACHE: bool = True
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -144,6 +148,10 @@ class Config:
                             default=None, help='override TRAIN_BATCH_SIZE')
         parser.add_argument('--epochs', dest='epochs', type=int, default=None,
                             help='override NUM_TRAIN_EPOCHS')
+        parser.add_argument('--no-data-cache', dest='no_data_cache',
+                            action='store_true',
+                            help='disable the binary token cache for the '
+                                 'train split')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -177,6 +185,8 @@ class Config:
             self.TEST_BATCH_SIZE = parsed.batch_size
         if parsed.epochs:
             self.NUM_TRAIN_EPOCHS = parsed.epochs
+        if parsed.no_data_cache:
+            self.TRAIN_DATA_CACHE = False
         return self
 
     # ------------------------------------------------------- derived props

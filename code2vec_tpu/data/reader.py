@@ -62,6 +62,63 @@ class EstimatorAction(Enum):
         return self.is_evaluate or self.is_predict
 
 
+def context_valid_mask(source: np.ndarray, path: np.ndarray,
+                       target: np.ndarray, token_pad: int,
+                       path_pad: int) -> np.ndarray:
+    """A context is valid iff any of its three parts is non-PAD
+    (reference path_context_reader.py:209-214, including the joined
+    PAD==OOV subtlety). Single definition — parity-critical."""
+    return ((source != token_pad) | (target != token_pad)
+            | (path != path_pad)).astype(np.float32)
+
+
+def prefetch_iterator(make_iterator, depth: int):
+    """Run ``make_iterator()`` in a background thread with a bounded queue
+    (the reference's ``prefetch``, path_context_reader.py:150). Safe to
+    abandon mid-iteration: closing the generator cancels the producer."""
+    out: 'queue.Queue' = queue.Queue(depth)
+    sentinel = object()
+    cancelled = threading.Event()
+    error: List[BaseException] = []
+
+    def produce():
+        try:
+            for item in make_iterator():
+                while not cancelled.is_set():
+                    try:
+                        out.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if cancelled.is_set():
+                    return
+        except BaseException as exc:  # propagate to consumer
+            error.append(exc)
+        finally:
+            # must not drop the sentinel on a full queue, or the consumer
+            # blocks forever after draining it
+            while not cancelled.is_set():
+                try:
+                    out.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    thread = threading.Thread(target=produce, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = out.get()
+            if item is sentinel:
+                break
+            yield item
+    finally:
+        cancelled.set()
+        thread.join()
+    if error:
+        raise error[0]
+
+
 class Batch(NamedTuple):
     """One device-ready batch. All arrays have static leading dimension
     ``batch_size``; short final batches are padded with ``weight == 0`` rows."""
@@ -189,13 +246,9 @@ class PathContextReader:
 
     def _context_valid_mask(self, source: np.ndarray, path: np.ndarray,
                             target: np.ndarray) -> np.ndarray:
-        """A context is valid iff any of its three parts is non-PAD
-        (reference path_context_reader.py:209-214, including the joined
-        PAD==OOV subtlety)."""
-        token_pad = self.vocabs.token_vocab.pad_index
-        path_pad = self.vocabs.path_vocab.pad_index
-        return ((source != token_pad) | (target != token_pad)
-                | (path != path_pad)).astype(np.float32)
+        return context_valid_mask(source, path, target,
+                                  self.vocabs.token_vocab.pad_index,
+                                  self.vocabs.path_vocab.pad_index)
 
     # ------------------------------------------------------------- batching
     def _lines_from_file(self) -> Iterator[str]:
@@ -340,52 +393,10 @@ class PathContextReader:
 
     def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,
                               seed: Optional[int] = None) -> Iterator[Batch]:
-        """``iter_epoch`` behind a background thread + bounded queue
-        (the reference's ``prefetch(40)``, path_context_reader.py:150).
-
-        Safe to abandon mid-epoch (e.g. a trainer breaking out to evaluate):
-        closing the generator cancels the producer thread instead of leaking
-        it blocked on the full queue."""
-        out: 'queue.Queue' = queue.Queue(self.config.READER_PREFETCH_BATCHES)
-        sentinel = object()
-        cancelled = threading.Event()
-        error: List[BaseException] = []
-
-        def produce():
-            try:
-                for batch in self.iter_epoch(shuffle=shuffle, seed=seed):
-                    while not cancelled.is_set():
-                        try:
-                            out.put(batch, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if cancelled.is_set():
-                        return
-            except BaseException as exc:  # propagate to consumer
-                error.append(exc)
-            finally:
-                # must not drop the sentinel on a full queue, or the consumer
-                # blocks forever after draining it
-                while not cancelled.is_set():
-                    try:
-                        out.put(sentinel, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-        thread = threading.Thread(target=produce, daemon=True)
-        thread.start()
-        try:
-            while True:
-                item = out.get()
-                if item is sentinel:
-                    break
-                yield item
-        finally:
-            cancelled.set()
-            thread.join()
-        if error:
-            raise error[0]
+        """``iter_epoch`` behind a background prefetch thread."""
+        yield from prefetch_iterator(
+            lambda: self.iter_epoch(shuffle=shuffle, seed=seed),
+            self.config.READER_PREFETCH_BATCHES)
 
     def process_input_rows(self, input_lines: Iterable[str]) -> Batch:
         """Tokenize raw extractor output lines for prediction — never

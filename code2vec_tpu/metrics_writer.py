@@ -1,0 +1,55 @@
+"""Training-metric summaries (the role of the reference's ``--tensorboard``
+flag, config.py:42-43 / keras_model.py:158-163, which attached a Keras
+TensorBoard callback).
+
+Scalars are appended as JSON lines to ``<logdir>/metrics.jsonl`` — robust,
+dependency-free, and trivially plottable. If TensorBoard's writer is
+importable (via torch), an event file is written as well.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class MetricsWriter:
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, 'metrics.jsonl'), 'a')
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+            self._tb = SummaryWriter(log_dir=logdir)
+        except Exception:
+            self._tb = None
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        record = {'tag': tag, 'value': float(value), 'step': int(step),
+                  'time': time.time()}
+        self._jsonl.write(json.dumps(record) + '\n')
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def maybe_create(config) -> Optional[MetricsWriter]:
+    """A writer when ``--tensorboard`` was passed and a place to write
+    exists (next to the model, like the reference's log dir)."""
+    if not config.USE_TENSORBOARD:
+        return None
+    if config.is_saving:
+        logdir = os.path.join(os.path.dirname(config.MODEL_SAVE_PATH),
+                              'summaries')
+    elif config.is_loading:
+        logdir = os.path.join(config.model_load_dir, 'summaries')
+    else:
+        logdir = 'summaries'
+    return MetricsWriter(logdir)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from code2vec_tpu import common
+from code2vec_tpu import common, metrics_writer
 from code2vec_tpu.checkpoints import CheckpointStore
 from code2vec_tpu.config import Config
 from code2vec_tpu.data.reader import Batch, EstimatorAction, PathContextReader
@@ -159,12 +159,31 @@ class Code2VecModel:
         reader = PathContextReader(self.vocabs, config, EstimatorAction.Train)
         save_store = (self._store_for(config.MODEL_SAVE_PATH)
                       if config.is_saving else None)
+        writer = metrics_writer.maybe_create(config)
         self.log('Starting training (%d epochs, batch %d, steps/epoch ~%d)'
                  % (config.NUM_TRAIN_EPOCHS, config.TRAIN_BATCH_SIZE,
                     config.train_steps_per_epoch))
 
-        def epoch_batches(epoch: int):
-            return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)
+        if config.TRAIN_DATA_CACHE:
+            from code2vec_tpu.data.cache import TokenCache
+            from code2vec_tpu.data.reader import prefetch_iterator
+            cache = TokenCache.build_or_load(config, self.vocabs, reader)
+
+            def epoch_batches(epoch: int):
+                # prefetch thread keeps chunk reads/shuffles off the
+                # training thread, like the streaming path
+                return prefetch_iterator(
+                    lambda: cache.iter_epoch(config.TRAIN_BATCH_SIZE,
+                                             shuffle=True, seed=epoch),
+                    config.READER_PREFETCH_BATCHES)
+        else:
+            def epoch_batches(epoch: int):
+                return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)
+
+        def on_log(step: int, avg_loss: float, throughput: float) -> None:
+            if writer is not None:
+                writer.scalar('train/loss', avg_loss, step)
+                writer.scalar('train/examples_per_sec', throughput, step)
 
         def on_epoch_end(epoch: int, state: TrainerState) -> None:
             self.params = state.params
@@ -174,11 +193,25 @@ class Code2VecModel:
             if config.is_testing:
                 results = self.evaluate()
                 self.log('After epoch %d: %s' % (epoch + 1, results))
+                if writer is not None:
+                    writer.scalar('eval/top1_acc',
+                                  float(results.topk_acc[0]), epoch + 1)
+                    writer.scalar('eval/subtoken_f1',
+                                  results.subtoken_f1, epoch + 1)
+                    writer.scalar('eval/subtoken_precision',
+                                  results.subtoken_precision, epoch + 1)
+                    writer.scalar('eval/subtoken_recall',
+                                  results.subtoken_recall, epoch + 1)
 
         start = getattr(self, '_start_epoch', 0)
-        self.state = self.trainer.fit(self.state, epoch_batches,
-                                      start_epoch=start,
-                                      on_epoch_end=on_epoch_end)
+        try:
+            self.state = self.trainer.fit(self.state, epoch_batches,
+                                          start_epoch=start,
+                                          on_epoch_end=on_epoch_end,
+                                          on_log=on_log)
+        finally:
+            if writer is not None:
+                writer.close()
         self.params = self.state.params
         if save_store is not None:
             save_store.close()

@@ -164,13 +164,16 @@ class Trainer:
     def fit(self, state: TrainerState,
             epoch_batches: Callable[[int], Iterable[Batch]],
             start_epoch: int = 0,
-            on_epoch_end: Optional[Callable[[int, TrainerState], None]] = None
+            on_epoch_end: Optional[Callable[[int, TrainerState], None]] = None,
+            on_log: Optional[Callable[[int, float, float], None]] = None
             ) -> TrainerState:
         """Epoch-driven loop with the reference's windowed throughput trace
         (tensorflow_model.py:74-101, 424-430)."""
         config = self.config
         log_every = config.NUM_BATCHES_TO_LOG_PROGRESS
-        batch_num = 0
+        # resumed runs continue the step axis instead of restarting at 0
+        # (metric streams are append-mode)
+        batch_num = start_epoch * config.train_steps_per_epoch
         window_losses = []  # device arrays: no per-step host sync, the
         window_examples = 0  # host only blocks once per log window
         window_start = time.time()
@@ -191,6 +194,9 @@ class Trainer:
                         'samples/sec' % (batch_num,
                                          sum_loss / len(window_losses),
                                          throughput))
+                    if on_log is not None:
+                        on_log(batch_num, sum_loss / len(window_losses),
+                               throughput)
                     window_losses = []
                     window_examples = 0
                     window_start = time.time()

@@ -1,0 +1,83 @@
+"""Binary token cache: same rows as the streaming reader, cache reuse,
+staleness invalidation, shuffle correctness."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.data.cache import TokenCache
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+
+from tests.test_reader import small_setup, _write_train  # noqa: F401
+
+
+def _rows_from_batches(batches):
+    rows = set()
+    for batch in batches:
+        for r in range(batch.label.shape[0]):
+            if batch.weight[r] > 0:
+                rows.add((int(batch.label[r]),
+                          tuple(batch.source[r].tolist()),
+                          tuple(batch.path[r].tolist()),
+                          tuple(batch.mask[r].tolist())))
+    return rows
+
+
+def test_cache_matches_streaming_reader(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1 zzz,p2,t1', 'lbl2 s2,p2,t1',
+                          'unknown s1,p1,t1', 'lbl2 zz,zz,zz'] * 5)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache.num_rows == 10  # 2 of 4 lines pass the train filter, x5
+    streamed = _rows_from_batches(reader.iter_epoch(shuffle=False))
+    cached = _rows_from_batches(cache.iter_epoch(2, shuffle=False))
+    assert streamed == cached
+
+
+def test_cache_is_reused_and_invalidated(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1'] * 4)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache1 = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache1.num_rows == 4
+    # unchanged file -> reused (same meta)
+    cache2 = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache2.meta == cache1.meta
+    # grown file -> rebuilt
+    _write_train(prefix, ['lbl1 s1,p1,t1'] * 6)
+    cache3 = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache3.num_rows == 6
+
+
+def test_cache_shuffle_is_epoch_dependent_permutation(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    lines = ['lbl1 s1,p1,t1', 'lbl2 s2,p2,t1', 'lbl1 s2,p1,t1',
+             'lbl2 s1,p2,t1'] * 4
+    _write_train(prefix, lines)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+
+    def epoch_rows(seed):
+        rows = []
+        for batch in cache.iter_epoch(4, shuffle=True, seed=seed,
+                                      chunk_rows=8):
+            for r in range(batch.label.shape[0]):
+                if batch.weight[r] > 0:
+                    rows.append((int(batch.label[r]),
+                                 tuple(batch.source[r].tolist())))
+        return rows
+
+    rows0, rows1 = epoch_rows(0), epoch_rows(1)
+    assert sorted(rows0) == sorted(rows1)  # same multiset
+    assert rows0 != rows1                  # different order
+
+
+def test_cache_partial_final_batch_padded(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1'] * 5)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    batches = list(cache.iter_epoch(2, shuffle=False))
+    assert len(batches) == 3
+    assert batches[-1].source.shape == (2, config.MAX_CONTEXTS)
+    np.testing.assert_array_equal(batches[-1].weight, [1.0, 0.0])
+    np.testing.assert_array_equal(batches[-1].mask[1], 0.0)

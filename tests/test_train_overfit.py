@@ -68,11 +68,12 @@ def test_overfit_tiny_corpus(tmp_path, framework):
     losses = []
     orig_fit = model.trainer.fit
 
-    def capturing_fit(state, epoch_batches, start_epoch=0, on_epoch_end=None):
+    def capturing_fit(state, epoch_batches, start_epoch=0, on_epoch_end=None,
+                      **kwargs):
         def wrapped_on_epoch_end(epoch, st):
             pass  # skip per-epoch evaluate to keep the test fast
         return orig_fit(state, epoch_batches, start_epoch=start_epoch,
-                        on_epoch_end=wrapped_on_epoch_end)
+                        on_epoch_end=wrapped_on_epoch_end, **kwargs)
 
     model.trainer.fit = capturing_fit
     model.train()
