@@ -1,0 +1,1436 @@
+// C# frontend for the native extractor.
+//
+// Reimplements the reference CSharpExtractor (a Roslyn-based C# program,
+// reference CSharpExtractor/): per method, group leaf TOKENS into
+// variables by name, enumerate variable pairs (plus self-pairs), reservoir-
+// sample up to --max_contexts pairs, and emit token-level AST paths rendered
+// with Roslyn SyntaxKind names — `Kind^Kind^...Kind_Kind`, childIds
+// (truncated at 3) appended under six parent kinds (Extractor.cs:23-24,
+// 90-99), plus COMMENT contexts from the file's comment trivia in
+// 5-subtoken batches (Extractor.cs:204-218).
+//
+// The parser is a pragmatic C# grammar (namespaces, classes, properties,
+// the full expression grammar incl. lambdas, ?. ?? is/as, object
+// initializers) producing Roslyn-style node kinds so paths line up with the
+// reference's vocabulary. Known deviations are listed in
+// extractor/README.md.
+#pragma once
+
+#include <algorithm>
+#include <map>
+#include <random>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "java_ast.h"
+#include "java_lexer.h"
+#include "java_parser.h"  // ParseError
+#include "pathctx.h"      // java_hash, ExtractorOptions
+
+namespace c2v {
+namespace cs {
+
+// A leaf token: text + the node the path starts from (token.Parent in
+// Roslyn terms; the method-name token hangs directly off MethodDeclaration,
+// Variable.cs:63-67).
+struct CsToken {
+  std::string text;
+  Node* parent = nullptr;
+  bool is_identifier = false;
+  bool is_literal = false;
+  bool is_predefined_type = false;
+};
+
+// ----------------------------------------------------------------- parser
+class CsParser {
+ public:
+  CsParser(std::vector<Token> tokens, Arena* arena)
+      : toks_(std::move(tokens)), arena_(arena) {}
+
+  Node* parse_compilation_unit() {
+    Node* root = arena_->make("CompilationUnit");
+    while (!at_end()) {
+      if (accept_punct(";")) continue;
+      parse_top_level(root);
+    }
+    return root;
+  }
+
+  // leaf tokens in DFS order, restricted to `scope`'s subtree
+  void collect_tokens(Node* scope, std::vector<CsToken>* out) const {
+    auto it = tokens_by_node_.find(scope);
+    if (it != tokens_by_node_.end())
+      out->insert(out->end(), it->second.begin(), it->second.end());
+    for (Node* child : scope->children) collect_tokens(child, out);
+  }
+
+  const std::vector<std::string>& comments() const { return comments_; }
+  void set_comments(std::vector<std::string> comments) {
+    comments_ = std::move(comments);
+  }
+
+ private:
+  std::vector<Token> toks_;
+  Arena* arena_;
+  size_t i_ = 0;
+  std::map<Node*, std::vector<CsToken>> tokens_by_node_;
+  std::vector<std::string> comments_;
+
+  static const std::set<std::string>& modifiers() {
+    static const std::set<std::string> kMods = {
+        "public", "protected", "private", "internal", "static", "readonly",
+        "sealed", "abstract", "virtual", "override", "async", "partial",
+        "const", "new", "extern", "unsafe", "volatile"};
+    return kMods;
+  }
+
+  static const std::set<std::string>& predefined_types() {
+    static const std::set<std::string> kPredef = {
+        "bool", "byte", "sbyte", "char", "decimal", "double", "float",
+        "int", "uint", "long", "ulong", "short", "ushort", "object",
+        "string", "void", "dynamic"};
+    return kPredef;
+  }
+
+  void add_token(Node* parent, const std::string& text, bool ident,
+                 bool literal, bool predefined) {
+    tokens_by_node_[parent].push_back(
+        CsToken{text, parent, ident, literal, predefined});
+  }
+
+  // ------------------------------------------------------- token helpers
+  const Token& cur() const { return toks_[i_]; }
+  const Token& ahead(size_t n) const {
+    size_t j = i_ + n;
+    return j < toks_.size() ? toks_[j] : toks_.back();
+  }
+  bool at_end() const { return cur().kind == Tok::kEnd; }
+  void advance() {
+    if (!at_end()) ++i_;
+  }
+  size_t mark() const { return i_; }
+  void rewind(size_t m) { i_ = m; }
+  bool is_punct(const std::string& p, size_t n = 0) const {
+    return ahead(n).kind == Tok::kPunct && ahead(n).text == p;
+  }
+  bool is_ident(const std::string& w, size_t n = 0) const {
+    return ahead(n).kind == Tok::kIdent && ahead(n).text == w;
+  }
+  bool accept_punct(const std::string& p) {
+    if (is_punct(p)) {
+      advance();
+      return true;
+    }
+    return false;
+  }
+  bool accept_ident(const std::string& w) {
+    if (is_ident(w)) {
+      advance();
+      return true;
+    }
+    return false;
+  }
+  void expect_punct(const std::string& p) {
+    if (!accept_punct(p))
+      throw ParseError("expected '" + p + "' got '" + cur().text + "'");
+  }
+  std::string expect_ident() {
+    if (cur().kind != Tok::kIdent)
+      throw ParseError("expected identifier, got '" + cur().text + "'");
+    std::string name = cur().text;
+    advance();
+    return name;
+  }
+  void skip_balanced(const std::string& open, const std::string& close) {
+    int depth = 0;
+    while (!at_end()) {
+      if (is_punct(open)) ++depth;
+      if (is_punct(close)) {
+        --depth;
+        if (depth == 0) {
+          advance();
+          return;
+        }
+      }
+      advance();
+    }
+  }
+
+  void skip_attributes() {
+    while (is_punct("[")) {
+      // attribute lists only appear at declaration positions; statement-
+      // level callers never route '[' here
+      skip_balanced("[", "]");
+    }
+  }
+
+  void skip_modifiers() {
+    // only called at declaration positions, where every modifier keyword
+    // (including 'new' as a hiding modifier) is safe to consume
+    while (cur().kind == Tok::kIdent && modifiers().count(cur().text))
+      advance();
+  }
+
+  void skip_generic_args() {
+    if (!is_punct("<")) return;
+    int depth = 0;
+    while (!at_end()) {
+      if (is_punct("<")) ++depth;
+      else if (is_punct(">")) --depth;
+      else if (is_punct(">>")) depth -= 2;
+      advance();
+      if (depth <= 0) return;
+    }
+  }
+
+  // disambiguate `F<int>(x)` from `a < b`: a generic argument list holds
+  // only type-shaped tokens and is followed by '('
+  bool generic_call_ahead() const {
+    if (!is_punct("<")) return false;
+    int depth = 0;
+    size_t j = 0;
+    while (ahead(j).kind != Tok::kEnd && j < 64) {
+      const Token& token = ahead(j);
+      if (token.kind == Tok::kPunct) {
+        if (token.text == "<") ++depth;
+        else if (token.text == ">") --depth;
+        else if (token.text == ">>") depth -= 2;
+        else if (token.text != "," && token.text != "." &&
+                 token.text != "?" && token.text != "[" &&
+                 token.text != "]")
+          return false;
+        if (depth <= 0) return is_punct("(", j + 1);
+      } else if (token.kind != Tok::kIdent) {
+        return false;
+      }
+      ++j;
+    }
+    return false;
+  }
+
+  void skip_where_clauses() {
+    while (is_ident("where")) {
+      advance();  // 'where'
+      while (!at_end() && !is_punct("{") && !is_punct(";") &&
+             !is_punct("=>") && !is_ident("where"))
+        advance();
+    }
+  }
+
+  // ---------------------------------------------------------- top level
+  void parse_top_level(Node* root) {
+    skip_attributes();
+    skip_modifiers();
+    if (at_end()) return;
+    if (accept_ident("using")) {
+      while (!at_end() && !accept_punct(";")) advance();
+      return;
+    }
+    if (accept_ident("namespace")) {
+      expect_ident();
+      while (accept_punct(".")) expect_ident();
+      if (accept_punct(";")) {  // file-scoped namespace
+        Node* ns = arena_->make("NamespaceDeclaration");
+        root->add(ns);
+        while (!at_end()) parse_top_level(ns);
+        return;
+      }
+      Node* ns = arena_->make("NamespaceDeclaration");
+      root->add(ns);
+      expect_punct("{");
+      while (!at_end() && !is_punct("}")) parse_top_level(ns);
+      accept_punct("}");
+      return;
+    }
+    if (is_ident("class") || is_ident("struct") || is_ident("interface") ||
+        is_ident("record")) {
+      root->add(parse_class());
+      return;
+    }
+    if (is_ident("enum")) {
+      advance();
+      expect_ident();
+      while (!at_end() && !is_punct("{")) advance();
+      if (is_punct("{")) skip_balanced("{", "}");
+      return;
+    }
+    advance();  // unknown: make progress
+  }
+
+  Node* parse_class() {
+    advance();  // class/struct/interface/record
+    std::string name = expect_ident();
+    Node* decl = arena_->make("ClassDeclaration", name);
+    skip_generic_args();
+    if (accept_punct(":")) {  // base list
+      parse_type();
+      while (accept_punct(",")) parse_type();
+    }
+    skip_where_clauses();
+    expect_punct("{");
+    while (!at_end() && !is_punct("}")) {
+      size_t member_start = mark();
+      try {
+        parse_member(decl);
+      } catch (const ParseError&) {
+        rewind(member_start);
+        skip_member();
+      }
+      if (mark() == member_start) skip_member();
+    }
+    accept_punct("}");
+    return decl;
+  }
+
+  void skip_member() {
+    while (!at_end() && !is_punct("}")) {
+      if (is_punct(";")) {
+        advance();
+        return;
+      }
+      if (is_punct("{")) {
+        skip_balanced("{", "}");
+        return;
+      }
+      advance();
+    }
+  }
+
+  void parse_member(Node* decl) {
+    skip_attributes();
+    skip_modifiers();
+    if (accept_punct(";")) return;
+    if (is_ident("class") || is_ident("struct") || is_ident("interface")) {
+      decl->add(parse_class());
+      return;
+    }
+    if (is_ident("enum")) {
+      advance();
+      expect_ident();
+      while (!at_end() && !is_punct("{")) advance();
+      if (is_punct("{")) skip_balanced("{", "}");
+      return;
+    }
+    // constructor: Ident '('
+    if (cur().kind == Tok::kIdent && is_punct("(", 1)) {
+      std::string name = expect_ident();
+      Node* ctor = arena_->make("ConstructorDeclaration", name);
+      parse_parameter_list(ctor);
+      if (accept_punct(":")) {  // : base(...) / this(...)
+        expect_ident();
+        if (is_punct("(")) skip_balanced("(", ")");
+      }
+      if (is_punct("{")) ctor->add(parse_block());
+      else if (accept_punct("=>")) {
+        ctor->add(parse_expression());
+        expect_punct(";");
+      } else
+        expect_punct(";");
+      decl->add(ctor);
+      return;
+    }
+    Node* type = parse_type();
+    std::string name = expect_ident();
+    skip_generic_args();  // generic method type params
+    if (is_punct("(")) {
+      decl->add(parse_method_rest(type, name));
+      return;
+    }
+    if (is_punct("{") || is_punct("=>")) {
+      // property: Type Name { get ... set ... } or expression-bodied
+      Node* property = arena_->make("PropertyDeclaration", name);
+      property->add(type);
+      if (accept_punct("=>")) {
+        property->add(parse_expression());
+        expect_punct(";");
+      } else {
+        advance();  // '{'
+        while (!at_end() && !is_punct("}")) {
+          skip_attributes();
+          skip_modifiers();
+          if (accept_ident("get") || accept_ident("set") ||
+              accept_ident("init") || accept_ident("add") ||
+              accept_ident("remove")) {
+            if (is_punct("{")) property->add(parse_block());
+            else if (accept_punct("=>")) {
+              property->add(parse_expression());
+              expect_punct(";");
+            } else
+              accept_punct(";");
+          } else {
+            advance();
+          }
+        }
+        accept_punct("}");
+        if (accept_punct("=")) {  // auto-property initializer
+          property->add(parse_expression());
+          expect_punct(";");
+        }
+      }
+      decl->add(property);
+      return;
+    }
+    // field
+    Node* field = arena_->make("FieldDeclaration");
+    Node* var_decl = arena_->make("VariableDeclaration");
+    var_decl->add(type);
+    field->add(var_decl);
+    var_decl->add(parse_variable_declarator(name));
+    while (accept_punct(",")) {
+      var_decl->add(parse_variable_declarator(expect_ident()));
+    }
+    expect_punct(";");
+    decl->add(field);
+  }
+
+  // MethodDeclaration: name token hangs directly off the method node
+  // (Roslyn), children = [return type, ParameterList, Block]
+  Node* parse_method_rest(Node* return_type, const std::string& name) {
+    Node* method = arena_->make("MethodDeclaration", name);
+    method->add(return_type);
+    add_token(method, name, /*ident=*/true, false, false);
+    parse_parameter_list(method);
+    skip_where_clauses();
+    if (is_punct("{")) {
+      method->add(parse_block());
+    } else if (accept_punct("=>")) {  // expression-bodied
+      Node* arrow = arena_->make("ArrowExpressionClause");
+      arrow->add(parse_expression());
+      method->add(arrow);
+      expect_punct(";");
+    } else {
+      expect_punct(";");
+    }
+    return method;
+  }
+
+  void parse_parameter_list(Node* owner) {
+    Node* parameter_list = arena_->make("ParameterList");
+    owner->add(parameter_list);
+    expect_punct("(");
+    if (accept_punct(")")) return;
+    do {
+      skip_attributes();
+      while (accept_ident("ref") || accept_ident("out") ||
+             accept_ident("in") || accept_ident("params") ||
+             accept_ident("this"))
+        ;
+      Node* parameter = arena_->make("Parameter");
+      parameter->add(parse_type());
+      if (cur().kind == Tok::kIdent) {
+        std::string name = expect_ident();
+        add_token(parameter, name, true, false, false);
+        if (accept_punct("=")) {
+          Node* default_value = arena_->make("EqualsValueClause");
+          default_value->add(parse_expression());
+          parameter->add(default_value);
+        }
+      }
+      parameter_list->add(parameter);
+    } while (accept_punct(","));
+    expect_punct(")");
+  }
+
+  // --------------------------------------------------------------- types
+  Node* parse_type() {
+    if (cur().kind == Tok::kIdent && predefined_types().count(cur().text)) {
+      Node* type = arena_->make("PredefinedType");
+      add_token(type, cur().text, false, false, /*predefined=*/true);
+      advance();
+      return maybe_type_suffix(type);
+    }
+    if (cur().kind != Tok::kIdent)
+      throw ParseError("expected type, got '" + cur().text + "'");
+    Node* type = parse_name_for_type();
+    return maybe_type_suffix(type);
+  }
+
+  Node* parse_name_for_type() {
+    std::string name = expect_ident();
+    Node* node = arena_->make("IdentifierName");
+    add_token(node, name, true, false, false);
+    skip_generic_args();
+    while (is_punct(".") && ahead(1).kind == Tok::kIdent) {
+      advance();
+      std::string next_name = expect_ident();
+      Node* qualified = arena_->make("QualifiedName");
+      Node* right = arena_->make("IdentifierName");
+      add_token(right, next_name, true, false, false);
+      qualified->add(node);
+      qualified->add(right);
+      skip_generic_args();
+      node = qualified;
+    }
+    return node;
+  }
+
+  Node* maybe_type_suffix(Node* type) {
+    while (true) {
+      if (accept_punct("?")) {
+        Node* nullable = arena_->make("NullableType");
+        nullable->add(type);
+        type = nullable;
+        continue;
+      }
+      if (is_punct("[") &&
+          (is_punct("]", 1) || (is_punct(",", 1) && is_punct("]", 2)))) {
+        skip_balanced("[", "]");
+        Node* array = arena_->make("ArrayType");
+        array->add(type);
+        type = array;
+        continue;
+      }
+      return type;
+    }
+  }
+
+  // ---------------------------------------------------------- statements
+  Node* parse_block() {
+    expect_punct("{");
+    Node* block = arena_->make("Block", "", true);
+    while (!at_end() && !is_punct("}")) block->add(parse_statement());
+    expect_punct("}");
+    return block;
+  }
+
+  Node* parse_statement() {
+    if (is_punct("{")) return parse_block();
+    if (accept_punct(";")) return arena_->make("EmptyStatement", "", true);
+    if (is_ident("if")) return parse_if();
+    if (is_ident("while")) return parse_while();
+    if (is_ident("do")) return parse_do();
+    if (is_ident("for")) return parse_for();
+    if (is_ident("foreach")) return parse_foreach();
+    if (is_ident("return")) {
+      advance();
+      Node* stmt = arena_->make("ReturnStatement", "", true);
+      if (!is_punct(";")) stmt->add(parse_expression());
+      expect_punct(";");
+      return stmt;
+    }
+    if (is_ident("throw")) {
+      advance();
+      Node* stmt = arena_->make("ThrowStatement", "", true);
+      if (!is_punct(";")) stmt->add(parse_expression());
+      expect_punct(";");
+      return stmt;
+    }
+    if (is_ident("break")) {
+      advance();
+      expect_punct(";");
+      return arena_->make("BreakStatement", "", true);
+    }
+    if (is_ident("continue")) {
+      advance();
+      expect_punct(";");
+      return arena_->make("ContinueStatement", "", true);
+    }
+    if (is_ident("try")) return parse_try();
+    if (is_ident("switch")) return parse_switch();
+    if (is_ident("using") && is_punct("(", 1)) {
+      advance();
+      Node* stmt = arena_->make("UsingStatement", "", true);
+      expect_punct("(");
+      Node* decl = try_parse_variable_declaration();
+      stmt->add(decl ? decl : parse_expression());
+      expect_punct(")");
+      stmt->add(parse_statement());
+      return stmt;
+    }
+    if (is_ident("lock")) {
+      advance();
+      Node* stmt = arena_->make("LockStatement", "", true);
+      expect_punct("(");
+      stmt->add(parse_expression());
+      expect_punct(")");
+      stmt->add(parse_statement());
+      return stmt;
+    }
+    if (is_ident("var") || cur().kind == Tok::kIdent) {
+      size_t m = mark();
+      Node* decl = try_parse_variable_declaration();
+      if (decl && accept_punct(";")) {
+        Node* stmt = arena_->make("LocalDeclarationStatement", "", true);
+        stmt->add(decl);
+        return stmt;
+      }
+      rewind(m);
+    }
+    Node* stmt = arena_->make("ExpressionStatement", "", true);
+    stmt->add(parse_expression());
+    expect_punct(";");
+    return stmt;
+  }
+
+  // VariableDeclaration: [type, VariableDeclarator...]; 'var' is NOT a
+  // leaf token (reference Tree.cs:168-175)
+  Node* try_parse_variable_declaration() {
+    try {
+      if (cur().kind != Tok::kIdent) return nullptr;
+      Node* type;
+      if (is_ident("var") && ahead(1).kind == Tok::kIdent) {
+        advance();
+        type = arena_->make("IdentifierName", "var");  // no leaf token
+      } else {
+        type = parse_type();
+      }
+      if (cur().kind != Tok::kIdent) return nullptr;
+      const Token& after = ahead(1);
+      if (!(after.kind == Tok::kPunct &&
+            (after.text == "=" || after.text == ";" || after.text == ",")))
+        return nullptr;
+      Node* decl = arena_->make("VariableDeclaration");
+      decl->add(type);
+      decl->add(parse_variable_declarator(expect_ident()));
+      while (accept_punct(","))
+        decl->add(parse_variable_declarator(expect_ident()));
+      return decl;
+    } catch (const ParseError&) {
+      return nullptr;
+    }
+  }
+
+  Node* parse_variable_declarator(const std::string& name) {
+    Node* declarator = arena_->make("VariableDeclarator", name);
+    add_token(declarator, name, true, false, false);
+    if (accept_punct("=")) {
+      Node* init = arena_->make("EqualsValueClause");
+      init->add(is_punct("{") ? parse_array_initializer()
+                              : parse_expression());
+      declarator->add(init);
+    }
+    return declarator;
+  }
+
+  Node* parse_if() {
+    advance();
+    Node* stmt = arena_->make("IfStatement", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    stmt->add(parse_statement());
+    if (accept_ident("else")) {
+      Node* else_clause = arena_->make("ElseClause");
+      else_clause->add(parse_statement());
+      stmt->add(else_clause);
+    }
+    return stmt;
+  }
+
+  Node* parse_while() {
+    advance();
+    Node* stmt = arena_->make("WhileStatement", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_do() {
+    advance();
+    Node* stmt = arena_->make("DoStatement", "", true);
+    stmt->add(parse_statement());
+    if (!accept_ident("while")) throw ParseError("expected while");
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    expect_punct(";");
+    return stmt;
+  }
+
+  Node* parse_for() {
+    advance();
+    Node* stmt = arena_->make("ForStatement", "", true);
+    expect_punct("(");
+    if (!is_punct(";")) {
+      Node* init = try_parse_variable_declaration();
+      if (init) stmt->add(init);
+      else {
+        stmt->add(parse_expression());
+        while (accept_punct(",")) stmt->add(parse_expression());
+      }
+    }
+    expect_punct(";");
+    if (!is_punct(";")) stmt->add(parse_expression());
+    expect_punct(";");
+    if (!is_punct(")")) {
+      stmt->add(parse_expression());
+      while (accept_punct(",")) stmt->add(parse_expression());
+    }
+    expect_punct(")");
+    stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_foreach() {
+    advance();
+    Node* stmt = arena_->make("ForEachStatement", "", true);
+    expect_punct("(");
+    if (is_ident("var")) {
+      advance();
+    } else {
+      stmt->add(parse_type());
+    }
+    std::string name = expect_ident();
+    add_token(stmt, name, true, false, false);
+    if (!accept_ident("in")) throw ParseError("expected in");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    stmt->add(parse_statement());
+    return stmt;
+  }
+
+  Node* parse_try() {
+    advance();
+    Node* stmt = arena_->make("TryStatement", "", true);
+    stmt->add(parse_block());
+    while (is_ident("catch")) {
+      advance();
+      Node* clause = arena_->make("CatchClause");
+      if (is_punct("(")) {
+        advance();
+        Node* decl = arena_->make("CatchDeclaration");
+        decl->add(parse_type());
+        if (cur().kind == Tok::kIdent)
+          add_token(decl, expect_ident(), true, false, false);
+        clause->add(decl);
+        expect_punct(")");
+      }
+      if (accept_ident("when")) {
+        expect_punct("(");
+        clause->add(parse_expression());
+        expect_punct(")");
+      }
+      clause->add(parse_block());
+      stmt->add(clause);
+    }
+    if (accept_ident("finally")) {
+      Node* fin = arena_->make("FinallyClause");
+      fin->add(parse_block());
+      stmt->add(fin);
+    }
+    return stmt;
+  }
+
+  Node* parse_switch() {
+    advance();
+    Node* stmt = arena_->make("SwitchStatement", "", true);
+    expect_punct("(");
+    stmt->add(parse_expression());
+    expect_punct(")");
+    expect_punct("{");
+    while (!at_end() && !is_punct("}")) {
+      Node* section = arena_->make("SwitchSection", "", true);
+      while (is_ident("case") || is_ident("default")) {
+        if (accept_ident("case")) {
+          section->add(parse_expression());
+          if (accept_ident("when")) section->add(parse_expression());
+        } else {
+          advance();  // default
+        }
+        expect_punct(":");
+      }
+      while (!at_end() && !is_punct("}") && !is_ident("case") &&
+             !is_ident("default"))
+        section->add(parse_statement());
+      stmt->add(section);
+    }
+    expect_punct("}");
+    return stmt;
+  }
+
+  Node* parse_array_initializer() {
+    expect_punct("{");
+    Node* init = arena_->make("InitializerExpression");
+    while (!at_end() && !is_punct("}")) {
+      init->add(is_punct("{") ? parse_array_initializer()
+                              : parse_expression());
+      if (!accept_punct(",")) break;
+    }
+    expect_punct("}");
+    return init;
+  }
+
+  // --------------------------------------------------------- expressions
+  Node* parse_expression() { return parse_assignment(); }
+
+  Node* parse_assignment() {
+    Node* left = parse_ternary();
+    static const std::pair<const char*, const char*> kAssign[] = {
+        {"=", "SimpleAssignmentExpression"},
+        {"+=", "AddAssignmentExpression"},
+        {"-=", "SubtractAssignmentExpression"},
+        {"*=", "MultiplyAssignmentExpression"},
+        {"/=", "DivideAssignmentExpression"},
+        {"%=", "ModuloAssignmentExpression"},
+        {"&=", "AndAssignmentExpression"},
+        {"|=", "OrAssignmentExpression"},
+        {"^=", "ExclusiveOrAssignmentExpression"},
+        {"<<=", "LeftShiftAssignmentExpression"},
+        {">>=", "RightShiftAssignmentExpression"},
+        {"?\?=", "CoalesceAssignmentExpression"}};
+    for (const auto& [text, kind] : kAssign) {
+      if (is_punct(text)) {
+        advance();
+        Node* assign = arena_->make(kind);
+        assign->add(left);
+        assign->add(is_punct("{") ? parse_array_initializer()
+                                  : parse_assignment());
+        return assign;
+      }
+    }
+    return left;
+  }
+
+  Node* parse_ternary() {
+    Node* condition = parse_binary(0);
+    if (is_punct("?") && !is_punct("?.")) {
+      advance();
+      Node* ternary = arena_->make("ConditionalExpression");
+      ternary->add(condition);
+      ternary->add(parse_expression());
+      expect_punct(":");
+      ternary->add(parse_expression());
+      return ternary;
+    }
+    return condition;
+  }
+
+  struct BinOp {
+    const char* text;
+    const char* kind;
+    int prec;
+  };
+
+  static const std::vector<BinOp>& binary_ops() {
+    // precedence starts at 1: parse_binary(0) matches ops with prec >= 1
+    static const std::vector<BinOp> kOps = {
+        {"??", "CoalesceExpression", 1},
+        {"||", "LogicalOrExpression", 2},
+        {"&&", "LogicalAndExpression", 3},
+        {"|", "BitwiseOrExpression", 4},
+        {"^", "ExclusiveOrExpression", 5},
+        {"&", "BitwiseAndExpression", 6},
+        {"==", "EqualsExpression", 7},
+        {"!=", "NotEqualsExpression", 7},
+        {"<", "LessThanExpression", 8},
+        {">", "GreaterThanExpression", 8},
+        {"<=", "LessThanOrEqualExpression", 8},
+        {">=", "GreaterThanOrEqualExpression", 8},
+        {"<<", "LeftShiftExpression", 9},
+        {">>", "RightShiftExpression", 9},
+        {"+", "AddExpression", 10},
+        {"-", "SubtractExpression", 10},
+        {"*", "MultiplyExpression", 11},
+        {"/", "DivideExpression", 11},
+        {"%", "ModuloExpression", 11}};
+    return kOps;
+  }
+
+  const BinOp* current_binop(int min_prec) {
+    if (cur().kind != Tok::kPunct) return nullptr;
+    for (const auto& op : binary_ops())
+      if (cur().text == op.text && op.prec >= min_prec) return &op;
+    return nullptr;
+  }
+
+  Node* parse_binary(int min_prec) {
+    Node* left = parse_unary();
+    while (true) {
+      if (is_ident("is") || is_ident("as")) {
+        bool is_is = is_ident("is");
+        advance();
+        Node* check =
+            arena_->make(is_is ? "IsExpression" : "AsExpression");
+        check->add(left);
+        check->add(parse_type());
+        if (is_is && cur().kind == Tok::kIdent &&
+            !is_ident("is") && !is_ident("as"))
+          add_token(check, expect_ident(), true, false, false);  // pattern
+        left = check;
+        continue;
+      }
+      const BinOp* op = current_binop(min_prec + 1);
+      if (!op) return left;
+      advance();
+      Node* right = parse_binary(op->prec);
+      Node* binary = arena_->make(op->kind);
+      binary->add(left);
+      binary->add(right);
+      left = binary;
+    }
+  }
+
+  Node* parse_unary() {
+    static const std::pair<const char*, const char*> kPrefix[] = {
+        {"+", "UnaryPlusExpression"},
+        {"-", "UnaryMinusExpression"},
+        {"!", "LogicalNotExpression"},
+        {"~", "BitwiseNotExpression"},
+        {"++", "PreIncrementExpression"},
+        {"--", "PreDecrementExpression"}};
+    for (const auto& [text, kind] : kPrefix) {
+      if (is_punct(text)) {
+        advance();
+        Node* unary = arena_->make(kind);
+        unary->add(parse_unary());
+        return unary;
+      }
+    }
+    if (is_punct("(")) {  // tentative cast
+      size_t m = mark();
+      advance();
+      try {
+        Node* type = parse_type();
+        if (accept_punct(")")) {
+          bool target = cur().kind == Tok::kIdent ||
+                        cur().kind == Tok::kIntLit ||
+                        cur().kind == Tok::kFloatLit ||
+                        cur().kind == Tok::kStringLit ||
+                        cur().kind == Tok::kCharLit || is_punct("(");
+          if (target) {
+            Node* cast = arena_->make("CastExpression");
+            cast->add(type);
+            cast->add(parse_unary());
+            return parse_postfix_ops(cast);
+          }
+        }
+      } catch (const ParseError&) {
+      }
+      rewind(m);
+    }
+    Node* expr = parse_primary();
+    expr = parse_postfix_ops(expr);
+    if (is_punct("++")) {
+      advance();
+      Node* unary = arena_->make("PostIncrementExpression");
+      unary->add(expr);
+      return unary;
+    }
+    if (is_punct("--")) {
+      advance();
+      Node* unary = arena_->make("PostDecrementExpression");
+      unary->add(expr);
+      return unary;
+    }
+    return expr;
+  }
+
+  void parse_argument_list(Node* owner, const std::string& kind,
+                           const std::string& open,
+                           const std::string& close) {
+    Node* argument_list = arena_->make(kind);
+    owner->add(argument_list);
+    expect_punct(open);
+    if (accept_punct(close)) return;
+    do {
+      while (accept_ident("ref") || accept_ident("out") ||
+             accept_ident("in"))
+        if (is_ident("var")) advance();
+      Node* argument = arena_->make("Argument");
+      if (cur().kind == Tok::kIdent && is_punct(":", 1) &&
+          !is_punct("::", 1)) {
+        advance();  // named argument label
+        advance();
+      }
+      argument->add(parse_expression());
+      argument_list->add(argument);
+    } while (accept_punct(","));
+    expect_punct(close);
+  }
+
+  Node* parse_postfix_ops(Node* expr) {
+    while (true) {
+      if (is_punct(".") || is_punct("?.")) {
+        bool conditional = is_punct("?.");
+        advance();
+        std::string name = expect_ident();
+        if (generic_call_ahead()) skip_generic_args();
+        Node* name_node = arena_->make("IdentifierName");
+        add_token(name_node, name, true, false, false);
+        Node* access = arena_->make(
+            conditional ? "ConditionalAccessExpression"
+                        : "SimpleMemberAccessExpression");
+        access->add(expr);
+        access->add(name_node);
+        if (is_punct("(")) {
+          Node* call = arena_->make("InvocationExpression");
+          call->add(access);
+          parse_argument_list(call, "ArgumentList", "(", ")");
+          expr = call;
+        } else {
+          expr = access;
+        }
+        continue;
+      }
+      if (is_punct("(")) {
+        Node* call = arena_->make("InvocationExpression");
+        call->add(expr);
+        parse_argument_list(call, "ArgumentList", "(", ")");
+        expr = call;
+        continue;
+      }
+      if (is_punct("[")) {
+        Node* access = arena_->make("ElementAccessExpression");
+        access->add(expr);
+        parse_argument_list(access, "BracketedArgumentList", "[", "]");
+        expr = access;
+        continue;
+      }
+      return expr;
+    }
+  }
+
+  bool lambda_ahead() {
+    if (cur().kind == Tok::kIdent && is_punct("=>", 1)) return true;
+    if (!is_punct("(")) return false;
+    int depth = 0;
+    size_t j = 0;
+    while (ahead(j).kind != Tok::kEnd) {
+      if (ahead(j).kind == Tok::kPunct) {
+        if (ahead(j).text == "(") ++depth;
+        if (ahead(j).text == ")") {
+          --depth;
+          if (depth == 0)
+            return ahead(j + 1).kind == Tok::kPunct &&
+                   ahead(j + 1).text == "=>";
+        }
+      }
+      ++j;
+    }
+    return false;
+  }
+
+  Node* parse_lambda() {
+    if (cur().kind == Tok::kIdent) {
+      Node* lambda = arena_->make("SimpleLambdaExpression");
+      Node* parameter = arena_->make("Parameter");
+      add_token(parameter, expect_ident(), true, false, false);
+      lambda->add(parameter);
+      expect_punct("=>");
+      lambda->add(is_punct("{") ? parse_block() : parse_expression());
+      return lambda;
+    }
+    Node* lambda = arena_->make("ParenthesizedLambdaExpression");
+    expect_punct("(");
+    while (!is_punct(")") && !at_end()) {
+      Node* parameter = arena_->make("Parameter");
+      size_t m = mark();
+      try {
+        Node* type = parse_type();
+        if (cur().kind == Tok::kIdent) {
+          parameter->add(type);
+          add_token(parameter, expect_ident(), true, false, false);
+        } else {
+          throw ParseError("untyped");
+        }
+      } catch (const ParseError&) {
+        rewind(m);
+        add_token(parameter, expect_ident(), true, false, false);
+      }
+      lambda->add(parameter);
+      if (!accept_punct(",")) break;
+    }
+    expect_punct(")");
+    expect_punct("=>");
+    lambda->add(is_punct("{") ? parse_block() : parse_expression());
+    return lambda;
+  }
+
+  Node* parse_primary() {
+    if (lambda_ahead()) return parse_lambda();
+    const Token& token = cur();
+    switch (token.kind) {
+      case Tok::kIntLit:
+      case Tok::kFloatLit: {
+        advance();
+        Node* literal = arena_->make("NumericLiteralExpression");
+        add_token(literal, token.text, false, true, false);
+        return literal;
+      }
+      case Tok::kCharLit: {
+        advance();
+        Node* literal = arena_->make("CharacterLiteralExpression");
+        add_token(literal, token.text, false, true, false);
+        return literal;
+      }
+      case Tok::kStringLit: {
+        advance();
+        Node* literal = arena_->make("StringLiteralExpression");
+        add_token(literal, token.text, false, true, false);
+        return literal;
+      }
+      case Tok::kIdent:
+        break;
+      case Tok::kPunct:
+        if (is_punct("(")) {
+          advance();
+          Node* enclosed = arena_->make("ParenthesizedExpression");
+          enclosed->add(parse_expression());
+          expect_punct(")");
+          return enclosed;
+        }
+        throw ParseError("unexpected token '" + token.text + "'");
+      default:
+        throw ParseError("unexpected end of input");
+    }
+    if (is_ident("new")) {
+      advance();
+      Node* creation = arena_->make("ObjectCreationExpression");
+      if (cur().kind == Tok::kIdent) creation->add(parse_type());
+      if (is_punct("("))
+        parse_argument_list(creation, "ArgumentList", "(", ")");
+      if (is_punct("[")) skip_balanced("[", "]");  // array ranks
+      if (is_punct("{")) creation->add(parse_array_initializer());
+      return creation;
+    }
+    if (is_ident("true") || is_ident("false")) {
+      Node* literal = arena_->make(is_ident("true")
+                                       ? "TrueLiteralExpression"
+                                       : "FalseLiteralExpression");
+      advance();
+      return literal;
+    }
+    if (is_ident("null")) {
+      advance();
+      return arena_->make("NullLiteralExpression");
+    }
+    if (is_ident("this")) {
+      advance();
+      return arena_->make("ThisExpression");
+    }
+    if (is_ident("base")) {
+      advance();
+      return arena_->make("BaseExpression");
+    }
+    if (is_ident("typeof") || is_ident("nameof") || is_ident("default") ||
+        is_ident("sizeof")) {
+      std::string which = cur().text;
+      advance();
+      Node* expr = arena_->make(
+          which == "typeof" ? "TypeOfExpression"
+          : which == "nameof" ? "InvocationExpression"
+          : which == "default" ? "DefaultExpression"
+                               : "SizeOfExpression");
+      if (is_punct("(")) {
+        advance();
+        if (!is_punct(")")) {
+          size_t m = mark();
+          try {
+            expr->add(parse_type());
+            if (!is_punct(")")) throw ParseError("not a type");
+          } catch (const ParseError&) {
+            rewind(m);
+            expr->add(parse_expression());
+          }
+        }
+        expect_punct(")");
+      }
+      return expr;
+    }
+    if (predefined_types().count(cur().text)) {
+      Node* type = arena_->make("PredefinedType");
+      add_token(type, cur().text, false, false, true);
+      advance();
+      return type;
+    }
+    std::string name = expect_ident();
+    if (generic_call_ahead()) skip_generic_args();
+    Node* node = arena_->make("IdentifierName");
+    add_token(node, name, true, false, false);
+    return node;
+  }
+};
+
+// ------------------------------------------------------------- extraction
+// reference Utilities.cs NormalizeName (C# variant: NUM whitelist
+// {0,1,2,3,4,5,10}, no careful-strip fallback)
+inline std::string cs_normalize_name(const std::string& original) {
+  static const std::set<std::string> kKeep = {"0", "1", "2", "3",
+                                              "4", "5", "10"};
+  std::string partially;
+  for (size_t i = 0; i < original.size(); ++i) {
+    char c = original[i];
+    if (c == '\\' && i + 1 < original.size() && original[i + 1] == 'n') {
+      ++i;
+      continue;
+    }
+    if (std::isspace(static_cast<unsigned char>(c))) continue;
+    unsigned char uc = static_cast<unsigned char>(c);
+    if (uc >= 0x80) continue;  // non-ascii dropped
+    partially.push_back(
+        static_cast<char>(std::tolower(static_cast<unsigned char>(c))));
+  }
+  std::string completely;
+  for (char c : partially)
+    if (std::isalpha(static_cast<unsigned char>(c)))
+      completely.push_back(c);
+  if (!completely.empty()) return completely;
+  bool all_digits = !partially.empty();
+  for (char c : partially)
+    if (!std::isdigit(static_cast<unsigned char>(c))) all_digits = false;
+  if (all_digits) return kKeep.count(partially) ? partially : "NUM";
+  return std::string();
+}
+
+inline std::vector<std::string> cs_split_subtokens(const std::string& name) {
+  // same boundaries as the Java splitter, but parts normalized with the C#
+  // rules (Utilities.cs:92-101)
+  std::vector<std::string> parts;
+  std::string current;
+  std::string trimmed = name;
+  auto flush = [&]() {
+    if (!current.empty()) {
+      std::string normalized = cs_normalize_name(current);
+      if (!normalized.empty()) parts.push_back(normalized);
+      current.clear();
+    }
+  };
+  for (size_t i = 0; i < trimmed.size(); ++i) {
+    char c = trimmed[i];
+    if (c == '_' || std::isdigit(static_cast<unsigned char>(c)) ||
+        std::isspace(static_cast<unsigned char>(c))) {
+      flush();
+      continue;
+    }
+    bool lower_to_upper =
+        i > 0 && std::islower(static_cast<unsigned char>(trimmed[i - 1])) &&
+        std::isupper(static_cast<unsigned char>(c));
+    bool acronym_end =
+        i + 1 < trimmed.size() &&
+        std::isupper(static_cast<unsigned char>(c)) && i > 0 &&
+        std::isupper(static_cast<unsigned char>(trimmed[i - 1])) &&
+        std::islower(static_cast<unsigned char>(trimmed[i + 1]));
+    if (lower_to_upper || acronym_end) flush();
+    current.push_back(c);
+  }
+  flush();
+  return parts;
+}
+
+// reference Extractor.cs:139-162
+inline std::string cs_split_name_unless_empty(const std::string& original) {
+  std::vector<std::string> subtokens = cs_split_subtokens(original);
+  std::string name = join(subtokens, "|");
+  if (name.empty()) name = cs_normalize_name(original);
+  if (name.empty()) {
+    bool all_space = !original.empty();
+    for (char c : original)
+      if (!std::isspace(static_cast<unsigned char>(c))) all_space = false;
+    name = all_space ? "SPACE" : "BLANK";
+  }
+  if (original == "METHOD_NAME") name = original;
+  return name;
+}
+
+inline const std::set<std::string>& cs_child_id_parent_kinds() {
+  // reference Extractor.cs:23-24
+  static const std::set<std::string> kKinds = {
+      "SimpleAssignmentExpression", "ElementAccessExpression",
+      "SimpleMemberAccessExpression", "InvocationExpression",
+      "BracketedArgumentList", "ArgumentList"};
+  return kKinds;
+}
+
+inline int cs_depth(const Node* node, const Node* root) {
+  int depth = 0;
+  while (node != root && node != nullptr) {
+    node = node->parent;
+    ++depth;
+  }
+  return depth;
+}
+
+// reference PathFinder.cs:82-111 + Extractor.cs:46-99
+inline std::string cs_find_path(const CsToken& left, const CsToken& right,
+                                const Node* method_root,
+                                const ExtractorOptions& options) {
+  const Node* l = left.parent;
+  const Node* r = right.parent;
+  int dl = cs_depth(l, method_root);
+  int dr = cs_depth(r, method_root);
+  // LCA by depth equalization
+  const Node* a = l;
+  const Node* b = r;
+  int da = dl, db = dr;
+  while (a != b) {
+    if (da >= db) {
+      a = a->parent;
+      --da;
+    } else {
+      b = b->parent;
+      --db;
+    }
+  }
+  const Node* lca = a;
+  int dlca = da;
+  if (dl + dr - 2 * dlca + 2 > options.max_path_length) return std::string();
+
+  std::vector<const Node*> left_side, right_side;
+  for (const Node* n = l; n != lca; n = n->parent) left_side.push_back(n);
+  for (const Node* n = r; n != lca; n = n->parent) right_side.push_back(n);
+  std::reverse(right_side.begin(), right_side.end());
+
+  if (!left_side.empty() && !right_side.empty()) {
+    int li = left_side.back()->child_id;
+    int ri = right_side.front()->child_id;
+    if (std::abs(li - ri) >= options.max_path_width) return std::string();
+  }
+
+  auto child_id_suffix = [&](const Node* n) -> std::string {
+    if (n->parent != nullptr &&
+        cs_child_id_parent_kinds().count(n->parent->raw_type)) {
+      return std::to_string(std::min(n->child_id, 3));  // truncated at 3
+    }
+    return std::string();
+  };
+
+  std::string out;
+  for (size_t i = 0; i < left_side.size(); ++i) {
+    out += left_side[i]->raw_type;
+    out += child_id_suffix(left_side[i]);
+    out += '^';
+  }
+  out += lca->raw_type;
+  for (size_t i = 0; i < right_side.size(); ++i) {
+    out += '_';
+    out += right_side[i]->raw_type;
+    out += child_id_suffix(right_side[i]);
+  }
+  return out;
+}
+
+// variables: leaves grouped by token text; METHOD_NAME for the method-name
+// token (reference Variable.cs:63-108)
+struct CsVariable {
+  std::string name;
+  std::vector<int> token_indices;
+};
+
+inline std::vector<MethodFeatures> cs_extract_all(
+    CsParser& parser, Node* root, const ExtractorOptions& options) {
+  std::vector<Node*> methods;
+  std::vector<Node*> stack{root};
+  while (!stack.empty()) {
+    Node* node = stack.back();
+    stack.pop_back();
+    if (node->raw_type == "MethodDeclaration") methods.push_back(node);
+    for (Node* child : node->children) stack.push_back(child);
+  }
+  std::reverse(methods.begin(), methods.end());
+
+  // file-level comment contexts, appended to every method
+  // (reference Extractor.cs:204-218 iterates the FULL tree's trivia inside
+  // the per-method loop)
+  std::vector<std::string> comment_contexts;
+  for (const std::string& comment : parser.comments()) {
+    std::string trimmed = comment;
+    auto is_trim = [](char c) {
+      return c == ' ' || c == '/' || c == '*' || c == '{' || c == '}';
+    };
+    while (!trimmed.empty() && is_trim(trimmed.front()))
+      trimmed.erase(trimmed.begin());
+    while (!trimmed.empty() && is_trim(trimmed.back())) trimmed.pop_back();
+    std::string normalized = cs_split_name_unless_empty(trimmed);
+    std::vector<std::string> parts;
+    size_t start = 0;
+    while (start <= normalized.size()) {
+      size_t end = normalized.find('|', start);
+      if (end == std::string::npos) end = normalized.size();
+      parts.push_back(normalized.substr(start, end - start));
+      start = end + 1;
+    }
+    for (size_t i = 0; i * 5 < parts.size(); ++i) {
+      std::vector<std::string> batch(
+          parts.begin() + i * 5,
+          parts.begin() + std::min(parts.size(), (i + 1) * 5));
+      std::string joined = join(batch, "|");
+      comment_contexts.push_back(joined + ",COMMENT," + joined);
+    }
+  }
+
+  std::vector<MethodFeatures> all;
+  std::mt19937 rng(0);  // deterministic (reference uses unseeded Random())
+  for (Node* method : methods) {
+    std::vector<CsToken> tokens;
+    parser.collect_tokens(method, &tokens);
+    // keep only leaf tokens (identifiers/literals/predefined-type)
+    std::vector<CsToken> leaves;
+    for (auto& token : tokens) {
+      if (token.is_identifier || token.is_literal ||
+          token.is_predefined_type)
+        leaves.push_back(token);
+    }
+
+    MethodFeatures features;
+    std::vector<std::string> label_parts = cs_split_subtokens(method->code);
+    features.label = label_parts.empty() ? cs_normalize_name(method->code)
+                                         : join(label_parts, "|");
+
+    // group into variables by name; method-name token -> METHOD_NAME
+    std::vector<CsVariable> variables;
+    std::map<std::string, int> variable_index;
+    for (size_t t = 0; t < leaves.size(); ++t) {
+      std::string name = leaves[t].text;
+      if (leaves[t].is_identifier && leaves[t].parent == method)
+        name = "METHOD_NAME";
+      auto [it, inserted] =
+          variable_index.emplace(name, variables.size());
+      if (inserted) variables.push_back(CsVariable{name, {}});
+      variables[it->second].token_indices.push_back(
+          static_cast<int>(t));
+    }
+
+    // variable pairs: Choose2 + self-pairs, reservoir-sampled
+    // (reference Extractor.cs:111-117)
+    std::vector<std::pair<int, int>> pairs;
+    for (size_t i = 0; i < variables.size(); ++i)
+      for (size_t j = i + 1; j < variables.size(); ++j)
+        pairs.emplace_back(static_cast<int>(i), static_cast<int>(j));
+    for (size_t i = 0; i < variables.size(); ++i)
+      pairs.emplace_back(static_cast<int>(i), static_cast<int>(i));
+    if (static_cast<int>(pairs.size()) > options.max_contexts_cs) {
+      // reservoir sample
+      std::vector<std::pair<int, int>> sample;
+      sample.reserve(options.max_contexts_cs);
+      for (size_t seen = 0; seen < pairs.size(); ++seen) {
+        if (static_cast<int>(sample.size()) < options.max_contexts_cs) {
+          sample.push_back(pairs[seen]);
+        } else {
+          std::uniform_int_distribution<size_t> dist(0, seen);
+          size_t position = dist(rng);
+          if (position < sample.size()) sample[position] = pairs[seen];
+        }
+      }
+      pairs = std::move(sample);
+    }
+
+    for (const auto& [vi, vj] : pairs) {
+      const CsVariable& left_var = variables[vi];
+      const CsVariable& right_var = variables[vj];
+      for (int rt : right_var.token_indices) {
+        for (int lt : left_var.token_indices) {
+          if (lt == rt) continue;
+          std::string path =
+              cs_find_path(leaves[lt], leaves[rt], method, options);
+          if (path.empty()) continue;
+          std::string path_out =
+              options.no_hash ? path : std::to_string(java_hash(path));
+          features.contexts.push_back(
+              cs_split_name_unless_empty(left_var.name) + ',' + path_out +
+              ',' + cs_split_name_unless_empty(right_var.name));
+        }
+      }
+    }
+    features.contexts.insert(features.contexts.end(),
+                             comment_contexts.begin(),
+                             comment_contexts.end());
+    if (!features.contexts.empty()) all.push_back(std::move(features));
+  }
+  return all;
+}
+
+}  // namespace cs
+}  // namespace c2v

@@ -33,7 +33,15 @@ struct LexError : std::runtime_error {
 
 class Lexer {
  public:
-  explicit Lexer(std::string_view src) : src_(src) {}
+  // csharp mode adds @identifiers, @"verbatim" and $"interpolated" strings
+  explicit Lexer(std::string_view src, bool csharp = false)
+      : src_(src), csharp_(csharp) {}
+
+  // when set, comment text is captured here instead of dropped (the C#
+  // extractor emits COMMENT contexts from comment trivia)
+  void capture_comments(std::vector<std::string>* sink) {
+    comments_ = sink;
+  }
 
   std::vector<Token> run() {
     std::vector<Token> out;
@@ -49,6 +57,8 @@ class Lexer {
  private:
   std::string_view src_;
   size_t pos_ = 0;
+  bool csharp_ = false;
+  std::vector<std::string>* comments_ = nullptr;
 
   char peek(size_t ahead = 0) const {
     return pos_ + ahead < src_.size() ? src_[pos_ + ahead] : '\0';
@@ -60,13 +70,21 @@ class Lexer {
       if (std::isspace(static_cast<unsigned char>(c))) {
         ++pos_;
       } else if (c == '/' && peek(1) == '/') {
+        size_t start = pos_;
         while (pos_ < src_.size() && src_[pos_] != '\n') ++pos_;
+        if (comments_)
+          comments_->push_back(
+              std::string(src_.substr(start, pos_ - start)));
       } else if (c == '/' && peek(1) == '*') {
+        size_t start = pos_;
         pos_ += 2;
         while (pos_ + 1 < src_.size() &&
                !(src_[pos_] == '*' && src_[pos_ + 1] == '/'))
           ++pos_;
         pos_ = pos_ + 2 <= src_.size() ? pos_ + 2 : src_.size();
+        if (comments_)
+          comments_->push_back(
+              std::string(src_.substr(start, pos_ - start)));
       } else {
         return;
       }
@@ -80,6 +98,29 @@ class Lexer {
     if (pos_ >= src_.size()) return token;
 
     char c = src_[pos_];
+    if (csharp_ && (c == '$' || c == '@')) {
+      // must run before the identifier branch: '$' would otherwise start
+      // a Java-style identifier
+      if (c == '@' && peek(1) == '"') return lex_verbatim_string();
+      if (c == '@' &&
+          (std::isalpha(static_cast<unsigned char>(peek(1))) ||
+           peek(1) == '_')) {
+        ++pos_;  // @identifier: drop the '@'
+        return next();
+      }
+      if (c == '$' && peek(1) == '"') {
+        ++pos_;  // interpolated string lexed as one string token
+        Token token = lex_string();
+        token.pos -= 1;
+        return token;
+      }
+      if (c == '$' && peek(1) == '@' && peek(2) == '"') {
+        ++pos_;
+        Token token = lex_verbatim_string();
+        token.pos -= 1;
+        return token;
+      }
+    }
     if (std::isalpha(static_cast<unsigned char>(c)) || c == '_' || c == '$') {
       size_t start = pos_;
       while (pos_ < src_.size() &&
@@ -97,6 +138,28 @@ class Lexer {
     if (c == '"') return lex_string();
     if (c == '\'') return lex_char();
     return lex_punct();
+  }
+
+  Token lex_verbatim_string() {
+    // @"..."; quotes escaped by doubling
+    Token token;
+    token.pos = pos_;
+    size_t start = pos_;
+    pos_ += 2;  // @"
+    while (pos_ < src_.size()) {
+      if (src_[pos_] == '"') {
+        if (peek(1) == '"') {
+          pos_ += 2;
+          continue;
+        }
+        ++pos_;
+        break;
+      }
+      ++pos_;
+    }
+    token.kind = Tok::kStringLit;
+    token.text = std::string(src_.substr(start, pos_ - start));
+    return token;
   }
 
   Token lex_number() {
@@ -179,29 +242,33 @@ class Lexer {
     static const char* two[] = {"==", "!=", "<=", ">=", "&&", "||", "++",
                                 "--", "+=", "-=", "*=", "/=", "%=", "&=",
                                 "|=", "^=", "<<", ">>", "->", "::"};
+    // C#-only tokens, gated so Java tokenization is untouched
+    // (e.g. Java `cond?.5:1.0` must lex '?' then '.5')
+    static const char* three_cs[] = {"?\?="};
+    static const char* two_cs[] = {"=>", "??", "?."};
     Token token;
     token.pos = pos_;
     token.kind = Tok::kPunct;
     std::string_view rest = src_.substr(pos_);
+    auto try_ops = [&](auto& ops, size_t len) -> bool {
+      for (const char* op : ops) {
+        if (rest.size() >= len && rest.substr(0, len) == op) {
+          token.text = op;
+          pos_ += len;
+          return true;
+        }
+      }
+      return false;
+    };
     if (rest.size() >= 4 && rest.substr(0, 4) == ">>>=") {
       token.text = ">>>=";
       pos_ += 4;
       return token;
     }
-    for (const char* op : three) {
-      if (rest.size() >= 3 && rest.substr(0, 3) == op) {
-        token.text = op;
-        pos_ += 3;
-        return token;
-      }
-    }
-    for (const char* op : two) {
-      if (rest.size() >= 2 && rest.substr(0, 2) == op) {
-        token.text = op;
-        pos_ += 2;
-        return token;
-      }
-    }
+    if (csharp_ && try_ops(three_cs, 3)) return token;
+    if (try_ops(three, 3)) return token;
+    if (csharp_ && try_ops(two_cs, 2)) return token;
+    if (try_ops(two, 2)) return token;
     token.text = std::string(1, src_[pos_]);
     ++pos_;
     return token;

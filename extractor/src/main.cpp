@@ -18,6 +18,7 @@
 #include <thread>
 #include <vector>
 
+#include "csharp.h"
 #include "java_ast.h"
 #include "java_lexer.h"
 #include "java_parser.h"
@@ -30,6 +31,7 @@ namespace {
 struct CliOptions {
   std::string file;
   std::string dir;
+  std::string lang;  // "java" | "csharp" | "" (auto by file extension)
   int num_threads = 32;
   c2v::ExtractorOptions extractor;
 };
@@ -83,6 +85,19 @@ bool parse_cli(int argc, char** argv, CliOptions* options) {
       if (!v || !parse_int_flag(v, &options->num_threads)) return false;
     } else if (arg == "--no_hash") {
       options->extractor.no_hash = true;
+    } else if (arg == "--lang") {
+      const char* v = next();
+      if (!v) return false;
+      options->lang = v;
+      if (options->lang != "java" && options->lang != "csharp") {
+        std::cerr << "--lang must be java or csharp\n";
+        return false;
+      }
+    } else if (arg == "--max_contexts") {
+      // C# frontend: reservoir cap (reference Utilities.cs:30-32)
+      const char* v = next();
+      if (!v || !parse_int_flag(v, &options->extractor.max_contexts_cs))
+        return false;
     } else if (arg == "--pretty_print") {
       // accepted for flag compatibility; no-op
     } else {
@@ -129,27 +144,7 @@ c2v::Node* parse_with_retries(const std::string& code, c2v::Arena* arena,
   return nullptr;
 }
 
-std::string extract_file_to_string(const std::string& path,
-                                   const c2v::ExtractorOptions& options,
-                                   std::string* error) {
-  std::ifstream in(path, std::ios::binary);
-  if (!in) {
-    *error = "cannot open file: " + path;
-    return std::string();
-  }
-  std::ostringstream buffer;
-  buffer << in.rdbuf();
-  std::string code = buffer.str();
-
-  c2v::Arena arena;
-  std::string parsed_source;
-  c2v::Node* root = parse_with_retries(code, &arena, &parsed_source);
-  if (root == nullptr) {
-    *error = "could not parse: " + path;
-    return std::string();
-  }
-  std::vector<c2v::MethodFeatures> methods =
-      c2v::extract_all(root, parsed_source, options);
+std::string render_methods(const std::vector<c2v::MethodFeatures>& methods) {
   std::string out;
   for (const auto& method : methods) {
     out += method.label;
@@ -162,6 +157,71 @@ std::string extract_file_to_string(const std::string& path,
   return out;
 }
 
+bool is_csharp(const CliOptions& cli, const std::string& path) {
+  if (!cli.lang.empty()) return cli.lang == "csharp";
+  return fs::path(path).extension() == ".cs";
+}
+
+std::string extract_csharp(const std::string& code,
+                           const c2v::ExtractorOptions& options,
+                           std::string* error) {
+  // plain parse, then a class-wrap retry for bare method snippets (the
+  // reference parses with dummy wraps too, Tree.cs DummyMethodName/Type).
+  // A clean parse that simply contains no methods (DTOs, interfaces) is
+  // SUCCESS with empty output, not an error.
+  const std::string candidates[2] = {
+      code, "public class Test {" + code + "}"};
+  bool plain_parse_ok = false;
+  for (size_t attempt = 0; attempt < 2; ++attempt) {
+    try {
+      c2v::Arena arena;
+      std::vector<std::string> comments;
+      c2v::Lexer lexer(candidates[attempt], /*csharp=*/true);
+      lexer.capture_comments(&comments);
+      c2v::cs::CsParser parser(lexer.run(), &arena);
+      c2v::Node* root = parser.parse_compilation_unit();
+      parser.set_comments(std::move(comments));
+      std::vector<c2v::MethodFeatures> methods =
+          c2v::cs::cs_extract_all(parser, root, options);
+      if (!methods.empty()) return render_methods(methods);
+      if (attempt == 0) plain_parse_ok = true;  // maybe the wrap finds more
+    } catch (const std::exception&) {
+    }
+  }
+  if (plain_parse_ok) return std::string();  // valid but method-less file
+  *error = "could not parse C# input";
+  return std::string();
+}
+
+std::string extract_file_to_string(const CliOptions& cli,
+                                   const std::string& path,
+                                   const c2v::ExtractorOptions& options,
+                                   std::string* error) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) {
+    *error = "cannot open file: " + path;
+    return std::string();
+  }
+  std::ostringstream buffer;
+  buffer << in.rdbuf();
+  std::string code = buffer.str();
+
+  if (is_csharp(cli, path)) {
+    std::string result = extract_csharp(code, options, error);
+    if (!error->empty()) *error += ": " + path;
+    return result;
+  }
+
+  c2v::Arena arena;
+  std::string parsed_source;
+  c2v::Node* root = parse_with_retries(code, &arena, &parsed_source);
+  if (root == nullptr) {
+    *error = "could not parse: " + path;
+    return std::string();
+  }
+  return render_methods(c2v::extract_all(root, parsed_source, options));
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -171,8 +231,8 @@ int main(int argc, char** argv) {
 
   if (!options.file.empty()) {
     std::string error;
-    std::string out =
-        extract_file_to_string(options.file, options.extractor, &error);
+    std::string out = extract_file_to_string(options, options.file,
+                                             options.extractor, &error);
     if (!error.empty()) {
       std::cerr << error << "\n";
       return 1;
@@ -189,7 +249,9 @@ int main(int argc, char** argv) {
            options.dir, fs::directory_options::skip_permission_denied, ec);
        it != fs::recursive_directory_iterator(); it.increment(ec)) {
     if (ec) break;
-    if (it->is_regular_file(ec) && it->path().extension() == ".java") {
+    if (it->is_regular_file(ec) &&
+        (it->path().extension() == ".java" ||
+         it->path().extension() == ".cs")) {
       files.push_back(it->path().string());
     }
   }
@@ -212,7 +274,7 @@ int main(int argc, char** argv) {
         if (index >= files.size()) return;
         std::string error;
         std::string out = extract_file_to_string(
-            files[index], options.extractor, &error);
+            options, files[index], options.extractor, &error);
         std::lock_guard<std::mutex> lock(out_mutex);
         if (!error.empty()) {
           std::cerr << error << "\n";

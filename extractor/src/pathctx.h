@@ -34,6 +34,9 @@ struct ExtractorOptions {
   int min_code_len = 1;
   int max_code_len = 10000;
   bool no_hash = false;
+  // C# frontend: reservoir-sample cap on variable pairs
+  // (reference Utilities.cs:30-32, default 30000)
+  int max_contexts_cs = 30000;
 };
 
 // ---------------------------------------------------------- normalization

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+###########################################################
+# preprocess_csharp.sh — C# dataset build
+# (role of the reference's preprocess_csharp.sh:42-66, using the native
+# extractor's C# frontend instead of `dotnet run`)
+
+TRAIN_DIR=${TRAIN_DIR:-dataset/train}
+VAL_DIR=${VAL_DIR:-dataset/val}
+TEST_DIR=${TEST_DIR:-dataset/test}
+DATASET_NAME=${DATASET_NAME:-csharp}
+MAX_CONTEXTS=${MAX_CONTEXTS:-200}
+MAX_SAMPLED_PAIRS=${MAX_SAMPLED_PAIRS:-30000}
+WORD_VOCAB_SIZE=${WORD_VOCAB_SIZE:-1301136}
+PATH_VOCAB_SIZE=${PATH_VOCAB_SIZE:-911417}
+TARGET_VOCAB_SIZE=${TARGET_VOCAB_SIZE:-261245}
+NUM_THREADS=${NUM_THREADS:-64}
+EXTRACTOR=${EXTRACTOR:-extractor/build/c2v-extract}
+
+set -e
+mkdir -p data/${DATASET_NAME}
+
+extract() {  # extract <dir> <out-file>
+  echo "Extracting C# paths from $1 ..."
+  "${EXTRACTOR}" --lang csharp --dir "$1" --max_path_length 8 \
+      --max_path_width 2 --max_contexts "${MAX_SAMPLED_PAIRS}" \
+      --num_threads "${NUM_THREADS}" > "$2"
+  echo "Finished extracting paths from $1"
+}
+
+TRAIN_RAW=data/${DATASET_NAME}/train.raw
+VAL_RAW=data/${DATASET_NAME}/val.raw
+TEST_RAW=data/${DATASET_NAME}/test.raw
+
+extract "${VAL_DIR}" "${VAL_RAW}"
+extract "${TEST_DIR}" "${TEST_RAW}"
+extract "${TRAIN_DIR}" "${TRAIN_RAW}.unshuffled"
+shuf "${TRAIN_RAW}.unshuffled" > "${TRAIN_RAW}"
+rm -f "${TRAIN_RAW}.unshuffled"
+
+python -m code2vec_tpu.data.preprocess \
+  --train_data "${TRAIN_RAW}" --val_data "${VAL_RAW}" --test_data "${TEST_RAW}" \
+  --max_contexts "${MAX_CONTEXTS}" \
+  --word_vocab_size "${WORD_VOCAB_SIZE}" \
+  --path_vocab_size "${PATH_VOCAB_SIZE}" \
+  --target_vocab_size "${TARGET_VOCAB_SIZE}" \
+  --output_name data/${DATASET_NAME}/${DATASET_NAME}
+
+rm -f "${TRAIN_RAW}" "${VAL_RAW}" "${TEST_RAW}"
+echo "Done preprocessing ${DATASET_NAME}"

@@ -163,6 +163,94 @@ class T {
         assert expected in line, expected
 
 
+CSHARP_SAMPLE = '''
+using System;
+
+namespace Demo
+{
+    public class Calc
+    {
+        // Adds two numbers
+        public int AddNumbers(int left, int right)
+        {
+            var sum = left + right;
+            return sum;
+        }
+
+        public bool IsPositive(int value) => value > 0;
+    }
+}
+'''
+
+
+def test_csharp_extraction(tmp_path):
+    src = tmp_path / 'Calc.cs'
+    src.write_text(CSHARP_SAMPLE)
+    lines = extract_file(str(src))
+    labels = [line.split(' ')[0] for line in lines]
+    assert labels == ['add|numbers', 'is|positive']
+    add_line = lines[0]
+    # Roslyn-style path kinds, no parens (reference Extractor.cs:46-88)
+    assert 'AddExpression' in add_line
+    assert 'MethodDeclaration' in add_line
+    assert 'METHOD_NAME,' in add_line or ',METHOD_NAME' in add_line
+    # COMMENT contexts from file trivia in 5-subtoken batches
+    assert 'adds|two|numbers,COMMENT,adds|two|numbers' in add_line
+    # comment contexts appended to EVERY method (reference quirk)
+    assert 'COMMENT' in lines[1]
+
+
+def test_csharp_variable_grouping_and_self_pairs(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { int Twice(int x) { return x + x; } }')
+    lines = extract_file(str(src))
+    contexts = lines[0].split(' ')[1:]
+    # x appears twice -> self-pair path between the two occurrences
+    xx = [c for c in contexts if c.startswith('x,') and c.endswith(',x')]
+    assert xx, contexts
+    assert 'AddExpression' in xx[0]
+
+
+def test_csharp_num_whitelist(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { int F(int a) { int b = a + 137; '
+                   'int c = b + 5; return c; } }')
+    line = extract_file(str(src))[0]
+    # 137 not in {0,1,2,3,4,5,10} -> NUM; 5 kept (Utilities.cs:37)
+    assert ',NUM' in line or 'NUM,' in line
+    assert ',5' in line or '5,' in line
+
+
+def test_csharp_hash_mode_consistent(tmp_path):
+    from code2vec_tpu import common as c
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { int Id(int x) { return x; } }')
+    raw = extract_file(str(src), no_hash=True)[0].split(' ')[1:]
+    hashed = extract_file(str(src), no_hash=False)[0].split(' ')[1:]
+    for r, h in zip(raw, hashed):
+        r_path = r.split(',')[1]
+        h_path = h.split(',')[1]
+        assert int(h_path) == c.java_string_hashcode(r_path)
+
+
+def test_csharp_modern_syntax_parses(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  string Render(int? count, string name) {
+    var label = name ?? "none";
+    var text = $"{label}: {count}";
+    if (count is int n && n > 0) { return text.ToUpper(); }
+    return items.Where(i => i > 0).Select(i => i * 2).ToString();
+  }
+}
+''')
+    lines = extract_file(str(src))
+    assert lines and lines[0].startswith('render ')
+    assert 'CoalesceExpression' in lines[0]
+    assert 'SimpleLambdaExpression' in lines[0]
+
+
 def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
     """End-to-end: real binary feeds the REPL (reference flow:
     interactive_predict.py + extractor.py + JAR)."""
