@@ -75,6 +75,12 @@ class Config:
     # (<data>.train.c2v.tokcache/, ~12 bytes/context on disk) and stream
     # int32 tensors for every later epoch.
     TRAIN_DATA_CACHE: bool = True
+    # When set, capture a jax.profiler trace of a few training steps into
+    # this directory (viewable with TensorBoard/Perfetto) — the step-level
+    # profiler the reference lacked (SURVEY.md §5 'Tracing / profiling').
+    PROFILE_DIR: Optional[str] = None
+    PROFILE_START_STEP: int = 10
+    PROFILE_NUM_STEPS: int = 5
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -152,6 +158,10 @@ class Config:
                             action='store_true',
                             help='disable the binary token cache for the '
                                  'train split')
+        parser.add_argument('--profile', dest='profile_dir', default=None,
+                            metavar='DIR',
+                            help='capture a jax.profiler trace of a few '
+                                 'train steps into DIR')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -187,6 +197,8 @@ class Config:
             self.NUM_TRAIN_EPOCHS = parsed.epochs
         if parsed.no_data_cache:
             self.TRAIN_DATA_CACHE = False
+        if parsed.profile_dir:
+            self.PROFILE_DIR = parsed.profile_dir
         return self
 
     # ------------------------------------------------------- derived props

@@ -177,8 +177,41 @@ class Trainer:
         window_losses = []  # device arrays: no per-step host sync, the
         window_examples = 0  # host only blocks once per log window
         window_start = time.time()
+        try:
+            state = self._fit_loop(
+                state, epoch_batches, start_epoch, on_epoch_end, on_log,
+                batch_num, window_losses, window_examples, window_start,
+                log_every)
+        finally:
+            if getattr(self, '_profiling', False):
+                jax.profiler.stop_trace()
+                self._profiling = False
+        return state
+
+    def _fit_loop(self, state, epoch_batches, start_epoch, on_epoch_end,
+                  on_log, batch_num, window_losses, window_examples,
+                  window_start, log_every):
+        config = self.config
+        self._profiling = False
+        profile_done = False
+        # profile window is relative to THIS run's first batch so resumed
+        # runs (batch_num starts past 0) still capture a trace
+        first_batch = batch_num
+        profile_start = first_batch + config.PROFILE_START_STEP
+        profile_stop_step = profile_start + config.PROFILE_NUM_STEPS
         for epoch in range(start_epoch, config.NUM_TRAIN_EPOCHS):
             for batch in epoch_batches(epoch):
+                if config.PROFILE_DIR and not profile_done:
+                    if batch_num >= profile_start and not self._profiling:
+                        jax.profiler.start_trace(config.PROFILE_DIR)
+                        self._profiling = True
+                    elif batch_num >= profile_stop_step and self._profiling:
+                        jax.block_until_ready(state.params)
+                        jax.profiler.stop_trace()
+                        self._profiling = False
+                        profile_done = True
+                        config.log('Profiler trace written to `%s`.'
+                                   % config.PROFILE_DIR)
                 state, loss = self.train_step(state, batch)
                 batch_num += 1
                 window_losses.append(loss)

@@ -1,0 +1,89 @@
+"""Fault-tolerant extraction driver: poison isolation, timeouts, fan-out."""
+import io
+import os
+import sys
+
+import pytest
+
+from code2vec_tpu.data.extract_driver import ExtractionDriver
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+
+pytestmark = pytest.mark.skipif(not os.path.isfile(BINARY),
+                                reason='extractor binary not built')
+
+
+def _make_tree(tmp_path):
+    good = tmp_path / 'projA' / 'src'
+    good.mkdir(parents=True)
+    (good / 'Good.java').write_text(
+        'class G { int add(int a, int b) { return a + b; } }')
+    (good / 'Also.java').write_text(
+        'class H { int sub(int a, int b) { return a - b; } }')
+    loose = tmp_path / 'Loose.java'
+    loose.write_text('class L { int one() { return 1; } }')
+    return tmp_path
+
+
+def test_extracts_all_dirs_and_loose_files(tmp_path):
+    root = _make_tree(tmp_path)
+    driver = ExtractionDriver([BINARY], log=lambda m: None)
+    out = io.StringIO()
+    driver.extract(str(root), out, workers=2)
+    labels = sorted(line.split(' ')[0]
+                    for line in out.getvalue().splitlines())
+    assert labels == ['add', 'one', 'sub']
+
+
+def test_poison_file_isolated_not_sinking_project(tmp_path):
+    root = _make_tree(tmp_path)
+    # a "poison" wrapper: fails on --dir projB (simulating a crash inside
+    # the project) and on the Bad file itself, so recursion must isolate it
+    wrapper = tmp_path / 'wrapper.py'
+    wrapper.write_text(
+        'import subprocess, sys\n'
+        'args = sys.argv[1:]\n'
+        'if any(a.endswith("projB") or "Bad" in a for a in args):\n'
+        '    sys.exit(1)\n'
+        'r = subprocess.run([%r] + args, capture_output=True, text=True)\n'
+        'sys.stdout.write(r.stdout)\n'
+        'sys.exit(r.returncode)\n' % BINARY)
+    bad_dir = root / 'projB'
+    bad_dir.mkdir()
+    (bad_dir / 'Bad.java').write_text('class B { int f() { return 2; } }')
+    (bad_dir / 'Fine.java').write_text('class F { int g() { return 3; } }')
+
+    logs = []
+    driver = ExtractionDriver([sys.executable, str(wrapper)],
+                              timeout_seconds=60, log=logs.append)
+    out = io.StringIO()
+    driver.extract(str(root), out, workers=1)
+    labels = sorted(line.split(' ')[0]
+                    for line in out.getvalue().splitlines())
+    # Fine.java survives via recursion; Bad.java skipped as poison
+    assert labels == ['add', 'g', 'one', 'sub']
+    assert driver.nr_failed_files == 1
+    assert any('poison' in m for m in logs)
+
+
+def test_timeout_triggers_isolation(tmp_path):
+    root = tmp_path
+    proj = root / 'proj'
+    proj.mkdir()
+    (proj / 'Slow.java').write_text('class S { int f() { return 1; } }')
+    # wrapper: hang on --dir, work on --file
+    wrapper = tmp_path / 'hang.py'
+    wrapper.write_text(
+        'import subprocess, sys, time\n'
+        'args = sys.argv[1:]\n'
+        'if "--dir" in args:\n'
+        '    time.sleep(60)\n'
+        'r = subprocess.run([%r] + args, capture_output=True, text=True)\n'
+        'sys.stdout.write(r.stdout)\n'
+        'sys.exit(r.returncode)\n' % BINARY)
+    driver = ExtractionDriver([sys.executable, str(wrapper)],
+                              timeout_seconds=3, log=lambda m: None)
+    out = io.StringIO()
+    driver.extract(str(root), out, workers=1)
+    assert 'f ' in out.getvalue()  # extracted via per-file fallback
