@@ -1,0 +1,3 @@
+from code2vec_tpu.ops.topk import sharded_top_k
+
+__all__ = ['sharded_top_k']

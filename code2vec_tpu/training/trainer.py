@@ -26,6 +26,7 @@ import optax
 
 from code2vec_tpu.config import Config
 from code2vec_tpu.data.reader import Batch
+from code2vec_tpu.ops.topk import sharded_top_k
 from code2vec_tpu.parallel import mesh as mesh_lib
 
 
@@ -85,18 +86,23 @@ class Trainer:
                                      step=state.step + 1, rng=state.rng)
             return new_state, loss
 
+        mesh = self.mesh
+
+        def take_top_k(logits):
+            # cross-shard merge on model-parallel meshes, plain lax.top_k
+            # otherwise — the dispatch lives in sharded_top_k
+            return sharded_top_k(logits, top_k, mesh)
+
         def eval_step(params, arrays):
             code_vectors, attention, logits = backend.forward(params, arrays)
-            k = min(top_k, logits.shape[-1])
-            topk_scores, topk_indices = jax.lax.top_k(logits, k)
+            topk_scores, topk_indices = take_top_k(logits)
             return {'topk_indices': topk_indices,
                     'topk_scores': topk_scores,
                     'code_vectors': code_vectors}
 
         def predict_step(params, arrays):
             code_vectors, attention, logits = backend.forward(params, arrays)
-            k = min(top_k, logits.shape[-1])
-            topk_scores, topk_indices = jax.lax.top_k(logits, k)
+            topk_scores, topk_indices = take_top_k(logits)
             return {'topk_indices': topk_indices,
                     'topk_scores': jax.nn.softmax(topk_scores, axis=-1),
                     'attention': attention,

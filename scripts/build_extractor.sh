@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# build_extractor.sh — build the native path-context extractor
+# (role of the reference's build_extractor.sh, which ran `mvn clean package`)
+set -e
+cd "$(dirname "$0")/../extractor"
+make
+echo "Built extractor/build/c2v-extract"

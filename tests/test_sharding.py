@@ -174,6 +174,32 @@ def test_checkpoint_metadata_mismatch_is_clear_error(tmp_path):
         store2.verify_metadata()
 
 
+def test_sharded_top_k_matches_lax_top_k():
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from code2vec_tpu.ops.topk import sharded_top_k
+    config = _config(2, 4)
+    mesh = mesh_lib.create_mesh(config)
+    rng = np.random.default_rng(0)
+    # distinct values so tie-breaking can't differ
+    logits = rng.permutation(16 * 64).reshape(16, 64).astype(np.float32)
+    ref_vals, ref_idx = jax.lax.top_k(jnp.asarray(logits), 10)
+    placed = jax.device_put(logits, NamedSharding(mesh, P('data', 'model')))
+    vals, idx = jax.jit(
+        lambda x: sharded_top_k(x, 10, mesh))(placed)
+    np.testing.assert_array_equal(np.asarray(ref_idx), np.asarray(idx))
+    np.testing.assert_allclose(np.asarray(ref_vals), np.asarray(vals))
+
+    # k larger than the per-shard width (V/m = 2 < k = 5): every shard
+    # contributes all columns
+    small = rng.permutation(16 * 8).reshape(16, 8).astype(np.float32)
+    ref_vals5, ref_idx5 = jax.lax.top_k(jnp.asarray(small), 5)
+    placed5 = jax.device_put(small, NamedSharding(mesh, P('data', 'model')))
+    vals5, idx5 = jax.jit(lambda x: sharded_top_k(x, 5, mesh))(placed5)
+    np.testing.assert_array_equal(np.asarray(ref_idx5), np.asarray(idx5))
+    np.testing.assert_allclose(np.asarray(ref_vals5), np.asarray(vals5))
+
+
 def test_flax_backend_shards_too():
     trainer = _trainer(4, 2, framework='flax')
     _, losses = _run_steps(trainer, n=2)
