@@ -1,0 +1,40 @@
+import json
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.metrics_writer import MetricsWriter, maybe_create
+
+
+def test_scalars_append_jsonl(tmp_path):
+    writer = MetricsWriter(str(tmp_path / 'logs'))
+    writer.scalar('train/loss', 1.5, 10)
+    writer.scalar('eval/f1', 0.25, 1)
+    writer.close()
+    lines = (tmp_path / 'logs' / 'metrics.jsonl').read_text().splitlines()
+    records = [json.loads(line) for line in lines]
+    assert records[0]['tag'] == 'train/loss'
+    assert records[0]['value'] == 1.5
+    assert records[0]['step'] == 10
+    assert records[1]['tag'] == 'eval/f1'
+
+
+def test_maybe_create_respects_flag(tmp_path):
+    config = Config(TRAIN_DATA_PATH_PREFIX='x', USE_TENSORBOARD=False)
+    assert maybe_create(config) is None
+    config2 = Config(TRAIN_DATA_PATH_PREFIX='x', USE_TENSORBOARD=True,
+                     MODEL_SAVE_PATH=str(tmp_path / 'm' / 'saved'))
+    writer = maybe_create(config2)
+    assert writer is not None
+    assert writer.logdir == str(tmp_path / 'm' / 'summaries')
+    writer.close()
+
+
+def test_append_mode_survives_reopen(tmp_path):
+    logdir = str(tmp_path / 'logs')
+    w1 = MetricsWriter(logdir)
+    w1.scalar('a', 1.0, 1)
+    w1.close()
+    w2 = MetricsWriter(logdir)
+    w2.scalar('a', 2.0, 2)
+    w2.close()
+    lines = (tmp_path / 'logs' / 'metrics.jsonl').read_text().splitlines()
+    assert len(lines) == 2
