@@ -75,6 +75,11 @@ class Config:
     # (<data>.train.c2v.tokcache/, ~12 bytes/context on disk) and stream
     # int32 tensors for every later epoch.
     TRAIN_DATA_CACHE: bool = True
+    # Experimental: use the fused Pallas encode kernel (split-TRANSFORM
+    # matmul + tanh + attention scores in one VMEM pass) for the
+    # deterministic forward (eval/predict). Enable after profiling shows
+    # the encode block bandwidth-bound on your chip.
+    USE_PALLAS_FUSED_ENCODE: bool = False
     # When set, capture a jax.profiler trace of a few training steps into
     # this directory (viewable with TensorBoard/Perfetto) — the step-level
     # profiler the reference lacked (SURVEY.md §5 'Tracing / profiling').

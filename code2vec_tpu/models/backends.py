@@ -73,7 +73,8 @@ class JaxBackend:
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]
         code_vectors, attention = functional.encode(
-            params, source, path, target, mask, dtype=self.dtype)
+            params, source, path, target, mask, dtype=self.dtype,
+            use_pallas=self.config.USE_PALLAS_FUSED_ENCODE)
         logits = functional.compute_logits(
             params, code_vectors, dtype=self.dtype,
             num_valid_targets=self.num_valid_targets)
@@ -104,7 +105,8 @@ class FlaxBackend:
             code_dim=config.CODE_VECTOR_SIZE,
             dropout_keep_rate=config.DROPOUT_KEEP_RATE,
             compute_dtype=self.dtype,
-            num_valid_targets=self.num_valid_targets)
+            num_valid_targets=self.num_valid_targets,
+            use_pallas=config.USE_PALLAS_FUSED_ENCODE)
 
     def init(self, rng: jax.Array):
         dummy = jnp.zeros((1, self.config.MAX_CONTEXTS), dtype=jnp.int32)

@@ -30,6 +30,8 @@ class Code2VecModule(nn.Module):
     compute_dtype: jnp.dtype = jnp.float32
     # true target-vocab size when target_vocab_size is padded for sharding
     num_valid_targets: Optional[int] = None
+    # route the deterministic forward through the fused Pallas kernel
+    use_pallas: bool = False
 
     def _params(self) -> functional.Code2VecParams:
         fan_out_uniform = jax.nn.initializers.variance_scaling(
@@ -64,7 +66,8 @@ class Code2VecModule(nn.Module):
         code_vectors, attention_weights = functional.encode(
             params, source, path, target, mask, dropout_rng=dropout_rng,
             dropout_keep_rate=self.dropout_keep_rate,
-            dtype=self.compute_dtype)
+            dtype=self.compute_dtype,
+            use_pallas=self.use_pallas and deterministic)
         logits = functional.compute_logits(
             params, code_vectors, dtype=self.compute_dtype,
             num_valid_targets=self.num_valid_targets)

@@ -98,14 +98,17 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
            target: jax.Array, mask: jax.Array, *,
            dropout_rng: Optional[jax.Array] = None,
            dropout_keep_rate: float = 1.0,
-           dtype: jnp.dtype = jnp.float32
+           dtype: jnp.dtype = jnp.float32,
+           use_pallas: bool = False
            ) -> Tuple[jax.Array, jax.Array]:
     """Bag-of-contexts → (code_vectors (B, D) fp32, attention (B, C) fp32).
 
     ``dtype`` is the MXU compute dtype; attention softmax runs fp32.
     Dropout is applied iff ``dropout_rng`` is given and keep < 1
     (reference applies it only in the train graph,
-    tensorflow_model.py:245-246).
+    tensorflow_model.py:245-246). ``use_pallas`` routes the deterministic
+    forward through the experimental fused kernel
+    (ops/pallas_encode.py); the dropout path always uses plain jnp.
     """
     source_embed = jnp.take(params.token_embedding, source,
                             axis=0).astype(dtype)       # (B, C, d)
@@ -113,25 +116,45 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
                           axis=0).astype(dtype)          # (B, C, d)
     target_embed = jnp.take(params.token_embedding, target,
                             axis=0).astype(dtype)        # (B, C, d)
-    context_embed = jnp.concatenate(
-        [source_embed, path_embed, target_embed], axis=-1)  # (B, C, 3d)
 
-    if dropout_rng is not None and dropout_keep_rate < 1.0:
-        keep_mask = jax.random.bernoulli(
-            dropout_rng, dropout_keep_rate, context_embed.shape)
-        context_embed = jnp.where(
-            keep_mask, context_embed / dropout_keep_rate,
-            jnp.zeros_like(context_embed))
-
-    # fp32 compute asks for true-fp32 MXU passes (TPU fp32 matmuls default
-    # to lower precision); bf16 compute uses the native fast path.
-    precision = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
-                 else jax.lax.Precision.DEFAULT)
-    x = jnp.tanh(jnp.matmul(context_embed, params.transform.astype(dtype),
-                            precision=precision))                 # (B, C, D)
-
-    scores = jnp.matmul(x, params.attention.astype(dtype),
-                        precision=precision)[..., 0]              # (B, C)
+    apply_dropout = dropout_rng is not None and dropout_keep_rate < 1.0
+    pallas_route = False
+    if use_pallas and not apply_dropout:
+        from code2vec_tpu.ops import pallas_encode
+        # only on a real TPU backend: off-TPU the kernel would run in the
+        # (test-only) interpreter, far slower than the fused XLA path below
+        pallas_route = (pallas_encode.PALLAS_AVAILABLE
+                        and jax.default_backend() == 'tpu')
+    if pallas_route:
+        from code2vec_tpu.ops.pallas_encode import fused_context_transform
+        batch, contexts = source.shape
+        # inputs stay in the compute dtype (bf16 ships half the bytes into
+        # VMEM); the kernel accumulates fp32 via preferred_element_type
+        x_flat, scores_flat = fused_context_transform(
+            source_embed.reshape(batch * contexts, -1),
+            path_embed.reshape(batch * contexts, -1),
+            target_embed.reshape(batch * contexts, -1),
+            params.transform.astype(dtype), params.attention.astype(dtype))
+        x = x_flat.reshape(batch, contexts, -1)
+        scores = scores_flat.reshape(batch, contexts)
+    else:
+        context_embed = jnp.concatenate(
+            [source_embed, path_embed, target_embed], axis=-1)  # (B, C, 3d)
+        if apply_dropout:
+            keep_mask = jax.random.bernoulli(
+                dropout_rng, dropout_keep_rate, context_embed.shape)
+            context_embed = jnp.where(
+                keep_mask, context_embed / dropout_keep_rate,
+                jnp.zeros_like(context_embed))
+        # fp32 compute asks for true-fp32 MXU passes (TPU fp32 matmuls
+        # default to lower precision); bf16 uses the native fast path.
+        precision = (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+                     else jax.lax.Precision.DEFAULT)
+        x = jnp.tanh(jnp.matmul(context_embed,
+                                params.transform.astype(dtype),
+                                precision=precision))             # (B, C, D)
+        scores = jnp.matmul(x, params.attention.astype(dtype),
+                            precision=precision)[..., 0]          # (B, C)
     scores = scores.astype(jnp.float32) + jnp.log(
         jnp.maximum(mask.astype(jnp.float32), _MASK_MIN))
     attention_weights = jax.nn.softmax(scores, axis=1)            # (B, C)

@@ -1,0 +1,77 @@
+"""Fused-encode Pallas kernel vs the plain jnp math (interpreter mode —
+no TPU needed for correctness)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.ops import pallas_encode
+
+pytestmark = pytest.mark.skipif(not pallas_encode.PALLAS_AVAILABLE,
+                                reason='pallas unavailable')
+
+
+@pytest.mark.parametrize('n', [512, 1024, 700])  # incl. non-multiple of tile
+def test_fused_matches_reference_math(n):
+    rng = np.random.default_rng(0)
+    token_dim, path_dim, code_dim = 16, 16, 48
+    src = rng.standard_normal((n, token_dim)).astype(np.float32)
+    path = rng.standard_normal((n, path_dim)).astype(np.float32)
+    tgt = rng.standard_normal((n, token_dim)).astype(np.float32)
+    transform = rng.standard_normal(
+        (2 * token_dim + path_dim, code_dim)).astype(np.float32) * 0.1
+    attention = rng.standard_normal((code_dim, 1)).astype(np.float32)
+
+    x, scores = pallas_encode.fused_context_transform(
+        src, path, tgt, transform, attention, interpret=True)
+
+    ctx = np.concatenate([src, path, tgt], axis=1)
+    ref_x = np.tanh(ctx @ transform)
+    ref_scores = ref_x @ attention
+    np.testing.assert_allclose(np.asarray(x), ref_x, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(scores), ref_scores, rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_encode_with_pallas_flag_matches_plain_path():
+    """On CPU the flag falls back to the jnp path (the kernel only routes
+    on a real TPU backend) — this asserts the flag is safe everywhere; the
+    kernel itself is covered by the interpret-mode tests above."""
+    from code2vec_tpu.models import functional
+    params = functional.init_params(
+        jax.random.PRNGKey(0), token_vocab_size=20, path_vocab_size=10,
+        target_vocab_size=8, token_dim=8, path_dim=8, code_dim=16)
+    rng = np.random.default_rng(3)
+    source = rng.integers(0, 20, (4, 6)).astype(np.int32)
+    path = rng.integers(0, 10, (4, 6)).astype(np.int32)
+    target = rng.integers(0, 20, (4, 6)).astype(np.int32)
+    mask = np.ones((4, 6), np.float32)
+    code_plain, attn_plain = functional.encode(
+        params, source, path, target, mask)
+    code_fused, attn_fused = functional.encode(
+        params, source, path, target, mask, use_pallas=True)
+    np.testing.assert_allclose(np.asarray(code_plain),
+                               np.asarray(code_fused), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(attn_plain),
+                               np.asarray(attn_fused), rtol=2e-5, atol=1e-6)
+
+
+def test_fused_under_jit_composition():
+    rng = np.random.default_rng(1)
+    src = rng.standard_normal((256, 8)).astype(np.float32)
+    path = rng.standard_normal((256, 8)).astype(np.float32)
+    tgt = rng.standard_normal((256, 8)).astype(np.float32)
+    transform = rng.standard_normal((24, 16)).astype(np.float32)
+    attention = rng.standard_normal((16, 1)).astype(np.float32)
+
+    @jax.jit
+    def run(a, b, c):
+        x, s = pallas_encode.fused_context_transform(
+            a, b, c, transform, attention, interpret=True)
+        return x.sum() + s.sum()
+
+    value = float(run(src, path, tgt))
+    ctx = np.concatenate([src, path, tgt], axis=1)
+    ref_x = np.tanh(ctx @ transform)
+    ref = ref_x.sum() + (ref_x @ attention).sum()
+    np.testing.assert_allclose(value, ref, rtol=1e-4)
