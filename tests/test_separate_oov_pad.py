@@ -1,0 +1,83 @@
+"""SEPARATE_OOV_AND_PAD=True policy end to end (reference
+vocabularies.py:26-29, 204-209: tokens/paths get distinct <PAD>/<OOV>,
+targets get only <OOV>)."""
+import pickle
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data import native
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+from code2vec_tpu.vocab import Code2VecVocabs
+
+
+@pytest.fixture
+def separate_setup(tmp_path):
+    prefix = tmp_path / 'ds'
+    with open(str(prefix) + '.dict.c2v', 'wb') as f:
+        pickle.dump({'s1': 10, 's2': 9}, f)
+        pickle.dump({'p1': 7}, f)
+        pickle.dump({'lbl1': 5, 'lbl2': 4}, f)
+        pickle.dump(4, f)
+    config = Config(TRAIN_DATA_PATH_PREFIX=str(prefix), VERBOSE_MODE=0,
+                    MAX_CONTEXTS=3, TRAIN_BATCH_SIZE=2, TEST_BATCH_SIZE=2,
+                    SEPARATE_OOV_AND_PAD=True, READER_USE_NATIVE=False)
+    vocabs = Code2VecVocabs(config)
+    return config, vocabs, prefix
+
+
+def test_vocab_indices_under_separate_policy(separate_setup):
+    config, vocabs, prefix = separate_setup
+    assert vocabs.token_vocab.pad_index == 0
+    assert vocabs.token_vocab.oov_index == 1
+    assert vocabs.token_vocab.size == 4      # PAD, OOV, s1, s2
+    assert vocabs.path_vocab.size == 3
+    # targets: OOV only (reference vocabularies.py:207-208)
+    assert vocabs.target_vocab.oov_index == 0
+    assert vocabs.target_vocab.size == 3
+
+
+def test_mask_distinguishes_oov_from_pad(separate_setup):
+    config, vocabs, prefix = separate_setup
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    batch = reader.tokenize_lines(['lbl1 zz,zz,zz s1,p1,s2 '])
+    # all-OOV context: indices are OOV(!=PAD) -> context IS valid under the
+    # separate policy (unlike the joined policy where OOV==PAD)
+    np.testing.assert_array_equal(batch.mask[0], [1.0, 1.0, 0.0])
+    np.testing.assert_array_equal(batch.source[0], [1, 2, 0])  # OOV,s1,PAD
+
+
+def test_native_tokenizer_separate_policy(separate_setup):
+    if not native.is_available():
+        pytest.skip('native toolchain unavailable')
+    config, vocabs, prefix = separate_setup
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    reader._native = None
+    tokenizer = native.get_tokenizer(vocabs, config)
+    lines = ['lbl1 zz,zz,zz s1,p1,s2 ', ' s1,p1,s2', 'unknown s2,p1,s1']
+    py_batch = reader.tokenize_lines(lines)
+    native_batch = tokenizer.tokenize_lines(lines)
+    np.testing.assert_array_equal(py_batch.source, native_batch.source)
+    np.testing.assert_array_equal(py_batch.path, native_batch.path)
+    np.testing.assert_array_equal(py_batch.target, native_batch.target)
+    np.testing.assert_array_equal(py_batch.mask, native_batch.mask)
+    np.testing.assert_array_equal(py_batch.label, native_batch.label)
+
+
+def test_training_smoke_under_separate_policy(tmp_path):
+    from tests.test_train_overfit import make_dataset
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix),
+        TEST_DATA_PATH=str(tmp_path / 'tiny.val.c2v'),
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=3,
+        SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False, SEPARATE_OOV_AND_PAD=True,
+        LEARNING_RATE=0.01)
+    model = Code2VecModel(config)
+    model.train()
+    results = model.evaluate()
+    assert np.isfinite(results.subtoken_f1)
