@@ -20,6 +20,12 @@ def main(args=None) -> None:
     config = Config().load_from_args(args)
     config.verify()
 
+    # multi-host: join the jax.distributed runtime when pod/env config is
+    # present (no-op single host)
+    from code2vec_tpu.parallel.distributed import \
+        maybe_initialize_distributed
+    maybe_initialize_distributed(log=config.log)
+
     from code2vec_tpu.model_api import Code2VecModel
     model = Code2VecModel(config)
     config.log('Done creating code2vec model')

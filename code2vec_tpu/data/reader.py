@@ -182,12 +182,17 @@ class PathContextReader:
     def __init__(self, vocabs: Code2VecVocabs, config: Config,
                  estimator_action: EstimatorAction,
                  data_path: Optional[str] = None,
-                 keep_strings: Optional[bool] = None):
+                 keep_strings: Optional[bool] = None,
+                 process_index: int = 0, process_count: int = 1):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
         self.data_path = data_path if data_path is not None else \
             config.data_path(is_evaluating=estimator_action.is_evaluate)
+        # multi-host: each process reads a disjoint line stride and emits
+        # its 1/process_count share of the GLOBAL batch
+        self.process_index = process_index
+        self.process_count = max(1, process_count)
         # Eval and predict keep the raw strings around for host-side metric
         # computation / attention display (reference kept string tensors in
         # the graph, path_context_reader.py:225-227).
@@ -253,7 +258,10 @@ class PathContextReader:
     # ------------------------------------------------------------- batching
     def _lines_from_file(self) -> Iterator[str]:
         with open(self.data_path, 'r', buffering=self.config.CSV_BUFFER_SIZE) as f:
-            for line in f:
+            for line_number, line in enumerate(f):
+                if self.process_count > 1 and \
+                        line_number % self.process_count != self.process_index:
+                    continue
                 if line.strip():
                     yield line
 
@@ -387,8 +395,11 @@ class PathContextReader:
         lines: Iterable[str] = self._lines_from_file()
         if shuffle:
             lines = self._shuffled(lines, random.Random(seed))
+        # per-process LOCAL batch: process-local shards assemble into the
+        # global batch on device (parallel/mesh.py shard_batch)
         batch_size = self.config.batch_size(
-            is_evaluating=self.estimator_action.is_evaluate)
+            is_evaluating=self.estimator_action.is_evaluate) \
+            // self.process_count
         yield from self._filtered_batches(lines, batch_size)
 
     def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,

@@ -156,15 +156,28 @@ class Code2VecModel:
     def train(self) -> None:
         config = self.config
         assert config.is_training
-        reader = PathContextReader(self.vocabs, config, EstimatorAction.Train)
+        process_count = jax.process_count()
+        reader = PathContextReader(self.vocabs, config, EstimatorAction.Train,
+                                   process_index=jax.process_index(),
+                                   process_count=process_count)
         save_store = (self._store_for(config.MODEL_SAVE_PATH)
                       if config.is_saving else None)
         writer = metrics_writer.maybe_create(config)
+        use_cache = config.TRAIN_DATA_CACHE
+        if use_cache and process_count > 1:
+            # the on-disk cache is keyed by the data file, not the process
+            # stride — fall back to streaming on multi-host shared storage
+            use_cache = False
+            self.log('TRAIN_DATA_CACHE disabled under multi-host training.')
+        run_evals = config.is_testing and process_count == 1
+        if config.is_testing and not run_evals:
+            self.log('Multi-host run: skipping in-training evaluation '
+                     '(see Code2VecModel.evaluate).')
         self.log('Starting training (%d epochs, batch %d, steps/epoch ~%d)'
                  % (config.NUM_TRAIN_EPOCHS, config.TRAIN_BATCH_SIZE,
                     config.train_steps_per_epoch))
 
-        if config.TRAIN_DATA_CACHE:
+        if use_cache:
             from code2vec_tpu.data.cache import TokenCache
             from code2vec_tpu.data.reader import prefetch_iterator
             cache = TokenCache.build_or_load(config, self.vocabs, reader)
@@ -185,30 +198,47 @@ class Code2VecModel:
                 writer.scalar('train/loss', avg_loss, step)
                 writer.scalar('train/examples_per_sec', throughput, step)
 
+        # one eval+log helper for both callbacks; the metric step axis is
+        # ALWAYS the global batch number (mixing epoch and batch steps on
+        # one tag corrupts the stream)
+        last_eval_batch = [-1]
+
+        def _evaluate_and_log(label: str, step: int, params) -> None:
+            results = self.evaluate(params=params)
+            self.log('After %s: %s' % (label, results))
+            if writer is not None:
+                writer.scalar('eval/top1_acc', float(results.topk_acc[0]),
+                              step)
+                writer.scalar('eval/subtoken_f1', results.subtoken_f1, step)
+                writer.scalar('eval/subtoken_precision',
+                              results.subtoken_precision, step)
+                writer.scalar('eval/subtoken_recall',
+                              results.subtoken_recall, step)
+
         def on_epoch_end(epoch: int, state: TrainerState) -> None:
-            self.params = state.params
             if save_store is not None and \
                     (epoch + 1) % config.SAVE_EVERY_EPOCHS == 0:
                 self.save(state=state, epoch=epoch)
-            if config.is_testing:
-                results = self.evaluate()
-                self.log('After epoch %d: %s' % (epoch + 1, results))
-                if writer is not None:
-                    writer.scalar('eval/top1_acc',
-                                  float(results.topk_acc[0]), epoch + 1)
-                    writer.scalar('eval/subtoken_f1',
-                                  results.subtoken_f1, epoch + 1)
-                    writer.scalar('eval/subtoken_precision',
-                                  results.subtoken_precision, epoch + 1)
-                    writer.scalar('eval/subtoken_recall',
-                                  results.subtoken_recall, epoch + 1)
+            if run_evals:
+                step = (epoch + 1) * config.train_steps_per_epoch
+                if last_eval_batch[0] == step:
+                    return  # the interval eval just ran on this batch
+                last_eval_batch[0] = step
+                _evaluate_and_log('epoch %d' % (epoch + 1), step,
+                                  state.params)
+
+        def on_eval_interval(batch_num: int, state: TrainerState) -> None:
+            last_eval_batch[0] = batch_num
+            _evaluate_and_log('batch %d' % batch_num, batch_num,
+                              state.params)
 
         start = getattr(self, '_start_epoch', 0)
         try:
-            self.state = self.trainer.fit(self.state, epoch_batches,
-                                          start_epoch=start,
-                                          on_epoch_end=on_epoch_end,
-                                          on_log=on_log)
+            self.state = self.trainer.fit(
+                self.state, epoch_batches, start_epoch=start,
+                on_epoch_end=on_epoch_end, on_log=on_log,
+                on_eval_interval=(on_eval_interval
+                                  if run_evals else None))
         finally:
             if writer is not None:
                 writer.close()
@@ -243,9 +273,20 @@ class Code2VecModel:
                  % self.config.MODEL_LOAD_PATH)
 
     # ------------------------------------------------------------ evaluate
-    def evaluate(self) -> ModelEvaluationResults:
+    def evaluate(self, params=None) -> ModelEvaluationResults:
+        """``params`` overrides the stored parameters for mid-training
+        evaluation (the stored ``self.params`` may alias buffers the next
+        donated train step will delete; callbacks pass the live state's
+        params explicitly instead of mutating the model object)."""
+        params = params if params is not None else self.params
         config = self.config
         assert config.is_testing
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                'Multi-host evaluation is not supported yet: per-host data '
+                'shards can yield unequal batch counts, deadlocking the '
+                'mesh collectives. Evaluate from a single-host run against '
+                'the checkpoint instead.')
         reader = PathContextReader(self.vocabs, config,
                                    EstimatorAction.Evaluate)
         oov = self.vocabs.target_vocab.special_words.OOV
@@ -271,7 +312,7 @@ class Code2VecModel:
         start_time = time.time()
         with open(log_path, 'w') as log_file:
             for batch in reader.iter_epoch_prefetched(shuffle=False):
-                out = as_numpy(self.trainer.eval_step(self.params, batch))
+                out = as_numpy(self.trainer.eval_step(params, batch))
                 results = decode_topk_batch(
                     out['topk_indices'], self._target_index_to_word,
                     batch.label_strings, batch.weight)

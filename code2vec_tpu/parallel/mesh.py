@@ -132,7 +132,22 @@ def attach_shardings(abstract_tree, mesh: Mesh):
 
 def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False):
     """Place a tuple of per-example numpy arrays onto the mesh: batch over
-    ``data``; optionally contexts over ``model`` for 2-D arrays."""
+    ``data``; optionally contexts over ``model`` for 2-D arrays.
+
+    Multi-host: each process holds its LOCAL 1/process_count share of the
+    global batch (the reader strides the data file per process);
+    ``make_array_from_process_local_data`` assembles the global sharded
+    array without any cross-host copy."""
+    if jax.process_count() > 1:
+        out = []
+        for a in arrays:
+            sharding = NamedSharding(mesh,
+                                     batch_spec(np.ndim(a), shard_contexts))
+            global_shape = ((a.shape[0] * jax.process_count(),)
+                            + tuple(a.shape[1:]))
+            out.append(jax.make_array_from_process_local_data(
+                sharding, np.asarray(a), global_shape))
+        return tuple(out)
     return tuple(
         jax.device_put(a, NamedSharding(
             mesh, batch_spec(np.ndim(a), shard_contexts)))

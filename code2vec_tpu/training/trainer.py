@@ -171,7 +171,9 @@ class Trainer:
             epoch_batches: Callable[[int], Iterable[Batch]],
             start_epoch: int = 0,
             on_epoch_end: Optional[Callable[[int, TrainerState], None]] = None,
-            on_log: Optional[Callable[[int, float, float], None]] = None
+            on_log: Optional[Callable[[int, float, float], None]] = None,
+            on_eval_interval: Optional[Callable[[int, TrainerState],
+                                                None]] = None
             ) -> TrainerState:
         """Epoch-driven loop with the reference's windowed throughput trace
         (tensorflow_model.py:74-101, 424-430)."""
@@ -186,8 +188,8 @@ class Trainer:
         try:
             state = self._fit_loop(
                 state, epoch_batches, start_epoch, on_epoch_end, on_log,
-                batch_num, window_losses, window_examples, window_start,
-                log_every)
+                on_eval_interval, batch_num, window_losses, window_examples,
+                window_start, log_every)
         finally:
             if getattr(self, '_profiling', False):
                 jax.profiler.stop_trace()
@@ -195,8 +197,8 @@ class Trainer:
         return state
 
     def _fit_loop(self, state, epoch_batches, start_epoch, on_epoch_end,
-                  on_log, batch_num, window_losses, window_examples,
-                  window_start, log_every):
+                  on_log, on_eval_interval, batch_num, window_losses,
+                  window_examples, window_start, log_every):
         config = self.config
         self._profiling = False
         profile_done = False
@@ -236,6 +238,18 @@ class Trainer:
                     if on_log is not None:
                         on_log(batch_num, sum_loss / len(window_losses),
                                throughput)
+                    window_losses = []
+                    window_examples = 0
+                    window_start = time.time()
+                # mid-epoch evaluation (the reference Keras backend's
+                # ModelEvaluationCallback every NUM_TRAIN_BATCHES_TO_EVALUATE
+                # batches, keras_model.py:326-345, config.py:53)
+                if on_eval_interval is not None and \
+                        config.NUM_TRAIN_BATCHES_TO_EVALUATE > 0 and \
+                        batch_num % config.NUM_TRAIN_BATCHES_TO_EVALUATE == 0:
+                    on_eval_interval(batch_num, state)
+                    # restart the throughput window completely: a partial
+                    # window timed from post-eval would overstate samples/sec
                     window_losses = []
                     window_examples = 0
                     window_start = time.time()

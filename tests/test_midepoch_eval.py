@@ -1,0 +1,72 @@
+import numpy as np
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.model_api import Code2VecModel
+from code2vec_tpu.parallel.distributed import maybe_initialize_distributed
+from tests.test_train_overfit import make_dataset
+
+
+def test_mid_epoch_evaluation_fires(tmp_path):
+    """Reference Keras evaluated every NUM_TRAIN_BATCHES_TO_EVALUATE
+    batches mid-epoch (keras_model.py:326-345)."""
+    prefix = make_dataset(tmp_path, n_train=96)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix),
+        TEST_DATA_PATH=str(tmp_path / 'tiny.val.c2v'),
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=2,
+        SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False, NUM_TRAIN_BATCHES_TO_EVALUATE=4)
+    model = Code2VecModel(config)
+    eval_count = [0]
+    orig_evaluate = model.evaluate
+
+    def counting_evaluate(**kwargs):
+        eval_count[0] += 1
+        return orig_evaluate(**kwargs)
+
+    model.evaluate = counting_evaluate
+    model.train()
+    # 96 examples / 16 = 6 batches/epoch, 2 epochs = 12 batches ->
+    # mid-epoch evals at batches 4 and 8 (12 coincides with epoch end)
+    # plus the 2 per-epoch evals
+    assert eval_count[0] >= 4
+
+
+def test_reader_process_striding(tmp_path):
+    """Each process reads a disjoint line stride and emits its share of the
+    global batch (multi-host input sharding)."""
+    import pickle
+    from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+    from code2vec_tpu.vocab import Code2VecVocabs
+    prefix = tmp_path / 'ds'
+    with open(str(prefix) + '.dict.c2v', 'wb') as f:
+        pickle.dump({'s%d' % i: 10 - i for i in range(8)}, f)
+        pickle.dump({'p1': 7}, f)
+        pickle.dump({'lbl%d' % i: 8 - i for i in range(8)}, f)
+        pickle.dump(8, f)
+    lines = ['lbl%d s%d,p1,s%d' % (i, i, i) for i in range(8)]
+    (tmp_path / 'ds.train.c2v').write_text('\n'.join(lines) + '\n')
+    config = Config(TRAIN_DATA_PATH_PREFIX=str(prefix), VERBOSE_MODE=0,
+                    MAX_CONTEXTS=2, TRAIN_BATCH_SIZE=4,
+                    READER_USE_NATIVE=False)
+    vocabs = Code2VecVocabs(config)
+    seen = []
+    for process_index in range(2):
+        reader = PathContextReader(vocabs, config, EstimatorAction.Train,
+                                   process_index=process_index,
+                                   process_count=2)
+        rows = []
+        for batch in reader.iter_epoch(shuffle=False):
+            assert batch.label.shape[0] == 2  # local share of global 4
+            rows.extend(batch.label[batch.weight > 0].tolist())
+        seen.append(set(rows))
+    assert seen[0].isdisjoint(seen[1])
+    assert len(seen[0] | seen[1]) == 8  # every line covered exactly once
+
+
+def test_distributed_init_is_noop_single_host(monkeypatch):
+    for var in ('JAX_COORDINATOR_ADDRESS', 'TPU_WORKER_HOSTNAMES',
+                'MEGASCALE_COORDINATOR_ADDRESS'):
+        monkeypatch.delenv(var, raising=False)
+    assert maybe_initialize_distributed() is False
