@@ -49,16 +49,9 @@ def main() -> None:
         MAX_TOKEN_VOCAB_SIZE=TOKEN_VOCAB, MAX_PATH_VOCAB_SIZE=PATH_VOCAB,
         MAX_TARGET_VOCAB_SIZE=TARGET_VOCAB)
 
-    class _SizedVocab:
-        def __init__(self, size):
-            self.size = size
-
-    class _SizedVocabs:
-        token_vocab = _SizedVocab(TOKEN_VOCAB)
-        path_vocab = _SizedVocab(PATH_VOCAB)
-        target_vocab = _SizedVocab(TARGET_VOCAB)
-
-    backend = create_backend(config, _SizedVocabs())
+    from code2vec_tpu.vocab import SizeOnlyVocabs
+    backend = create_backend(
+        config, SizeOnlyVocabs(TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB))
     trainer = Trainer(config, backend)
     state = trainer.init_state(seed=0)
 

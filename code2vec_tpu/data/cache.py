@@ -134,6 +134,13 @@ class TokenCache:
         finally:
             for handle in handles.values():
                 handle.close()
+        if num_rows == 0:
+            import shutil
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise ValueError(
+                'No training examples survived filtering in `%s` — every '
+                'row has an out-of-vocab target or no valid contexts.'
+                % reader.data_path)
         meta = dict(fingerprint)
         meta['num_rows'] = num_rows
         with open(os.path.join(tmp_dir, 'meta.json'), 'w') as f:

@@ -397,9 +397,14 @@ class PathContextReader:
             lines = self._shuffled(lines, random.Random(seed))
         # per-process LOCAL batch: process-local shards assemble into the
         # global batch on device (parallel/mesh.py shard_batch)
-        batch_size = self.config.batch_size(
-            is_evaluating=self.estimator_action.is_evaluate) \
-            // self.process_count
+        global_batch = self.config.batch_size(
+            is_evaluating=self.estimator_action.is_evaluate)
+        if global_batch % self.process_count:
+            raise ValueError(
+                'batch size %d must be divisible by the process count (%d) '
+                'so process-local shards assemble into the global batch.'
+                % (global_batch, self.process_count))
+        batch_size = global_batch // self.process_count
         yield from self._filtered_batches(lines, batch_size)
 
     def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,

@@ -189,6 +189,33 @@ class Code2VecModel:
                     lambda: cache.iter_epoch(config.TRAIN_BATCH_SIZE,
                                              shuffle=True, seed=epoch),
                     config.READER_PREFETCH_BATCHES)
+        elif process_count > 1:
+            # multi-host: every process MUST run the same number of jitted
+            # steps per epoch or the mesh collectives pair mismatched steps
+            # and hang. Fix the step count globally (floor of the unfiltered
+            # example count) and cycle each host's shard to fill it.
+            if config.TRAIN_BATCH_SIZE % process_count:
+                raise ValueError(
+                    'TRAIN_BATCH_SIZE=%d must be divisible by the process '
+                    'count (%d).' % (config.TRAIN_BATCH_SIZE, process_count))
+            steps_per_epoch = max(
+                1, config.NUM_TRAIN_EXAMPLES // config.TRAIN_BATCH_SIZE)
+
+            def epoch_batches(epoch: int):
+                import itertools
+
+                def cycled():
+                    while True:
+                        produced = False
+                        for batch in reader.iter_epoch(shuffle=True,
+                                                       seed=epoch):
+                            produced = True
+                            yield batch
+                        if not produced:
+                            raise ValueError(
+                                'Process %d has no training batches in its '
+                                'shard.' % jax.process_index())
+                return itertools.islice(cycled(), steps_per_epoch)
         else:
             def epoch_batches(epoch: int):
                 return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)
@@ -215,16 +242,16 @@ class Code2VecModel:
                 writer.scalar('eval/subtoken_recall',
                               results.subtoken_recall, step)
 
-        def on_epoch_end(epoch: int, state: TrainerState) -> None:
+        def on_epoch_end(epoch: int, state: TrainerState,
+                         batch_num: int) -> None:
             if save_store is not None and \
                     (epoch + 1) % config.SAVE_EVERY_EPOCHS == 0:
                 self.save(state=state, epoch=epoch)
             if run_evals:
-                step = (epoch + 1) * config.train_steps_per_epoch
-                if last_eval_batch[0] == step:
+                if last_eval_batch[0] == batch_num:
                     return  # the interval eval just ran on this batch
-                last_eval_batch[0] = step
-                _evaluate_and_log('epoch %d' % (epoch + 1), step,
+                last_eval_batch[0] = batch_num
+                _evaluate_and_log('epoch %d' % (epoch + 1), batch_num,
                                   state.params)
 
         def on_eval_interval(batch_num: int, state: TrainerState) -> None:

@@ -170,7 +170,8 @@ class Trainer:
     def fit(self, state: TrainerState,
             epoch_batches: Callable[[int], Iterable[Batch]],
             start_epoch: int = 0,
-            on_epoch_end: Optional[Callable[[int, TrainerState], None]] = None,
+            on_epoch_end: Optional[Callable[[int, TrainerState, int],
+                                            None]] = None,
             on_log: Optional[Callable[[int, float, float], None]] = None,
             on_eval_interval: Optional[Callable[[int, TrainerState],
                                                 None]] = None
@@ -254,7 +255,10 @@ class Trainer:
                     window_examples = 0
                     window_start = time.time()
             if on_epoch_end is not None:
-                on_epoch_end(epoch, state)
+                # pass the ACTUAL global batch number: estimates from the
+                # unfiltered line count would put eval metrics on a
+                # different (non-monotonic) step axis than interval evals
+                on_epoch_end(epoch, state, batch_num)
                 window_start = time.time()  # don't bill eval/save time
         return state
 

@@ -121,6 +121,11 @@ class Vocab:
         index_to_word = pickle.load(file)
         size_wo_specials = pickle.load(file)
         assert len(index_to_word) == len(word_to_index) == size_wo_specials
+        if not index_to_word:
+            raise ValueError(
+                'Stored vocabulary %s is empty (only special words were in '
+                'it at save time) — the model was trained on a degenerate '
+                'dataset.' % vocab_type)
         min_idx = min(index_to_word.keys())
         if min_idx != len(specials):
             raise ValueError(
@@ -162,6 +167,21 @@ def load_word_freq_dict(path: str) -> WordFreqDicts:
     return WordFreqDicts(token_to_count=token_to_count,
                          path_to_count=path_to_count,
                          target_to_count=target_to_count)
+
+
+class SizeOnlyVocab:
+    def __init__(self, size: int):
+        self.size = size
+
+
+class SizeOnlyVocabs:
+    """Vocab stand-in carrying only sizes — for benchmarks, the graft entry
+    and sharding tests, where no dataset exists."""
+
+    def __init__(self, token: int, path: int, target: int):
+        self.token_vocab = SizeOnlyVocab(token)
+        self.path_vocab = SizeOnlyVocab(path)
+        self.target_vocab = SizeOnlyVocab(target)
 
 
 class Code2VecVocabs:

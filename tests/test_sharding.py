@@ -11,7 +11,7 @@ from code2vec_tpu.data.reader import Batch
 from code2vec_tpu.models.backends import create_backend
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.training.trainer import Trainer
-from code2vec_tpu.vocab import Code2VecVocabs
+from code2vec_tpu.vocab import Code2VecVocabs, SizeOnlyVocabs
 
 
 def _make_batch(rng, B=16, C=8, Vt=40, Vp=12):
@@ -37,21 +37,9 @@ def _config(data_axis, model_axis, framework='jax'):
         TARGET_EMBEDDINGS_SIZE=24, LEARNING_RATE=0.01)
 
 
-class _FakeVocab:
-    def __init__(self, size):
-        self.size = size
-
-
-class _FakeVocabs:
-    def __init__(self, vt, vp, vy):
-        self.token_vocab = _FakeVocab(vt)
-        self.path_vocab = _FakeVocab(vp)
-        self.target_vocab = _FakeVocab(vy)
-
-
 def _trainer(data_axis, model_axis, framework='jax'):
     config = _config(data_axis, model_axis, framework)
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend = create_backend(config, vocabs)
     return Trainer(config, backend)
 
@@ -95,7 +83,7 @@ def test_param_placement_on_mixed_mesh():
 def test_sharded_training_matches_single_device(mesh_shape):
     # ground truth: 1x1 mesh on device 0
     config1 = _config(1, 1)
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend1 = create_backend(config1, vocabs)
     mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
     trainer1 = Trainer(config1, backend1, mesh=mesh1)
@@ -108,7 +96,7 @@ def test_sharded_training_matches_single_device(mesh_shape):
 
 def test_eval_step_on_sharded_mesh_matches_single_device():
     config1 = _config(1, 1)
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend1 = create_backend(config1, vocabs)
     mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
     trainer1 = Trainer(config1, backend1, mesh=mesh1)
@@ -132,7 +120,7 @@ def test_shard_contexts_divisibility_validated_upfront():
     config = _config(2, 4)
     config.SHARD_CONTEXTS = True
     config.MAX_CONTEXTS = 6  # not divisible by model axis 4
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend = create_backend(config, vocabs)
     with pytest.raises(ValueError, match='SHARD_CONTEXTS'):
         Trainer(config, backend)
@@ -141,7 +129,7 @@ def test_shard_contexts_divisibility_validated_upfront():
 def test_row_alignment_divisibility_validated_upfront():
     config = _config(2, 4)
     config.PARAM_ROW_ALIGNMENT = 6  # not divisible by model axis 4
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend = create_backend(config, vocabs)
     with pytest.raises(ValueError, match='PARAM_ROW_ALIGNMENT'):
         Trainer(config, backend)
@@ -150,13 +138,13 @@ def test_row_alignment_divisibility_validated_upfront():
 def test_shard_contexts_training_matches_unsharded():
     config = _config(2, 4)
     config.SHARD_CONTEXTS = True  # MAX_CONTEXTS=8 divisible by 4
-    vocabs = _FakeVocabs(40, 12, 24)
+    vocabs = SizeOnlyVocabs(40, 12, 24)
     backend = create_backend(config, vocabs)
     trainer_sp = Trainer(config, backend)
     _, losses_sp = _run_steps(trainer_sp)
 
     config1 = _config(1, 1)
-    backend1 = create_backend(config1, _FakeVocabs(40, 12, 24))
+    backend1 = create_backend(config1, SizeOnlyVocabs(40, 12, 24))
     mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
     trainer1 = Trainer(config1, backend1, mesh=mesh1)
     _, losses1 = _run_steps(trainer1)

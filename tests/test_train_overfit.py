@@ -70,7 +70,7 @@ def test_overfit_tiny_corpus(tmp_path, framework):
 
     def capturing_fit(state, epoch_batches, start_epoch=0, on_epoch_end=None,
                       **kwargs):
-        def wrapped_on_epoch_end(epoch, st):
+        def wrapped_on_epoch_end(epoch, st, batch_num):
             pass  # skip per-epoch evaluate to keep the test fast
         return orig_fit(state, epoch_batches, start_epoch=start_epoch,
                         on_epoch_end=wrapped_on_epoch_end, **kwargs)
