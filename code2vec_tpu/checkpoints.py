@@ -90,12 +90,17 @@ class CheckpointStore:
 
     # ---------------------------------------------------------------- save
     def save_training(self, *, params, opt_state, step: int,
-                      epoch: int) -> None:
+                      epoch: int, wait: bool = False) -> None:
+        """Async by default: orbax copies device arrays to host
+        synchronously, then persists in the background while the next epoch
+        trains (SURVEY.md §5's 'orbax async checkpointing'). ``close()``
+        and the next ``save_training`` drain any in-flight save."""
         state = {'params': params, 'opt_state': opt_state,
                  'step': np.asarray(step, np.int32),
                  'epoch': np.asarray(epoch, np.int32)}
         self.manager().save(epoch, args=ocp.args.StandardSave(state))
-        self.manager().wait_until_finished()
+        if wait:
+            self.manager().wait_until_finished()
         self._write_metadata()
 
     def save_release(self, params) -> None:

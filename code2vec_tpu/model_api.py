@@ -82,6 +82,7 @@ class Code2VecModel:
         self.trainer = Trainer(config, self.backend, mesh=self.mesh)
         self.state: Optional[TrainerState] = None
         self.params: Optional[Any] = None
+        self._stores: Dict[str, CheckpointStore] = {}
         self._load_or_create()
 
     # ----------------------------------------------------------- lifecycle
@@ -110,12 +111,26 @@ class Code2VecModel:
         return num
 
     def _store_for(self, path: str) -> CheckpointStore:
-        return CheckpointStore(
-            path, max_to_keep=self.config.MAX_TO_KEEP,
-            metadata={'param_row_alignment': self.config.PARAM_ROW_ALIGNMENT,
-                      'token_dim': self.config.TOKEN_EMBEDDINGS_SIZE,
-                      'path_dim': self.config.PATH_EMBEDDINGS_SIZE,
-                      'code_dim': self.config.CODE_VECTOR_SIZE})
+        """Stores are cached per path and stay open so per-epoch saves run
+        asynchronously (closing an orbax manager drains pending saves);
+        ``close_stores`` flushes everything."""
+        store = self._stores.get(path)
+        if store is None:
+            store = CheckpointStore(
+                path, max_to_keep=self.config.MAX_TO_KEEP,
+                metadata={
+                    'param_row_alignment': self.config.PARAM_ROW_ALIGNMENT,
+                    'token_dim': self.config.TOKEN_EMBEDDINGS_SIZE,
+                    'path_dim': self.config.PATH_EMBEDDINGS_SIZE,
+                    'code_dim': self.config.CODE_VECTOR_SIZE})
+            self._stores[path] = store
+        return store
+
+    def close_stores(self) -> None:
+        """Drain in-flight async checkpoint saves."""
+        for store in self._stores.values():
+            store.close()
+        self._stores.clear()
 
     def _load_or_create(self) -> None:
         if self.config.is_loading:
@@ -146,7 +161,6 @@ class Code2VecModel:
                                      % self.config.MODEL_LOAD_PATH)
                 self.params = params
                 self._start_epoch = 0
-            store.close()
         else:
             self.state = self.trainer.init_state()
             self.params = self.state.params
@@ -246,7 +260,9 @@ class Code2VecModel:
                          batch_num: int) -> None:
             if save_store is not None and \
                     (epoch + 1) % config.SAVE_EVERY_EPOCHS == 0:
-                self.save(state=state, epoch=epoch)
+                # async: the write finalizes in the background while the
+                # next epoch trains; train()'s finally drains it
+                self.save(state=state, epoch=epoch, wait=False)
             if run_evals:
                 if last_eval_batch[0] == batch_num:
                     return  # the interval eval just ran on this batch
@@ -267,18 +283,21 @@ class Code2VecModel:
                 on_eval_interval=(on_eval_interval
                                   if run_evals else None))
         finally:
+            # drain in-flight async checkpoint saves even when training
+            # raises: a commenced save must end up durable
+            self.close_stores()
             if writer is not None:
                 writer.close()
         self.params = self.state.params
-        if save_store is not None:
-            save_store.close()
 
     # ---------------------------------------------------------------- save
     def save(self, model_save_path: Optional[str] = None,
              state: Optional[TrainerState] = None,
-             epoch: int = 0) -> None:
+             epoch: int = 0, wait: bool = True) -> None:
         """vocab sidecar + full training state
-        (reference model_base.py:102-109)."""
+        (reference model_base.py:102-109). Durable on return by default;
+        ``wait=False`` (the in-training cadence) lets orbax finalize in the
+        background — train()'s finally drains it."""
         path = model_save_path or self.config.MODEL_SAVE_PATH
         save_dir = os.path.dirname(path)
         if save_dir and not os.path.isdir(save_dir):
@@ -287,15 +306,14 @@ class Code2VecModel:
         state = state if state is not None else self.state
         store = self._store_for(path)
         store.save_training(params=state.params, opt_state=state.opt_state,
-                            step=int(state.step), epoch=epoch)
-        store.close()
+                            step=int(state.step), epoch=epoch, wait=wait)
 
     def release_model(self) -> None:
         """Strip optimizer state (reference tensorflow_model.py:132-136)."""
         assert self.config.is_loading
         store = self._store_for(self.config.MODEL_LOAD_PATH)
         store.save_release(self.params)
-        store.close()
+        self.close_stores()
         self.log('Released model saved under `%s__only-weights`.'
                  % self.config.MODEL_LOAD_PATH)
 

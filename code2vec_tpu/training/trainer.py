@@ -93,12 +93,18 @@ class Trainer:
             # otherwise — the dispatch lives in sharded_top_k
             return sharded_top_k(logits, top_k, mesh)
 
+        export_vectors = self.config.EXPORT_CODE_VECTORS
+
         def eval_step(params, arrays):
             code_vectors, attention, logits = backend.forward(params, arrays)
             topk_scores, topk_indices = take_top_k(logits)
-            return {'topk_indices': topk_indices,
-                    'topk_scores': topk_scores,
-                    'code_vectors': code_vectors}
+            out = {'topk_indices': topk_indices,
+                   'topk_scores': topk_scores}
+            if export_vectors:
+                # only ship (B, D) code vectors to host when exporting —
+                # it is per-batch device->host traffic otherwise wasted
+                out['code_vectors'] = code_vectors
+            return out
 
         def predict_step(params, arrays):
             code_vectors, attention, logits = backend.forward(params, arrays)
