@@ -75,6 +75,7 @@ class CsParser {
   Arena* arena_;
   size_t i_ = 0;
   std::map<Node*, std::vector<CsToken>> tokens_by_node_;
+  int depth_ = 0;
   std::vector<std::string> comments_;
 
   static const std::set<std::string>& modifiers() {
@@ -220,6 +221,7 @@ class CsParser {
 
   // ---------------------------------------------------------- top level
   void parse_top_level(Node* root) {
+    DepthGuard depth_guard(&depth_);  // nested-namespace cycle
     skip_attributes();
     skip_modifiers();
     if (at_end()) return;
@@ -259,6 +261,7 @@ class CsParser {
   }
 
   Node* parse_class() {
+    DepthGuard depth_guard(&depth_);  // nested-type cycle
     advance();  // class/struct/interface/record
     std::string name = expect_ident();
     Node* decl = arena_->make("ClassDeclaration", name);
@@ -434,6 +437,7 @@ class CsParser {
 
   // --------------------------------------------------------------- types
   Node* parse_type() {
+    DepthGuard depth_guard(&depth_);
     if (cur().kind == Tok::kIdent && predefined_types().count(cur().text)) {
       Node* type = arena_->make("PredefinedType");
       add_token(type, cur().text, false, false, /*predefined=*/true);
@@ -487,6 +491,7 @@ class CsParser {
 
   // ---------------------------------------------------------- statements
   Node* parse_block() {
+    DepthGuard depth_guard(&depth_);
     expect_punct("{");
     Node* block = arena_->make("Block", "", true);
     while (!at_end() && !is_punct("}")) block->add(parse_statement());
@@ -495,6 +500,7 @@ class CsParser {
   }
 
   Node* parse_statement() {
+    DepthGuard depth_guard(&depth_);
     if (is_punct("{")) return parse_block();
     if (accept_punct(";")) return arena_->make("EmptyStatement", "", true);
     if (is_ident("if")) return parse_if();
@@ -757,6 +763,7 @@ class CsParser {
   Node* parse_expression() { return parse_assignment(); }
 
   Node* parse_assignment() {
+    DepthGuard depth_guard(&depth_);
     Node* left = parse_ternary();
     static const std::pair<const char*, const char*> kAssign[] = {
         {"=", "SimpleAssignmentExpression"},
@@ -864,6 +871,7 @@ class CsParser {
   }
 
   Node* parse_unary() {
+    DepthGuard depth_guard(&depth_);
     static const std::pair<const char*, const char*> kPrefix[] = {
         {"+", "UnaryPlusExpression"},
         {"-", "UnaryMinusExpression"},

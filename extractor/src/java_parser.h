@@ -29,6 +29,25 @@ struct ParseError : std::runtime_error {
   explicit ParseError(const std::string& what) : std::runtime_error(what) {}
 };
 
+// Recursion ceiling: pathological nesting (tens of thousands deep) would
+// otherwise overflow the C stack and SEGFAULT the whole process — in --dir
+// mode that kills every worker's output. A graceful ParseError lets the
+// file be skipped like any other unparseable input.
+constexpr int kMaxParseDepth = 2000;
+
+struct DepthGuard {
+  int* depth;
+  explicit DepthGuard(int* d) : depth(d) {
+    if (++*depth > kMaxParseDepth) {
+      --*depth;
+      throw ParseError("maximum nesting depth exceeded");
+    }
+  }
+  DepthGuard(const DepthGuard&) = delete;
+  DepthGuard& operator=(const DepthGuard&) = delete;
+  ~DepthGuard() { --*depth; }
+};
+
 class Parser {
  public:
   Parser(std::vector<Token> tokens, Arena* arena)
@@ -51,6 +70,7 @@ class Parser {
   Arena* arena_;
   size_t i_ = 0;
   std::vector<std::pair<size_t, std::string>> mutations_;
+  int depth_ = 0;
 
   static const std::set<std::string>& modifiers() {
     static const std::set<std::string> kMods = {
@@ -202,6 +222,7 @@ class Parser {
   }
 
   Node* parse_class_or_interface() {
+    DepthGuard depth_guard(&depth_);  // nested/anonymous class cycle
     bool is_interface = is_ident("interface");
     advance();  // class/interface
     std::string name = expect_ident();
@@ -398,6 +419,7 @@ class Parser {
 
   // --------------------------------------------------------------- types
   Node* parse_type() {
+    DepthGuard depth_guard(&depth_);
     skip_annotations();
     if (is_ident("void")) {
       advance();
@@ -468,6 +490,7 @@ class Parser {
 
   // ---------------------------------------------------------- statements
   Node* parse_block() {
+    DepthGuard depth_guard(&depth_);
     size_t begin = cur().pos;
     expect_punct("{");
     Node* block = arena_->make("BlockStmt", "", /*is_statement=*/true);
@@ -481,6 +504,7 @@ class Parser {
   }
 
   Node* parse_statement() {
+    DepthGuard depth_guard(&depth_);
     skip_annotations();
     if (is_punct("{")) return parse_block();
     if (accept_punct(";"))
@@ -778,6 +802,7 @@ class Parser {
   Node* parse_expression() { return parse_assignment(); }
 
   Node* parse_assignment() {
+    DepthGuard depth_guard(&depth_);
     Node* left = parse_ternary();
     static const std::pair<const char*, const char*> kAssignOps[] = {
         {"=", "ASSIGN"},       {"+=", "PLUS"},
@@ -867,6 +892,7 @@ class Parser {
   }
 
   Node* parse_unary() {
+    DepthGuard depth_guard(&depth_);
     static const std::pair<const char*, const char*> kPrefix[] = {
         {"+", "PLUS"},
         {"-", "MINUS"},
