@@ -102,7 +102,10 @@ class Code2VecModel:
     @staticmethod
     def _count_examples(dataset_path: str) -> int:
         sidecar = dataset_path + '.num_examples'
-        if os.path.isfile(sidecar):
+        # unlike the reference (model_base.py:86-96), a sidecar older than
+        # the data file is stale and recounted
+        if os.path.isfile(sidecar) and \
+                os.path.getmtime(sidecar) >= os.path.getmtime(dataset_path):
             with open(sidecar, 'r') as f:
                 return int(f.readline())
         num = common.count_lines_in_file(dataset_path)
