@@ -17,6 +17,7 @@ training).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -30,6 +31,15 @@ BATCH_SIZE = 1024
 MAX_CONTEXTS = 200
 WARMUP_STEPS = 10
 MEASURE_STEPS = 30
+
+# BENCH_SMOKE=1: tiny shapes so the harness itself can be validated on CPU.
+# The emitted metric is renamed so a smoke line can never be mistaken for a
+# java14m benchmark number.
+SMOKE = os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
+if SMOKE:
+    TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB = 1000, 1000, 500
+    BATCH_SIZE, MAX_CONTEXTS = 64, 16
+    WARMUP_STEPS, MEASURE_STEPS = 2, 5
 
 
 def main() -> None:
@@ -85,10 +95,12 @@ def main() -> None:
     examples_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
     per_chip = examples_per_sec / n_devices
     print(json.dumps({
-        'metric': 'train_examples_per_sec_per_chip_java14m',
+        'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
+                   else 'train_examples_per_sec_per_chip_java14m'),
         'value': round(per_chip, 1),
         'unit': 'examples/sec/chip',
-        'vs_baseline': round(per_chip / V100_BASELINE_EXAMPLES_PER_SEC, 3),
+        'vs_baseline': (0.0 if SMOKE else
+                        round(per_chip / V100_BASELINE_EXAMPLES_PER_SEC, 3)),
     }))
 
 

@@ -109,8 +109,11 @@ class Code2VecModel:
             with open(sidecar, 'r') as f:
                 return int(f.readline())
         num = common.count_lines_in_file(dataset_path)
-        with open(sidecar, 'w') as f:
-            f.write(str(num))
+        try:
+            with open(sidecar, 'w') as f:
+                f.write(str(num))
+        except OSError:
+            pass  # read-only dataset dir: the fresh count is still valid
         return num
 
     def _store_for(self, path: str) -> CheckpointStore:
