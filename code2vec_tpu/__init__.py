@@ -26,7 +26,16 @@ from code2vec_tpu.vocab import Vocab, Code2VecVocabs, VocabType, SpecialWords
 __version__ = '0.1.0'
 
 __all__ = [
-    'Config',
+    'Config', 'Code2VecModel',
     'Vocab', 'Code2VecVocabs', 'VocabType', 'SpecialWords',
     '__version__',
 ]
+
+
+def __getattr__(name):
+    # lazy: importing the model pulls in jax; keep bare package import light
+    if name == 'Code2VecModel':
+        from code2vec_tpu.model_api import Code2VecModel
+        return Code2VecModel
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))
