@@ -43,8 +43,13 @@ def maybe_initialize_distributed(
     process_id = process_id if process_id is not None else (
         int(env_pid) if env_pid else None)
 
-    on_tpu_pod = bool(os.environ.get('TPU_WORKER_HOSTNAMES')
-                      or os.environ.get('MEGASCALE_COORDINATOR_ADDRESS'))
+    # a pod is MORE THAN ONE worker: single-host TPU setups (including the
+    # axon tunnel, whose sitecustomize sets TPU_WORKER_HOSTNAMES=localhost)
+    # must not trigger a coordinator handshake
+    worker_hostnames = [h for h in os.environ.get(
+        'TPU_WORKER_HOSTNAMES', '').split(',') if h]
+    on_tpu_pod = (len(worker_hostnames) > 1
+                  or bool(os.environ.get('MEGASCALE_COORDINATOR_ADDRESS')))
     if coordinator_address is None and not on_tpu_pod:
         return False  # single-host run
 
