@@ -20,6 +20,18 @@ def main(args=None) -> None:
     config = Config().load_from_args(args)
     config.verify()
 
+    # honor the caller's JAX_PLATFORMS even when a sitecustomize preimport
+    # pinned a different platform list before this process's env was read
+    import os
+
+    import jax
+    env_platforms = os.environ.get('JAX_PLATFORMS')
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        try:
+            jax.config.update('jax_platforms', env_platforms)
+        except RuntimeError:
+            pass  # backends already initialized
+
     # multi-host: join the jax.distributed runtime when pod/env config is
     # present (no-op single host)
     from code2vec_tpu.parallel.distributed import \
