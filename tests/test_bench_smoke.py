@@ -1,0 +1,25 @@
+"""bench.py harness smoke (BENCH_SMOKE shapes, CPU): guards the benchmark
+entry point against import/config rot between rounds."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_emits_one_json_line():
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run([sys.executable, os.path.join(REPO, 'bench.py')],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines() if line.strip()]
+    assert len(lines) == 1
+    record = json.loads(lines[0])
+    assert set(record) == {'metric', 'value', 'unit', 'vs_baseline'}
+    # a smoke line must never masquerade as the java14m number
+    assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
+    assert record['vs_baseline'] == 0.0
+    assert record['value'] > 0
