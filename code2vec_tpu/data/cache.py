@@ -51,6 +51,10 @@ _FILES = ('source.bin', 'path.bin', 'target.bin', 'label.bin')
 def _fingerprint(config: Config, vocabs: Code2VecVocabs,
                  data_path: str) -> dict:
     stat = os.stat(data_path)
+    # vocab content hash, not just sizes: sizes are commonly pinned at the
+    # MAX_*_VOCAB_SIZE caps, so loading a different model's dictionaries
+    # over the same data file keeps every size equal while silently
+    # remapping word→index — a stale cache would then feed wrong indices.
     return {
         'data_size': stat.st_size,
         'data_mtime': stat.st_mtime,
@@ -58,6 +62,7 @@ def _fingerprint(config: Config, vocabs: Code2VecVocabs,
         'token_vocab': vocabs.token_vocab.size,
         'path_vocab': vocabs.path_vocab.size,
         'target_vocab': vocabs.target_vocab.size,
+        'vocab_content_hash': vocabs.content_hash(),
     }
 
 

@@ -23,7 +23,8 @@ import pickle
 import random
 from argparse import ArgumentParser
 from collections import Counter
-from typing import Dict, Iterable, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 from code2vec_tpu import common
 
@@ -64,66 +65,100 @@ def save_histogram(counter: Counter, path: str) -> None:
 truncate_to_max_size = common.truncate_histogram_to_max_size
 
 
-def _context_full_found(parts, word_to_count, path_to_count) -> bool:
-    return (parts[0] in word_to_count and parts[1] in path_to_count
-            and parts[2] in word_to_count)
+# Sampling tiers (reference preprocess.py:41-56 semantics): when a row has
+# more contexts than fit, contexts whose three parts are all in-vocab win
+# over those with any in-vocab part, which win over fully-OOV ones.
+_TIER_ALL_IN_VOCAB = 2
+_TIER_SOME_IN_VOCAB = 1
+_TIER_NONE_IN_VOCAB = 0
 
 
-def _context_partial_found(parts, word_to_count, path_to_count) -> bool:
-    return (parts[0] in word_to_count or parts[1] in path_to_count
-            or parts[2] in word_to_count)
+def _vocab_tier(context: str, token_vocab: Dict[str, int],
+                path_vocab: Dict[str, int]) -> int:
+    pieces = context.split(',')
+    hits = (pieces[0] in token_vocab, pieces[1] in path_vocab,
+            pieces[2] in token_vocab)
+    if all(hits):
+        return _TIER_ALL_IN_VOCAB
+    return _TIER_SOME_IN_VOCAB if any(hits) else _TIER_NONE_IN_VOCAB
+
+
+def sample_contexts(contexts: list, limit: int,
+                    token_vocab: Dict[str, int], path_vocab: Dict[str, int],
+                    rng) -> list:
+    """Tiered downsampling of one row's contexts to at most ``limit``.
+
+    Rows already within the limit pass through untouched.  Oversized rows
+    are partitioned by vocabulary tier; the fully-OOV tier is discarded,
+    and random sampling breaks ties within the first tier that overflows
+    the remaining budget.  The result can therefore be *shorter* than
+    ``limit`` — or empty, which callers treat as a dropped row — exactly
+    the reference's behavior (preprocess.py:41-60).
+    """
+    if len(contexts) <= limit:
+        return contexts
+    tiers: Dict[int, list] = {_TIER_ALL_IN_VOCAB: [], _TIER_SOME_IN_VOCAB: [],
+                              _TIER_NONE_IN_VOCAB: []}
+    for context in contexts:
+        tiers[_vocab_tier(context, token_vocab, path_vocab)].append(context)
+    keep = tiers[_TIER_ALL_IN_VOCAB]
+    if len(keep) >= limit:
+        return rng.sample(keep, limit)
+    runners_up = tiers[_TIER_SOME_IN_VOCAB]
+    budget = limit - len(keep)
+    if len(runners_up) > budget:
+        runners_up = rng.sample(runners_up, budget)
+    return keep + runners_up
+
+
+@dataclass
+class SplitStats:
+    """Per-split accounting, reported once the split is written."""
+    rows_kept: int = 0
+    rows_dropped_empty: int = 0
+    contexts_seen: int = 0
+    contexts_written: int = 0
+    widest_raw_row: int = 0
+
+    def observe_raw(self, n_contexts: int) -> None:
+        self.contexts_seen += n_contexts
+        self.widest_raw_row = max(self.widest_raw_row, n_contexts)
+
+    def report(self, source_path: str) -> None:
+        print(f'{source_path}: kept {self.rows_kept} rows, dropped '
+              f'{self.rows_dropped_empty} empty', flush=True)
+        if self.rows_kept:
+            print(f'  contexts/row: {self.contexts_seen / self.rows_kept:.2f}'
+                  f' raw -> {self.contexts_written / self.rows_kept:.2f}'
+                  f' after sampling; widest raw row: {self.widest_raw_row}')
 
 
 def process_file(file_path: str, data_file_role: str, dataset_name: str,
                  word_to_count: Dict[str, int], path_to_count: Dict[str, int],
                  max_contexts: int, rng: Optional[random.Random] = None) -> int:
-    """Vocab-aware truncation + space padding for one split
-    (reference preprocess.py:23-74). Returns the number of kept examples."""
+    """Stream one raw split through tiered sampling into
+    ``<dataset>.<role>.c2v``, space-padding every row to exactly
+    ``max_contexts`` context fields (byte-layout compatible with reference
+    readers, preprocess.py:64-65).  Returns the number of rows kept.
+    """
     rng = rng or random
-    sum_total = sum_sampled = total = empty = max_unfiltered = 0
-    output_path = '{}.{}.c2v'.format(dataset_name, data_file_role)
-    with open(output_path, 'w') as outfile, open(file_path, 'r') as file:
-        for line in file:
-            parts = line.rstrip('\n').split(' ')
-            target_name = parts[0]
-            contexts = parts[1:]
-            max_unfiltered = max(max_unfiltered, len(contexts))
-            sum_total += len(contexts)
-            if len(contexts) > max_contexts:
-                context_parts = [c.split(',') for c in contexts]
-                full = [c for i, c in enumerate(contexts)
-                        if _context_full_found(context_parts[i],
-                                               word_to_count, path_to_count)]
-                partial = [c for i, c in enumerate(contexts)
-                           if _context_partial_found(context_parts[i],
-                                                     word_to_count, path_to_count)
-                           and not _context_full_found(context_parts[i],
-                                                       word_to_count,
-                                                       path_to_count)]
-                if len(full) > max_contexts:
-                    contexts = rng.sample(full, max_contexts)
-                elif len(full) + len(partial) > max_contexts:
-                    contexts = full + rng.sample(partial,
-                                                 max_contexts - len(full))
-                else:
-                    contexts = full + partial
-            if len(contexts) == 0:
-                empty += 1
+    stats = SplitStats()
+    output_path = f'{dataset_name}.{data_file_role}.c2v'
+    with open(file_path, 'r') as source, open(output_path, 'w') as sink:
+        for line in source:
+            label, *contexts = line.rstrip('\n').split(' ')
+            stats.observe_raw(len(contexts))
+            kept = sample_contexts(contexts, max_contexts,
+                                   word_to_count, path_to_count, rng)
+            if not kept:
+                stats.rows_dropped_empty += 1
                 continue
-            sum_sampled += len(contexts)
-            csv_padding = ' ' * (max_contexts - len(contexts))
-            outfile.write(target_name + ' ' + ' '.join(contexts)
-                          + csv_padding + '\n')
-            total += 1
-    print('File: ' + file_path)
-    if total:
-        print('Average total contexts: ' + str(float(sum_total) / total))
-        print('Average final (after sampling) contexts: '
-              + str(float(sum_sampled) / total))
-    print('Total examples: ' + str(total))
-    print('Empty examples: ' + str(empty))
-    print('Max number of contexts per word: ' + str(max_unfiltered))
-    return total
+            stats.contexts_written += len(kept)
+            stats.rows_kept += 1
+            padding = ' ' * (max_contexts - len(kept))
+            sink.write(f"{label} {' '.join(kept)}{padding}\n")
+    stats.report(file_path)
+    return stats.rows_kept
 
 
 def save_dictionaries(dataset_name: str, word_to_count: Dict[str, int],

@@ -1,13 +1,18 @@
-"""Interactive prediction REPL (reference interactive_predict.py:28-57).
+"""Interactive prediction shell.
 
-Loop: user edits ``Input.java`` → extractor subprocess produces path
-contexts → model predicts → print top-k names with probabilities,
-per-context attention (paths un-hashed for display), and optionally the
-code vector.
+A thin presentation layer over the batch ``model.predict`` API: read a
+source file, run the extractor bridge, predict every method in one batched
+call, and render a per-method report.  The display tokens ("Original
+name:", "Attention:", the per-context lines) follow the reference REPL's
+output contract (reference interactive_predict.py:47-57) — that format is
+user-facing spec; the code below is this framework's own decomposition:
+``predict_file`` (extract → batch predict → parse) and
+``render_method_report`` (pure result → text) are reusable outside the
+REPL loop, e.g. for one-shot CLI prediction or tests.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
 from code2vec_tpu import common
 from code2vec_tpu.config import Config
@@ -15,10 +20,53 @@ from code2vec_tpu.serving.extractor_bridge import Extractor
 
 SHOW_TOP_CONTEXTS = 10           # reference interactive_predict.py:6
 DEFAULT_INPUT_FILENAME = 'Input.java'
-EXIT_KEYWORDS = ['exit', 'quit', 'q']
+QUIT_WORDS = frozenset({'exit', 'quit', 'q'})
+
+
+def predict_contexts(model, context_lines, path_unhash,
+                     topk: int = SHOW_TOP_CONTEXTS) -> List[Tuple[object, object]]:
+    """Predict every method in one batched ``model.predict`` call.
+
+    Returns ``[(method_result, raw_result), ...]`` — the parsed
+    presentation view paired with the raw backend output (which carries
+    the code vector).
+    """
+    raw_results = model.predict(context_lines)
+    parsed = common.parse_prediction_results(
+        raw_results, path_unhash,
+        model.vocabs.target_vocab.special_words.OOV, topk=topk)
+    return list(zip(parsed, raw_results))
+
+
+def predict_file(model, extractor: Extractor, source_path: str,
+                 topk: int = SHOW_TOP_CONTEXTS) -> List[Tuple[object, object]]:
+    """Extract path contexts from ``source_path``, then ``predict_contexts``.
+    Raises ``ValueError`` if the extractor finds no parseable method."""
+    context_lines, path_unhash = extractor.extract_paths(source_path)
+    return predict_contexts(model, context_lines, path_unhash, topk)
+
+
+def render_method_report(method_result,
+                         code_vector: Optional[Sequence[float]] = None) -> str:
+    """Pure text rendering of one method's prediction (display contract:
+    reference interactive_predict.py:47-57)."""
+    lines = [f'Original name:\t{method_result.original_name}']
+    lines.extend(
+        f"\t({candidate['probability']:f}) predicted: {candidate['name']}"
+        for candidate in method_result.predictions)
+    lines.append('Attention:')
+    lines.extend(
+        f"{ctx['score']:f}\tcontext: {ctx['token1']},{ctx['path']},{ctx['token2']}"
+        for ctx in method_result.attention_paths)
+    if code_vector is not None:
+        lines.append('Code vector:')
+        lines.append(' '.join(map(str, code_vector)))
+    return '\n'.join(lines)
 
 
 class InteractivePredictor:
+    """REPL driving ``predict_file`` over a user-edited input file."""
+
     def __init__(self, config: Config, model,
                  extractor: Optional[Extractor] = None,
                  input_filename: str = DEFAULT_INPUT_FILENAME):
@@ -29,35 +77,24 @@ class InteractivePredictor:
 
     def predict(self) -> None:
         print('Starting interactive prediction...')
+        prompt = (f'Modify the file: "{self.input_filename}" and press any '
+                  'key when ready, or "q" / "quit" / "exit" to exit')
         while True:
-            print('Modify the file: "%s" and press any key when ready, or '
-                  '"q" / "quit" / "exit" to exit' % self.input_filename)
-            user_input = input()
-            if user_input.lower() in EXIT_KEYWORDS:
+            print(prompt)
+            if input().lower() in QUIT_WORDS:
                 print('Exiting...')
                 return
             try:
-                predict_lines, hash_to_string_dict = \
+                # Only extraction errors are user-recoverable (bad input
+                # file); model-side failures must surface, not re-prompt.
+                context_lines, path_unhash = \
                     self.path_extractor.extract_paths(self.input_filename)
             except ValueError as e:
                 print(e)
                 continue
-            raw_results = self.model.predict(predict_lines)
-            results = common.parse_prediction_results(
-                raw_results, hash_to_string_dict,
-                self.model.vocabs.target_vocab.special_words.OOV,
-                topk=SHOW_TOP_CONTEXTS)
-            for raw_result, method_result in zip(raw_results, results):
-                print('Original name:\t' + method_result.original_name)
-                for name_prob_pair in method_result.predictions:
-                    print('\t(%f) predicted: %s' % (
-                        name_prob_pair['probability'],
-                        name_prob_pair['name']))
-                print('Attention:')
-                for attention in method_result.attention_paths:
-                    print('%f\tcontext: %s,%s,%s' % (
-                        attention['score'], attention['token1'],
-                        attention['path'], attention['token2']))
-                if self.config.EXPORT_CODE_VECTORS:
-                    print('Code vector:')
-                    print(' '.join(map(str, raw_result.code_vector)))
+            reports = predict_contexts(self.model, context_lines,
+                                       path_unhash)
+            for method_result, raw_result in reports:
+                vector = (raw_result.code_vector
+                          if self.config.EXPORT_CODE_VECTORS else None)
+                print(render_method_report(method_result, vector))

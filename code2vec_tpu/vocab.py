@@ -16,6 +16,7 @@ eval/predict also happens on host.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 from enum import Enum
@@ -195,6 +196,20 @@ class Code2VecVocabs:
         self.target_vocab: Optional[Vocab] = None
         self._already_saved_in_paths = set()
         self._load_or_create()
+
+    def content_hash(self) -> str:
+        """Digest of the three index-ordered word lists — identifies vocab
+        *content* (not just sizes) for downstream freshness checks such as
+        the token-cache fingerprint.  Stable across the `.dict.c2v` /
+        `dictionaries.bin` save-load round trip (same mapping ⇒ same hash),
+        unlike a hash of the source file bytes."""
+        digest = hashlib.sha256()
+        for vocab in (self.token_vocab, self.path_vocab, self.target_vocab):
+            lookup = vocab.index_to_word.get
+            words = '\x00'.join(lookup(i, '') for i in range(vocab.size))
+            digest.update(words.encode('utf-8', 'surrogatepass'))
+            digest.update(b'\x01')
+        return digest.hexdigest()
 
     def _load_or_create(self) -> None:
         assert self.config.is_training or self.config.is_loading

@@ -48,6 +48,39 @@ def test_cache_is_reused_and_invalidated(small_setup):  # noqa: F811
     assert cache3.num_rows == 6
 
 
+def test_cache_invalidated_by_vocab_content_change(small_setup):  # noqa: F811
+    """Same vocab *sizes*, different word→index mapping (the fine-tuning
+    trap: sizes pinned at caps while dictionaries.bin differs) must NOT
+    reuse the cache — indices would silently be wrong (ADVICE r1)."""
+    import pickle
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.vocab import Code2VecVocabs
+
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1', 'lbl2 s2,p2,t1'] * 2)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache1 = TokenCache.build_or_load(config, vocabs, reader)
+
+    # Identical sizes, swapped frequency order -> s1/s2 swap indices.
+    with open(str(prefix) + '.dict.c2v', 'wb') as f:
+        pickle.dump({'s2': 10, 's1': 9, 't1': 8}, f)
+        pickle.dump({'p2': 7, 'p1': 6}, f)
+        pickle.dump({'lbl2': 5, 'lbl1': 4}, f)
+        pickle.dump(4, f)
+    config2 = Config(TRAIN_DATA_PATH_PREFIX=str(prefix), VERBOSE_MODE=0,
+                     MAX_CONTEXTS=4, TRAIN_BATCH_SIZE=2, TEST_BATCH_SIZE=2,
+                     SHUFFLE_BUFFER_SIZE=16, READER_USE_NATIVE=False)
+    vocabs2 = Code2VecVocabs(config2)
+    assert vocabs2.token_vocab.size == vocabs.token_vocab.size
+    reader2 = PathContextReader(vocabs2, config2, EstimatorAction.Train)
+    cache2 = TokenCache.build_or_load(config2, vocabs2, reader2)
+    assert cache2.meta != cache1.meta  # rebuilt, not reused
+    s1_new = vocabs2.token_vocab.lookup_index('s1')
+    assert any(s1_new in batch.source
+               for batch in cache2.iter_epoch(2, shuffle=False))
+
+
 def test_cache_shuffle_is_epoch_dependent_permutation(small_setup):  # noqa: F811
     config, vocabs, prefix = small_setup
     lines = ['lbl1 s1,p1,t1', 'lbl2 s2,p2,t1', 'lbl1 s2,p1,t1',

@@ -122,6 +122,9 @@ def test_code2vec_vocabs_save_and_reload(tmp_path):
     assert vocabs2.token_vocab.word_to_index == vocabs.token_vocab.word_to_index
     assert vocabs2.path_vocab.word_to_index == vocabs.path_vocab.word_to_index
     assert vocabs2.target_vocab.word_to_index == vocabs.target_vocab.word_to_index
+    # content hash must be stable across the save/load round trip, or the
+    # token cache would needlessly rebuild on every resume/fine-tune run
+    assert vocabs2.content_hash() == vocabs.content_hash()
 
 
 def test_index_to_word_array():
