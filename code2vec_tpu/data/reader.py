@@ -346,6 +346,28 @@ class PathContextReader:
         if pending_rows:
             yield self._pad_batch(self._concat(pending), batch_size)
 
+    def empty_batch(self, batch_size: int) -> Batch:
+        """All-padding batch (every row weight 0): multi-host evaluation
+        emits these so every process runs the same number of jitted steps
+        even when data shards are uneven — the padded rows drop out of the
+        metrics and the loss.  Delegates to ``_pad_batch`` so the pad-row
+        fill policy has a single definition."""
+        contexts = self.config.MAX_CONTEXTS
+        zero_rows = Batch(
+            source=np.zeros((0, contexts), np.int32),
+            path=np.zeros((0, contexts), np.int32),
+            target=np.zeros((0, contexts), np.int32),
+            mask=np.zeros((0, contexts), np.float32),
+            label=np.zeros((0,), np.int32),
+            weight=np.zeros((0,), np.float32))
+        if self.keep_strings:
+            zero_rows = zero_rows._replace(
+                label_strings=np.zeros((0,), dtype=object),
+                source_strings=np.zeros((0, contexts), dtype=object),
+                path_strings=np.zeros((0, contexts), dtype=object),
+                target_strings=np.zeros((0, contexts), dtype=object))
+        return self._pad_batch(zero_rows, batch_size)
+
     def pad_batch_to(self, batch: Batch, batch_size: int) -> Batch:
         """Pad a batch up to ``batch_size`` rows with zero-weight rows
         (replaces the reference's ragged final batch; also used to make

@@ -58,6 +58,18 @@ class SubtokensEvaluationMetric:
                 if element not in predicted_subtokens)
             self.nr_predictions += 1
 
+    def count_vector(self) -> np.ndarray:
+        """Raw accumulator counts, for exact cross-process merging
+        (multi-host eval sums these and calls ``set_count_vector``)."""
+        return np.array([self.nr_true_positives, self.nr_false_positives,
+                         self.nr_false_negatives, self.nr_predictions],
+                        dtype=np.int64)
+
+    def set_count_vector(self, counts: np.ndarray) -> None:
+        (self.nr_true_positives, self.nr_false_positives,
+         self.nr_false_negatives, self.nr_predictions) = (
+            int(c) for c in counts)
+
     @property
     def precision(self) -> float:
         denom = self.nr_true_positives + self.nr_false_positives
@@ -93,6 +105,15 @@ class TopKAccuracyEvaluationMetric:
             if found_match is not None:
                 suggestion_idx, _ = found_match
                 self.nr_correct_predictions[suggestion_idx:self.top_k] += 1
+
+    def count_vector(self) -> np.ndarray:
+        """Raw accumulator counts, for exact cross-process merging."""
+        return np.concatenate([[self.nr_predictions],
+                               self.nr_correct_predictions]).astype(np.int64)
+
+    def set_count_vector(self, counts: np.ndarray) -> None:
+        self.nr_predictions = int(counts[0])
+        self.nr_correct_predictions = counts[1:].astype(np.float64)
 
     @property
     def topk_correct_predictions(self) -> np.ndarray:

@@ -189,10 +189,7 @@ class Code2VecModel:
             # stride — fall back to streaming on multi-host shared storage
             use_cache = False
             self.log('TRAIN_DATA_CACHE disabled under multi-host training.')
-        run_evals = config.is_testing and process_count == 1
-        if config.is_testing and not run_evals:
-            self.log('Multi-host run: skipping in-training evaluation '
-                     '(see Code2VecModel.evaluate).')
+        run_evals = config.is_testing
         self.log('Starting training (%d epochs, batch %d, steps/epoch ~%d)'
                  % (config.NUM_TRAIN_EPOCHS, config.TRAIN_BATCH_SIZE,
                     config.train_steps_per_epoch))
@@ -328,25 +325,34 @@ class Code2VecModel:
         """``params`` overrides the stored parameters for mid-training
         evaluation (the stored ``self.params`` may alias buffers the next
         donated train step will delete; callbacks pass the live state's
-        params explicitly instead of mutating the model object)."""
+        params explicitly instead of mutating the model object).
+
+        Multi-host: every process reads its line stride of the test file
+        and runs a FIXED global step count (``ceil(unfiltered examples /
+        global batch)`` — provably ≥ every process's local batch count, so
+        it needs no communication to agree on), padding with zero-weight
+        batches past its own data; mismatched jitted step counts would
+        deadlock the mesh collectives.  Each process updates metric
+        counters for its own rows, then one all-gather sums the counters —
+        results are exact and identical on every process.
+        """
         params = params if params is not None else self.params
         config = self.config
         assert config.is_testing
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                'Multi-host evaluation is not supported yet: per-host data '
-                'shards can yield unequal batch counts, deadlocking the '
-                'mesh collectives. Evaluate from a single-host run against '
-                'the checkpoint instead.')
+        process_count = jax.process_count()
+        process_index = jax.process_index()
         reader = PathContextReader(self.vocabs, config,
-                                   EstimatorAction.Evaluate)
+                                   EstimatorAction.Evaluate,
+                                   process_index=process_index,
+                                   process_count=process_count)
         oov = self.vocabs.target_vocab.special_words.OOV
         topk_metric = TopKAccuracyEvaluationMetric(
             config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION, oov)
         subtoken_metric = SubtokensEvaluationMetric(oov)
         # per-example prediction log lives next to the model artifacts
         # (the reference wrote a bare 'log.txt' into the CWD,
-        # tensorflow_model.py:138 — polluting wherever you ran from)
+        # tensorflow_model.py:138 — polluting wherever you ran from);
+        # each process logs its own shard
         if config.is_saving:
             log_dir = os.path.dirname(config.MODEL_SAVE_PATH)
         elif config.is_loading:
@@ -355,24 +361,57 @@ class Code2VecModel:
             log_dir = '.'
         if log_dir and log_dir != '.':
             os.makedirs(log_dir, exist_ok=True)
-        log_path = os.path.join(log_dir, 'log.txt')
-        vectors_path = config.TEST_DATA_PATH + '.vectors'
+        shard_suffix = '' if process_index == 0 else '.proc%d' % process_index
+        log_path = os.path.join(log_dir, 'log.txt' + shard_suffix)
+        vectors_path = config.TEST_DATA_PATH + '.vectors' + shard_suffix
         vectors_file = (open(vectors_path, 'w')
                         if config.EXPORT_CODE_VECTORS else None)
+
+        fixed_steps = None
+        if process_count > 1:
+            total_unfiltered = getattr(config, 'NUM_TEST_EXAMPLES', 0) or \
+                common.count_lines_in_file(config.TEST_DATA_PATH)
+            fixed_steps = -(-total_unfiltered // config.TEST_BATCH_SIZE)
+        local_batch_size = config.TEST_BATCH_SIZE // process_count
+
+        def eval_batches():
+            steps = 0
+            for batch in reader.iter_epoch_prefetched(shuffle=False):
+                steps += 1
+                if fixed_steps is not None and steps > fixed_steps:
+                    raise RuntimeError(
+                        'Process %d produced more eval batches (%d) than '
+                        'the agreed global step count (%d); filtering can '
+                        'only shrink shards, so the test file changed '
+                        'under us.' % (process_index, steps, fixed_steps))
+                yield batch
+            if fixed_steps is not None and steps < fixed_steps:
+                pad = reader.empty_batch(local_batch_size)
+                for _ in range(fixed_steps - steps):
+                    yield pad
+
         total = 0
+        loss_sum = 0.0
+        weight_sum = 0.0
         start_time = time.time()
         with open(log_path, 'w') as log_file:
-            for batch in reader.iter_epoch_prefetched(shuffle=False):
-                out = as_numpy(self.trainer.eval_step(params, batch))
+            for batch in eval_batches():
+                out = self.trainer.eval_step(params, batch)
+                # loss sums are global (the jitted reduction spans all
+                # processes' rows) — accumulate, don't re-merge
+                loss_sum += float(out['loss_sum'])
+                weight_sum += float(out['weight_sum'])
+                topk_local = mesh_lib.local_rows(out['topk_indices'])
                 results = decode_topk_batch(
-                    out['topk_indices'], self._target_index_to_word,
+                    topk_local, self._target_index_to_word,
                     batch.label_strings, batch.weight)
                 topk_metric.update_batch(results)
                 subtoken_metric.update_batch(results)
                 self._log_predictions_during_evaluation(results, log_file)
                 if vectors_file is not None:
                     valid = batch.weight > 0
-                    for vec in out['code_vectors'][valid]:
+                    vectors = mesh_lib.local_rows(out['code_vectors'])
+                    for vec in vectors[valid]:
                         vectors_file.write(' '.join(map(str, vec)) + '\n')
                 total += len(results)
                 if total and total % (
@@ -384,11 +423,21 @@ class Code2VecModel:
         if vectors_file is not None:
             vectors_file.close()
             self.log('Code vectors written to `%s`.' % vectors_path)
+        if process_count > 1:
+            from jax.experimental import multihost_utils
+            topk_len = topk_metric.count_vector().shape[0]
+            local_counts = np.concatenate([topk_metric.count_vector(),
+                                           subtoken_metric.count_vector()])
+            merged = np.asarray(multihost_utils.process_allgather(
+                local_counts)).sum(axis=0)
+            topk_metric.set_count_vector(merged[:topk_len])
+            subtoken_metric.set_count_vector(merged[topk_len:])
         return ModelEvaluationResults(
             topk_acc=topk_metric.topk_correct_predictions,
             subtoken_precision=subtoken_metric.precision,
             subtoken_recall=subtoken_metric.recall,
-            subtoken_f1=subtoken_metric.f1)
+            subtoken_f1=subtoken_metric.f1,
+            loss=(loss_sum / weight_sum) if weight_sum > 0 else None)
 
     def _log_predictions_during_evaluation(self, results, output_file) -> None:
         """Per-example prediction log (reference

@@ -188,6 +188,16 @@ def compute_logits(params: Code2VecParams, code_vectors: jax.Array,
     return logits
 
 
+def weighted_ce_sums(logits: jax.Array, label: jax.Array,
+                     weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(weighted CE sum, weight sum) — the single definition of the
+    cross-entropy used by both the training loss and the streaming eval
+    loss (which aggregates the sums exactly across batches and hosts)."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(log_probs, label[:, None], axis=1)[:, 0]
+    return (ce * weight).sum(), weight.sum()
+
+
 def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  target: jax.Array, mask: jax.Array, label: jax.Array,
                  weight: jax.Array, *,
@@ -203,9 +213,7 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
         dropout_keep_rate=dropout_keep_rate, dtype=dtype)
     logits = compute_logits(params, code_vectors, dtype=dtype,
                             num_valid_targets=num_valid_targets)
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(log_probs, label[:, None], axis=1)[:, 0]
-    denom = jnp.maximum(weight.sum(), 1.0)
-    loss = (ce * weight).sum() / denom
+    ce_sum, weight_sum = weighted_ce_sums(logits, label, weight)
+    loss = ce_sum / jnp.maximum(weight_sum, 1.0)
     return loss, {'code_vectors': code_vectors,
-                  'num_valid': weight.sum()}
+                  'num_valid': weight_sum}

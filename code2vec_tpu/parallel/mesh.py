@@ -130,6 +130,35 @@ def attach_shardings(abstract_tree, mesh: Mesh):
         abstract_tree, shardings)
 
 
+def local_rows(array: jax.Array) -> np.ndarray:
+    """Host numpy view of THIS process's rows of a batch-sharded output.
+
+    Multi-host eval pairs device outputs (top-k indices) with host-side
+    strings (labels) that only the producing process holds, so each process
+    must read back exactly the rows it fed in via
+    ``make_array_from_process_local_data``.  Addressable shards are
+    deduplicated (model-axis replicas carry identical rows) and stitched in
+    ascending global-row order — the order the local batch was provided in.
+    """
+    if array.is_fully_addressable:
+        return np.asarray(array)
+    blocks: dict = {}
+    for shard in array.addressable_shards:
+        index = shard.index
+        row0 = (index[0].start or 0) if index else 0
+        col0 = (index[1].start or 0) if len(index) > 1 else 0
+        cols = blocks.setdefault(row0, {})
+        if col0 not in cols:  # skip D2H copies of model-axis replicas
+            cols[col0] = np.asarray(shard.data)
+    row_blocks = []
+    for row0 in sorted(blocks):
+        cols = blocks[row0]
+        row_blocks.append(
+            np.concatenate([cols[c] for c in sorted(cols)], axis=1)
+            if len(cols) > 1 else next(iter(cols.values())))
+    return np.concatenate(row_blocks, axis=0)
+
+
 def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False):
     """Place a tuple of per-example numpy arrays onto the mesh: batch over
     ``data``; optionally contexts over ``model`` for 2-D arrays.

@@ -26,6 +26,7 @@ import optax
 
 from code2vec_tpu.config import Config
 from code2vec_tpu.data.reader import Batch
+from code2vec_tpu.models import functional
 from code2vec_tpu.ops.topk import sharded_top_k
 from code2vec_tpu.parallel import mesh as mesh_lib
 
@@ -98,8 +99,17 @@ class Trainer:
         def eval_step(params, arrays):
             code_vectors, attention, logits = backend.forward(params, arrays)
             topk_scores, topk_indices = take_top_k(logits)
+            # weighted CE sums (not the mean): exact streaming aggregation
+            # across batches and hosts — the reference's Keras backend
+            # reports eval loss (keras_model.py:179-193); padded rows have
+            # weight 0 and drop out
+            _source, _path, _target, _mask, label, weight = arrays
+            loss_sum, weight_sum = functional.weighted_ce_sums(
+                logits, label, weight)
             out = {'topk_indices': topk_indices,
-                   'topk_scores': topk_scores}
+                   'topk_scores': topk_scores,
+                   'loss_sum': loss_sum,
+                   'weight_sum': weight_sum}
             if export_vectors:
                 # only ship (B, D) code vectors to host when exporting —
                 # it is per-batch device->host traffic otherwise wasted

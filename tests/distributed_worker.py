@@ -1,0 +1,71 @@
+"""Worker process for the real multi-process distributed tests.
+
+Launched by tests/test_distributed.py in N separate OS processes joined via
+``jax.distributed.initialize`` on the CPU platform — the TPU answer to
+"multi-node tests without a cluster" (SURVEY.md §4), but with *actual*
+process boundaries: striding, fixed step counts, and collective pairing run
+for real, which single-process virtual-device tests cannot exercise.
+
+Writes one JSON record (eval metrics + a few train facts) to ``--out``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--coordinator', required=True)
+    parser.add_argument('--process_id', type=int, required=True)
+    parser.add_argument('--num_processes', type=int, required=True)
+    parser.add_argument('--prefix', required=True)
+    parser.add_argument('--out', required=True)
+    parser.add_argument('--train_epochs', type=int, default=0,
+                        help='0 = evaluate the seed-42 init params only')
+    args = parser.parse_args()
+
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=args.prefix,
+        TEST_DATA_PATH=args.prefix + '.val.c2v',
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32',
+        MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=8, TEST_BATCH_SIZE=8,
+        NUM_TRAIN_EPOCHS=max(args.train_epochs, 1),
+        SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False, LEARNING_RATE=0.01,
+        TRAIN_DATA_CACHE=False)
+    model = Code2VecModel(config)
+
+    record = {
+        'process_id': args.process_id,
+        'process_count': jax.process_count(),
+        'n_global_devices': jax.device_count(),
+        'n_local_devices': jax.local_device_count(),
+    }
+    if args.train_epochs > 0:
+        model.train()  # includes the per-epoch multi-host evaluate
+        record['trained_epochs'] = args.train_epochs
+
+    results = model.evaluate()
+    record.update({
+        'topk_acc': [float(x) for x in results.topk_acc],
+        'precision': results.subtoken_precision,
+        'recall': results.subtoken_recall,
+        'f1': results.subtoken_f1,
+        'loss': results.loss,
+    })
+    with open(args.out, 'w') as f:
+        json.dump(record, f)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,124 @@
+"""REAL multi-process distributed tests (VERDICT r1 #4).
+
+Two OS processes join a ``jax.distributed`` cluster over localhost on the
+CPU platform (2 virtual devices each → a 4-device global mesh), then train
+and evaluate through the full ``Code2VecModel`` lifecycle.  This exercises
+what single-process virtual-device tests cannot: per-process data striding,
+globally agreed fixed step counts, cross-process collective pairing, and
+the metric-counter all-gather — the deadlock class multi-host guards
+against only exists across real process boundaries.
+
+Asserts eval parity: per-example metrics are independent of batch
+membership and every example is evaluated exactly once on exactly one
+process, so the merged 2-process counters must equal the single-process
+result bit-for-bit (loss to float tolerance — summation order differs).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.test_train_overfit import make_dataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, 'tests', 'distributed_worker.py')
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('localhost', 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    # PYTHONPATH=REPO only (no axon sitecustomize: a wedged TPU tunnel must
+    # not hang the CPU worker processes); 2 virtual CPU devices per process.
+    return {
+        'PATH': os.environ.get('PATH', '/usr/bin:/bin'),
+        'HOME': os.environ.get('HOME', '/root'),
+        'PYTHONPATH': REPO,
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+    }
+
+
+def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
+                 timeout: float = 420.0) -> list:
+    port = _free_port()
+    outs = []
+    procs = []
+    for pid in range(num_processes):
+        out = tmp_path / f'result_p{num_processes}_{pid}_{train_epochs}.json'
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER,
+             '--coordinator', f'localhost:{port}',
+             '--process_id', str(pid),
+             '--num_processes', str(num_processes),
+             '--prefix', str(prefix),
+             '--out', str(out),
+             '--train_epochs', str(train_epochs)],
+            env=_worker_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    records = []
+    try:
+        for pid, proc in enumerate(procs):
+            stdout, _ = proc.communicate(timeout=timeout)
+            assert proc.returncode == 0, (
+                'worker %d failed:\n%s' % (pid, stdout[-4000:]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+    for out in outs:
+        with open(out) as f:
+            records.append(json.load(f))
+    return records
+
+
+@pytest.fixture(scope='module')
+def dataset(tmp_path_factory):
+    return make_dataset(tmp_path_factory.mktemp('dist'))
+
+
+def test_two_process_eval_matches_single_process(tmp_path, dataset):
+    two = _run_cluster(tmp_path, dataset, num_processes=2, train_epochs=0)
+    one = _run_cluster(tmp_path, dataset, num_processes=1, train_epochs=0)
+
+    assert [r['process_count'] for r in two] == [2, 2]
+    assert two[0]['n_global_devices'] == 4
+    assert two[0]['n_local_devices'] == 2
+
+    # both processes computed (and must agree on) the merged global result
+    assert two[0]['topk_acc'] == two[1]['topk_acc']
+    assert two[0]['f1'] == two[1]['f1']
+
+    # exact counter parity with the single-process evaluation
+    baseline = one[0]
+    np.testing.assert_array_equal(two[0]['topk_acc'], baseline['topk_acc'])
+    assert two[0]['precision'] == baseline['precision']
+    assert two[0]['recall'] == baseline['recall']
+    assert two[0]['f1'] == baseline['f1']
+    # loss: same examples, different summation order
+    assert baseline['loss'] is not None
+    np.testing.assert_allclose(two[0]['loss'], baseline['loss'], rtol=1e-5)
+
+
+def test_two_process_train_and_eval_completes(tmp_path, dataset):
+    """Striding + fixed train step counts + per-epoch multi-host eval with
+    real collectives: the run completing at all proves no step-count
+    mismatch deadlocked the mesh."""
+    records = _run_cluster(tmp_path, dataset, num_processes=2,
+                           train_epochs=2)
+    assert [r['trained_epochs'] for r in records] == [2, 2]
+    for r in records:
+        assert r['loss'] is not None and np.isfinite(r['loss'])
+    # trained params are identical on both processes, so the final merged
+    # eval must agree exactly
+    assert records[0]['topk_acc'] == records[1]['topk_acc']
+    assert records[0]['f1'] == records[1]['f1']
