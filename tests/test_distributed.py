@@ -62,8 +62,8 @@ def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
              '--prefix', str(prefix),
              '--out', str(out),
              '--train_epochs', str(train_epochs)],
-            env=_worker_env(), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
+            env=_worker_env(), cwd=str(tmp_path),  # eval log.txt goes here
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     records = []
     try:
         for pid, proc in enumerate(procs):
