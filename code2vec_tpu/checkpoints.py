@@ -42,14 +42,22 @@ class CheckpointStore:
     """Orbax-backed store for one model path prefix."""
 
     def __init__(self, model_path: str, max_to_keep: int = 10,
-                 metadata: Optional[Dict[str, Any]] = None):
+                 metadata: Optional[Dict[str, Any]] = None,
+                 snapshot_max_to_keep: int = 2):
         self.model_path = model_path
         self.entire_dir = os.path.abspath(
             Config.get_entire_model_path(model_path))
         self.weights_dir = os.path.abspath(
             Config.get_model_weights_path(model_path))
+        # step-interval snapshots (preemption insurance) live in their own
+        # manager with a small retention window, so frequent interval saves
+        # can never evict the epoch-boundary history max_to_keep promises
+        self.snapshot_dir = os.path.abspath(
+            Config.get_step_snapshots_path(model_path))
         self._manager: Optional[ocp.CheckpointManager] = None
+        self._snapshot_manager: Optional[ocp.CheckpointManager] = None
         self.max_to_keep = max_to_keep
+        self.snapshot_max_to_keep = snapshot_max_to_keep
         # shape-determining settings (e.g. PARAM_ROW_ALIGNMENT): written at
         # save, verified before restore so a mismatch is a clear config
         # error instead of an opaque orbax shape mismatch
@@ -83,24 +91,49 @@ class CheckpointStore:
                     max_to_keep=self.max_to_keep, create=True))
         return self._manager
 
+    def snapshot_manager(self) -> ocp.CheckpointManager:
+        if self._snapshot_manager is None:
+            self._snapshot_manager = ocp.CheckpointManager(
+                self.snapshot_dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.snapshot_max_to_keep, create=True))
+        return self._snapshot_manager
+
     def close(self) -> None:
-        if self._manager is not None:
-            self._manager.close()
+        # exception-safe: a failure draining one manager must not abandon
+        # the other's in-flight async save
+        try:
+            if self._manager is not None:
+                self._manager.close()
+        finally:
             self._manager = None
+            try:
+                if self._snapshot_manager is not None:
+                    self._snapshot_manager.close()
+            finally:
+                self._snapshot_manager = None
 
     # ---------------------------------------------------------------- save
     def save_training(self, *, params, opt_state, step: int,
-                      epoch: int, wait: bool = False) -> None:
+                      epoch: int, wait: bool = False,
+                      snapshot: bool = False) -> None:
         """Async by default: orbax copies device arrays to host
-        synchronously, then persists in the background while the next epoch
-        trains (SURVEY.md §5's 'orbax async checkpointing'). ``close()``
-        and the next ``save_training`` drain any in-flight save."""
+        synchronously (<1 train step of stall), then persists in the
+        background while training continues (SURVEY.md §5's 'orbax async
+        checkpointing'). ``close()`` and the next ``save_training`` drain
+        any in-flight save.
+
+        Checkpoints are keyed by the global *step*; ``epoch`` records the
+        last fully completed epoch for resume.  ``snapshot=True`` routes
+        step-interval saves (``SAVE_EVERY_N_STEPS``) to the separate
+        short-retention snapshot manager."""
         state = {'params': params, 'opt_state': opt_state,
                  'step': np.asarray(step, np.int32),
                  'epoch': np.asarray(epoch, np.int32)}
-        self.manager().save(epoch, args=ocp.args.StandardSave(state))
+        manager = self.snapshot_manager() if snapshot else self.manager()
+        manager.save(step, args=ocp.args.StandardSave(state))
         if wait:
-            self.manager().wait_until_finished()
+            manager.wait_until_finished()
         self._write_metadata()
 
     def save_release(self, params) -> None:
@@ -116,23 +149,36 @@ class CheckpointStore:
         self._write_metadata()
 
     # ------------------------------------------------------------- restore
-    def latest_epoch(self) -> Optional[int]:
-        if not os.path.isdir(self.entire_dir):
-            return None
-        return self.manager().latest_step()
+    def _newest(self) -> Optional[Tuple[ocp.CheckpointManager, int]]:
+        """(manager, step) of the newest checkpoint across the epoch and
+        snapshot managers.  Keys are global steps (older checkpoints were
+        keyed by epoch — restore handles either, the stored state carries
+        both numbers)."""
+        candidates = []
+        if os.path.isdir(self.entire_dir):
+            latest = self.manager().latest_step()
+            if latest is not None:
+                candidates.append((self.manager(), latest))
+        if os.path.isdir(self.snapshot_dir):
+            latest = self.snapshot_manager().latest_step()
+            if latest is not None:
+                candidates.append((self.snapshot_manager(), latest))
+        return max(candidates, key=lambda c: c[1]) if candidates else None
 
     def restore_training(self, abstract_params, abstract_opt_state
                          ) -> Optional[RestoredTraining]:
-        """Restore the newest full training state, re-sharded to match the
-        abstract target (shapes + shardings)."""
-        latest = self.latest_epoch()
-        if latest is None:
+        """Restore the newest full training state (epoch checkpoint or
+        step-interval snapshot, whichever is newer), re-sharded to match
+        the abstract target (shapes + shardings)."""
+        newest = self._newest()
+        if newest is None:
             return None
+        manager, latest = newest
         self.verify_metadata()
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
-        restored = self.manager().restore(
+        restored = manager.restore(
             latest, args=ocp.args.StandardRestore(target))
         return RestoredTraining(
             params=restored['params'], opt_state=restored['opt_state'],
@@ -149,13 +195,14 @@ class CheckpointStore:
                 self.weights_dir, {'params': abstract_params})
             checkpointer.close()
             return restored['params']
-        latest = self.latest_epoch()
-        if latest is None:
+        newest = self._newest()
+        if newest is None:
             return None
+        manager, latest = newest
         # partial restore: pull only the params subtree out of a full
         # training checkpoint (the reference's load-for-eval path similarly
         # ignores optimizer slots)
-        restored = self.manager().restore(
+        restored = manager.restore(
             latest, args=ocp.args.PyTreeRestore(
                 item={'params': abstract_params},
                 restore_args=ocp.checkpoint_utils.construct_restore_args(

@@ -21,6 +21,10 @@ class Config:
     # ---- training schedule (reference config.py:47-57) ----
     NUM_TRAIN_EPOCHS: int = 20
     SAVE_EVERY_EPOCHS: int = 1
+    # 0 = per-epoch saves only. At java14m scale an epoch is ~14K steps
+    # (~an hour of chip time); step-interval async saves bound the work a
+    # preemption can destroy — the reference had no equivalent.
+    SAVE_EVERY_N_STEPS: int = 0
     TRAIN_BATCH_SIZE: int = 1024
     TEST_BATCH_SIZE: int = 1024
     TOP_K_WORDS_CONSIDERED_DURING_PREDICTION: int = 10
@@ -167,6 +171,10 @@ class Config:
                             metavar='DIR',
                             help='capture a jax.profiler trace of a few '
                                  'train steps into DIR')
+        parser.add_argument('--save-every-steps', dest='save_every_steps',
+                            type=int, default=None, metavar='N',
+                            help='additionally checkpoint every N train '
+                                 'steps (async), bounding preemption loss')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -204,6 +212,8 @@ class Config:
             self.TRAIN_DATA_CACHE = False
         if parsed.profile_dir:
             self.PROFILE_DIR = parsed.profile_dir
+        if parsed.save_every_steps is not None:
+            self.SAVE_EVERY_N_STEPS = parsed.save_every_steps
         return self
 
     # ------------------------------------------------------- derived props
@@ -271,6 +281,11 @@ class Config:
     @classmethod
     def get_model_weights_path(cls, model_path: str) -> str:
         return model_path + '__only-weights'
+
+    @classmethod
+    def get_step_snapshots_path(cls, model_path: str) -> str:
+        """Step-interval preemption snapshots (SAVE_EVERY_N_STEPS)."""
+        return model_path + '__step-snapshots'
 
     @property
     def model_load_dir(self) -> str:

@@ -259,13 +259,37 @@ class Code2VecModel:
                 writer.scalar('eval/subtoken_recall',
                               results.subtoken_recall, step)
 
+        # both save cadences funnel through one guard: an epoch boundary
+        # save must not be duplicated by the interval firing at the top of
+        # the next epoch's first iteration (same step, same state). A
+        # resumed run starts with its restored step already "saved".
+        last_saved_step = [int(self.state.step)]
+
+        def _save_at(state: TrainerState, last_complete_epoch: int,
+                     snapshot: bool = False) -> None:
+            step = int(state.step)
+            if step == last_saved_step[0]:
+                return
+            last_saved_step[0] = step
+            # async: the write finalizes in the background while training
+            # continues; train()'s finally drains it
+            self.save(state=state, epoch=last_complete_epoch, wait=False,
+                      snapshot=snapshot)
+
+        def on_save_interval(epoch: int, batch_num: int,
+                             state: TrainerState) -> None:
+            # fires at the top of an iteration of `epoch`: the state is
+            # either mid-`epoch` or exactly at the previous epoch's
+            # boundary — in both cases the last fully completed epoch is
+            # epoch-1, and resume restarts the interrupted epoch
+            # (at-least-once semantics over the epoch's data)
+            _save_at(state, epoch - 1, snapshot=True)
+
         def on_epoch_end(epoch: int, state: TrainerState,
                          batch_num: int) -> None:
             if save_store is not None and \
                     (epoch + 1) % config.SAVE_EVERY_EPOCHS == 0:
-                # async: the write finalizes in the background while the
-                # next epoch trains; train()'s finally drains it
-                self.save(state=state, epoch=epoch, wait=False)
+                _save_at(state, epoch)
             if run_evals:
                 if last_eval_batch[0] == batch_num:
                     return  # the interval eval just ran on this batch
@@ -284,7 +308,9 @@ class Code2VecModel:
                 self.state, epoch_batches, start_epoch=start,
                 on_epoch_end=on_epoch_end, on_log=on_log,
                 on_eval_interval=(on_eval_interval
-                                  if run_evals else None))
+                                  if run_evals else None),
+                on_save_interval=(on_save_interval
+                                  if save_store is not None else None))
         finally:
             # drain in-flight async checkpoint saves even when training
             # raises: a commenced save must end up durable
@@ -296,11 +322,13 @@ class Code2VecModel:
     # ---------------------------------------------------------------- save
     def save(self, model_save_path: Optional[str] = None,
              state: Optional[TrainerState] = None,
-             epoch: int = 0, wait: bool = True) -> None:
+             epoch: int = 0, wait: bool = True,
+             snapshot: bool = False) -> None:
         """vocab sidecar + full training state
         (reference model_base.py:102-109). Durable on return by default;
         ``wait=False`` (the in-training cadence) lets orbax finalize in the
-        background — train()'s finally drains it."""
+        background — train()'s finally drains it. ``snapshot=True`` routes
+        a step-interval save to the short-retention snapshot store."""
         path = model_save_path or self.config.MODEL_SAVE_PATH
         save_dir = os.path.dirname(path)
         if save_dir and not os.path.isdir(save_dir):
@@ -309,7 +337,8 @@ class Code2VecModel:
         state = state if state is not None else self.state
         store = self._store_for(path)
         store.save_training(params=state.params, opt_state=state.opt_state,
-                            step=int(state.step), epoch=epoch, wait=wait)
+                            step=int(state.step), epoch=epoch, wait=wait,
+                            snapshot=snapshot)
 
     def release_model(self) -> None:
         """Strip optimizer state (reference tensorflow_model.py:132-136)."""

@@ -190,6 +190,8 @@ class Trainer:
                                             None]] = None,
             on_log: Optional[Callable[[int, float, float], None]] = None,
             on_eval_interval: Optional[Callable[[int, TrainerState],
+                                                None]] = None,
+            on_save_interval: Optional[Callable[[int, int, TrainerState],
                                                 None]] = None
             ) -> TrainerState:
         """Epoch-driven loop with the reference's windowed throughput trace
@@ -205,8 +207,8 @@ class Trainer:
         try:
             state = self._fit_loop(
                 state, epoch_batches, start_epoch, on_epoch_end, on_log,
-                on_eval_interval, batch_num, window_losses, window_examples,
-                window_start, log_every)
+                on_eval_interval, on_save_interval, batch_num, window_losses,
+                window_examples, window_start, log_every)
         finally:
             if getattr(self, '_profiling', False):
                 jax.profiler.stop_trace()
@@ -214,8 +216,8 @@ class Trainer:
         return state
 
     def _fit_loop(self, state, epoch_batches, start_epoch, on_epoch_end,
-                  on_log, on_eval_interval, batch_num, window_losses,
-                  window_examples, window_start, log_every):
+                  on_log, on_eval_interval, on_save_interval, batch_num,
+                  window_losses, window_examples, window_start, log_every):
         config = self.config
         self._profiling = False
         profile_done = False
@@ -226,6 +228,16 @@ class Trainer:
         profile_stop_step = profile_start + config.PROFILE_NUM_STEPS
         for epoch in range(start_epoch, config.NUM_TRAIN_EPOCHS):
             for batch in epoch_batches(epoch):
+                # step-interval checkpointing fires at the TOP of the next
+                # iteration (state reflects batch_num completed steps): an
+                # interval landing on an epoch's final step must not
+                # pre-empt on_epoch_end's save, which records the completed
+                # epoch for resume. Async, so it costs one device->host
+                # copy, not a persistence stall.
+                if on_save_interval is not None and batch_num > 0 and \
+                        config.SAVE_EVERY_N_STEPS > 0 and \
+                        batch_num % config.SAVE_EVERY_N_STEPS == 0:
+                    on_save_interval(epoch, batch_num, state)
                 if config.PROFILE_DIR and not profile_done:
                     if batch_num >= profile_start and not self._profiling:
                         jax.profiler.start_trace(config.PROFILE_DIR)

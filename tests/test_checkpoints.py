@@ -65,6 +65,49 @@ def test_resume_training_continues_from_epoch(tmp_path):
     model2.train()  # runs epochs 2..3 without error
 
 
+def test_step_interval_saves_and_midepoch_resume(tmp_path):
+    """SAVE_EVERY_N_STEPS (VERDICT r1 #8): step-keyed async snapshots
+    during the epoch bound preemption loss, in their OWN short-retention
+    store (they must not evict epoch-boundary history); resume prefers the
+    newest state across both stores and restarts an interrupted epoch."""
+    # 60 examples, batch 16 -> 4 (padded) steps/epoch, 8 steps over 2 epochs
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=2,
+                           SAVE_EVERY_EPOCHS=1, SAVE_EVERY_N_STEPS=2)
+    model = Code2VecModel(config)
+    model.train()
+
+    store = model._store_for(config.MODEL_SAVE_PATH)
+    # epoch-boundary saves keep their own retention window...
+    assert sorted(store.manager().all_steps()) == [4, 8]
+    # ...interval snapshots fire between boundaries (the step-4 interval is
+    # deduplicated against the epoch-0 boundary save)
+    assert sorted(store.snapshot_manager().all_steps()) == [2, 6]
+    model.close_stores()
+
+    # newest checkpoint (step 8 = end of epoch 1) must record epoch 1 even
+    # though a step interval also landed on that boundary -> resume at
+    # epoch 2, not a replay of epoch 1
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_N_STEPS=0,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert int(model2.state.step) == 8
+    assert model2._start_epoch == 2
+    model2.close_stores()
+
+    # drop the epoch-boundary checkpoints: the newest mid-epoch snapshot
+    # (step 6, inside epoch 1) must restart epoch 1
+    import shutil
+    entire = tmp_path / 'models' / 'saved_model__entire-model'
+    shutil.rmtree(entire / '8')
+    shutil.rmtree(entire / '4')
+    model3 = Code2VecModel(config2)
+    assert int(model3.state.step) == 6
+    assert model3._start_epoch == 1  # restart the interrupted epoch
+    model3.train()  # completes epoch 1 without error
+
+
 def test_release_params_only(tmp_path):
     prefix = make_dataset(tmp_path)
     config = _train_config(tmp_path, prefix)
