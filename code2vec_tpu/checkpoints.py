@@ -64,23 +64,48 @@ class CheckpointStore:
         self.metadata = metadata or {}
         self.meta_path = os.path.abspath(model_path) + '.meta.json'
 
+    #: stamped into every meta file; absence marks a checkpoint written
+    #: before the canonical flat {name: array} params layout
+    _LAYOUT = 'canonical-v1'
+
     def _write_metadata(self) -> None:
-        if self.metadata:
-            with open(self.meta_path, 'w') as f:
-                json.dump(self.metadata, f)
+        if not self.metadata:
+            return
+        to_write = dict(self.metadata, checkpoint_layout=self._LAYOUT)
+        stored = self._stored_metadata()
+        for key in self._NON_STRICT_KEYS:
+            # the original writer wins: e.g. --release under another
+            # framework must not relabel the training checkpoint's
+            # framework, or the resume diagnostic below lies
+            if key in stored:
+                to_write[key] = stored[key]
+        with open(self.meta_path, 'w') as f:
+            json.dump(to_write, f)
+
+    # metadata keys that are informational, not shape-determining: a
+    # mismatch is fine for params-only loads (the canonical checkpoint
+    # layout is backend-agnostic)
+    _NON_STRICT_KEYS = frozenset({'framework'})
 
     def verify_metadata(self) -> None:
         if not self.metadata or not os.path.isfile(self.meta_path):
             return
-        with open(self.meta_path, 'r') as f:
-            stored = json.load(f)
+        stored = self._stored_metadata()
         for key, value in self.metadata.items():
+            if key in self._NON_STRICT_KEYS:
+                continue
             if key in stored and stored[key] != value:
                 raise ValueError(
                     'Checkpoint at `%s` was saved with %s=%r but the current '
                     'config has %s=%r; these settings determine parameter '
                     'shapes and must match.' % (self.model_path, key,
                                                 stored[key], key, value))
+
+    def _stored_metadata(self) -> Dict[str, Any]:
+        if not os.path.isfile(self.meta_path):
+            return {}
+        with open(self.meta_path, 'r') as f:
+            return json.load(f)
 
     # ------------------------------------------------------------- manager
     def manager(self) -> ocp.CheckpointManager:
@@ -178,8 +203,28 @@ class CheckpointStore:
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
-        restored = manager.restore(
-            latest, args=ocp.args.StandardRestore(target))
+        try:
+            restored = manager.restore(
+                latest, args=ocp.args.StandardRestore(target))
+        except Exception as exc:
+            stored = self._stored_metadata()
+            if stored and stored.get('checkpoint_layout') != self._LAYOUT:
+                raise ValueError(
+                    'Checkpoint at `%s` predates the canonical parameter '
+                    'layout (no checkpoint_layout marker); it cannot be '
+                    'restored by this version. Re-save it from the version '
+                    'that wrote it.' % self.model_path) from exc
+            stored_fw = stored.get('framework')
+            current_fw = self.metadata.get('framework')
+            if stored_fw and current_fw and stored_fw != current_fw:
+                raise ValueError(
+                    'Cannot resume TRAINING from `%s` with framework=%r: '
+                    'the checkpoint was written by framework=%r and '
+                    'optimizer state is backend-specific. Params-only '
+                    'loads (evaluate / predict / --release) work across '
+                    'frameworks.' % (self.model_path, current_fw,
+                                     stored_fw)) from exc
+            raise
         return RestoredTraining(
             params=restored['params'], opt_state=restored['opt_state'],
             step=int(restored['step']), epoch=int(restored['epoch']))

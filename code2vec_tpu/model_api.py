@@ -128,7 +128,10 @@ class Code2VecModel:
                     'param_row_alignment': self.config.PARAM_ROW_ALIGNMENT,
                     'token_dim': self.config.TOKEN_EMBEDDINGS_SIZE,
                     'path_dim': self.config.PATH_EMBEDDINGS_SIZE,
-                    'code_dim': self.config.CODE_VECTOR_SIZE})
+                    'code_dim': self.config.CODE_VECTOR_SIZE,
+                    # informational (non-strict): params load across
+                    # frameworks, only training resume needs a match
+                    'framework': self.config.DL_FRAMEWORK})
             self._stores[path] = store
         return store
 
@@ -152,7 +155,8 @@ class Code2VecModel:
                     raise ValueError('No checkpoint found under `%s`.'
                                      % self.config.MODEL_LOAD_PATH)
                 self.state = TrainerState(
-                    params=restored.params, opt_state=restored.opt_state,
+                    params=self.backend.from_canonical(restored.params),
+                    opt_state=restored.opt_state,
                     step=jnp.asarray(restored.step, jnp.int32),
                     rng=jax.random.PRNGKey(42))
                 self.params = self.state.params
@@ -165,7 +169,7 @@ class Code2VecModel:
                 if params is None:
                     raise ValueError('No checkpoint found under `%s`.'
                                      % self.config.MODEL_LOAD_PATH)
-                self.params = params
+                self.params = self.backend.from_canonical(params)
                 self._start_epoch = 0
         else:
             self.state = self.trainer.init_state()
@@ -336,7 +340,9 @@ class Code2VecModel:
         self.vocabs.save(Config.get_vocabularies_path_from_model_path(path))
         state = state if state is not None else self.state
         store = self._store_for(path)
-        store.save_training(params=state.params, opt_state=state.opt_state,
+        # canonical {name: array} layout: loadable under either backend
+        canonical = self.backend.named_params(state.params)._asdict()
+        store.save_training(params=canonical, opt_state=state.opt_state,
                             step=int(state.step), epoch=epoch, wait=wait,
                             snapshot=snapshot)
 
@@ -344,7 +350,7 @@ class Code2VecModel:
         """Strip optimizer state (reference tensorflow_model.py:132-136)."""
         assert self.config.is_loading
         store = self._store_for(self.config.MODEL_LOAD_PATH)
-        store.save_release(self.params)
+        store.save_release(self.backend.named_params(self.params)._asdict())
         self.close_stores()
         self.log('Released model saved under `%s__only-weights`.'
                  % self.config.MODEL_LOAD_PATH)

@@ -83,6 +83,10 @@ class JaxBackend:
     def named_params(self, params) -> functional.Code2VecParams:
         return params
 
+    def from_canonical(self, named: dict) -> functional.Code2VecParams:
+        """Canonical {name: array} checkpoint layout → backend layout."""
+        return functional.Code2VecParams(**named)
+
 
 class FlaxBackend:
     """flax.linen backend: params are the module's ``{'params': {...}}``
@@ -137,6 +141,10 @@ class FlaxBackend:
             target_embedding=inner['target_embedding'],
             transform=inner['transform'],
             attention=inner['attention'])
+
+    def from_canonical(self, named: dict):
+        """Canonical {name: array} checkpoint layout → flax module layout."""
+        return {'params': dict(named)}
 
 
 def create_backend(config: Config, vocabs: Code2VecVocabs):

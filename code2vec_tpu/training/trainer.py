@@ -147,14 +147,21 @@ class Trainer:
                        out_shardings=opt_shardings)(params)
 
     def abstract_state(self) -> Tuple[Any, Any]:
-        """(abstract_params, abstract_opt_state) with *current-mesh*
-        shardings attached, for checkpoint restore targets — nothing is
-        materialized on device (no throwaway init at 384M-param scale)."""
+        """(abstract_canonical_params, abstract_opt_state) with
+        *current-mesh* shardings attached, for checkpoint restore targets —
+        nothing is materialized on device (no throwaway init at
+        384M-param-scale).
+
+        Params use the CANONICAL checkpoint layout (flat {name: array}
+        dict) so checkpoints are loadable under either backend; optimizer
+        state keeps the backend-native tree (training resume requires the
+        same backend — enforced with a clear error in CheckpointStore)."""
         abstract_params = jax.tree_util.tree_map(
             lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype),
             self.backend.param_shapes())
         abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
-        return (mesh_lib.attach_shardings(abstract_params, self.mesh),
+        canonical = self.backend.named_params(abstract_params)._asdict()
+        return (mesh_lib.attach_shardings(canonical, self.mesh),
                 mesh_lib.attach_shardings(abstract_opt, self.mesh))
 
     def state_from_params(self, params, step: int = 0,

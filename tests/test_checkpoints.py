@@ -29,10 +29,16 @@ def test_save_creates_sidecar_and_checkpoints(tmp_path):
     assert (model_dir / 'saved_model__entire-model').is_dir()
 
 
-@pytest.mark.parametrize('framework', ['jax', 'flax'])
-def test_load_params_reproduces_predictions(tmp_path, framework):
+@pytest.mark.parametrize('train_framework,load_framework',
+                         [('jax', 'jax'), ('flax', 'flax'),
+                          ('jax', 'flax'), ('flax', 'jax')])
+def test_load_params_reproduces_predictions(tmp_path, train_framework,
+                                            load_framework):
+    """Checkpoints use a canonical params layout: a model trained under
+    either backend loads (params-only) under either backend — a capability
+    the reference lacked (README.md:210)."""
     prefix = make_dataset(tmp_path)
-    config = _train_config(tmp_path, prefix, DL_FRAMEWORK=framework)
+    config = _train_config(tmp_path, prefix, DL_FRAMEWORK=train_framework)
     model = Code2VecModel(config)
     model.train()
     line = 'get|a toka0,pA,toka1 toka1,pB,toka2    '
@@ -40,13 +46,52 @@ def test_load_params_reproduces_predictions(tmp_path, framework):
 
     config2 = Config(
         MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'),
-        DL_FRAMEWORK=framework, COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        DL_FRAMEWORK=load_framework, COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
         VERBOSE_MODE=0, READER_USE_NATIVE=False)
     model2 = Code2VecModel(config2)
     after = model2.predict([line])[0]
     assert before.topk_predicted_words == after.topk_predicted_words
     np.testing.assert_allclose(before.topk_predicted_words_scores,
                                after.topk_predicted_words_scores, rtol=1e-5)
+
+
+def test_release_under_other_framework_preserves_meta(tmp_path):
+    """--release under the other backend must not relabel the training
+    checkpoint's framework in meta.json — the cross-framework resume
+    diagnostic depends on the original writer's value."""
+    import json
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, DL_FRAMEWORK='jax',
+                           NUM_TRAIN_EPOCHS=1)
+    Code2VecModel(config).train()
+
+    load_path = str(tmp_path / 'models' / 'saved_model')
+    config_r = Config(MODEL_LOAD_PATH=load_path, RELEASE=True,
+                      DL_FRAMEWORK='flax', COMPUTE_DTYPE='float32',
+                      MAX_CONTEXTS=6, VERBOSE_MODE=0,
+                      READER_USE_NATIVE=False)
+    model_r = Code2VecModel(config_r)
+    model_r.release_model()
+    with open(load_path + '.meta.json') as f:
+        meta = json.load(f)
+    assert meta['framework'] == 'jax'
+    assert meta['checkpoint_layout'] == 'canonical-v1'
+
+
+def test_cross_framework_training_resume_raises_clearly(tmp_path):
+    """Optimizer state is backend-specific: resuming TRAINING under the
+    other framework must fail with an explanation, not an orbax shape
+    error (params-only loads are covered by the test above)."""
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, DL_FRAMEWORK='jax',
+                           NUM_TRAIN_EPOCHS=1)
+    Code2VecModel(config).train()
+
+    config2 = _train_config(
+        tmp_path, prefix, DL_FRAMEWORK='flax', NUM_TRAIN_EPOCHS=2,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    with pytest.raises(ValueError, match='framework'):
+        Code2VecModel(config2)
 
 
 def test_resume_training_continues_from_epoch(tmp_path):
