@@ -12,7 +12,7 @@ target). Baseline: ~4,700 examples/sec on a Tesla V100 (README.md:69,127 —
 Data is synthetic (uniform random indices): this measures the device compute
 path the way the reference's numbers measure theirs — the host input
 pipeline is overlap-hidden behind the step in training and is benchmarked
-separately.
+separately (benchmarks/bench_host_pipeline.py; results in PARITY.md).
 
 Resilience: the TPU tunnel in this environment can be flaky in two ways —
 backend init raises UNAVAILABLE, or it wedges and `jax.devices()` hangs

@@ -1,0 +1,134 @@
+"""Host input-pipeline benchmark: lines/sec through ``reader.iter_epoch``.
+
+SURVEY.md §7 hard part #3: the host loader must not starve a v3-32 — the
+north star is ~18,700 examples/sec aggregate (BASELINE.json), i.e. the
+whole pod's appetite served by the input hosts. This benchmark measures
+the three host paths on synthetic java14m-shaped data (200 contexts/row):
+
+- ``python``  — pure-Python parse + dict-lookup tokenization
+- ``native``  — C++ tokenizer (indices in C++, zero Python inner loop)
+- ``cache``   — binary token cache steady state (epoch 2+: sequential
+                disk reads + chunk shuffling, no tokenization at all)
+
+Prints one JSON line per variant:
+  {"metric": "host_pipeline_examples_per_sec", "variant": ..., "value": ...,
+   "vs_north_star": ...}
+
+Usage: python benchmarks/bench_host_pipeline.py [--rows N] [--contexts C]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+NORTH_STAR_EXAMPLES_PER_SEC = 18700.0
+
+
+def synthesize_dataset(prefix: str, rows: int, contexts: int,
+                       n_tokens: int = 2000, n_paths: int = 3000,
+                       n_labels: int = 500, seed: int = 0) -> None:
+    """java14m-shaped rows: space-padded to exactly ``contexts`` fields."""
+    import pickle
+    rng = random.Random(seed)
+    tokens = [f'tok{i}' for i in range(n_tokens)]
+    paths = [str(rng.getrandbits(31)) for _ in range(n_paths)]
+    labels = [f'do|thing|{i}' for i in range(n_labels)]
+    with open(prefix + '.train.c2v', 'w') as f:
+        for _ in range(rows):
+            n = rng.randint(contexts // 2, contexts)
+            ctxs = ' '.join(
+                f'{rng.choice(tokens)},{rng.choice(paths)},{rng.choice(tokens)}'
+                for _ in range(n))
+            f.write(f'{rng.choice(labels)} {ctxs}{" " * (contexts - n)}\n')
+    with open(prefix + '.dict.c2v', 'wb') as f:
+        pickle.dump({t: 10 for t in tokens}, f)
+        pickle.dump({p: 10 for p in paths}, f)
+        pickle.dump({l: 10 for l in labels}, f)
+        pickle.dump(rows, f)
+
+
+def consume(batches) -> int:
+    total = 0
+    for batch in batches:
+        total += batch.num_valid_examples
+    return total
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--rows', type=int, default=20000)
+    parser.add_argument('--contexts', type=int, default=200)
+    parser.add_argument('--batch-size', type=int, default=1024)
+    parser.add_argument('--variants', default='python,native,cache')
+    args = parser.parse_args()
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data import native
+    from code2vec_tpu.data.cache import TokenCache
+    from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+    from code2vec_tpu.vocab import Code2VecVocabs
+
+    workdir = tempfile.mkdtemp(prefix='c2v_hostbench_')
+    try:
+        prefix = os.path.join(workdir, 'synth')
+        synthesize_dataset(prefix, args.rows, args.contexts)
+
+        def make(use_native: bool):
+            config = Config(TRAIN_DATA_PATH_PREFIX=prefix, VERBOSE_MODE=0,
+                            MAX_CONTEXTS=args.contexts,
+                            TRAIN_BATCH_SIZE=args.batch_size,
+                            READER_USE_NATIVE=use_native)
+            vocabs = Code2VecVocabs(config)
+            reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+            return config, vocabs, reader
+
+        results = {}
+        variants = args.variants.split(',')
+
+        if 'python' in variants:
+            _, _, reader = make(use_native=False)
+            start = time.perf_counter()
+            n = consume(reader.iter_epoch(shuffle=False))
+            results['python'] = n / (time.perf_counter() - start)
+
+        if 'native' in variants and native.is_available():
+            _, _, reader = make(use_native=True)
+            assert reader._native is not None
+            start = time.perf_counter()
+            n = consume(reader.iter_epoch(shuffle=False))
+            results['native'] = n / (time.perf_counter() - start)
+
+        if 'cache' in variants:
+            config, vocabs, reader = make(use_native=native.is_available())
+            cache = TokenCache.build_or_load(config, vocabs, reader)
+            consume(cache.iter_epoch(args.batch_size))  # warm page cache
+            start = time.perf_counter()
+            n = consume(cache.iter_epoch(args.batch_size, shuffle=True,
+                                         seed=1))
+            results['cache'] = n / (time.perf_counter() - start)
+
+        for variant, examples_per_sec in results.items():
+            print(json.dumps({
+                'metric': 'host_pipeline_examples_per_sec',
+                'variant': variant,
+                'value': round(examples_per_sec, 1),
+                'unit': 'examples/sec',
+                'vs_north_star': round(
+                    examples_per_sec / NORTH_STAR_EXAMPLES_PER_SEC, 3),
+            }))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == '__main__':
+    main()

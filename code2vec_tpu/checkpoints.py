@@ -253,7 +253,31 @@ class CheckpointStore:
                 restore_args=ocp.checkpoint_utils.construct_restore_args(
                     {'params': abstract_params}),
                 partial_restore=True))
+        self._check_materialized(restored['params'])
         return restored['params']
+
+    def _check_materialized(self, params) -> None:
+        """partial_restore=True silently leaves target leaves UNRESTORED
+        (as ShapeDtypeStructs) when the stored tree doesn't match — e.g. a
+        checkpoint in the pre-canonical backend-native layout. Turn that
+        into a clear error instead of a downstream 'not a valid JAX type'."""
+        unrestored = [
+            jax.tree_util.keystr(path)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+            if isinstance(leaf, jax.ShapeDtypeStruct)]
+        if not unrestored:
+            return
+        stored = self._stored_metadata()
+        if stored and stored.get('checkpoint_layout') != self._LAYOUT:
+            raise ValueError(
+                'Checkpoint at `%s` predates the canonical parameter '
+                'layout (no checkpoint_layout marker); it cannot be '
+                'restored by this version. Re-save it from the version '
+                'that wrote it.' % self.model_path)
+        raise ValueError(
+            'Checkpoint at `%s` did not contain these parameters: %s — '
+            'the stored tree does not match the expected canonical '
+            'layout.' % (self.model_path, ', '.join(unrestored)))
 
 
 def abstract_like(tree, shardings=None):

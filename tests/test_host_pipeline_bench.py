@@ -1,0 +1,27 @@
+"""Smoke for the host input-pipeline benchmark (VERDICT r1 #7): guards
+the script against import/config rot; the real numbers are captured by
+running it at full size (see PARITY.md 'Host pipeline throughput')."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, 'benchmarks', 'bench_host_pipeline.py')
+
+
+def test_host_pipeline_bench_emits_json_lines():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, '--rows', '400', '--contexts', '8',
+         '--batch-size', '64'],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line) for line in proc.stdout.splitlines()
+               if line.strip()]
+    variants = {r['variant'] for r in records}
+    assert 'python' in variants and 'cache' in variants
+    for record in records:
+        assert record['metric'] == 'host_pipeline_examples_per_sec'
+        assert record['value'] > 0
+        assert 'vs_north_star' in record
