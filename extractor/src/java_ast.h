@@ -13,7 +13,7 @@
 namespace c2v {
 
 struct Node {
-  std::string type;       // e.g. "BinaryExpr:PLUS" (operator-augmented)
+  std::string type;       // e.g. "BinaryExpr:plus" (operator-augmented)
   std::string raw_type;   // e.g. "BinaryExpr" (no operator suffix)
   std::string code;       // source text (leaf naming / normalization)
   Node* parent = nullptr;

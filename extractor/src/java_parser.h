@@ -9,9 +9,14 @@
 // skips whole files on parse failure after its wrap-retries,
 // FeatureExtractor.java:51-75; per-member recovery is strictly better).
 //
-// Operator spellings use javaparser 3.x enum names (PLUS, ASSIGN,
-// PREFIX_INCREMENT, ...) — reference Property.java:33-42 appends them to the
-// node type as "BinaryExpr:PLUS".
+// Operator spellings use javaparser 3.0.0-alpha.4 enum names (plus, assign,
+// preIncrement, ...) — extracted from the enum constant pools of the
+// reference's checked-in fat JAR (JavaExtractor-0.0.1-SNAPSHOT.jar:
+// com/github/javaparser/ast/expr/{Binary,Unary,Assign}Expr$Operator.class;
+// no toString override, so Operator.toString() == the enum constant name).
+// Reference Property.java:33-42 appends them to the node type as
+// "BinaryExpr:plus", which flows into the path vocabulary — exact spellings
+// are required for drop-in compatibility with reference-extracted datasets.
 #pragma once
 
 #include <optional>
@@ -804,13 +809,14 @@ class Parser {
   Node* parse_assignment() {
     DepthGuard depth_guard(&depth_);
     Node* left = parse_ternary();
+    // AssignExpr$Operator constants, javaparser 3.0.0-alpha.4
     static const std::pair<const char*, const char*> kAssignOps[] = {
-        {"=", "ASSIGN"},       {"+=", "PLUS"},
-        {"-=", "MINUS"},       {"*=", "MULTIPLY"},
-        {"/=", "DIVIDE"},      {"%=", "REMAINDER"},
-        {"&=", "AND"},         {"|=", "OR"},
-        {"^=", "XOR"},         {"<<=", "LEFT_SHIFT"},
-        {">>=", "SIGNED_RIGHT_SHIFT"}, {">>>=", "UNSIGNED_RIGHT_SHIFT"}};
+        {"=", "assign"},       {"+=", "plus"},
+        {"-=", "minus"},       {"*=", "star"},
+        {"/=", "slash"},       {"%=", "rem"},
+        {"&=", "and"},         {"|=", "or"},
+        {"^=", "xor"},         {"<<=", "lShift"},
+        {">>=", "rSignedShift"}, {">>>=", "rUnsignedShift"}};
     for (const auto& [text, name] : kAssignOps) {
       if (is_punct(text)) {
         advance();
@@ -845,18 +851,19 @@ class Parser {
   };
 
   static const std::vector<BinOp>& binary_ops() {
+    // BinaryExpr$Operator constants, javaparser 3.0.0-alpha.4
     static const std::vector<BinOp> kOps = {
-        {"||", "OR", 1},           {"&&", "AND", 2},
-        {"|", "BINARY_OR", 3},     {"^", "XOR", 4},
-        {"&", "BINARY_AND", 5},    {"==", "EQUALS", 6},
-        {"!=", "NOT_EQUALS", 6},   {"<", "LESS", 7},
-        {">", "GREATER", 7},       {"<=", "LESS_EQUALS", 7},
-        {">=", "GREATER_EQUALS", 7},
-        {"<<", "LEFT_SHIFT", 8},   {">>", "SIGNED_RIGHT_SHIFT", 8},
-        {">>>", "UNSIGNED_RIGHT_SHIFT", 8},
-        {"+", "PLUS", 9},          {"-", "MINUS", 9},
-        {"*", "MULTIPLY", 10},     {"/", "DIVIDE", 10},
-        {"%", "REMAINDER", 10}};
+        {"||", "or", 1},           {"&&", "and", 2},
+        {"|", "binOr", 3},         {"^", "xor", 4},
+        {"&", "binAnd", 5},        {"==", "equals", 6},
+        {"!=", "notEquals", 6},    {"<", "less", 7},
+        {">", "greater", 7},       {"<=", "lessEquals", 7},
+        {">=", "greaterEquals", 7},
+        {"<<", "lShift", 8},       {">>", "rSignedShift", 8},
+        {">>>", "rUnsignedShift", 8},
+        {"+", "plus", 9},          {"-", "minus", 9},
+        {"*", "times", 10},        {"/", "divide", 10},
+        {"%", "remainder", 10}};
     return kOps;
   }
 
@@ -893,13 +900,14 @@ class Parser {
 
   Node* parse_unary() {
     DepthGuard depth_guard(&depth_);
+    // UnaryExpr$Operator constants, javaparser 3.0.0-alpha.4
     static const std::pair<const char*, const char*> kPrefix[] = {
-        {"+", "PLUS"},
-        {"-", "MINUS"},
-        {"!", "LOGICAL_COMPLEMENT"},
-        {"~", "BITWISE_COMPLEMENT"},
-        {"++", "PREFIX_INCREMENT"},
-        {"--", "PREFIX_DECREMENT"}};
+        {"+", "positive"},
+        {"-", "negative"},
+        {"!", "not"},
+        {"~", "inverse"},
+        {"++", "preIncrement"},
+        {"--", "preDecrement"}};
     for (const auto& [text, name] : kPrefix) {
       if (is_punct(text)) {
         advance();
@@ -944,13 +952,13 @@ class Parser {
     expr = parse_postfix_ops(expr);
     if (is_punct("++")) {
       advance();
-      Node* unary = arena_->make_op("UnaryExpr", "POSTFIX_INCREMENT");
+      Node* unary = arena_->make_op("UnaryExpr", "posIncrement");
       unary->add(expr);
       return unary;
     }
     if (is_punct("--")) {
       advance();
-      Node* unary = arena_->make_op("UnaryExpr", "POSTFIX_DECREMENT");
+      Node* unary = arena_->make_op("UnaryExpr", "posDecrement");
       unary->add(expr);
       return unary;
     }

@@ -53,7 +53,7 @@ def test_simple_method_golden(tmp_path):
     assert parts[0] == 'get|square'   # subtoken label
     contexts = parts[1:]
     # the x*x pair: both leaves under the BinaryExpr, childIds 0 and 1
-    assert 'x,(NameExpr0)^(BinaryExpr:MULTIPLY)_(NameExpr1),x' in contexts
+    assert 'x,(NameExpr0)^(BinaryExpr:times)_(NameExpr1),x' in contexts
     # METHOD_NAME substitution for the name leaf
     assert any(',METHOD_NAME' in c or c.startswith('METHOD_NAME,')
                for c in contexts)
@@ -80,7 +80,7 @@ def test_snippet_wrap_retry(tmp_path):
     src.write_text('int add(int a, int b) { return a + b; }')
     lines = extract_file(str(src))
     assert lines[0].startswith('add ')
-    assert '(BinaryExpr:PLUS)' in lines[0]
+    assert '(BinaryExpr:plus)' in lines[0]
 
 
 def test_hash_mode_matches_java_hashcode(tmp_path):
@@ -157,8 +157,8 @@ class T {
 ''')
     line = extract_file(str(src))[0]
     assert line.split(' ')[0] == 'compute'
-    for expected in ['BinaryExpr:LESS', 'UnaryExpr:POSTFIX_INCREMENT',
-                     'AssignExpr:PLUS', 'ArrayAccessExpr', 'ConditionalExpr',
+    for expected in ['BinaryExpr:less', 'UnaryExpr:posIncrement',
+                     'AssignExpr:plus', 'ArrayAccessExpr', 'ConditionalExpr',
                      'FieldAccessExpr', 'ForStmt', 'WhileStmt', 'IfStmt']:
         assert expected in line, expected
 
@@ -281,4 +281,4 @@ def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
     assert 'Original name:\tget|square' in out
     assert 'Attention:' in out
     # attention paths are displayed un-hashed
-    assert '(BinaryExpr:MULTIPLY)' in out
+    assert '(BinaryExpr:times)' in out
