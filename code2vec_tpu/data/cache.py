@@ -93,9 +93,22 @@ class TokenCache:
     def build_or_load(cls, config: Config, vocabs: Code2VecVocabs,
                       reader: PathContextReader,
                       data_path: Optional[str] = None) -> 'TokenCache':
+        """Multi-host: the reader strides the data file per process, so each
+        process builds/loads a cache of ITS OWN stride in a per-process
+        directory (``.tokcache.p<i>of<n>``) — processes sharing storage
+        never collide, and every epoch after the first is sequential disk
+        reads instead of a full re-tokenization per process."""
         data_path = data_path or config.train_data_path
-        cache_dir = data_path + '.tokcache'
+        suffix = ('.tokcache' if reader.process_count <= 1 else
+                  '.tokcache.p%dof%d' % (reader.process_index,
+                                         reader.process_count))
+        cache_dir = data_path + suffix
         expected = _fingerprint(config, vocabs, data_path)
+        if reader.process_count > 1:
+            # single-process caches skip these keys so pre-existing caches
+            # stay fresh; the stride is also encoded in the directory name
+            expected['process_index'] = reader.process_index
+            expected['process_count'] = reader.process_count
         meta_path = os.path.join(cache_dir, 'meta.json')
 
         def is_fresh() -> bool:

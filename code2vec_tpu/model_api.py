@@ -188,55 +188,62 @@ class Code2VecModel:
                       if config.is_saving else None)
         writer = metrics_writer.maybe_create(config)
         use_cache = config.TRAIN_DATA_CACHE
-        if use_cache and process_count > 1:
-            # the on-disk cache is keyed by the data file, not the process
-            # stride — fall back to streaming on multi-host shared storage
-            use_cache = False
-            self.log('TRAIN_DATA_CACHE disabled under multi-host training.')
+        if process_count > 1 and config.TRAIN_BATCH_SIZE % process_count:
+            raise ValueError(
+                'TRAIN_BATCH_SIZE=%d must be divisible by the process '
+                'count (%d).' % (config.TRAIN_BATCH_SIZE, process_count))
         run_evals = config.is_testing
         self.log('Starting training (%d epochs, batch %d, steps/epoch ~%d)'
                  % (config.NUM_TRAIN_EPOCHS, config.TRAIN_BATCH_SIZE,
                     config.train_steps_per_epoch))
 
+        # multi-host: every process MUST run the same number of jitted
+        # steps per epoch or the mesh collectives pair mismatched steps
+        # and hang. Fix the step count globally (floor of the unfiltered
+        # example count) and cycle each host's local batches to fill it.
+        steps_per_epoch = max(
+            1, config.NUM_TRAIN_EXAMPLES // config.TRAIN_BATCH_SIZE)
+
+        def fixed_step_epoch(make_local_batches):
+            import itertools
+
+            def cycled():
+                while True:
+                    produced = False
+                    for batch in make_local_batches():
+                        produced = True
+                        yield batch
+                    if not produced:
+                        raise ValueError(
+                            'Process %d has no training batches in its '
+                            'shard.' % jax.process_index())
+            return itertools.islice(cycled(), steps_per_epoch)
+
         if use_cache:
             from code2vec_tpu.data.cache import TokenCache
             from code2vec_tpu.data.reader import prefetch_iterator
+            # multi-host: per-process cache of this process's stride —
+            # without it the streaming path re-reads and re-tokenizes the
+            # full file every epoch on every process (round-1 weak #7)
             cache = TokenCache.build_or_load(config, self.vocabs, reader)
+            local_batch_size = config.TRAIN_BATCH_SIZE // process_count
 
             def epoch_batches(epoch: int):
                 # prefetch thread keeps chunk reads/shuffles off the
                 # training thread, like the streaming path
+                def local_batches():
+                    return cache.iter_epoch(local_batch_size, shuffle=True,
+                                            seed=epoch)
+                if process_count == 1:
+                    return prefetch_iterator(local_batches,
+                                             config.READER_PREFETCH_BATCHES)
                 return prefetch_iterator(
-                    lambda: cache.iter_epoch(config.TRAIN_BATCH_SIZE,
-                                             shuffle=True, seed=epoch),
+                    lambda: fixed_step_epoch(local_batches),
                     config.READER_PREFETCH_BATCHES)
         elif process_count > 1:
-            # multi-host: every process MUST run the same number of jitted
-            # steps per epoch or the mesh collectives pair mismatched steps
-            # and hang. Fix the step count globally (floor of the unfiltered
-            # example count) and cycle each host's shard to fill it.
-            if config.TRAIN_BATCH_SIZE % process_count:
-                raise ValueError(
-                    'TRAIN_BATCH_SIZE=%d must be divisible by the process '
-                    'count (%d).' % (config.TRAIN_BATCH_SIZE, process_count))
-            steps_per_epoch = max(
-                1, config.NUM_TRAIN_EXAMPLES // config.TRAIN_BATCH_SIZE)
-
             def epoch_batches(epoch: int):
-                import itertools
-
-                def cycled():
-                    while True:
-                        produced = False
-                        for batch in reader.iter_epoch(shuffle=True,
-                                                       seed=epoch):
-                            produced = True
-                            yield batch
-                        if not produced:
-                            raise ValueError(
-                                'Process %d has no training batches in its '
-                                'shard.' % jax.process_index())
-                return itertools.islice(cycled(), steps_per_epoch)
+                return fixed_step_epoch(
+                    lambda: reader.iter_epoch(shuffle=True, seed=epoch))
         else:
             def epoch_batches(epoch: int):
                 return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)

@@ -23,6 +23,8 @@ def main() -> None:
     parser.add_argument('--out', required=True)
     parser.add_argument('--train_epochs', type=int, default=0,
                         help='0 = evaluate the seed-42 init params only')
+    parser.add_argument('--data_cache', type=int, default=1,
+                        help='1 = per-process token cache, 0 = streaming')
     args = parser.parse_args()
 
     import jax
@@ -42,7 +44,9 @@ def main() -> None:
         NUM_TRAIN_EPOCHS=max(args.train_epochs, 1),
         SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
         READER_USE_NATIVE=False, LEARNING_RATE=0.01,
-        TRAIN_DATA_CACHE=False)
+        # 1 exercises the per-process token cache (.tokcache.p<i>of<n>),
+        # 0 the streaming fixed-step multi-host path
+        TRAIN_DATA_CACHE=bool(args.data_cache))
     model = Code2VecModel(config)
 
     record = {

@@ -47,12 +47,13 @@ def _worker_env() -> dict:
 
 
 def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
-                 timeout: float = 420.0) -> list:
+                 timeout: float = 420.0, data_cache: int = 1) -> list:
     port = _free_port()
     outs = []
     procs = []
     for pid in range(num_processes):
-        out = tmp_path / f'result_p{num_processes}_{pid}_{train_epochs}.json'
+        out = tmp_path / (f'result_p{num_processes}_{pid}_{train_epochs}'
+                          f'_{data_cache}.json')
         outs.append(out)
         procs.append(subprocess.Popen(
             [sys.executable, WORKER,
@@ -61,7 +62,8 @@ def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
              '--num_processes', str(num_processes),
              '--prefix', str(prefix),
              '--out', str(out),
-             '--train_epochs', str(train_epochs)],
+             '--train_epochs', str(train_epochs),
+             '--data_cache', str(data_cache)],
             env=_worker_env(), cwd=str(tmp_path),  # eval log.txt goes here
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     records = []
@@ -109,12 +111,15 @@ def test_two_process_eval_matches_single_process(tmp_path, dataset):
     np.testing.assert_allclose(two[0]['loss'], baseline['loss'], rtol=1e-5)
 
 
-def test_two_process_train_and_eval_completes(tmp_path, dataset):
+@pytest.mark.parametrize('data_cache', [1, 0],
+                         ids=['process-cache', 'streaming'])
+def test_two_process_train_and_eval_completes(tmp_path, dataset, data_cache):
     """Striding + fixed train step counts + per-epoch multi-host eval with
-    real collectives: the run completing at all proves no step-count
+    real collectives, over BOTH multi-host input paths (per-process token
+    cache and streaming): the run completing at all proves no step-count
     mismatch deadlocked the mesh."""
     records = _run_cluster(tmp_path, dataset, num_processes=2,
-                           train_epochs=2)
+                           train_epochs=2, data_cache=data_cache)
     assert [r['trained_epochs'] for r in records] == [2, 2]
     for r in records:
         assert r['loss'] is not None and np.isfinite(r['loss'])
