@@ -557,7 +557,7 @@ class Parser {
         !is_punct(".", 1)) {
       // local class
       Node* stmt =
-          arena_->make("LocalClassDeclarationStmt", "", true);
+          arena_->make("TypeDeclarationStmt", "", true);
       skip_modifiers();
       stmt->add(parse_class_or_interface());
       return stmt;
@@ -594,13 +594,9 @@ class Parser {
     try {
       skip_modifiers();  // final / annotations
       if (cur().kind != Tok::kIdent) return nullptr;
-      Node* type;
-      if (is_ident("var") && ahead(1).kind == Tok::kIdent) {
-        advance();
-        type = arena_->make("VarType", "var");
-      } else {
-        type = parse_type();
-      }
+      // `var` needs no special case: parse_type() yields the same
+      // ClassOrInterfaceType("var") node alpha.4 would produce
+      Node* type = parse_type();
       if (cur().kind != Tok::kIdent) return nullptr;
       // next after name must be one of = ; , [ to be a declaration
       const Token& after = ahead(1);
@@ -665,9 +661,7 @@ class Parser {
       skip_modifiers();
       try {
         if (cur().kind == Tok::kIdent) {
-          Node* type = (is_ident("var") && ahead(1).kind == Tok::kIdent)
-                           ? (advance(), arena_->make("VarType", "var"))
-                           : parse_type();
+          Node* type = parse_type();
           if (cur().kind == Tok::kIdent && is_punct(":", 1)) {
             Node* stmt = arena_->make("ForeachStmt", "", true);
             Node* decl = arena_->make("VariableDeclarationExpr");

@@ -164,6 +164,46 @@ def test_hashed_output_is_no_hash_output_hashed(tmp_path, seed):
             assert h_path == str(common.java_string_hashcode(path))
 
 
+# ------------------------------------------------- node-type name audit
+
+REFERENCE_JAR = os.path.join(
+    '/root', 'reference', 'JavaExtractor', 'JPredict', 'target',
+    'JavaExtractor-0.0.1-SNAPSHOT.jar')
+
+
+@pytest.mark.skipif(not os.path.isfile(REFERENCE_JAR),
+                    reason='reference JAR not present')
+def test_every_emitted_node_type_exists_in_reference_javaparser():
+    """Path strings render node-class simple names (Property.java:28-31);
+    every name our parser can emit must be a real javaparser-3.0.0-alpha.4
+    AST class, read straight from the reference JAR's file list — a
+    misspelled or postdated node name would silently fork the path
+    vocabulary."""
+    import re
+    import zipfile
+    with zipfile.ZipFile(REFERENCE_JAR) as jar:
+        reference_classes = {
+            os.path.basename(name)[:-len('.class')]
+            for name in jar.namelist()
+            if name.startswith('com/github/javaparser/ast/')
+            and name.endswith('.class')
+            and '$' not in os.path.basename(name)}
+    assert len(reference_classes) > 100  # sanity: the AST package is large
+
+    emitted = set()
+    for source in ['java_parser.h', 'pathctx.h']:
+        path = os.path.join(REPO, 'extractor', 'src', source)
+        with open(path) as f:
+            emitted |= set(re.findall(r'make(?:_op)?\("([A-Za-z]+)"',
+                                      f.read()))
+    # "PrimitiveType" renames and "GenericClass" (Property.java:28-54) are
+    # rendering-time substitutions, also checked against the same list
+    emitted |= {'PrimitiveType'}
+    unknown = sorted(emitted - reference_classes - {'GenericClass'})
+    assert not unknown, (
+        'node types not in javaparser-3.0.0-alpha.4: %s' % unknown)
+
+
 # ------------------------------------------------- deviating constructs
 
 def test_annotated_method_still_extracts(tmp_path):
