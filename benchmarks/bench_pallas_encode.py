@@ -1,0 +1,127 @@
+"""On-chip A/B for the fused Pallas encode kernel (VERDICT r1 #2).
+
+Runs the SAME timed train-step loop as bench.py twice — XLA path vs
+``USE_PALLAS_FUSED_ENCODE`` — on the real TPU at the java14m headline
+configuration, and prints one JSON line per variant plus a verdict line:
+
+  {"metric": "train_examples_per_sec_per_chip_java14m", "variant": "xla", ...}
+  {"metric": "train_examples_per_sec_per_chip_java14m", "variant": "pallas", ...}
+  {"verdict": "keep-pallas" | "keep-xla", "speedup": ...}
+
+This is the evidence the USE_PALLAS_FUSED_ENCODE default decision needs;
+refuses to run on non-TPU backends (interpreter-mode numbers would be
+meaningless). Run it whenever the TPU tunnel is healthy:
+
+  python benchmarks/bench_pallas_encode.py            # full java14m shapes
+  BENCH_SMOKE=1 python benchmarks/bench_pallas_encode.py  # harness check
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TOKEN_VOCAB = 1301136
+PATH_VOCAB = 911417
+TARGET_VOCAB = 261245
+BATCH_SIZE = 1024
+MAX_CONTEXTS = 200
+WARMUP_STEPS = 10
+MEASURE_STEPS = 30
+
+SMOKE = os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
+if SMOKE:
+    TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB = 1000, 1000, 500
+    BATCH_SIZE, MAX_CONTEXTS = 64, 16
+    WARMUP_STEPS, MEASURE_STEPS = 2, 5
+
+
+def measure(use_pallas: bool) -> float:
+    import numpy as np
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.data.reader import Batch
+    from code2vec_tpu.models.backends import create_backend
+    from code2vec_tpu.training.trainer import Trainer
+    from code2vec_tpu.vocab import SizeOnlyVocabs
+
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX='bench', DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='bfloat16', VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        TRAIN_BATCH_SIZE=BATCH_SIZE, TEST_BATCH_SIZE=BATCH_SIZE,
+        MAX_CONTEXTS=MAX_CONTEXTS, USE_PALLAS_FUSED_ENCODE=use_pallas,
+        MAX_TOKEN_VOCAB_SIZE=TOKEN_VOCAB, MAX_PATH_VOCAB_SIZE=PATH_VOCAB,
+        MAX_TARGET_VOCAB_SIZE=TARGET_VOCAB)
+    backend = create_backend(
+        config, SizeOnlyVocabs(TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB))
+    trainer = Trainer(config, backend)
+    state = trainer.init_state(seed=0)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return Batch(
+            source=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            path=rng.integers(1, PATH_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            target=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
+            mask=np.ones((BATCH_SIZE, MAX_CONTEXTS), np.float32),
+            label=rng.integers(1, TARGET_VOCAB, (BATCH_SIZE,)).astype(np.int32),
+            weight=np.ones((BATCH_SIZE,), np.float32))
+
+    batches = [make_batch() for _ in range(4)]
+    for i in range(WARMUP_STEPS):
+        state, loss = trainer.train_step(state, batches[i % len(batches)])
+        float(loss)
+    start = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, loss = trainer.train_step(state, batches[i % len(batches)])
+        float(loss)
+    elapsed = time.perf_counter() - start
+    return MEASURE_STEPS * BATCH_SIZE / elapsed
+
+
+def main() -> None:
+    import jax
+    env_platforms = os.environ.get('JAX_PLATFORMS')
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        try:
+            jax.config.update('jax_platforms', env_platforms)
+        except RuntimeError:
+            pass
+    platform = jax.devices()[0].platform.lower()
+    if not SMOKE and platform not in ('tpu', 'axon'):
+        print(json.dumps({'error': 'tpu_unavailable',
+                          'detail': f'platform={platform}'}))
+        return
+
+    results = {}
+    for variant, use_pallas in [('xla', False), ('pallas', True)]:
+        try:
+            examples_per_sec = measure(use_pallas)
+        except Exception as exc:  # a kernel compile failure IS the answer
+            print(json.dumps({'variant': variant, 'error': str(exc)[:300]}))
+            if variant == 'pallas':
+                print(json.dumps({'verdict': 'keep-xla',
+                                  'reason': 'pallas path failed'}))
+                return
+            raise
+        results[variant] = examples_per_sec
+        print(json.dumps({
+            'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
+                       else 'train_examples_per_sec_per_chip_java14m'),
+            'variant': variant,
+            'value': round(examples_per_sec, 1),
+            'unit': 'examples/sec/chip'}))
+    speedup = results['pallas'] / results['xla']
+    print(json.dumps({
+        'verdict': 'keep-pallas' if speedup > 1.02 else 'keep-xla',
+        'speedup': round(speedup, 4)}))
+
+
+if __name__ == '__main__':
+    main()
