@@ -44,12 +44,12 @@ def _trainer(data_axis, model_axis, framework='jax'):
     return Trainer(config, backend)
 
 
-def _run_steps(trainer, n=3, seed=0):
+def _run_steps(trainer, n=3, seed=0, make_batch=_make_batch):
     state = trainer.init_state(seed=123)
     rng = np.random.default_rng(seed)
     losses = []
     for _ in range(n):
-        batch = _make_batch(rng)
+        batch = make_batch(rng)
         state, loss = trainer.train_step(state, batch)
         losses.append(float(loss))
     return state, losses
@@ -149,6 +149,59 @@ def test_shard_contexts_training_matches_unsharded():
     trainer1 = Trainer(config1, backend1, mesh=mesh1)
     _, losses1 = _run_steps(trainer1)
     np.testing.assert_allclose(losses1, losses_sp, rtol=2e-4, atol=1e-5)
+
+
+def test_shard_contexts_long_bag_matches_unsharded():
+    """Long-context scaling (SURVEY.md §5): a 1024-context bag sharded
+    over the model axis (the order-free 'ring attention' analog — the
+    attention reductions compile to XLA collectives) must match the
+    unsharded numbers. This is the MAX_CONTEXTS-scaling story, not just
+    the divisibility smoke at C=8."""
+    LONG_C = 1024
+    config = _config(2, 4)
+    config.MAX_CONTEXTS = LONG_C
+    config.SHARD_CONTEXTS = True
+    vocabs = SizeOnlyVocabs(40, 12, 24)
+    trainer_sp = Trainer(config, create_backend(config, vocabs))
+
+    config1 = _config(1, 1)
+    config1.MAX_CONTEXTS = LONG_C
+    backend1 = create_backend(config1, SizeOnlyVocabs(40, 12, 24))
+    mesh1 = mesh_lib.create_mesh(config1, devices=jax.devices()[:1])
+    trainer1 = Trainer(config1, backend1, mesh=mesh1)
+
+    def make_long_batch(rng):
+        batch = _make_batch(rng, B=8, C=LONG_C)
+        # half the contexts masked: the masked-softmax denominator must
+        # psum identically across context shards
+        return batch._replace(
+            mask=(np.arange(LONG_C)[None, :] < LONG_C // 2)
+            .astype(np.float32).repeat(8, axis=0))
+
+    _, losses1 = _run_steps(trainer1, n=2, seed=7,
+                            make_batch=make_long_batch)
+    _, losses_sp = _run_steps(trainer_sp, n=2, seed=7,
+                              make_batch=make_long_batch)
+    np.testing.assert_allclose(losses1, losses_sp, rtol=2e-4, atol=1e-5)
+
+
+def test_profile_trace_capture_smoke(tmp_path):
+    """--profile (jax.profiler window inside fit): must produce a trace
+    artifact — guards the path so the on-chip profiling day isn't spent
+    debugging the harness (VERDICT r1 #2 groundwork)."""
+    config = _config(8, 1)
+    config.NUM_TRAIN_EPOCHS = 1
+    config.PROFILE_DIR = str(tmp_path / 'trace')
+    config.PROFILE_START_STEP = 1
+    config.PROFILE_NUM_STEPS = 2
+    vocabs = SizeOnlyVocabs(40, 12, 24)
+    trainer = Trainer(config, create_backend(config, vocabs))
+    state = trainer.init_state(seed=0)
+    rng = np.random.default_rng(0)
+    batches = [_make_batch(rng) for _ in range(6)]
+    trainer.fit(state, lambda epoch: iter(batches), start_epoch=0)
+    trace_files = list((tmp_path / 'trace').rglob('*'))
+    assert any(f.is_file() for f in trace_files), 'no trace artifacts'
 
 
 def test_checkpoint_metadata_mismatch_is_clear_error(tmp_path):
