@@ -74,15 +74,23 @@ class Config:
     PARAM_ROW_ALIGNMENT: int = 128
     # Host input pipeline.
     READER_PREFETCH_BATCHES: int = 8
+    # How many batches fit()/evaluate() stage onto the device ahead of the
+    # step consuming them, so host->device transfer overlaps the previous
+    # steps' compute (jax transfers are async; without staging, each
+    # step's dispatch serializes behind its own upload). 0 disables.
+    DEVICE_PREFETCH_BATCHES: int = 2
     READER_USE_NATIVE: bool = True  # use the C++ tokenizer when available
     # Tokenize the train split once into a binary cache
     # (<data>.train.c2v.tokcache/, ~12 bytes/context on disk) and stream
     # int32 tensors for every later epoch.
     TRAIN_DATA_CACHE: bool = True
-    # Experimental: use the fused Pallas encode kernel (split-TRANSFORM
-    # matmul + tanh + attention scores in one VMEM pass) for the
-    # deterministic forward (eval/predict). Enable after profiling shows
-    # the encode block bandwidth-bound on your chip.
+    # Use the fused Pallas encode kernel (split-TRANSFORM matmul + tanh +
+    # attention scores in one VMEM pass) for the deterministic forward
+    # (eval/predict). Measured on-chip at the java14m config: 0.99x vs
+    # XLA (PERF.md) — the encode block is small next to the 261K-vocab
+    # logits matmul + top-k — so this stays off by default; it is worth
+    # re-measuring for long-context configs (MAX_CONTEXTS >> 200) where
+    # the encode block dominates.
     USE_PALLAS_FUSED_ENCODE: bool = False
     # When set, capture a jax.profiler trace of a few training steps into
     # this directory (viewable with TensorBoard/Perfetto) — the step-level

@@ -437,8 +437,8 @@ class Code2VecModel:
         weight_sum = 0.0
         start_time = time.time()
         with open(log_path, 'w') as log_file:
-            for batch in eval_batches():
-                out = self.trainer.eval_step(params, batch)
+            def consume(out, batch) -> None:
+                nonlocal total, loss_sum, weight_sum
                 # loss sums are global (the jitted reduction spans all
                 # processes' rows) — accumulate, don't re-merge
                 loss_sum += float(out['loss_sum'])
@@ -462,6 +462,18 @@ class Code2VecModel:
                     elapsed = time.time() - start_time
                     self.log('Evaluated %d examples... (%d samples/sec)'
                              % (total, int(total / max(elapsed, 1e-9))))
+
+            # one-step pipeline: dispatch batch k+1 (async) BEFORE pulling
+            # batch k's outputs to host, so per-batch decode/logging
+            # overlaps device compute instead of serializing on it
+            pending = None
+            for arrays, batch in self.trainer.stage_batches(eval_batches()):
+                out = self.trainer.eval_step_placed(params, arrays)
+                if pending is not None:
+                    consume(*pending)
+                pending = (out, batch)
+            if pending is not None:
+                consume(*pending)
         if vectors_file is not None:
             vectors_file.close()
             self.log('Code vectors written to `%s`.' % vectors_path)

@@ -16,6 +16,7 @@ sharded-table gathers, sharded softmax) with no collective written by hand.
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Callable, Iterable, NamedTuple, Optional, Tuple
 
@@ -179,6 +180,46 @@ class Trainer:
                                       self.config.SHARD_CONTEXTS)
         return self._train_step(state, arrays)
 
+    def stage_batches(self, batches: Iterable[Batch]):
+        """Place batches on the device ahead of the step consuming them,
+        yielding ``(placed_arrays, batch)`` (the host Batch rides along for
+        consumers that need its strings/weights, e.g. eval decode).
+
+        jax transfers are async, so staging the next batch while the
+        current step computes overlaps the host->device copy with device
+        work instead of serializing upload -> step -> upload (through this
+        environment's device tunnel one batch upload costs ~290 ms against
+        a ~51 ms step — see benchmarks/diag_step_breakdown.py).
+        ``DEVICE_PREFETCH_BATCHES`` bounds the device memory held by staged
+        batches; 0 degenerates to place-then-consume."""
+        depth = max(0, self.config.DEVICE_PREFETCH_BATCHES)
+        if self.mesh.devices.flat[0].platform.lower() == 'cpu':
+            # XLA:CPU's in-process collectives can deadlock their 40s
+            # rendezvous when extra async placements are in flight next to
+            # a sharded program on starved hosts (observed as SIGABRT on a
+            # 1-core 8-virtual-device mesh). Host==device memory on CPU, so
+            # lookahead buys nothing there anyway.
+            depth = 0
+        shard_contexts = self.config.SHARD_CONTEXTS
+        staged = collections.deque()
+        for batch in batches:
+            staged.append((mesh_lib.shard_batch(batch.device_arrays(),
+                                                self.mesh, shard_contexts),
+                           batch))
+            if len(staged) > depth:
+                yield staged.popleft()
+        while staged:
+            yield staged.popleft()
+
+    def train_step_placed(self, state: TrainerState, arrays
+                          ) -> Tuple[TrainerState, jax.Array]:
+        """train_step over arrays already placed by ``stage_batches``."""
+        return self._train_step(state, arrays)
+
+    def eval_step_placed(self, params, arrays) -> dict:
+        """eval_step over arrays already placed by ``stage_batches``."""
+        return self._eval_step(params, arrays)
+
     def eval_step(self, params, batch: Batch) -> dict:
         arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
                                       self.config.SHARD_CONTEXTS)
@@ -234,7 +275,7 @@ class Trainer:
         profile_start = first_batch + config.PROFILE_START_STEP
         profile_stop_step = profile_start + config.PROFILE_NUM_STEPS
         for epoch in range(start_epoch, config.NUM_TRAIN_EPOCHS):
-            for batch in epoch_batches(epoch):
+            for arrays, host_batch in self.stage_batches(epoch_batches(epoch)):
                 # step-interval checkpointing fires at the TOP of the next
                 # iteration (state reflects batch_num completed steps): an
                 # interval landing on an epoch's final step must not
@@ -256,10 +297,10 @@ class Trainer:
                         profile_done = True
                         config.log('Profiler trace written to `%s`.'
                                    % config.PROFILE_DIR)
-                state, loss = self.train_step(state, batch)
+                state, loss = self._train_step(state, arrays)
                 batch_num += 1
                 window_losses.append(loss)
-                window_examples += batch.num_valid_examples
+                window_examples += host_batch.num_valid_examples
                 if batch_num % log_every == 0:
                     # device_get, not eager jnp ops: stacking mesh-sharded
                     # scalars eagerly aborts in jaxlib on CPU meshes
