@@ -122,9 +122,12 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
     if use_pallas and not apply_dropout:
         from code2vec_tpu.ops import pallas_encode
         # only on a real TPU backend: off-TPU the kernel would run in the
-        # (test-only) interpreter, far slower than the fused XLA path below
+        # (test-only) interpreter, far slower than the fused XLA path
+        # below. Gate on the DEVICE platform (tpu_backend_active), not
+        # jax.default_backend() — tunnel plugins register the backend
+        # under another name while devices report 'tpu'.
         pallas_route = (pallas_encode.PALLAS_AVAILABLE
-                        and jax.default_backend() == 'tpu')
+                        and pallas_encode.tpu_backend_active())
     if pallas_route:
         from code2vec_tpu.ops.pallas_encode import fused_context_transform
         batch, contexts = source.shape

@@ -34,6 +34,19 @@ except ImportError:  # pragma: no cover
 ROW_TILE = 512  # context rows per grid step; N is padded to a multiple
 
 
+def tpu_backend_active() -> bool:
+    """True iff the default backend's devices are real TPUs. Checks the
+    DEVICE platform, not ``jax.default_backend()``: behind device-tunnel
+    plugins the backend may register under another name (e.g. 'axon')
+    while its devices report platform 'tpu' — gating on the backend name
+    silently reroutes the kernel to the plain XLA path."""
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return False
+    return bool(devices) and devices[0].platform.lower() == 'tpu'
+
+
 def _kernel(src_ref, path_ref, tgt_ref, w_src_ref, w_path_ref, w_tgt_ref,
             attn_ref, x_ref, scores_ref):
     x = jnp.dot(src_ref[:], w_src_ref[:],
@@ -62,7 +75,7 @@ def fused_context_transform(src_e: jax.Array, path_e: jax.Array,
     correctly) everywhere.
     """
     if interpret is None:
-        interpret = jax.default_backend() != 'tpu'
+        interpret = not tpu_backend_active()
     n, token_dim = src_e.shape
     path_dim = path_e.shape[1]
     code_dim = transform.shape[1]
