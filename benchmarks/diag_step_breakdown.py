@@ -1,0 +1,122 @@
+"""Diagnose where the on-chip train-step time goes (tunnel vs compute).
+
+Round-2 context: the first driver-captured bench number was 2,420
+examples/sec/chip (0.52x V100) at ~423 ms/step, far above the ~25 ms/step
+roofline estimate (0.9 TFLOP matmul work + ~11 GB HBM traffic for the dense
+Adam update over 384M params).  This script separates:
+
+  rtt            host->device->host round-trip latency of a trivial op
+  h2d            per-step batch upload cost (numpy args vs device-resident)
+  sync-per-step  the round-1 bench's per-step float(loss) sync
+  sync-at-end    enqueue N steps, block once on the final loss
+  staged         end-to-end host batches through Trainer.stage_batches
+
+Prints one JSON line per measurement.  Run on the real chip; measured
+results are recorded in PERF.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+
+SHAPES = benchlib.JAVA14M
+WARMUP = 5
+STEPS = 20
+
+
+def main() -> None:
+    import numpy as np
+
+    import jax
+
+    benchlib.honor_env_platforms()
+    print(json.dumps({'platform': jax.devices()[0].platform.lower()}),
+          flush=True)
+
+    # --- tunnel round-trip latency on a trivial op
+    tiny = jax.jit(lambda x: x + 1)
+    v = tiny(jax.numpy.zeros(()))
+    float(v)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        float(tiny(v))
+    rtt = (time.perf_counter() - t0) / 20
+    print(json.dumps({'measure': 'rtt_trivial_op_ms',
+                      'value': round(rtt * 1e3, 2)}), flush=True)
+
+    config = benchlib.headline_config(SHAPES)
+    trainer, state = benchlib.build_trainer(config, SHAPES)
+    host_batches = benchlib.random_batches(SHAPES, 4)
+
+    # --- upload cost for one batch
+    t0 = time.perf_counter()
+    dev_batches = [jax.block_until_ready(arrays) for arrays, _ in
+                   trainer.stage_batches(iter(host_batches))]
+    h2d = (time.perf_counter() - t0) / len(host_batches)
+    print(json.dumps({'measure': 'h2d_one_batch_ms',
+                      'value': round(h2d * 1e3, 2)}), flush=True)
+
+    def timed(label, step_fn, feeds, sync_each):
+        nonlocal state
+        for i in range(WARMUP):
+            state, loss = step_fn(state, feeds[i % len(feeds)])
+            float(loss)
+        t0 = time.perf_counter()
+        last = None
+        for i in range(STEPS):
+            state, last = step_fn(state, feeds[i % len(feeds)])
+            if sync_each:
+                float(last)
+        if not sync_each:
+            float(last)
+        dt = (time.perf_counter() - t0) / STEPS
+        print(json.dumps(
+            {'measure': label, 'value': round(dt * 1e3, 2),
+             'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
+            flush=True)
+
+    timed('step_ms_hostargs_sync_each', trainer.train_step, host_batches,
+          True)
+    timed('step_ms_devargs_sync_each', trainer.train_step_placed,
+          dev_batches, True)
+    timed('step_ms_devargs_sync_end', trainer.train_step_placed,
+          dev_batches, False)
+    timed('step_ms_hostargs_sync_end', trainer.train_step, host_batches,
+          False)
+
+    # --- is the per-batch upload bandwidth- or latency-bound?  One
+    # contiguous array of the same total byte size:
+    total_bytes = sum(np.asarray(a).nbytes for a in host_batches[0])
+    flat = np.zeros(total_bytes // 4, np.int32)
+    jax.block_until_ready(jax.device_put(flat))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.block_until_ready(jax.device_put(flat))
+    print(json.dumps({'measure': 'h2d_packed_same_bytes_ms',
+                      'value': round((time.perf_counter() - t0) / 5 * 1e3,
+                                     2)}), flush=True)
+
+    # --- does stage_batches overlap uploads behind compute end-to-end?
+    fresh = benchlib.random_batches(SHAPES, STEPS, seed=1)
+    last = None
+    t0 = time.perf_counter()
+    for arrays, _b in trainer.stage_batches(iter(fresh)):
+        state, last = trainer.train_step_placed(state, arrays)
+    float(last)
+    dt = (time.perf_counter() - t0) / STEPS
+    print(json.dumps(
+        {'measure': 'step_ms_staged_hostargs_end_to_end',
+         'value': round(dt * 1e3, 2),
+         'examples_per_sec': round(SHAPES.batch_size / dt, 1)}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

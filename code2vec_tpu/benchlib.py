@@ -1,0 +1,122 @@
+"""Shared harness pieces for the repo-root ``bench.py`` and the scripts
+under ``benchmarks/`` — one definition of the java14m headline
+configuration (reference config.py:47-70), the synthetic batch maker, and
+the platform workaround, so a change to the benchmark configuration cannot
+silently apply to some scripts and not others."""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+V100_BASELINE_EXAMPLES_PER_SEC = 4700.0  # reference README.md:69,127
+
+
+class BenchShapes(NamedTuple):
+    token_vocab: int
+    path_vocab: int
+    target_vocab: int
+    batch_size: int
+    max_contexts: int
+
+
+JAVA14M = BenchShapes(token_vocab=1301136, path_vocab=911417,
+                      target_vocab=261245, batch_size=1024, max_contexts=200)
+# Tiny shapes so a harness can be validated on CPU; metric names must be
+# renamed by the caller so a smoke line is never mistaken for a real one.
+SMOKE_SHAPES = BenchShapes(token_vocab=1000, path_vocab=1000,
+                           target_vocab=500, batch_size=64, max_contexts=16)
+
+
+def smoke_requested() -> bool:
+    return os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
+
+
+def bench_steps(smoke: bool):
+    """(warmup_steps, measure_steps) shared by every timed harness.
+    60 measure steps keep the one amortized tunnel round-trip <2.5% at
+    ~51 ms/step."""
+    return (2, 5) if smoke else (10, 60)
+
+
+def honor_env_platforms() -> None:
+    """Honor the caller's JAX_PLATFORMS even though the sitecustomize
+    preimport pins a platform list before this process's env is read (same
+    guard as cli.py) — without this, CPU smoke runs hang whenever the TPU
+    tunnel is wedged."""
+    import jax
+    env_platforms = os.environ.get('JAX_PLATFORMS')
+    if env_platforms and jax.config.jax_platforms != env_platforms:
+        try:
+            jax.config.update('jax_platforms', env_platforms)
+        except RuntimeError:
+            pass  # backends already initialized
+
+
+def headline_config(shapes: BenchShapes, **overrides):
+    """The java14m benchmark Config (bfloat16 compute, jax backend)."""
+    from code2vec_tpu.config import Config
+    kwargs = dict(
+        TRAIN_DATA_PATH_PREFIX='bench', DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='bfloat16', VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        TRAIN_BATCH_SIZE=shapes.batch_size, TEST_BATCH_SIZE=shapes.batch_size,
+        MAX_CONTEXTS=shapes.max_contexts,
+        MAX_TOKEN_VOCAB_SIZE=shapes.token_vocab,
+        MAX_PATH_VOCAB_SIZE=shapes.path_vocab,
+        MAX_TARGET_VOCAB_SIZE=shapes.target_vocab)
+    kwargs.update(overrides)
+    return Config(**kwargs)
+
+
+def _make_trainer(config, shapes: BenchShapes):
+    from code2vec_tpu.models.backends import create_backend
+    from code2vec_tpu.training.trainer import Trainer
+    from code2vec_tpu.vocab import SizeOnlyVocabs
+    backend = create_backend(
+        config, SizeOnlyVocabs(shapes.token_vocab, shapes.path_vocab,
+                               shapes.target_vocab))
+    return Trainer(config, backend)
+
+
+def build_trainer(config, shapes: BenchShapes):
+    """(trainer, initial training state) for the benchmark Config."""
+    trainer = _make_trainer(config, shapes)
+    return trainer, trainer.init_state(seed=0)
+
+
+def build_eval_trainer(config, shapes: BenchShapes):
+    """(trainer, sharded params) WITHOUT optimizer state — eval-only
+    harnesses must not burn device memory on ~3 GB of Adam moments they
+    never read."""
+    import jax
+
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    trainer = _make_trainer(config, shapes)
+    params = mesh_lib.shard_params(trainer.backend.init(
+        jax.random.PRNGKey(0)), trainer.mesh)
+    return trainer, params
+
+
+def random_batches(shapes: BenchShapes, n: int, seed: int = 0):
+    """``n`` synthetic host batches of uniform random indices."""
+    import numpy as np
+
+    from code2vec_tpu.data.reader import Batch
+    rng = np.random.default_rng(seed)
+    batch, contexts = shapes.batch_size, shapes.max_contexts
+    return [Batch(
+        source=rng.integers(1, shapes.token_vocab,
+                            (batch, contexts)).astype(np.int32),
+        path=rng.integers(1, shapes.path_vocab,
+                          (batch, contexts)).astype(np.int32),
+        target=rng.integers(1, shapes.token_vocab,
+                            (batch, contexts)).astype(np.int32),
+        mask=np.ones((batch, contexts), np.float32),
+        label=rng.integers(1, shapes.target_vocab, (batch,)).astype(np.int32),
+        weight=np.ones((batch,), np.float32)) for _ in range(n)]
+
+
+def staged(trainer, host_batches):
+    """Mesh-aware device placement via the trainer's own staging path (a
+    bare jax.device_put would pin every array to device 0 and bill a
+    redistribution to each timed step on multi-device meshes)."""
+    return [arrays for arrays, _ in trainer.stage_batches(iter(host_batches))]
