@@ -64,15 +64,17 @@ def main() -> None:
     print(json.dumps({'measure': 'h2d_one_batch_ms',
                       'value': round(h2d * 1e3, 2)}), flush=True)
 
-    def timed(label, step_fn, feeds, sync_each):
-        nonlocal state
+    def timed(label, step_fn, init_state, feeds, sync_each):
+        """Warmup + measure one step function; returns the final state so
+        variants can keep training off their own state."""
+        st = init_state
         for i in range(WARMUP):
-            state, loss = step_fn(state, feeds[i % len(feeds)])
+            st, loss = step_fn(st, feeds[i % len(feeds)])
             float(loss)
         t0 = time.perf_counter()
         last = None
         for i in range(STEPS):
-            state, last = step_fn(state, feeds[i % len(feeds)])
+            st, last = step_fn(st, feeds[i % len(feeds)])
             if sync_each:
                 float(last)
         if not sync_each:
@@ -82,15 +84,16 @@ def main() -> None:
             {'measure': label, 'value': round(dt * 1e3, 2),
              'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
             flush=True)
+        return st
 
-    timed('step_ms_hostargs_sync_each', trainer.train_step, host_batches,
-          True)
-    timed('step_ms_devargs_sync_each', trainer.train_step_placed,
-          dev_batches, True)
-    timed('step_ms_devargs_sync_end', trainer.train_step_placed,
-          dev_batches, False)
-    timed('step_ms_hostargs_sync_end', trainer.train_step, host_batches,
-          False)
+    state = timed('step_ms_hostargs_sync_each', trainer.train_step, state,
+                  host_batches, True)
+    state = timed('step_ms_devargs_sync_each', trainer.train_step_placed,
+                  state, dev_batches, True)
+    state = timed('step_ms_devargs_sync_end', trainer.train_step_placed,
+                  state, dev_batches, False)
+    state = timed('step_ms_hostargs_sync_end', trainer.train_step, state,
+                  host_batches, False)
 
     # --- is the per-batch upload bandwidth- or latency-bound?  One
     # contiguous array of the same total byte size:
@@ -116,6 +119,29 @@ def main() -> None:
         {'measure': 'step_ms_staged_hostargs_end_to_end',
          'value': round(dt * 1e3, 2),
          'examples_per_sec': round(SHAPES.batch_size / dt, 1)}), flush=True)
+
+    # --- config-variant A/Bs, one fresh trainer each. The previous
+    # variant's 4.6 GB state is freed before the next is built; memory
+    # stays within one trainer + one variant at a time.
+    state = dev_batches = fresh = trainer = None  # noqa: F841
+    variants = [
+        # how much of the step is the dropout mask's threefry RNG?
+        # (B=1024, C=200, 3d=640 -> 131M bernoulli draws per step)
+        ('step_ms_devargs_sync_end_no_dropout',
+         dict(DROPOUT_KEEP_RATE=1.0)),
+        # lazy (sparse-row) Adam for the token/path tables: does cutting
+        # the optimizer's O(vocab) HBM walk to O(touched rows) pay?
+        ('step_ms_devargs_sync_end_lazy_adam',
+         dict(LAZY_EMBEDDING_ADAM=True)),
+    ]
+    for label, overrides in variants:
+        variant_config = benchlib.headline_config(SHAPES, **overrides)
+        variant_trainer, variant_state = benchlib.build_trainer(
+            variant_config, SHAPES)
+        feeds = benchlib.staged(variant_trainer, host_batches)
+        timed(label, variant_trainer.train_step_placed, variant_state,
+              feeds, False)
+        variant_trainer = variant_state = feeds = None  # noqa: F841
 
 
 if __name__ == '__main__':

@@ -60,6 +60,19 @@ class Config:
     # Learning rate for Adam (reference uses tf.train.AdamOptimizer defaults,
     # tensorflow_model.py:232 -> lr=0.001).
     LEARNING_RATE: float = 0.001
+    # Update the token/path embedding tables with lazy (sparse-row) Adam
+    # (tf.contrib.opt.LazyAdamOptimizer semantics) instead of dense Adam:
+    # moments decay only for rows present in the batch, and the
+    # optimizer's HBM traffic scales with the batch (<=614K touched rows)
+    # instead of the 2.2M-row vocabulary. The DEFAULT dense Adam is the
+    # reference-parity behavior (TF1's AdamOptimizer decays moments
+    # densely even for IndexedSlices gradients); the lazy variant is a
+    # deliberate throughput/semantics trade-off for giant tables and stays
+    # off until the on-chip A/B records a win and a quality check passes
+    # (ops/lazy_adam.py, benchmarks/diag_step_breakdown.py). Dense
+    # parameters (TRANSFORM/ATTENTION/target table) keep optax Adam
+    # either way.
+    LAZY_EMBEDDING_ADAM: bool = False
     # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
     # model mesh axis — order-free sequence parallelism for large bags: the
     # attention softmax reductions become XLA collectives (SURVEY.md §5

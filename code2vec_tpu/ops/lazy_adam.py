@@ -1,0 +1,144 @@
+"""Lazy (sparse-row) Adam for the giant embedding tables.
+
+Why: at java14m scale the token/path tables hold 283M of the model's 384M
+parameters (reference config.py:61-64), but one batch touches at most
+B*C*2 + B*C = 614,400 rows — under 28% of the rows, with heavy repetition.
+A dense Adam update walks params+mu+nu for EVERY row every step (~8 GB of
+HBM traffic); updating only the touched rows makes the optimizer cost
+proportional to the batch, not the vocabulary.
+
+Semantics: `tf.contrib.opt.LazyAdamOptimizer` — moments decay and rows
+move only when present in the batch, with bias correction from the GLOBAL
+step:
+
+    lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
+    m    = b1 * m + (1 - b1) * g          (touched rows only)
+    v    = b2 * v + (1 - b2) * g^2        (touched rows only)
+    p    = p - lr_t * m / (sqrt(v) + eps)
+
+NOTE this is deliberately NOT the reference's exact optimizer: the
+reference's `tf.compat.v1.train.AdamOptimizer` decays m/v DENSELY over the
+whole table and applies a dense var update even for IndexedSlices
+gradients (`_apply_sparse_shared`: `m.assign(m * beta1)` then scatter-add)
+— which is what the default dense optax Adam reproduces. The lazy variant
+is the standard throughput trade-off for giant embedding tables (rows
+without gradient keep stale moments and skip their momentum drift); it is
+opt-in (`LAZY_EMBEDDING_ADAM`) and off by default.
+
+Duplicate rows: ``dense_grad`` is the scatter-added gradient array, so
+every duplicate of a row reads the SAME aggregated gradient and computes
+the SAME updated row — the scatter writes are idempotent and the result is
+deterministic regardless of duplicate count or order. This also makes the
+formulation pjit-safe: with the batch sharded over the data axis and the
+table row-sharded over the model axis, XLA routes the row updates to the
+owning shards.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def sparse_row_adam(table: jax.Array, mu: jax.Array, nu: jax.Array,
+                    dense_grad: jax.Array, rows: jax.Array, *,
+                    learning_rate: float, step: jax.Array,
+                    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One lazy-Adam update of ``table`` at ``rows`` (may repeat).
+
+    ``step`` is the 1-based global step (int scalar) for bias correction;
+    ``dense_grad`` is the full-shape gradient array (only its touched rows
+    are read). Returns (new_table, new_mu, new_nu); untouched rows of all
+    three are bit-identical to the inputs.
+    """
+    rows = rows.reshape(-1)
+    g = dense_grad[rows]                               # (N, d)
+    m = b1 * mu[rows] + (1.0 - b1) * g
+    v = b2 * nu[rows] + (1.0 - b2) * (g * g)
+    t = step.astype(jnp.float32)
+    lr_t = learning_rate * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+    new_rows = table[rows] - lr_t * m / (jnp.sqrt(v) + eps)
+    return (table.at[rows].set(new_rows),
+            mu.at[rows].set(m),
+            nu.at[rows].set(v))
+
+
+class LazyAdamState(NamedTuple):
+    """Optimizer state for LazyEmbeddingAdam. ``mu``/``nu`` are dicts keyed
+    by the table's canonical parameter name so the mesh layout machinery
+    (mesh.sharding_for_tree matches leaves by name) row-shards the moments
+    exactly like the tables they mirror."""
+    dense: Any   # optax state over {'target_embedding','transform','attention'}
+    mu: dict     # {'token_embedding': ..., 'path_embedding': ...}
+    nu: dict
+
+
+class LazyEmbeddingAdam:
+    """Adam with TF1 sparse-row updates for the token/path tables and
+    ordinary optax Adam for everything dense (see module docstring).
+
+    Backend-agnostic: parameter trees are viewed through the backend's
+    canonical named layout (``named_params`` / ``from_canonical``), so the
+    raw-pytree jax backend and the flax backend share this code.
+    """
+
+    DENSE_KEYS = ('target_embedding', 'transform', 'attention')
+    SPARSE_KEYS = ('token_embedding', 'path_embedding')
+
+    def __init__(self, learning_rate: float, backend,
+                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+        self.learning_rate = learning_rate
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.backend = backend
+        self._dense = optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+    def init(self, params) -> LazyAdamState:
+        named = self.backend.named_params(params)
+        dense = {k: getattr(named, k) for k in self.DENSE_KEYS}
+        zeros = {k: jnp.zeros_like(getattr(named, k))
+                 for k in self.SPARSE_KEYS}
+        return LazyAdamState(
+            dense=self._dense.init(dense),
+            mu=zeros,
+            nu={k: jnp.zeros_like(v) for k, v in zeros.items()})
+
+    def update_sparse(self, params, grads, opt_state: LazyAdamState,
+                      step: jax.Array, source: jax.Array, path: jax.Array,
+                      target: jax.Array):
+        """One optimizer step. ``step`` is the completed-steps counter
+        (0-based); bias correction uses step+1. ``source``/``path``/
+        ``target`` are the batch index arrays that define the touched rows.
+        Returns (new_params, new_opt_state)."""
+        named_p = self.backend.named_params(params)
+        named_g = self.backend.named_params(grads)
+        dense_p = {k: getattr(named_p, k) for k in self.DENSE_KEYS}
+        dense_g = {k: getattr(named_g, k) for k in self.DENSE_KEYS}
+        updates, new_dense = self._dense.update(dense_g, opt_state.dense,
+                                                dense_p)
+        dense_new = optax.apply_updates(dense_p, updates)
+
+        t = step + 1
+        token_rows = jnp.concatenate([source.reshape(-1),
+                                      target.reshape(-1)])
+        new_tok, m_tok, v_tok = sparse_row_adam(
+            named_p.token_embedding, opt_state.mu['token_embedding'],
+            opt_state.nu['token_embedding'], named_g.token_embedding,
+            token_rows, learning_rate=self.learning_rate, step=t,
+            b1=self.b1, b2=self.b2, eps=self.eps)
+        new_path, m_path, v_path = sparse_row_adam(
+            named_p.path_embedding, opt_state.mu['path_embedding'],
+            opt_state.nu['path_embedding'], named_g.path_embedding,
+            path.reshape(-1), learning_rate=self.learning_rate, step=t,
+            b1=self.b1, b2=self.b2, eps=self.eps)
+
+        new_named = dict(dense_new, token_embedding=new_tok,
+                         path_embedding=new_path)
+        new_params = self.backend.from_canonical(new_named)
+        new_opt = LazyAdamState(
+            dense=new_dense,
+            mu={'token_embedding': m_tok, 'path_embedding': m_path},
+            nu={'token_embedding': v_tok, 'path_embedding': v_path})
+        return new_params, new_opt

@@ -63,7 +63,15 @@ class Trainer:
                 % (config.PARAM_ROW_ALIGNMENT, model_size))
         # Reference uses tf.train.AdamOptimizer() defaults
         # (tensorflow_model.py:232): lr=1e-3, b1=0.9, b2=0.999, eps=1e-8.
-        self.optimizer = optax.adam(config.LEARNING_RATE)
+        # LAZY_EMBEDDING_ADAM swaps in LazyAdam-style sparse-row updates
+        # for the token/path tables (a throughput trade-off, NOT the
+        # reference's semantics — see ops/lazy_adam.py); dense params keep
+        # optax Adam either way.
+        if config.LAZY_EMBEDDING_ADAM:
+            from code2vec_tpu.ops.lazy_adam import LazyEmbeddingAdam
+            self.optimizer = LazyEmbeddingAdam(config.LEARNING_RATE, backend)
+        else:
+            self.optimizer = optax.adam(config.LEARNING_RATE)
         self._build_steps()
 
     # ----------------------------------------------------------- jit steps
@@ -71,6 +79,8 @@ class Trainer:
         backend = self.backend
         optimizer = self.optimizer
         top_k = self.config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
+
+        lazy = self.config.LAZY_EMBEDDING_ADAM
 
         def train_step(state: TrainerState, arrays) -> Tuple[TrainerState, jax.Array]:
             dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -80,9 +90,15 @@ class Trainer:
                 return loss
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
-            updates, new_opt_state = optimizer.update(grads, state.opt_state,
-                                                      state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            if lazy:
+                source, path, target = arrays[0], arrays[1], arrays[2]
+                new_params, new_opt_state = optimizer.update_sparse(
+                    state.params, grads, state.opt_state, state.step,
+                    source, path, target)
+            else:
+                updates, new_opt_state = optimizer.update(
+                    grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
             new_state = TrainerState(params=new_params,
                                      opt_state=new_opt_state,
                                      step=state.step + 1, rng=state.rng)

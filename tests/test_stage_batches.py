@@ -1,0 +1,80 @@
+"""Device staging (Trainer.stage_batches) semantics: order preservation,
+host-batch ride-along, and the CPU lookahead gate (the XLA:CPU in-process
+collective rendezvous can deadlock with extra async placements in flight,
+so on CPU meshes the depth must degenerate to place-then-consume)."""
+import numpy as np
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import Batch
+from code2vec_tpu.models.backends import create_backend
+from code2vec_tpu.training.trainer import Trainer
+from code2vec_tpu.vocab import SizeOnlyVocabs
+
+
+def make_trainer(**overrides):
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX='unused', DL_FRAMEWORK='jax',
+        VERBOSE_MODE=0, READER_USE_NATIVE=False, MAX_CONTEXTS=4,
+        TRAIN_BATCH_SIZE=8, TEST_BATCH_SIZE=8, COMPUTE_DTYPE='float32',
+        MAX_TOKEN_VOCAB_SIZE=32, MAX_PATH_VOCAB_SIZE=16,
+        MAX_TARGET_VOCAB_SIZE=16, TOKEN_EMBEDDINGS_SIZE=8,
+        PATH_EMBEDDINGS_SIZE=8, CODE_VECTOR_SIZE=24,
+        TARGET_EMBEDDINGS_SIZE=24, **overrides)
+    backend = create_backend(config, SizeOnlyVocabs(32, 16, 16))
+    return Trainer(config, backend)
+
+
+def make_batches(n, batch=8, contexts=4):
+    rng = np.random.default_rng(0)
+    return [Batch(
+        source=rng.integers(1, 32, (batch, contexts)).astype(np.int32),
+        path=rng.integers(1, 16, (batch, contexts)).astype(np.int32),
+        target=rng.integers(1, 32, (batch, contexts)).astype(np.int32),
+        mask=np.ones((batch, contexts), np.float32),
+        label=np.full((batch,), i % 16, np.int32),
+        weight=np.ones((batch,), np.float32)) for i in range(n)]
+
+
+def test_stage_batches_preserves_order_and_batches():
+    trainer = make_trainer(DEVICE_PREFETCH_BATCHES=2)
+    batches = make_batches(5)
+    out = list(trainer.stage_batches(iter(batches)))
+    assert len(out) == 5
+    for i, (arrays, host_batch) in enumerate(out):
+        assert host_batch is batches[i]
+        # placed arrays hold the same values as the host batch
+        np.testing.assert_array_equal(np.asarray(arrays[0]),
+                                      batches[i].source)
+        np.testing.assert_array_equal(np.asarray(arrays[4]), batches[i].label)
+
+
+def test_stage_batches_cpu_lookahead_is_disabled():
+    """On a CPU mesh the generator must not place ahead of consumption:
+    after pulling item k, exactly k+1 placements may have happened."""
+    trainer = make_trainer(DEVICE_PREFETCH_BATCHES=4)
+    placed_log = []
+    orig = trainer.mesh  # the gate keys off the mesh devices
+    assert orig.devices.flat[0].platform.lower() == 'cpu'
+
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    real_shard_batch = mesh_lib.shard_batch
+
+    def counting_shard_batch(arrays, mesh, shard_contexts):
+        placed_log.append(1)
+        return real_shard_batch(arrays, mesh, shard_contexts)
+
+    mesh_lib.shard_batch, saved = counting_shard_batch, real_shard_batch
+    try:
+        gen = trainer.stage_batches(iter(make_batches(4)))
+        next(gen)
+        assert sum(placed_log) == 1  # no lookahead on CPU
+        next(gen)
+        assert sum(placed_log) == 2
+        gen.close()
+    finally:
+        mesh_lib.shard_batch = saved
+
+
+def test_stage_batches_empty_iterator():
+    trainer = make_trainer()
+    assert list(trainer.stage_batches(iter([]))) == []
