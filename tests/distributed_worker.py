@@ -25,6 +25,8 @@ def main() -> None:
                         help='0 = evaluate the seed-42 init params only')
     parser.add_argument('--data_cache', type=int, default=1,
                         help='1 = per-process token cache, 0 = streaming')
+    parser.add_argument('--model_axis', type=int, default=1,
+                        help='mesh model-axis size (TP across processes)')
     args = parser.parse_args()
 
     import jax
@@ -46,7 +48,11 @@ def main() -> None:
         READER_USE_NATIVE=False, LEARNING_RATE=0.01,
         # 1 exercises the per-process token cache (.tokcache.p<i>of<n>),
         # 0 the streaming fixed-step multi-host path
-        TRAIN_DATA_CACHE=bool(args.data_cache))
+        TRAIN_DATA_CACHE=bool(args.data_cache),
+        # model_axis > 1: row-sharded tables + sharded softmax/top-k with
+        # collectives that cross the process boundary (PARAM_ROW_ALIGNMENT
+        # must divide evenly; 8 covers the tiny test vocabs)
+        MESH_MODEL_AXIS_SIZE=args.model_axis, PARAM_ROW_ALIGNMENT=8)
     model = Code2VecModel(config)
 
     record = {

@@ -47,13 +47,14 @@ def _worker_env() -> dict:
 
 
 def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
-                 timeout: float = 420.0, data_cache: int = 1) -> list:
+                 timeout: float = 420.0, data_cache: int = 1,
+                 model_axis: int = 1) -> list:
     port = _free_port()
     outs = []
     procs = []
     for pid in range(num_processes):
         out = tmp_path / (f'result_p{num_processes}_{pid}_{train_epochs}'
-                          f'_{data_cache}.json')
+                          f'_{data_cache}_m{model_axis}.json')
         outs.append(out)
         procs.append(subprocess.Popen(
             [sys.executable, WORKER,
@@ -63,7 +64,8 @@ def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
              '--prefix', str(prefix),
              '--out', str(out),
              '--train_epochs', str(train_epochs),
-             '--data_cache', str(data_cache)],
+             '--data_cache', str(data_cache),
+             '--model_axis', str(model_axis)],
             env=_worker_env(), cwd=str(tmp_path),  # eval log.txt goes here
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     records = []
@@ -127,3 +129,32 @@ def test_two_process_train_and_eval_completes(tmp_path, dataset, data_cache):
     # eval must agree exactly
     assert records[0]['topk_acc'] == records[1]['topk_acc']
     assert records[0]['f1'] == records[1]['f1']
+
+
+def test_two_process_tensor_parallel_eval_matches(tmp_path, dataset):
+    """TP across the process boundary: a 2x2 (data, model) mesh over two
+    processes row-shards the embedding tables and column-shards the softmax
+    so the top-k merge and metric collectives cross processes. Metrics are
+    mesh-independent, so the result must equal the model_axis=1 run."""
+    tp = _run_cluster(tmp_path, dataset, num_processes=2, train_epochs=0,
+                      model_axis=2)
+    dp = _run_cluster(tmp_path, dataset, num_processes=2, train_epochs=0)
+
+    assert tp[0]['topk_acc'] == tp[1]['topk_acc']
+    np.testing.assert_array_equal(tp[0]['topk_acc'], dp[0]['topk_acc'])
+    assert tp[0]['precision'] == dp[0]['precision']
+    assert tp[0]['recall'] == dp[0]['recall']
+    assert tp[0]['f1'] == dp[0]['f1']
+    np.testing.assert_allclose(tp[0]['loss'], dp[0]['loss'], rtol=1e-5)
+
+
+def test_two_process_tensor_parallel_train_completes(tmp_path, dataset):
+    """One epoch of training on the cross-process 2x2 mesh (DP gradient
+    psum + row-sharded table updates + sharded-softmax backward all with
+    real process boundaries) completes and both processes agree."""
+    records = _run_cluster(tmp_path, dataset, num_processes=2,
+                           train_epochs=1, model_axis=2)
+    assert [r['trained_epochs'] for r in records] == [1, 1]
+    for r in records:
+        assert r['loss'] is not None and np.isfinite(r['loss'])
+    assert records[0]['topk_acc'] == records[1]['topk_acc']
