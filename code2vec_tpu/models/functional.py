@@ -162,9 +162,18 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
         jnp.maximum(mask.astype(jnp.float32), _MASK_MIN))
     attention_weights = jax.nn.softmax(scores, axis=1)            # (B, C)
 
-    code_vectors = jnp.einsum(
-        'bc,bcd->bd', attention_weights, x.astype(jnp.float32),
-        precision=jax.lax.Precision.HIGHEST)                      # (B, D)
+    if x.dtype == jnp.float32:
+        code_vectors = jnp.einsum(
+            'bc,bcd->bd', attention_weights, x,
+            precision=jax.lax.Precision.HIGHEST)                  # (B, D)
+    else:
+        # bf16 compute mode: keep the weighted sum on the MXU fast path
+        # with fp32 accumulation instead of round-tripping a full
+        # (B, C, D) fp32 copy of the activations through HBM (~315 MB at
+        # the java14m configuration). Softmax itself stays fp32 above.
+        code_vectors = jnp.einsum(
+            'bc,bcd->bd', attention_weights.astype(x.dtype), x,
+            preferred_element_type=jnp.float32)                   # (B, D)
     return code_vectors, attention_weights
 
 
@@ -195,9 +204,16 @@ def weighted_ce_sums(logits: jax.Array, label: jax.Array,
                      weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """(weighted CE sum, weight sum) — the single definition of the
     cross-entropy used by both the training loss and the streaming eval
-    loss (which aggregates the sums exactly across batches and hosts)."""
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(log_probs, label[:, None], axis=1)[:, 0]
+    loss (which aggregates the sums exactly across batches and hosts).
+
+    Written as ``logsumexp(logits) - logits[label]`` rather than indexing
+    into ``log_softmax(logits)``: mathematically identical, but it reduces
+    to per-example scalars without materializing a second (B, target_vocab)
+    fp32 array — at java14m scale that intermediate is ~1 GB of HBM
+    round-trip per step."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)          # (B,)
+    picked = jnp.take_along_axis(logits, label[:, None], axis=1)[:, 0]
+    ce = lse - picked
     return (ce * weight).sum(), weight.sum()
 
 
