@@ -138,3 +138,51 @@ def test_full_pipeline_extract_train_release_predict(tmp_path):
     # prediction names are subtoken lists (reference common.py:135-158)
     top_names = [p['name'] for p in method_result.predictions]
     assert ['get', 'width'] in top_names[:3], top_names
+
+
+CS_TEMPLATES = [
+    ('Get{F}', 'int Get{F}() {{ return this.{f}; }}'),
+    ('Set{F}', 'void Set{F}(int value) {{ this.{f} = value; }}'),
+    ('Has{F}', 'bool Has{F}() {{ return this.{f} > 0; }}'),
+    ('Reset{F}', 'void Reset{F}() {{ this.{f} = 0; }}'),
+]
+
+
+def _write_cs_project(root, n_classes: int, seed_offset: int = 0) -> None:
+    os.makedirs(root, exist_ok=True)
+    for i in range(n_classes):
+        field = FIELDS[(i + seed_offset) % len(FIELDS)]
+        methods = '\n'.join(
+            body.format(F=field.capitalize(), f=field)
+            for _name, body in CS_TEMPLATES)
+        with open(os.path.join(root, f'C{seed_offset}_{i}.cs'), 'w') as f:
+            f.write('class C%d_%d {\n  int %s;\n%s\n}\n'
+                    % (seed_offset, i, field, methods))
+
+
+def test_full_pipeline_csharp(tmp_path):
+    """BASELINE.json acceptance config: 'C# method-name prediction
+    (CSharpExtractor -> path_context_reader)' — the documented
+    preprocess_csharp.sh flow end to end into training + eval."""
+    _write_cs_project(tmp_path / 'dataset' / 'train', n_classes=30)
+    _write_cs_project(tmp_path / 'dataset' / 'train', n_classes=30,
+                      seed_offset=1)
+    _write_cs_project(tmp_path / 'dataset' / 'val', n_classes=4)
+    _write_cs_project(tmp_path / 'dataset' / 'test', n_classes=4,
+                      seed_offset=2)
+    _run(['bash', os.path.join(REPO, 'scripts', 'preprocess_csharp.sh')],
+         cwd=str(tmp_path), EXTRACTOR=EXTRACTOR, NUM_THREADS='8')
+    data_prefix = tmp_path / 'data' / 'csharp' / 'csharp'
+    for suffix in ['.train.c2v', '.val.c2v', '.test.c2v', '.dict.c2v']:
+        assert os.path.getsize(str(data_prefix) + suffix) > 0, suffix
+
+    out = _run([sys.executable, '-m', 'code2vec_tpu.cli',
+                '--data', str(data_prefix),
+                '--test', str(data_prefix) + '.val.c2v',
+                '--save', str(tmp_path / 'models' / 'cs' / 'saved_model'),
+                '--epochs', '12', '--batch-size', '16',
+                '--framework', 'jax', '--dtype', 'float32'],
+               cwd=str(tmp_path), timeout=540)
+    f1_scores = [float(m) for m in re.findall(r'F1: ([0-9.]+)', out)]
+    assert f1_scores, 'no eval F1 reported:\n' + out[-2000:]
+    assert f1_scores[-1] > 0.5, out[-2000:]
