@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# One-shot on-chip capture orchestrator.
+#
+# The TPU tunnel here wedges for hours at a time; when it comes back the
+# healthy window may be short. This script probes first, then runs every
+# pending capture in priority order, each under its own hard timeout,
+# appending raw results to benchmarks/results/capture_<date>.jsonl so a
+# mid-run wedge still leaves durable artifacts.
+#
+#   bash benchmarks/capture_all.sh
+set -u
+cd "$(dirname "$0")/.."
+
+STAMP=$(date -u +%Y-%m-%dT%H%MZ)
+OUT=benchmarks/results/capture_${STAMP}.jsonl
+mkdir -p benchmarks/results
+
+probe() {
+  BENCH_CHILD=probe timeout 90 python bench.py 2>/dev/null
+}
+
+run_stage() {  # run_stage <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "--- stage: ${name}" >&2
+  local start=$(date +%s)
+  local out
+  out=$(timeout "${tmo}" "$@" 2>/dev/null)
+  local rc=$?
+  local secs=$(( $(date +%s) - start ))
+  # keep only JSON lines; tag each with the stage
+  while IFS= read -r line; do
+    case "${line}" in
+      '{'*) printf '{"stage": "%s", "rc": %d, "secs": %d, "data": %s}\n' \
+                   "${name}" "${rc}" "${secs}" "${line}" >> "${OUT}" ;;
+    esac
+  done <<< "${out}"
+  if [ ${rc} -ne 0 ] && [ -z "${out}" ]; then
+    printf '{"stage": "%s", "rc": %d, "secs": %d, "data": null}\n' \
+           "${name}" "${rc}" "${secs}" >> "${OUT}"
+  fi
+  return ${rc}
+}
+
+if ! probe | grep -q '"probe"'; then
+  echo "tunnel wedged (probe failed); nothing captured" >&2
+  exit 3
+fi
+echo "tunnel healthy; capturing to ${OUT}" >&2
+
+# Priority order: the decisions blocked on each artifact, most important
+# first. Re-probe between stages: a wedge mid-sequence should stop cheaply
+# rather than eat the remaining timeouts.
+run_stage bench 900 python bench.py
+probe >/dev/null || { echo "wedged after bench" >&2; exit 3; }
+run_stage diag 900 python benchmarks/diag_step_breakdown.py
+probe >/dev/null || { echo "wedged after diag" >&2; exit 3; }
+run_stage profile 600 python benchmarks/capture_profile.py
+probe >/dev/null || { echo "wedged after profile" >&2; exit 3; }
+run_stage pallas_ab 900 python benchmarks/bench_pallas_encode.py
+probe >/dev/null || { echo "wedged after pallas_ab" >&2; exit 3; }
+BENCH_CONTEXTS=1024 run_stage pallas_ab_c1024 900 \
+  python benchmarks/bench_pallas_encode.py
+
+echo "capture complete: ${OUT}" >&2
