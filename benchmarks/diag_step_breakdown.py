@@ -143,6 +143,38 @@ def main() -> None:
               feeds, False)
         variant_trainer = variant_state = feeds = None  # noqa: F841
 
+    # --- top-k micro A/B: monolithic lax.top_k vs the exact grouped
+    # two-stage merge over java14m-shaped logits. Chained by feeding each
+    # round's max value back into the input (the tunnel's async dispatch
+    # makes unchained timings meaningless — see PERF.md).
+    import jax.numpy as jnp
+
+    from code2vec_tpu.ops.topk import grouped_top_k
+
+    logits = jax.device_put(np.random.default_rng(0).normal(
+        size=(SHAPES.batch_size, 261248)).astype(np.float32))
+    jax.block_until_ready(logits)
+
+    def bench_topk(label, fn):
+        stepped = jax.jit(lambda x, t: fn(x + t * 0.0, 10))
+        token = jnp.zeros((), jnp.float32)
+        for _ in range(3):
+            values, _ = stepped(logits, token)
+            token = values[0, 0]
+        float(token)
+        t0 = time.perf_counter()
+        token = jnp.zeros((), jnp.float32)
+        for _ in range(10):
+            values, _ = stepped(logits, token)
+            token = values[0, 0]
+        float(token)
+        dt = (time.perf_counter() - t0) / 10
+        print(json.dumps({'measure': label, 'value': round(dt * 1e3, 2)}),
+              flush=True)
+
+    bench_topk('topk_ms_lax_b1024_v261k', jax.lax.top_k)
+    bench_topk('topk_ms_grouped_b1024_v261k', grouped_top_k)
+
 
 if __name__ == '__main__':
     main()

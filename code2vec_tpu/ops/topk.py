@@ -27,6 +27,43 @@ from jax.sharding import Mesh, PartitionSpec as P
 from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
+def grouped_top_k(x: jax.Array, k: int, group_size: int = 2048
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """EXACT top-k over the last axis via a two-stage group merge.
+
+    Stage 1 takes top-k within each ``group_size`` slice of the vocab
+    axis; stage 2 takes top-k over the groups*k candidates. Every global
+    top-k element is necessarily in its group's top-k, so the result is
+    exact — and tie-breaking matches ``lax.top_k`` (lowest index wins):
+    within a group by lax.top_k itself, across groups because candidates
+    are ordered by group and groups cover ascending index ranges.
+
+    Motivation: one monolithic top-k over a (B, 261K) logits matrix makes
+    the selection network as wide as the vocab; two narrow stages map
+    better onto the VPU. Whether that wins on a given chip is measured,
+    not assumed (benchmarks/diag_step_breakdown.py stages a lax-vs-grouped
+    A/B); callers opt in explicitly.
+    """
+    v = x.shape[-1]
+    if v <= group_size or k >= group_size:
+        return jax.lax.top_k(x, k)
+    lead = x.shape[:-1]
+    groups = -(-v // group_size)
+    pad = groups * group_size - v
+    if pad:
+        pad_widths = [(0, 0)] * len(lead) + [(0, pad)]
+        x = jnp.pad(x, pad_widths, constant_values=-jnp.inf)
+    grouped = x.reshape(*lead, groups, group_size)
+    group_values, group_indices = jax.lax.top_k(grouped, k)  # (..., G, k)
+    base = (jnp.arange(groups, dtype=group_indices.dtype)
+            * group_size)[:, None]
+    cand_values = group_values.reshape(*lead, groups * k)
+    cand_indices = (group_indices + base).reshape(*lead, groups * k)
+    final_values, positions = jax.lax.top_k(cand_values, k)
+    final_indices = jnp.take_along_axis(cand_indices, positions, axis=-1)
+    return final_values, final_indices
+
+
 def sharded_top_k(logits: jax.Array, k: int, mesh: Mesh
                   ) -> Tuple[jax.Array, jax.Array]:
     """Top-k over the last (vocab) axis of ``logits`` laid out

@@ -78,3 +78,39 @@ def test_stage_batches_cpu_lookahead_is_disabled():
 def test_stage_batches_empty_iterator():
     trainer = make_trainer()
     assert list(trainer.stage_batches(iter([]))) == []
+
+
+class TestGroupedTopK:
+    """grouped_top_k must match lax.top_k exactly, ties included."""
+
+    def _check(self, x, k, group_size):
+        import jax
+        import jax.numpy as jnp
+        from code2vec_tpu.ops.topk import grouped_top_k
+        want_v, want_i = jax.lax.top_k(jnp.asarray(x), k)
+        got_v, got_i = grouped_top_k(jnp.asarray(x), k,
+                                     group_size=group_size)
+        np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+        np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+
+    def test_random_matches_lax(self):
+        rng = np.random.default_rng(0)
+        self._check(rng.normal(size=(7, 1000)).astype(np.float32), 10, 64)
+
+    def test_uneven_group_padding(self):
+        rng = np.random.default_rng(1)
+        self._check(rng.normal(size=(3, 997)).astype(np.float32), 10, 64)
+
+    def test_ties_break_by_lowest_index(self):
+        # many duplicate values spread across group boundaries
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 5, size=(5, 512)).astype(np.float32)
+        self._check(x, 16, 32)
+
+    def test_small_vocab_falls_back(self):
+        rng = np.random.default_rng(3)
+        self._check(rng.normal(size=(2, 50)).astype(np.float32), 10, 64)
+
+    def test_k_not_exceeding_group(self):
+        rng = np.random.default_rng(4)
+        self._check(rng.normal(size=(2, 300)).astype(np.float32), 40, 32)
