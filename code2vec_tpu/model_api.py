@@ -23,7 +23,7 @@ import numpy as np
 from code2vec_tpu import common, metrics_writer
 from code2vec_tpu.checkpoints import CheckpointStore
 from code2vec_tpu.config import Config
-from code2vec_tpu.data.reader import Batch, EstimatorAction, PathContextReader
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
 from code2vec_tpu.metrics import (SubtokensEvaluationMetric,
                                   TopKAccuracyEvaluationMetric,
                                   decode_topk_batch)

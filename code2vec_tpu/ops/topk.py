@@ -16,7 +16,6 @@ kernel does the standard two-stage merge instead:
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
