@@ -1,0 +1,58 @@
+"""On-chip A/B: threefry vs hardware-RNG (`rbg`) dropout mask.
+
+The 2026-07-29 diag capture showed dropout's ~131M threefry draws cost
+~4.8 ms of the 49.25 ms java14m train step (PERF.md). This measures the
+same devargs/sync-at-end step with `DROPOUT_PRNG_IMPL='rbg'` against the
+default, to decide whether the knob should become the TPU default.
+
+Prints one JSON line per measurement (same chained methodology as
+benchmarks/diag_step_breakdown.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+
+SHAPES = benchlib.JAVA14M
+WARMUP = 5
+STEPS = 20
+
+
+def measure(label: str, **overrides) -> None:
+    config = benchlib.headline_config(SHAPES, **overrides)
+    trainer, state = benchlib.build_trainer(config, SHAPES)
+    feeds = benchlib.staged(trainer, benchlib.random_batches(SHAPES, 4))
+    for i in range(WARMUP):
+        state, loss = trainer.train_step_placed(state, feeds[i % len(feeds)])
+        float(loss)
+    t0 = time.perf_counter()
+    last = None
+    for i in range(STEPS):
+        state, last = trainer.train_step_placed(state, feeds[i % len(feeds)])
+    float(last)
+    dt = (time.perf_counter() - t0) / STEPS
+    print(json.dumps({'measure': label, 'value': round(dt * 1e3, 2),
+                      'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
+          flush=True)
+
+
+def main() -> None:
+    import jax
+
+    benchlib.honor_env_platforms()
+    print(json.dumps({'platform': jax.devices()[0].platform.lower()}),
+          flush=True)
+    measure('step_ms_dropout_threefry')
+    measure('step_ms_dropout_rbg', DROPOUT_PRNG_IMPL='rbg')
+
+
+if __name__ == '__main__':
+    main()

@@ -131,8 +131,13 @@ def main() -> None:
          dict(DROPOUT_KEEP_RATE=1.0)),
         # lazy (sparse-row) Adam for the token/path tables: does cutting
         # the optimizer's O(vocab) HBM walk to O(touched rows) pay?
+        # (measured 2026-07-29: 90.85 ms vs dense 49.25 — it does not)
         ('step_ms_devargs_sync_end_lazy_adam',
          dict(LAZY_EMBEDDING_ADAM=True)),
+        # hardware RngBitGenerator for the dropout mask vs the ~4.8 ms of
+        # threefry the no-dropout variant exposed
+        ('step_ms_devargs_sync_end_rbg_dropout',
+         dict(DROPOUT_PRNG_IMPL='rbg')),
     ]
     for label, overrides in variants:
         variant_config = benchlib.headline_config(SHAPES, **overrides)

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel; when a healthy window opens, run the pending
+# round-2b captures (stages not covered by the 13:49Z sweep), then exit.
+#
+#   bash benchmarks/watch_and_capture.sh [max_wait_seconds]
+#
+# Stages:
+#   rbg_dropout     threefry-vs-rbg dropout A/B (bench_rbg_dropout.py)
+#   pallas_c1024    long-context Pallas A/B, 1800 s budget (its 900 s
+#                   stage timed out on compile in the first sweep)
+set -u
+cd "$(dirname "$0")/.."
+
+MAX_WAIT=${1:-10800}
+STAMP=$(date -u +%Y-%m-%dT%H%MZ)
+OUT=benchmarks/results/capture_${STAMP}_r2b.jsonl
+mkdir -p benchmarks/results
+
+probe() {
+  BENCH_CHILD=probe timeout 90 python bench.py 2>/dev/null | grep -q '"probe"'
+}
+
+run_stage() {  # run_stage <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "--- stage: ${name}" >&2
+  local start=$(date +%s)
+  local out
+  out=$(timeout "${tmo}" "$@" 2>/dev/null)
+  local rc=$?
+  local secs=$(( $(date +%s) - start ))
+  while IFS= read -r line; do
+    case "${line}" in
+      '{'*) printf '{"stage": "%s", "rc": %d, "secs": %d, "data": %s}\n' \
+                   "${name}" "${rc}" "${secs}" "${line}" >> "${OUT}" ;;
+    esac
+  done <<< "${out}"
+  if [ ${rc} -ne 0 ] && [ -z "${out}" ]; then
+    printf '{"stage": "%s", "rc": %d, "secs": %d, "data": null}\n' \
+           "${name}" "${rc}" "${secs}" >> "${OUT}"
+  fi
+  return ${rc}
+}
+
+deadline=$(( $(date +%s) + MAX_WAIT ))
+until probe; do
+  if [ "$(date +%s)" -ge "${deadline}" ]; then
+    echo "gave up waiting for a healthy tunnel after ${MAX_WAIT}s" >&2
+    exit 3
+  fi
+  sleep 180
+done
+echo "tunnel healthy; capturing to ${OUT}" >&2
+
+run_stage rbg_dropout 900 python benchmarks/bench_rbg_dropout.py
+probe || { echo "wedged after rbg_dropout" >&2; exit 3; }
+BENCH_CONTEXTS=1024 run_stage pallas_c1024 1800 \
+  python benchmarks/bench_pallas_encode.py
+
+echo "capture complete: ${OUT}" >&2

@@ -53,6 +53,15 @@ class Config:
     # 'bfloat16' casts activations/matmuls for the MXU and keeps the loss in
     # fp32. 'float32' matches reference numerics bit-closely for tests.
     COMPUTE_DTYPE: str = 'bfloat16'
+    # PRNG implementation for the dropout mask. 'threefry2x32' is JAX's
+    # default counter-based generator — portable across platforms, but the
+    # (B, C, 3d) mask is ~131M draws/step at the java14m config, ~10% of
+    # the measured train step (PERF.md). 'rbg' derives a per-step key for
+    # the hardware RngBitGenerator instead — same keep-probability, a
+    # different (still deterministic, seed-keyed) random stream. The
+    # checkpointed key stays threefry either way; the rbg key is derived
+    # inside the step, so checkpoints are unaffected by this knob.
+    DROPOUT_PRNG_IMPL: str = 'threefry2x32'
     # Mesh shape: (data, model). data axis = DP (gradient psum over ICI);
     # model axis = row-sharded embedding tables + column-sharded softmax.
     MESH_DATA_AXIS_SIZE: int = -1   # -1: all devices on the data axis
@@ -341,6 +350,9 @@ class Config:
         if self.COMPUTE_DTYPE not in {'bfloat16', 'float32'}:
             raise ValueError("config.COMPUTE_DTYPE must be in "
                              "{'bfloat16', 'float32'}.")
+        if self.DROPOUT_PRNG_IMPL not in {'threefry2x32', 'rbg'}:
+            raise ValueError("config.DROPOUT_PRNG_IMPL must be in "
+                             "{'threefry2x32', 'rbg'}.")
 
     def __iter__(self) -> Iterator[Tuple[str, Any]]:
         for field in dataclasses.fields(self):

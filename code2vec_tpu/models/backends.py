@@ -68,6 +68,7 @@ class JaxBackend:
             params, source, path, target, mask, label, weight,
             dropout_rng=dropout_rng,
             dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
+            dropout_prng_impl=self.config.DROPOUT_PRNG_IMPL,
             dtype=self.dtype, num_valid_targets=self.num_valid_targets)
 
     def forward(self, params, arrays):

@@ -98,6 +98,7 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
            target: jax.Array, mask: jax.Array, *,
            dropout_rng: Optional[jax.Array] = None,
            dropout_keep_rate: float = 1.0,
+           dropout_prng_impl: str = 'threefry2x32',
            dtype: jnp.dtype = jnp.float32,
            use_pallas: bool = False
            ) -> Tuple[jax.Array, jax.Array]:
@@ -144,6 +145,14 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
         context_embed = jnp.concatenate(
             [source_embed, path_embed, target_embed], axis=-1)  # (B, C, 3d)
         if apply_dropout:
+            if dropout_prng_impl == 'rbg':
+                # rewrap onto the hardware RngBitGenerator: the incoming
+                # (checkpoint-portable) threefry key seeds 4 words of rbg
+                # state, so the big (B, C, 3d) mask draw costs hardware
+                # RNG throughput instead of ~131M threefry rounds
+                dropout_rng = jax.random.wrap_key_data(
+                    jax.random.bits(dropout_rng, (4,), jnp.uint32),
+                    impl='rbg')
             keep_mask = jax.random.bernoulli(
                 dropout_rng, dropout_keep_rate, context_embed.shape)
             context_embed = jnp.where(
@@ -222,6 +231,7 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  weight: jax.Array, *,
                  dropout_rng: Optional[jax.Array] = None,
                  dropout_keep_rate: float = 1.0,
+                 dropout_prng_impl: str = 'threefry2x32',
                  dtype: jnp.dtype = jnp.float32,
                  num_valid_targets: Optional[int] = None):
     """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
@@ -229,7 +239,8 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
     per-example weight plays that role: padded rows have weight 0)."""
     code_vectors, _ = encode(
         params, source, path, target, mask, dropout_rng=dropout_rng,
-        dropout_keep_rate=dropout_keep_rate, dtype=dtype)
+        dropout_keep_rate=dropout_keep_rate,
+        dropout_prng_impl=dropout_prng_impl, dtype=dtype)
     logits = compute_logits(params, code_vectors, dtype=dtype,
                             num_valid_targets=num_valid_targets)
     ce_sum, weight_sum = weighted_ce_sums(logits, label, weight)

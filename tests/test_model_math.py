@@ -116,6 +116,33 @@ def test_dropout_train_vs_eval(tiny_params):
     np.testing.assert_allclose(np.asarray(code_eval), np.asarray(code_keep1))
 
 
+def test_dropout_rbg_impl(tiny_params):
+    """DROPOUT_PRNG_IMPL='rbg' draws the mask from the hardware generator:
+    still deterministic per key, still a genuine dropout mask, but a
+    different stream than threefry (no cross-impl reproducibility claim)."""
+    rng = np.random.default_rng(6)
+    source, path, target, mask = _random_batch(rng)
+
+    def enc(key, impl):
+        out, _ = functional.encode(
+            tiny_params, source, path, target, mask,
+            dropout_rng=jax.random.PRNGKey(key), dropout_keep_rate=0.5,
+            dropout_prng_impl=impl)
+        return np.asarray(out)
+
+    code_eval, _ = functional.encode(tiny_params, source, path, target, mask)
+    a, b = enc(0, 'rbg'), enc(0, 'rbg')
+    np.testing.assert_allclose(a, b)                 # keyed-deterministic
+    assert not np.allclose(a, np.asarray(code_eval))  # dropout applied
+    assert not np.allclose(a, enc(1, 'rbg'))          # key-sensitive
+    # under jit too (the trainer always runs it jitted)
+    jitted = jax.jit(lambda k: functional.encode(
+        tiny_params, source, path, target, mask, dropout_rng=k,
+        dropout_keep_rate=0.5, dropout_prng_impl='rbg')[0])
+    np.testing.assert_allclose(np.asarray(jitted(jax.random.PRNGKey(0))), a,
+                               rtol=1e-6)
+
+
 def test_bfloat16_compute_close_to_fp32(tiny_params):
     rng = np.random.default_rng(5)
     source, path, target, mask = _random_batch(rng)
