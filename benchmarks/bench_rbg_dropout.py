@@ -52,6 +52,9 @@ def main() -> None:
           flush=True)
     measure('step_ms_dropout_threefry')
     measure('step_ms_dropout_rbg', DROPOUT_PRNG_IMPL='rbg')
+    measure('step_ms_bf16_mu', ADAM_MU_DTYPE='bfloat16')
+    measure('step_ms_rbg_and_bf16_mu',
+            DROPOUT_PRNG_IMPL='rbg', ADAM_MU_DTYPE='bfloat16')
 
 
 if __name__ == '__main__':

@@ -82,6 +82,15 @@ class Config:
     # parameters (TRANSFORM/ATTENTION/target table) keep optax Adam
     # either way.
     LAZY_EMBEDDING_ADAM: bool = False
+    # Storage dtype for Adam's FIRST moment (optax mu_dtype). 'bfloat16'
+    # halves the first-moment HBM traffic (~1.5 GB/step read+write at
+    # java14m's 384M params) in the HBM-bound update (PERF.md roofline);
+    # the second moment and params stay fp32. A measured-throughput /
+    # update-precision trade-off, off by default. Changing it changes the
+    # optimizer-state dtype, so training resume requires the same setting
+    # (checkpoint restore targets adapt via eval_shape; a mismatched
+    # resume fails with an explicit shape/dtype error).
+    ADAM_MU_DTYPE: str = 'float32'
     # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
     # model mesh axis — order-free sequence parallelism for large bags: the
     # attention softmax reductions become XLA collectives (SURVEY.md §5
@@ -353,6 +362,14 @@ class Config:
         if self.DROPOUT_PRNG_IMPL not in {'threefry2x32', 'rbg'}:
             raise ValueError("config.DROPOUT_PRNG_IMPL must be in "
                              "{'threefry2x32', 'rbg'}.")
+        if self.ADAM_MU_DTYPE not in {'float32', 'bfloat16'}:
+            raise ValueError("config.ADAM_MU_DTYPE must be in "
+                             "{'float32', 'bfloat16'}.")
+        if self.LAZY_EMBEDDING_ADAM and self.ADAM_MU_DTYPE != 'float32':
+            raise ValueError(
+                'config.ADAM_MU_DTYPE applies to the dense optax Adam only; '
+                'LAZY_EMBEDDING_ADAM keeps fp32 moments (the sparse-row '
+                'update does not implement reduced-precision mu).')
 
     def __iter__(self) -> Iterator[Tuple[str, Any]]:
         for field in dataclasses.fields(self):

@@ -68,10 +68,20 @@ class Trainer:
         # reference's semantics — see ops/lazy_adam.py); dense params keep
         # optax Adam either way.
         if config.LAZY_EMBEDDING_ADAM:
+            if config.ADAM_MU_DTYPE != 'float32':
+                raise ValueError(
+                    'ADAM_MU_DTYPE applies to the dense optax Adam only; '
+                    'LAZY_EMBEDDING_ADAM keeps fp32 moments.')
             from code2vec_tpu.ops.lazy_adam import LazyEmbeddingAdam
             self.optimizer = LazyEmbeddingAdam(config.LEARNING_RATE, backend)
         else:
-            self.optimizer = optax.adam(config.LEARNING_RATE)
+            # ADAM_MU_DTYPE='bfloat16' stores the first moment in bf16 —
+            # an HBM-traffic knob for the HBM-bound dense update (config
+            # comment + PERF.md); None keeps optax's param-dtype default
+            mu_dtype = (jnp.bfloat16
+                        if config.ADAM_MU_DTYPE == 'bfloat16' else None)
+            self.optimizer = optax.adam(config.LEARNING_RATE,
+                                        mu_dtype=mu_dtype)
         self._build_steps()
 
     # ----------------------------------------------------------- jit steps

@@ -114,3 +114,4 @@ class TestGroupedTopK:
     def test_k_not_exceeding_group(self):
         rng = np.random.default_rng(4)
         self._check(rng.normal(size=(2, 300)).astype(np.float32), 40, 32)
+
