@@ -42,6 +42,11 @@ def grouped_top_k(x: jax.Array, k: int, group_size: int = 2048
     better onto the VPU. Whether that wins on a given chip is measured,
     not assumed (benchmarks/diag_step_breakdown.py stages a lax-vs-grouped
     A/B); callers opt in explicitly.
+
+    MEASURED VERDICT (2026-07-29, v5e-class chip, PERF.md): 119.3 ms vs
+    lax.top_k's 24.8 ms at (1024, 261K), k=10 — XLA's monolithic top-k
+    wins 4.8×; nothing routes here in production. Retained as a tested,
+    documented negative result.
     """
     v = x.shape[-1]
     if v <= group_size or k >= group_size:
