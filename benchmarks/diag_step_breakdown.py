@@ -152,6 +152,41 @@ def main() -> None:
               feeds, False)
         variant_trainer = variant_state = feeds = None  # noqa: F841
 
+    # --- DIAGNOSTIC (not a product knob): how much of the step is the
+    # embedding backward (gather-grad -> scatter-adds into the 1.3M/911K
+    # tables)? stop_gradient on the tables removes exactly that from the
+    # backward while the forward AND the dense Adam walk over the full
+    # tables stay; baseline minus this = the scatter/gather-backward cost
+    # the cost-analysis roofline can't itemize.
+    import optax
+
+    frozen_config = benchlib.headline_config(SHAPES)
+    frozen_trainer, frozen_state = benchlib.build_trainer(
+        frozen_config, SHAPES)
+    feeds = benchlib.staged(frozen_trainer, host_batches)
+    backend = frozen_trainer.backend
+    frozen_opt = optax.adam(frozen_config.LEARNING_RATE)
+
+    def frozen_tables_step(state, arrays):
+        def loss_fn(params):
+            stopped = params._replace(
+                token_embedding=jax.lax.stop_gradient(params.token_embedding),
+                path_embedding=jax.lax.stop_gradient(params.path_embedding))
+            loss, _aux = backend.loss_fn(stopped, arrays, jax.random.fold_in(
+                state.rng, state.step))
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = frozen_opt.update(grads, state.opt_state,
+                                             state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return state._replace(params=new_params, opt_state=new_opt,
+                              step=state.step + 1), loss
+
+    frozen_jit = jax.jit(frozen_tables_step, donate_argnums=(0,))
+    timed('step_ms_devargs_sync_end_frozen_tables', frozen_jit,
+          frozen_state, feeds, False)
+    frozen_trainer = frozen_state = feeds = None  # noqa: F841
+
     # --- top-k micro A/B: monolithic lax.top_k vs the exact grouped
     # two-stage merge over java14m-shaped logits. Chained by feeding each
     # round's max value back into the input (the tunnel's async dispatch
