@@ -55,5 +55,9 @@ run_stage rbg_dropout 900 python benchmarks/bench_rbg_dropout.py
 probe || { echo "wedged after rbg_dropout" >&2; exit 3; }
 BENCH_CONTEXTS=1024 run_stage pallas_c1024 1800 \
   python benchmarks/bench_pallas_encode.py
+probe || { echo "wedged after pallas_c1024" >&2; exit 3; }
+# diagnostics last: re-runs the full breakdown incl. the new
+# frozen-tables (embedding-backward isolation) and bf16-mu variants
+run_stage diag 1200 python benchmarks/diag_step_breakdown.py
 
 echo "capture complete: ${OUT}" >&2
