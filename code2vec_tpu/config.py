@@ -214,6 +214,13 @@ class Config:
                             type=int, default=None, metavar='N',
                             help='additionally checkpoint every N train '
                                  'steps (async), bounding preemption loss')
+        parser.add_argument('--dropout-prng', dest='dropout_prng_impl',
+                            choices=['threefry2x32', 'rbg'], default=None,
+                            help='PRNG for the dropout mask; rbg uses the '
+                                 'hardware generator (PERF.md)')
+        parser.add_argument('--adam-mu-dtype', dest='adam_mu_dtype',
+                            choices=['float32', 'bfloat16'], default=None,
+                            help='storage dtype for Adam\'s first moment')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -253,6 +260,10 @@ class Config:
             self.PROFILE_DIR = parsed.profile_dir
         if parsed.save_every_steps is not None:
             self.SAVE_EVERY_N_STEPS = parsed.save_every_steps
+        if parsed.dropout_prng_impl:
+            self.DROPOUT_PRNG_IMPL = parsed.dropout_prng_impl
+        if parsed.adam_mu_dtype:
+            self.ADAM_MU_DTYPE = parsed.adam_mu_dtype
         return self
 
     # ------------------------------------------------------- derived props

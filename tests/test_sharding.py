@@ -25,7 +25,7 @@ def _make_batch(rng, B=16, C=8, Vt=40, Vp=12):
                  label=label, weight=weight)
 
 
-def _config(data_axis, model_axis, framework='jax'):
+def _config(data_axis, model_axis, framework='jax', **overrides):
     return Config(
         TRAIN_DATA_PATH_PREFIX='unused', DL_FRAMEWORK=framework,
         COMPUTE_DTYPE='float32', MAX_CONTEXTS=8, TRAIN_BATCH_SIZE=16,
@@ -34,11 +34,11 @@ def _config(data_axis, model_axis, framework='jax'):
         MAX_TOKEN_VOCAB_SIZE=40, MAX_PATH_VOCAB_SIZE=12,
         MAX_TARGET_VOCAB_SIZE=24, TOKEN_EMBEDDINGS_SIZE=8,
         PATH_EMBEDDINGS_SIZE=8, CODE_VECTOR_SIZE=24,
-        TARGET_EMBEDDINGS_SIZE=24, LEARNING_RATE=0.01)
+        TARGET_EMBEDDINGS_SIZE=24, LEARNING_RATE=0.01, **overrides)
 
 
-def _trainer(data_axis, model_axis, framework='jax'):
-    config = _config(data_axis, model_axis, framework)
+def _trainer(data_axis, model_axis, framework='jax', **overrides):
+    config = _config(data_axis, model_axis, framework, **overrides)
     vocabs = SizeOnlyVocabs(40, 12, 24)
     backend = create_backend(config, vocabs)
     return Trainer(config, backend)
@@ -245,3 +245,22 @@ def test_flax_backend_shards_too():
     trainer = _trainer(4, 2, framework='flax')
     _, losses = _run_steps(trainer, n=2)
     assert all(np.isfinite(losses))
+
+
+def test_bf16_mu_matches_layout_on_tp_mesh():
+    """ADAM_MU_DTYPE='bfloat16' on a (4, 2) mesh: the bf16 first moment
+    must mirror the row-sharded table layout (mu sharded like params) and
+    training must still run."""
+    import jax.numpy as jnp
+
+    trainer = _trainer(4, 2, ADAM_MU_DTYPE='bfloat16')
+    state, losses = _run_steps(trainer, n=2)
+    assert np.isfinite(losses).all()
+
+    mu = state.opt_state[0].mu
+    leaves = jax.tree_util.tree_leaves(mu)
+    assert {leaf.dtype for leaf in leaves} == {np.dtype(jnp.bfloat16)}
+    # the token table's mu shards over 'model' rows exactly like the param
+    token_mu = mu.token_embedding
+    token_param = state.params.token_embedding
+    assert token_mu.sharding.spec == token_param.sharding.spec
