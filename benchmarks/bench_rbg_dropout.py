@@ -21,9 +21,12 @@ if REPO not in sys.path:
 
 from code2vec_tpu import benchlib  # noqa: E402
 
-SHAPES = benchlib.JAVA14M
-WARMUP = 5
-STEPS = 20
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+# Shared methodology: one end-of-chain sync amortizes the ~70 ms tunnel RTT
+# to <2.5%/step only at the benchlib step counts (10 warmup / 60 measured);
+# hardcoding fewer steps made ms/step incomparable with the diag table.
+WARMUP, STEPS = benchlib.bench_steps(SMOKE)
 
 
 def measure(label: str, **overrides) -> None:
@@ -39,6 +42,8 @@ def measure(label: str, **overrides) -> None:
         state, last = trainer.train_step_placed(state, feeds[i % len(feeds)])
     float(last)
     dt = (time.perf_counter() - t0) / STEPS
+    if SMOKE:
+        label += '_SMOKE_ONLY'  # never mistakable for a java14m capture
     print(json.dumps({'measure': label, 'value': round(dt * 1e3, 2),
                       'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
           flush=True)

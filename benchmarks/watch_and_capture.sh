@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Poll the TPU tunnel; when a healthy window opens, run the pending
-# round-2b captures (stages not covered by the 13:49Z sweep), then exit.
+# round-3 captures, then exit.
 #
 #   bash benchmarks/watch_and_capture.sh [max_wait_seconds]
 #
-# Stages:
-#   rbg_dropout     threefry-vs-rbg dropout A/B (bench_rbg_dropout.py)
+# Stages (ordered by VERDICT r2 priority):
+#   headline        a fresh bench.py headline capture (short inner budget —
+#                   the probe loop here already did the waiting)
+#   rbg_dropout     threefry-vs-rbg dropout A/B + bf16-mu combos
+#   embed_grad      dense/sorted/dedup table-gradient A/B, uniform+zipf
+#   diag            step breakdown incl. frozen-tables (scatter isolation)
 #   pallas_c1024    long-context Pallas A/B, 1800 s budget (its 900 s
 #                   stage timed out on compile in the first sweep)
 set -u
@@ -13,7 +17,7 @@ cd "$(dirname "$0")/.."
 
 MAX_WAIT=${1:-10800}
 STAMP=$(date -u +%Y-%m-%dT%H%MZ)
-OUT=benchmarks/results/capture_${STAMP}_r2b.jsonl
+OUT=benchmarks/results/capture_${STAMP}_r3.jsonl
 mkdir -p benchmarks/results
 
 probe() {
@@ -25,7 +29,10 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   echo "--- stage: ${name}" >&2
   local start=$(date +%s)
   local out
-  out=$(timeout "${tmo}" "$@" 2>/dev/null)
+  # Keep stage stderr: a failed unattended stage with no diagnostic is
+  # useless when the healthy window it burned won't come back for hours.
+  local errlog="${OUT%.jsonl}.${name}.stderr.log"
+  out=$(timeout "${tmo}" "$@" 2>>"${errlog}")
   local rc=$?
   local secs=$(( $(date +%s) - start ))
   while IFS= read -r line; do
@@ -51,13 +58,17 @@ until probe; do
 done
 echo "tunnel healthy; capturing to ${OUT}" >&2
 
+BENCH_TOTAL_BUDGET=600 run_stage headline 700 python bench.py
+probe || { echo "wedged after headline" >&2; exit 3; }
 run_stage rbg_dropout 900 python benchmarks/bench_rbg_dropout.py
 probe || { echo "wedged after rbg_dropout" >&2; exit 3; }
+run_stage embed_grad 1500 python benchmarks/bench_embed_grad.py
+probe || { echo "wedged after embed_grad" >&2; exit 3; }
+# frozen-tables (embedding-backward isolation) and the other breakdown
+# variants
+run_stage diag 1200 python benchmarks/diag_step_breakdown.py
+probe || { echo "wedged after diag" >&2; exit 3; }
 BENCH_CONTEXTS=1024 run_stage pallas_c1024 1800 \
   python benchmarks/bench_pallas_encode.py
-probe || { echo "wedged after pallas_c1024" >&2; exit 3; }
-# diagnostics last: re-runs the full breakdown incl. the new
-# frozen-tables (embedding-backward isolation) and bf16-mu variants
-run_stage diag 1200 python benchmarks/diag_step_breakdown.py
 
 echo "capture complete: ${OUT}" >&2

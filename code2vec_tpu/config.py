@@ -91,6 +91,14 @@ class Config:
     # (checkpoint restore targets adapt via eval_shape; a mismatched
     # resume fails with an explicit shape/dtype error).
     ADAM_MU_DTYPE: str = 'float32'
+    # Backward-pass strategy for the token/path table gradients
+    # (ops/embed_grad.py): 'dense' leaves the B*C-row scatter-add to XLA;
+    # 'sorted' sorts the index stream so duplicate row hits are adjacent;
+    # 'dedup' additionally pre-combines duplicates with a segmented scan so
+    # each table row is written at most once. Numerically equivalent up to
+    # fp summation order; default decided by the on-chip A/B
+    # (benchmarks/bench_embed_grad.py, PERF.md).
+    EMBED_GRAD_IMPL: str = 'dense'
     # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
     # model mesh axis — order-free sequence parallelism for large bags: the
     # attention softmax reductions become XLA collectives (SURVEY.md §5
@@ -221,6 +229,11 @@ class Config:
         parser.add_argument('--adam-mu-dtype', dest='adam_mu_dtype',
                             choices=['float32', 'bfloat16'], default=None,
                             help='storage dtype for Adam\'s first moment')
+        parser.add_argument('--embed-grad', dest='embed_grad_impl',
+                            choices=['dense', 'sorted', 'dedup'],
+                            default=None,
+                            help='token/path table gradient strategy '
+                                 '(ops/embed_grad.py, PERF.md)')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -264,6 +277,8 @@ class Config:
             self.DROPOUT_PRNG_IMPL = parsed.dropout_prng_impl
         if parsed.adam_mu_dtype:
             self.ADAM_MU_DTYPE = parsed.adam_mu_dtype
+        if parsed.embed_grad_impl:
+            self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
         return self
 
     # ------------------------------------------------------- derived props
@@ -373,6 +388,9 @@ class Config:
         if self.DROPOUT_PRNG_IMPL not in {'threefry2x32', 'rbg'}:
             raise ValueError("config.DROPOUT_PRNG_IMPL must be in "
                              "{'threefry2x32', 'rbg'}.")
+        if self.EMBED_GRAD_IMPL not in {'dense', 'sorted', 'dedup'}:
+            raise ValueError("config.EMBED_GRAD_IMPL must be in "
+                             "{'dense', 'sorted', 'dedup'}.")
         if self.ADAM_MU_DTYPE not in {'float32', 'bfloat16'}:
             raise ValueError("config.ADAM_MU_DTYPE must be in "
                              "{'float32', 'bfloat16'}.")

@@ -69,7 +69,8 @@ class JaxBackend:
             dropout_rng=dropout_rng,
             dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
             dropout_prng_impl=self.config.DROPOUT_PRNG_IMPL,
-            dtype=self.dtype, num_valid_targets=self.num_valid_targets)
+            dtype=self.dtype, num_valid_targets=self.num_valid_targets,
+            embed_grad_impl=self.config.EMBED_GRAD_IMPL)
 
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]

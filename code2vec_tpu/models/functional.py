@@ -100,7 +100,8 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
            dropout_keep_rate: float = 1.0,
            dropout_prng_impl: str = 'threefry2x32',
            dtype: jnp.dtype = jnp.float32,
-           use_pallas: bool = False
+           use_pallas: bool = False,
+           embed_grad_impl: str = 'dense'
            ) -> Tuple[jax.Array, jax.Array]:
     """Bag-of-contexts → (code_vectors (B, D) fp32, attention (B, C) fp32).
 
@@ -111,12 +112,15 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
     forward through the experimental fused kernel
     (ops/pallas_encode.py); the dropout path always uses plain jnp.
     """
-    source_embed = jnp.take(params.token_embedding, source,
-                            axis=0).astype(dtype)       # (B, C, d)
-    path_embed = jnp.take(params.path_embedding, path,
-                          axis=0).astype(dtype)          # (B, C, d)
-    target_embed = jnp.take(params.token_embedding, target,
-                            axis=0).astype(dtype)        # (B, C, d)
+    # take_rows == jnp.take for the default 'dense'; other impls reshape
+    # the backward scatter-add (ops/embed_grad.py, Config.EMBED_GRAD_IMPL)
+    from code2vec_tpu.ops.embed_grad import take_rows
+    source_embed = take_rows(params.token_embedding, source,
+                             impl=embed_grad_impl).astype(dtype)  # (B, C, d)
+    path_embed = take_rows(params.path_embedding, path,
+                           impl=embed_grad_impl).astype(dtype)    # (B, C, d)
+    target_embed = take_rows(params.token_embedding, target,
+                             impl=embed_grad_impl).astype(dtype)  # (B, C, d)
 
     apply_dropout = dropout_rng is not None and dropout_keep_rate < 1.0
     pallas_route = False
@@ -233,14 +237,16 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  dropout_keep_rate: float = 1.0,
                  dropout_prng_impl: str = 'threefry2x32',
                  dtype: jnp.dtype = jnp.float32,
-                 num_valid_targets: Optional[int] = None):
+                 num_valid_targets: Optional[int] = None,
+                 embed_grad_impl: str = 'dense'):
     """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
     divides the CE sum by the dynamic batch size; with static shapes the
     per-example weight plays that role: padded rows have weight 0)."""
     code_vectors, _ = encode(
         params, source, path, target, mask, dropout_rng=dropout_rng,
         dropout_keep_rate=dropout_keep_rate,
-        dropout_prng_impl=dropout_prng_impl, dtype=dtype)
+        dropout_prng_impl=dropout_prng_impl, dtype=dtype,
+        embed_grad_impl=embed_grad_impl)
     logits = compute_logits(params, code_vectors, dtype=dtype,
                             num_valid_targets=num_valid_targets)
     ce_sum, weight_sum = weighted_ce_sums(logits, label, weight)

@@ -1,0 +1,118 @@
+"""Embedding-table gradient strategies for the giant token/path tables.
+
+The train step's backward pass turns each table gather (``jnp.take`` in
+models/functional.py::encode) into a scatter-add of B*C = 204,800 rows into
+a 1.3M/911K-row table (reference forward: tensorflow_model.py:236-244; the
+reference left this entirely to TF's ``IndexedSlices`` machinery on GPU).
+On TPU, XLA lowers a scatter-add with *possibly-duplicate* indices
+conservatively — duplicate hits on a row must be ordered — which is the
+leading suspect for the measured gap between the 49.25 ms java14m step and
+its ~25 ms HBM roofline (PERF.md; isolated by the frozen-tables variant in
+benchmarks/diag_step_breakdown.py).
+
+This module provides ``take_rows``, a drop-in gather whose *backward* is
+selectable:
+
+- ``'dense'``  — plain autodiff scatter-add (the default; XLA decides).
+- ``'sorted'`` — sort the flattened indices once, permute the incoming
+  cotangent rows to match, and scatter with ``indices_are_sorted=True``:
+  duplicate hits on a row become adjacent, which XLA can turn into local
+  accumulation instead of remote row revisits.
+- ``'dedup'``  — as ``'sorted'``, then pre-combine duplicate rows with a
+  segmented associative scan so each table row is written by AT MOST one
+  update; non-final duplicates are redirected to an out-of-range sentinel
+  and dropped. The scatter that reaches HBM touches each row once, at the
+  price of one log-depth scan over the (N, d) cotangent block.
+
+All three are numerically equivalent up to fp summation order (tested
+exactly at fp32 against autodiff in tests/test_embed_grad.py). The knob is
+``Config.EMBED_GRAD_IMPL``; the default stays ``'dense'`` until the
+on-chip A/B (benchmarks/bench_embed_grad.py) records a win.
+
+Duplicate-row statistics decide how much ``'dedup'`` can save: uniform
+synthetic indices (benchlib.random_batches) hit ~93% unique rows, while
+real corpora are Zipfian — java14m token draws repeat heavily, so the
+A/B measures both distributions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IMPLS = ('dense', 'sorted', 'dedup')
+
+
+def take_rows(table: jax.Array, idx: jax.Array, *,
+              impl: str = 'dense') -> jax.Array:
+    """``jnp.take(table, idx, axis=0)`` with a selectable gradient path.
+
+    ``impl='dense'`` is literally ``jnp.take`` (no custom_vjp wrapping, so
+    autodiff, vjp-of-vjp, and jvp all behave exactly as before). The other
+    impls close over the table's static shape/dtype, so the custom_vjp is
+    built per call site — traced once per jit like everything else.
+    """
+    if impl == 'dense':
+        return jnp.take(table, idx, axis=0)
+    if impl not in IMPLS:
+        raise ValueError('embed grad impl must be one of %s, got %r'
+                         % (IMPLS, impl))
+    num_rows, table_dtype = table.shape[0], table.dtype
+
+    @jax.custom_vjp
+    def gather(t, i):
+        return jnp.take(t, i, axis=0)
+
+    def gather_fwd(t, i):
+        return jnp.take(t, i, axis=0), i
+
+    def gather_bwd(i, g):
+        return table_grad(g, i, num_rows, table_dtype, impl), None
+
+    gather.defvjp(gather_fwd, gather_bwd)
+    return gather(table, idx)
+
+
+def _segmented_sum_combine(a, b):
+    """Associative operator for a segmented inclusive prefix sum: values
+    accumulate left-to-right but reset wherever the right operand starts a
+    new segment."""
+    value_a, start_a = a
+    value_b, start_b = b
+    value = jnp.where(start_b[..., None], value_b, value_a + value_b)
+    return value, start_a | start_b
+
+
+def table_grad(g: jax.Array, idx: jax.Array, num_rows: int,
+               table_dtype, impl: str) -> jax.Array:
+    """Accumulate cotangent rows ``g`` (..., d) at ``idx`` (...) into a
+    dense (num_rows, d) table gradient using the chosen strategy."""
+    d = g.shape[-1]
+    flat_g = g.reshape(-1, d).astype(table_dtype)
+    flat_idx = idx.reshape(-1)
+    if impl == 'dense':
+        return jnp.zeros((num_rows, d), table_dtype).at[flat_idx].add(flat_g)
+
+    order = jnp.argsort(flat_idx)
+    sorted_idx = jnp.take(flat_idx, order)
+    sorted_g = jnp.take(flat_g, order, axis=0)
+    if impl == 'sorted':
+        return jnp.zeros((num_rows, d), table_dtype).at[sorted_idx].add(
+            sorted_g, indices_are_sorted=True)
+
+    assert impl == 'dedup'
+    # run starts: first row of each group of equal indices
+    starts = jnp.concatenate([
+        jnp.ones((1,), bool), sorted_idx[1:] != sorted_idx[:-1]])
+    # inclusive segmented prefix sum: at each run's LAST row this holds the
+    # full per-row gradient sum
+    summed, _ = jax.lax.associative_scan(
+        _segmented_sum_combine, (sorted_g, starts))
+    is_end = jnp.concatenate([starts[1:], jnp.ones((1,), bool)])
+    # redirect non-final duplicates out of range; mode='drop' discards
+    # them, so each surviving update hits a distinct row. NO
+    # indices_are_sorted hint: the sentinel lands BEFORE each run's final
+    # element, so the rewritten stream is not sorted — claiming it is
+    # would be undefined behavior on TPU.
+    scatter_idx = jnp.where(is_end, sorted_idx, num_rows)
+    return jnp.zeros((num_rows, d), table_dtype).at[scatter_idx].add(
+        summed, mode='drop')

@@ -72,6 +72,12 @@ class Trainer:
                 raise ValueError(
                     'ADAM_MU_DTYPE applies to the dense optax Adam only; '
                     'LAZY_EMBEDDING_ADAM keeps fp32 moments.')
+            import logging
+            logging.getLogger(__name__).warning(
+                'LAZY_EMBEDDING_ADAM is measured SLOWER on v5e-class chips '
+                '(0.54x the dense step at java14m shapes, PERF.md): the '
+                'scatter update serializes against the fused dense update. '
+                'It remains available for semantics experiments only.')
             from code2vec_tpu.ops.lazy_adam import LazyEmbeddingAdam
             self.optimizer = LazyEmbeddingAdam(config.LEARNING_RATE, backend)
         else:

@@ -31,9 +31,14 @@ def main() -> None:
 
     import jax
     jax.config.update('jax_platforms', 'cpu')
+    # Bounded join: under heavy host load a sibling worker can start late;
+    # 120s is the barrier deadline — a missed join fails THIS process fast
+    # with a clear error instead of wedging until the harness's outer
+    # timeout, and the harness retries the whole cluster once.
     jax.distributed.initialize(coordinator_address=args.coordinator,
                                num_processes=args.num_processes,
-                               process_id=args.process_id)
+                               process_id=args.process_id,
+                               initialization_timeout=120)
 
     from code2vec_tpu.config import Config
     from code2vec_tpu.model_api import Code2VecModel

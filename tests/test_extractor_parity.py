@@ -18,11 +18,11 @@ import pytest
 
 from code2vec_tpu import common
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BINARY = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+from tests.extractor_bin import BINARY, REPO, binary_missing_reason
 
-pytestmark = pytest.mark.skipif(not os.path.isfile(BINARY),
-                                reason='extractor binary not built')
+pytestmark = pytest.mark.skipif(
+    binary_missing_reason() is not None or not os.path.isfile(BINARY),
+    reason=str(binary_missing_reason() or 'extractor binary not built'))
 
 
 def extract(path, no_hash=True, lang=None):

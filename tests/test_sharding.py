@@ -264,3 +264,23 @@ def test_bf16_mu_matches_layout_on_tp_mesh():
     token_mu = mu.token_embedding
     token_param = state.params.token_embedding
     assert token_mu.sharding.spec == token_param.sharding.spec
+
+
+def test_rbg_dropout_trains_on_tp_mesh():
+    """DROPOUT_PRNG_IMPL='rbg' on a (4, 2) mesh with SHARD_CONTEXTS: the
+    (B, C, 3d) rng_bit_generator mask draw must lower through SPMD
+    partitioning (it was only exercised single-device before) and produce
+    finite, decreasing-ish losses like the threefry path."""
+    trainer = _trainer(4, 2, DROPOUT_PRNG_IMPL='rbg', SHARD_CONTEXTS=True)
+    _, losses = _run_steps(trainer, n=3)
+    assert np.isfinite(losses).all()
+    # seed-deterministic, so this is not flaky: a degenerate rbg mask
+    # (e.g. all-dropped) would keep loss pinned at ~ln(V) instead
+    assert losses[-1] < losses[0]
+
+    # same data, threefry path: rbg is a different (valid) random stream,
+    # so only coarse agreement is expected — both must actually learn
+    trainer_tf = _trainer(4, 2, SHARD_CONTEXTS=True)
+    _, losses_tf = _run_steps(trainer_tf, n=3)
+    assert np.isfinite(losses_tf).all()
+    assert abs(losses[0] - losses_tf[0]) < 1.0
