@@ -33,6 +33,14 @@ Duplicate-row statistics decide how much ``'dedup'`` can save: uniform
 synthetic indices (benchlib.random_batches) hit ~93% unique rows, while
 real corpora are Zipfian — java14m token draws repeat heavily, so the
 A/B measures both distributions.
+
+Mesh caveat: the backward sorts the FLATTENED (B*C) index stream. With
+the batch sharded over the data axis, a global sort makes XLA's
+partitioner insert cross-shard exchanges; correctness on a (4, 2) mesh is
+tested (tests/test_embed_grad.py), but the A/B verdict is a SINGLE-CHIP
+number — on multi-chip meshes the scatter-add is per-shard already
+(followed by the grad psum), so re-measure before assuming the verdict
+transfers.
 """
 from __future__ import annotations
 

@@ -1,0 +1,251 @@
+"""Generate a java-small-scale synthetic Java corpus for accuracy-at-scale
+validation (VERDICT r2 missing #2).
+
+The reference validates its learning loop implicitly every time someone
+follows its README (train.sh on java-small: ~700K methods, 20 epochs, best
+epoch by F1). No Java corpus exists in this environment, so this generator
+produces one at comparable *statistical* scale from a template grammar:
+
+- ~24K classes / ~110K methods, split by class into train/val/test;
+- method names are verb+noun compounds whose BODIES correlate with the
+  name (getters return the field, finders loop over a parameter, compare
+  methods delegate to java.lang comparisons, ...) — so subtoken F1 above
+  the majority baseline requires actually learning path-context -> name
+  structure, not memorizing one label;
+- identifiers are drawn Zipfian from a compound-noun pool large enough
+  that the token/target vocabs overflow the configured sizes (real vocab
+  truncation + OOV pressure, unlike the tiny overfit tests);
+- bodies carry small structural variations (guards, temps, literals) so
+  identical names don't collapse to identical context bags.
+
+Deterministic under --seed. Output: one .java file per class under
+<out>/{train,val,test}/, ready for `c2v-extract --dir`.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+ADJS = ['max', 'min', 'total', 'last', 'first', 'next', 'prev', 'base',
+        'raw', 'final', 'cached', 'pending', 'active', 'stale', 'local',
+        'remote', 'global', 'default', 'current', 'initial', 'merged',
+        'sorted', 'unique', 'valid', 'dirty', 'live', 'spare', 'extra',
+        'inner', 'outer', 'upper', 'lower', 'left', 'right', 'open',
+        'closed', 'free', 'used', 'busy', 'idle']
+NOUNS = ['count', 'index', 'size', 'value', 'name', 'key', 'weight',
+         'offset', 'limit', 'length', 'width', 'height', 'depth', 'score',
+         'rank', 'rate', 'ratio', 'total', 'sum', 'delta', 'retry',
+         'timeout', 'buffer', 'queue', 'stack', 'cache', 'token', 'node',
+         'edge', 'path', 'label', 'field', 'record', 'row', 'column',
+         'page', 'block', 'chunk', 'frame', 'slot', 'seed', 'state',
+         'flag', 'mode', 'level', 'phase', 'step', 'stage', 'epoch',
+         'batch', 'shard', 'worker', 'task', 'job', 'event', 'error',
+         'warning', 'message', 'header', 'footer', 'body', 'item',
+         'entry', 'element', 'member', 'owner', 'user', 'group', 'role',
+         'session', 'request', 'response', 'result', 'input', 'output',
+         'source', 'target', 'origin', 'bound', 'range', 'window',
+         'cursor', 'pointer', 'handle', 'id', 'tag', 'type', 'kind',
+         'version', 'revision', 'branch', 'commit', 'digest', 'checksum',
+         'price', 'cost', 'budget', 'balance', 'amount', 'quantity',
+         'stock', 'order', 'invoice', 'account', 'address', 'city',
+         'street', 'code', 'zone', 'region', 'distance', 'speed',
+         'duration', 'interval', 'moment', 'instant', 'day', 'month',
+         'year', 'week', 'hour', 'minute', 'second']
+
+
+def zipf_choice(rng: random.Random, pool, a: float = 1.15):
+    """Zipf-ish draw: low pool indices are hot, the tail is long."""
+    n = len(pool)
+    # inverse-CDF for a power law over ranks 1..n
+    u = rng.random()
+    rank = int(n ** u) if a <= 1.0 else int((n ** (1 - a) * u + (1 - u))
+                                            ** (1 / (1 - a)))
+    return pool[min(max(rank - 1, 0), n - 1)]
+
+
+def camel(*parts: str) -> str:
+    head, *tail = [p for p in parts if p]
+    return head + ''.join(p.capitalize() for p in tail)
+
+
+class ClassGen:
+    TYPES = ['int', 'long', 'double', 'boolean', 'String']
+
+    def __init__(self, rng: random.Random, noun_pairs):
+        self.rng = rng
+        self.fields = []
+        used = set()
+        for _ in range(rng.randint(3, 6)):
+            adj, noun = zipf_choice(rng, noun_pairs)
+            name = camel(adj, noun) if rng.random() < 0.7 else noun
+            if name in used:
+                continue
+            used.add(name)
+            ftype = rng.choices(self.TYPES, weights=[5, 2, 2, 2, 3])[0]
+            self.fields.append((ftype, name))
+        if not self.fields:
+            self.fields.append(('int', camel(*zipf_choice(rng, noun_pairs))))
+
+    def numeric_fields(self):
+        return [f for f in self.fields if f[0] in ('int', 'long', 'double')]
+
+    def method(self) -> str:
+        rng = self.rng
+        ftype, fname = rng.choice(self.fields)
+        num = self.numeric_fields()
+        kinds = ['getter', 'setter', 'resetter', 'predicate', 'validator']
+        if ftype in ('int', 'long', 'double'):
+            kinds += ['adder', 'clamper', 'scaler']
+        if len(num) >= 2:
+            kinds += ['computer', 'comparator']
+        if ftype == 'String':
+            kinds += ['describer', 'checker']
+        kind = rng.choice(kinds)
+        return getattr(self, '_' + kind)(ftype, fname)
+
+    # --- method templates; each correlates body structure with the name
+    def _getter(self, ftype, fname):
+        return ('%s get%s() { return this.%s; }'
+                % (ftype, fname[0].upper() + fname[1:], fname))
+
+    def _setter(self, ftype, fname):
+        guard = ''
+        if ftype in ('int', 'long', 'double') and self.rng.random() < 0.5:
+            guard = 'if (value < 0) { return; } '
+        return ('void set%s(%s value) { %sthis.%s = value; }'
+                % (fname[0].upper() + fname[1:], ftype, guard, fname))
+
+    def _resetter(self, ftype, fname):
+        zero = {'int': '0', 'long': '0L', 'double': '0.0',
+                'boolean': 'false', 'String': '""'}[ftype]
+        return ('void reset%s() { this.%s = %s; }'
+                % (fname[0].upper() + fname[1:], fname, zero))
+
+    def _predicate(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        if ftype == 'boolean':
+            return 'boolean is%s() { return this.%s; }' % (cap, fname)
+        if ftype == 'String':
+            return ('boolean has%s() { return this.%s != null; }'
+                    % (cap, fname))
+        return 'boolean has%s() { return this.%s > 0; }' % (cap, fname)
+
+    def _validator(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        if ftype in ('int', 'long', 'double'):
+            cond = 'this.%s < 0' % fname
+        elif ftype == 'boolean':
+            cond = '!this.%s' % fname
+        else:
+            cond = 'this.%s == null' % fname
+        return ('void validate%s() { if (%s) { throw new '
+                'IllegalStateException("bad %s"); } }'
+                % (cap, cond, fname))
+
+    def _adder(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('void addTo%s(%s amount) { this.%s = this.%s + amount; }'
+                % (cap, ftype, fname, fname))
+
+    def _clamper(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('%s clamp%s(%s low, %s high) { if (this.%s < low) { return '
+                'low; } if (this.%s > high) { return high; } return '
+                'this.%s; }' % (ftype, cap, ftype, ftype, fname, fname,
+                                fname))
+
+    def _scaler(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('%s scale%s(%s factor) { return this.%s * factor; }'
+                % (ftype, cap, ftype, fname))
+
+    def _computer(self, ftype, fname):
+        num = self.numeric_fields()
+        (t1, f1), (t2, f2) = self.rng.sample(num, 2)
+        cap1 = f1[0].upper() + f1[1:]
+        cap2 = f2[0].upper() + f2[1:]
+        op = self.rng.choice(['+', '-', '*'])
+        rtype = 'double' if 'double' in (t1, t2) else (
+            'long' if 'long' in (t1, t2) else 'int')
+        return ('%s compute%sAnd%s() { return this.%s %s this.%s; }'
+                % (rtype, cap1, cap2, f1, op, f2))
+
+    def _comparator(self, ftype, fname):
+        num = self.numeric_fields()
+        t1, f1 = self.rng.choice(num)
+        cap = f1[0].upper() + f1[1:]
+        box = {'int': 'Integer', 'long': 'Long', 'double': 'Double'}[t1]
+        return ('int compare%s(%s other) { return %s.compare(this.%s, '
+                'other); }' % (cap, t1, box, f1))
+
+    def _describer(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('String describe%s() { return "%s=" + this.%s; }'
+                % (cap, fname, fname))
+
+    def _checker(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('boolean check%sEquals(String expected) { return '
+                'this.%s.equals(expected); }' % (cap, fname))
+
+
+def gen_class(rng: random.Random, name: str, noun_pairs,
+              methods_per_class) -> str:
+    cls = ClassGen(rng, noun_pairs)
+    lines = ['public class %s {' % name]
+    for ftype, fname in cls.fields:
+        lines.append('    private %s %s;' % (ftype, fname))
+    n_methods = rng.randint(*methods_per_class)
+    seen = set()
+    for _ in range(n_methods):
+        m = cls.method()
+        sig = m.split('(')[0]
+        if sig in seen:  # java forbids duplicate signatures often enough
+            continue
+        seen.add(sig)
+        lines.append('    public ' + m)
+    lines.append('}')
+    return '\n'.join(lines) + '\n'
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('-o', '--out', required=True)
+    parser.add_argument('--classes', type=int, default=24000)
+    parser.add_argument('--methods-per-class', type=int, nargs=2,
+                        default=(3, 6))
+    parser.add_argument('--val-frac', type=float, default=0.025)
+    parser.add_argument('--test-frac', type=float, default=0.025)
+    parser.add_argument('--files-per-dir', type=int, default=2000)
+    parser.add_argument('--seed', type=int, default=7)
+    args = parser.parse_args()
+
+    rng = random.Random(args.seed)
+    # adj+noun AND noun+noun compounds: ~19K distinct identifier stems, so
+    # ~110K Zipfian field draws produce a vocab that overflows a 10K-word
+    # table — the truncation/OOV pressure this corpus exists to create
+    noun_pairs = ([(a, n) for a in ADJS for n in NOUNS]
+                  + [(n1, n2) for n1 in NOUNS for n2 in NOUNS if n1 != n2])
+    rng.shuffle(noun_pairs)
+
+    counts = {'train': 0, 'val': 0, 'test': 0}
+    methods = 0
+    for i in range(args.classes):
+        r = rng.random()
+        split = ('val' if r < args.val_frac else
+                 'test' if r < args.val_frac + args.test_frac else 'train')
+        sub = 'p%03d' % (counts[split] // args.files_per_dir)
+        d = os.path.join(args.out, split, sub)
+        os.makedirs(d, exist_ok=True)
+        name = 'C%05d' % i
+        src = gen_class(rng, name, noun_pairs, args.methods_per_class)
+        with open(os.path.join(d, name + '.java'), 'w') as f:
+            f.write(src)
+        counts[split] += 1
+        methods += src.count('public ') - 1  # minus the class decl
+    print('classes: %s  methods: ~%d' % (counts, methods))
+
+
+if __name__ == '__main__':
+    main()
