@@ -1,0 +1,77 @@
+"""On-chip A/B: flash-style fused softmax-CE (ops/pallas_ce.py) vs the
+materialized-logits XLA path, at the java14m train step.
+
+The fused kernel removes ~4.3 GB/step of (B, 261K) logits HBM traffic
+(module docstring) — roughly 5 ms at the measured ~819 GB/s — IF its
+blockwise matmuls keep the MXU as busy as XLA's monolithic ones. This
+measures the full train step both ways (same chained devargs/sync-at-end
+methodology as the other harnesses, PERF.md), plus the combined
+fused-CE + rbg-dropout + bf16-mu candidate default set.
+
+Engagement check: before timing the fused arm, the compiled HLO is
+searched for the Mosaic custom call so the kernel demonstrably ran
+(the same guard bench_pallas_encode.py uses).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+WARMUP, STEPS = benchlib.bench_steps(SMOKE)
+
+
+def measure(label: str, check_engaged: bool = False, **overrides) -> None:
+    config = benchlib.headline_config(SHAPES, **overrides)
+    trainer, state = benchlib.build_trainer(config, SHAPES)
+    feeds = benchlib.staged(trainer, benchlib.random_batches(SHAPES, 4))
+    if check_engaged:
+        engaged = benchlib.mosaic_engaged(trainer._train_step, state,
+                                          feeds[0])
+        print(json.dumps({'measure': label + '_kernel_engaged',
+                          'value': bool(engaged)}), flush=True)
+    for i in range(WARMUP):
+        state, loss = trainer.train_step_placed(state, feeds[i % len(feeds)])
+        float(loss)
+    t0 = time.perf_counter()
+    last = None
+    for i in range(STEPS):
+        state, last = trainer.train_step_placed(state, feeds[i % len(feeds)])
+    float(last)
+    dt = (time.perf_counter() - t0) / STEPS
+    if SMOKE:
+        label += '_SMOKE_ONLY'
+    print(json.dumps({'measure': label, 'value': round(dt * 1e3, 2),
+                      'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
+          flush=True)
+
+
+def main() -> None:
+    import jax
+
+    benchlib.honor_env_platforms()
+    print(json.dumps({'platform': jax.devices()[0].platform.lower()}),
+          flush=True)
+    measure('step_ms_ce_xla')
+    measure('step_ms_ce_fused', check_engaged=True,
+            USE_PALLAS_FUSED_CE=True)
+    # the candidate full default set if every queued A/B wins. No second
+    # engagement check: same kernel flag as the arm above, and each check
+    # costs a full extra AOT compile of the java14m step — real money
+    # against the tunnel's stage timeouts.
+    measure('step_ms_ce_fused_rbg_bf16mu',
+            USE_PALLAS_FUSED_CE=True, DROPOUT_PRNG_IMPL='rbg',
+            ADAM_MU_DTYPE='bfloat16')
+
+
+if __name__ == '__main__':
+    main()

@@ -43,15 +43,6 @@ if _contexts:
 WARMUP_STEPS, MEASURE_STEPS = benchlib.bench_steps(SMOKE)
 
 
-def kernel_engaged(trainer, params, arrays) -> bool:
-    """True iff the compiled eval step contains the Pallas (Mosaic) TPU
-    custom-call. A bare 'custom-call' match would false-positive on other
-    TPU custom-calls (e.g. top-k lowerings), so look for the Mosaic
-    target specifically."""
-    txt = trainer._eval_step.lower(params, arrays).compile().as_text()
-    return 'tpu_custom_call' in txt
-
-
 def measure(use_pallas: bool):
     """Returns (examples_per_sec_per_chip, engaged)."""
     import jax
@@ -76,7 +67,8 @@ def measure(use_pallas: bool):
     placed = benchlib.staged(trainer, benchlib.random_batches(SHAPES, 4))
     # AOT HLO inspection costs a full extra compile of the java14m eval
     # program — only pay it for the variant whose engagement is in doubt.
-    engaged = (kernel_engaged(trainer, params, placed[0])
+    engaged = (benchlib.mosaic_engaged(trainer._eval_step, params,
+                                       placed[0])
                if use_pallas else False)
 
     chain_weight = jax.jit(lambda w, t: w + t * 0)

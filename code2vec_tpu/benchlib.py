@@ -67,6 +67,15 @@ def headline_config(shapes: BenchShapes, **overrides):
     return Config(**kwargs)
 
 
+def mosaic_engaged(jitted, *args) -> bool:
+    """True iff the compiled program contains the Pallas (Mosaic) TPU
+    custom-call. A bare 'custom-call' match would false-positive on other
+    TPU custom-calls (e.g. top-k lowerings), so look for the Mosaic
+    target 'tpu_custom_call' specifically. Costs one AOT compile — use
+    once per A/B arm family, not per variant."""
+    return 'tpu_custom_call' in jitted.lower(*args).compile().as_text()
+
+
 def _make_trainer(config, shapes: BenchShapes):
     from code2vec_tpu.models.backends import create_backend
     from code2vec_tpu.training.trainer import Trainer

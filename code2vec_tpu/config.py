@@ -99,6 +99,15 @@ class Config:
     # fp summation order; default decided by the on-chip A/B
     # (benchmarks/bench_embed_grad.py, PERF.md).
     EMBED_GRAD_IMPL: str = 'dense'
+    # Route the TRAINING cross-entropy through the flash-style fused Pallas
+    # kernel (ops/pallas_ce.py): logsumexp + label pick computed blockwise
+    # over the target table, so the (B, target_vocab) logits matrix never
+    # exists in HBM in either direction (~4.3 GB/step at java14m shapes).
+    # Multi-device meshes use the shard_mapped variant (table row-sharded
+    # over 'model', batch over 'data', online stats merged over ICI).
+    # Off until the on-chip A/B (benchmarks/bench_fused_ce.py) records a
+    # win. Eval/predict always materialize logits (top-k needs them).
+    USE_PALLAS_FUSED_CE: bool = False
     # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
     # model mesh axis — order-free sequence parallelism for large bags: the
     # attention softmax reductions become XLA collectives (SURVEY.md §5
@@ -234,6 +243,11 @@ class Config:
                             default=None,
                             help='token/path table gradient strategy '
                                  '(ops/embed_grad.py, PERF.md)')
+        parser.add_argument('--fused-ce', dest='fused_ce',
+                            action='store_true',
+                            help='train-time CE via the flash-style fused '
+                                 'Pallas kernel: no (B, V) logits in HBM '
+                                 '(ops/pallas_ce.py, PERF.md)')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -279,6 +293,8 @@ class Config:
             self.ADAM_MU_DTYPE = parsed.adam_mu_dtype
         if parsed.embed_grad_impl:
             self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
+        if parsed.fused_ce:
+            self.USE_PALLAS_FUSED_CE = True
         return self
 
     # ------------------------------------------------------- derived props

@@ -160,6 +160,16 @@ class Code2VecModel:
                 path, max_to_keep=self.config.MAX_TO_KEEP,
                 metadata={
                     'param_row_alignment': self.config.PARAM_ROW_ALIGNMENT,
+                    # the ACTUAL padded target-table rows: the allocation
+                    # additionally folds in the fused-CE vocab tile and
+                    # mesh model axis (backends.target_row_alignment), so
+                    # a resume that flips USE_PALLAS_FUSED_CE or reshapes
+                    # the mesh would otherwise hit an opaque orbax shape
+                    # mismatch; recording the row count (not the
+                    # alignment) accepts resumes whose padding happens to
+                    # coincide
+                    'target_vocab_rows':
+                        self.backend.sizes['target_vocab_size'],
                     'token_dim': self.config.TOKEN_EMBEDDINGS_SIZE,
                     'path_dim': self.config.PATH_EMBEDDINGS_SIZE,
                     'code_dim': self.config.CODE_VECTOR_SIZE,

@@ -7,7 +7,7 @@ how parameters are created and stored. The trainer and serving layers are
 backend-agnostic — a backend exposes:
 
 - ``init(rng) -> params``                   (pytree of fp32 arrays)
-- ``loss_fn(params, arrays, dropout_rng)``  → (loss, aux)
+- ``loss_fn(params, arrays, dropout_rng, mesh=None)`` → (loss, aux)
 - ``forward(params, arrays)``               → (code_vectors, attention, logits)
 - ``named_params(params) -> Code2VecParams`` (for export / sharding)
 """
@@ -35,6 +35,27 @@ def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
+def _lcm(a: int, b: int) -> int:
+    import math
+    return a * b // math.gcd(a, b)
+
+
+def target_row_alignment(config: Config) -> int:
+    """Row alignment of the TARGET table allocation. Folds in the fused-CE
+    tile so the kernel's own pad is a no-op (otherwise every step would
+    physically copy the ~400 MB table to a tile multiple, twice); on a
+    model-sharded mesh the kernel streams PER-SHARD rows, so the no-copy
+    condition is V/model_axis % VOCAB_TILE == 0. The resulting padded row
+    count is recorded in checkpoint metadata ('target_vocab_rows') since
+    it determines the saved array's shape."""
+    align = max(config.PARAM_ROW_ALIGNMENT, 1)
+    if config.USE_PALLAS_FUSED_CE:
+        from code2vec_tpu.ops.pallas_ce import VOCAB_TILE
+        align = _lcm(align,
+                     VOCAB_TILE * max(config.MESH_MODEL_AXIS_SIZE, 1))
+    return align
+
+
 class JaxBackend:
     """Raw functional backend: params are a ``Code2VecParams`` NamedTuple."""
 
@@ -43,6 +64,10 @@ class JaxBackend:
     def __init__(self, config: Config, vocabs: Code2VecVocabs):
         self.config = config
         align = max(config.PARAM_ROW_ALIGNMENT, 1)
+        # fused CE grows the target alignment to its vocab tile; padded
+        # columns are masked by num_valid_targets everywhere, so only the
+        # allocation grows
+        target_align = target_row_alignment(config)
         # tables padded for even row-sharding over the model axis; padded
         # token/path rows are never gathered, padded target columns are
         # masked out of the softmax via num_valid_targets
@@ -50,7 +75,8 @@ class JaxBackend:
         self.sizes = dict(
             token_vocab_size=_round_up(vocabs.token_vocab.size, align),
             path_vocab_size=_round_up(vocabs.path_vocab.size, align),
-            target_vocab_size=_round_up(vocabs.target_vocab.size, align),
+            target_vocab_size=_round_up(vocabs.target_vocab.size,
+                                        target_align),
             token_dim=config.TOKEN_EMBEDDINGS_SIZE,
             path_dim=config.PATH_EMBEDDINGS_SIZE,
             code_dim=config.CODE_VECTOR_SIZE)
@@ -62,7 +88,8 @@ class JaxBackend:
     def param_shapes(self) -> functional.Code2VecParams:
         return functional.param_shapes(**self.sizes)
 
-    def loss_fn(self, params, arrays, dropout_rng) -> Tuple[jax.Array, Any]:
+    def loss_fn(self, params, arrays, dropout_rng,
+                mesh=None) -> Tuple[jax.Array, Any]:
         source, path, target, mask, label, weight = arrays
         return functional.loss_and_aux(
             params, source, path, target, mask, label, weight,
@@ -70,7 +97,9 @@ class JaxBackend:
             dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
             dropout_prng_impl=self.config.DROPOUT_PRNG_IMPL,
             dtype=self.dtype, num_valid_targets=self.num_valid_targets,
-            embed_grad_impl=self.config.EMBED_GRAD_IMPL)
+            embed_grad_impl=self.config.EMBED_GRAD_IMPL,
+            use_fused_ce=self.config.USE_PALLAS_FUSED_CE,
+            fused_ce_mesh=mesh)
 
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]
@@ -124,11 +153,12 @@ class FlaxBackend:
         shapes = self._jax_twin.param_shapes()
         return {'params': shapes._asdict()}
 
-    def loss_fn(self, params, arrays, dropout_rng) -> Tuple[jax.Array, Any]:
+    def loss_fn(self, params, arrays, dropout_rng,
+                mesh=None) -> Tuple[jax.Array, Any]:
         # Delegate the loss math to functional via the extracted params so
         # both backends are numerically identical.
         return self._jax_twin.loss_fn(self.named_params(params), arrays,
-                                      dropout_rng)
+                                      dropout_rng, mesh=mesh)
 
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]

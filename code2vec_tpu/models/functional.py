@@ -238,18 +238,44 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  dropout_prng_impl: str = 'threefry2x32',
                  dtype: jnp.dtype = jnp.float32,
                  num_valid_targets: Optional[int] = None,
-                 embed_grad_impl: str = 'dense'):
+                 embed_grad_impl: str = 'dense',
+                 use_fused_ce: bool = False,
+                 fused_ce_mesh=None):
     """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
     divides the CE sum by the dynamic batch size; with static shapes the
-    per-example weight plays that role: padded rows have weight 0)."""
+    per-example weight plays that role: padded rows have weight 0).
+
+    ``use_fused_ce`` routes the CE through the flash-style Pallas kernel
+    (ops/pallas_ce.py): no (B, V) logits in HBM, forward or backward. On a
+    multi-device mesh the kernel must be shard_mapped (GSPMD would
+    replicate the opaque pallas_call), so callers pass ``fused_ce_mesh``;
+    a 1-device mesh or None uses the plain kernel.
+    """
     code_vectors, _ = encode(
         params, source, path, target, mask, dropout_rng=dropout_rng,
         dropout_keep_rate=dropout_keep_rate,
         dropout_prng_impl=dropout_prng_impl, dtype=dtype,
         embed_grad_impl=embed_grad_impl)
-    logits = compute_logits(params, code_vectors, dtype=dtype,
-                            num_valid_targets=num_valid_targets)
-    ce_sum, weight_sum = weighted_ce_sums(logits, label, weight)
+    if use_fused_ce:
+        from code2vec_tpu.ops import pallas_ce
+        if not pallas_ce.PALLAS_AVAILABLE:
+            raise ValueError(
+                'USE_PALLAS_FUSED_CE requires jax.experimental.pallas, '
+                'which failed to import on this install.')
+        num_valid = (num_valid_targets if num_valid_targets is not None
+                     else params.target_embedding.shape[0])
+        if fused_ce_mesh is not None and fused_ce_mesh.size > 1:
+            ce_sum, weight_sum = pallas_ce.sharded_fused_weighted_ce_sums(
+                params.target_embedding, code_vectors, label, weight,
+                num_valid, fused_ce_mesh, dtype=dtype)
+        else:
+            ce_sum, weight_sum = pallas_ce.fused_weighted_ce_sums(
+                params.target_embedding, code_vectors, label, weight,
+                num_valid, dtype=dtype)
+    else:
+        logits = compute_logits(params, code_vectors, dtype=dtype,
+                                num_valid_targets=num_valid_targets)
+        ce_sum, weight_sum = weighted_ce_sums(logits, label, weight)
     loss = ce_sum / jnp.maximum(weight_sum, 1.0)
     return loss, {'code_vectors': code_vectors,
                   'num_valid': weight_sum}

@@ -61,6 +61,13 @@ class Trainer:
                 'PARAM_ROW_ALIGNMENT=%d must be divisible by the mesh model '
                 'axis (%d) for even table sharding.'
                 % (config.PARAM_ROW_ALIGNMENT, model_size))
+        # USE_PALLAS_FUSED_CE on a multi-device mesh routes through the
+        # shard_mapped kernel (ops/pallas_ce.py::sharded_fused_weighted_
+        # ce_sums): GSPMD cannot partition the opaque pallas_call itself,
+        # so the plain kernel would be replicated (full batch + full
+        # table on every device) exactly where sharding matters. The
+        # PARAM_ROW_ALIGNMENT check above already guarantees the sharded
+        # variant's V % model_axis == 0 requirement.
         # Reference uses tf.train.AdamOptimizer() defaults
         # (tensorflow_model.py:232): lr=1e-3, b1=0.9, b2=0.999, eps=1e-8.
         # LAZY_EMBEDDING_ADAM swaps in LazyAdam-style sparse-row updates
@@ -97,12 +104,16 @@ class Trainer:
         top_k = self.config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION
 
         lazy = self.config.LAZY_EMBEDDING_ADAM
+        # the mesh only matters to the loss when the fused CE must be
+        # shard_mapped; None keeps single-device tracing mesh-free
+        loss_mesh = self.mesh if self.mesh.size > 1 else None
 
         def train_step(state: TrainerState, arrays) -> Tuple[TrainerState, jax.Array]:
             dropout_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_fn(params):
-                loss, _aux = backend.loss_fn(params, arrays, dropout_rng)
+                loss, _aux = backend.loss_fn(params, arrays, dropout_rng,
+                                             mesh=loss_mesh)
                 return loss
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)

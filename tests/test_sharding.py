@@ -215,6 +215,32 @@ def test_checkpoint_metadata_mismatch_is_clear_error(tmp_path):
         store2.verify_metadata()
 
 
+def test_fused_ce_changes_target_table_allocation():
+    """USE_PALLAS_FUSED_CE (and the mesh model axis under it) grows the
+    target-table allocation; the padded row count is what checkpoint
+    metadata records ('target_vocab_rows') so a resume whose allocation
+    differs fails with a clear config error instead of an opaque orbax
+    shape mismatch — while resumes whose padding coincides still load."""
+    from code2vec_tpu.models.backends import (JaxBackend,
+                                              target_row_alignment)
+    from code2vec_tpu.ops.pallas_ce import VOCAB_TILE
+
+    base = _config(1, 1, PARAM_ROW_ALIGNMENT=8)
+    assert target_row_alignment(base) == 8
+    fused = _config(1, 1, PARAM_ROW_ALIGNMENT=8, USE_PALLAS_FUSED_CE=True)
+    assert target_row_alignment(fused) == VOCAB_TILE
+    fused_tp = _config(4, 2, PARAM_ROW_ALIGNMENT=8,
+                       USE_PALLAS_FUSED_CE=True)
+    assert target_row_alignment(fused_tp) == 2 * VOCAB_TILE
+
+    vocabs = SizeOnlyVocabs(40, 12, 24)
+    assert JaxBackend(base, vocabs).sizes['target_vocab_size'] == 24
+    assert JaxBackend(fused, vocabs).sizes['target_vocab_size'] == \
+        VOCAB_TILE
+    assert JaxBackend(fused_tp, vocabs).sizes['target_vocab_size'] == \
+        2 * VOCAB_TILE
+
+
 def test_sharded_top_k_matches_lax_top_k():
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
