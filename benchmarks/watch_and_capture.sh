@@ -9,6 +9,8 @@
 #                   the probe loop here already did the waiting)
 #   rbg_dropout     threefry-vs-rbg dropout A/B + bf16-mu combos
 #   embed_grad      dense/sorted/dedup table-gradient A/B, uniform+zipf
+#   fused_ce        flash-CE Pallas kernel A/B (ops/pallas_ce.py) +
+#                   the combined candidate default set
 #   diag            step breakdown incl. frozen-tables (scatter isolation)
 #   pallas_c1024    long-context Pallas A/B, 1800 s budget (its 900 s
 #                   stage timed out on compile in the first sweep)
@@ -64,6 +66,8 @@ run_stage rbg_dropout 900 python benchmarks/bench_rbg_dropout.py
 probe || { echo "wedged after rbg_dropout" >&2; exit 3; }
 run_stage embed_grad 1500 python benchmarks/bench_embed_grad.py
 probe || { echo "wedged after embed_grad" >&2; exit 3; }
+run_stage fused_ce 1200 python benchmarks/bench_fused_ce.py
+probe || { echo "wedged after fused_ce" >&2; exit 3; }
 # frozen-tables (embedding-backward isolation) and the other breakdown
 # variants
 run_stage diag 1200 python benchmarks/diag_step_breakdown.py

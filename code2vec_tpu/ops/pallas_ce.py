@@ -113,7 +113,10 @@ def _bwd_kernel(precision, code_ref, w_ref, label_ref, nv_ref, lse_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + j * block
     valid = col < nv_ref[:]
     softmax = jnp.where(valid, jnp.exp(logits - lse_ref[:]), 0.0)
-    onehot = jnp.where(col == label_ref[:], 1.0, 0.0)
+    # the valid mask keeps the vjp the true linearization even for a
+    # label in the masked range: the forward picks the _NEG constant
+    # there, which has zero dependence on w and code
+    onehot = jnp.where((col == label_ref[:]) & valid, 1.0, 0.0)
     dlogits = dlse_ref[:] * softmax + dpicked_ref[:] * onehot  # (B, VB) f32
 
     compute_dtype = code_ref.dtype
