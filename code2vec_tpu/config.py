@@ -113,6 +113,18 @@ class Config:
     # attention softmax reductions become XLA collectives (SURVEY.md §5
     # 'long-context'). Off by default (MAX_CONTEXTS=200 fits comfortably).
     SHARD_CONTEXTS: bool = False
+    # Layout of Adam's moment tables over the mesh. 'mirror' (default)
+    # copies each parameter's own sharding: row-sharded over 'model',
+    # REPLICATED along 'data' — every data shard stores the full ~3.1 GB
+    # of moments at java14m scale. 'zero' (ZeRO-1-style) additionally
+    # shards the three tables' moments over the data axis: per-device
+    # optimizer memory drops by the data-axis size and XLA turns the
+    # update into reduce-scatter/all-gather collectives it places itself.
+    # Parameters stay replicated along 'data' either way (this is
+    # optimizer-STATE partitioning, not ZeRO-3). Numerics are unchanged
+    # (tests/test_sharding.py); requires PARAM_ROW_ALIGNMENT divisible by
+    # the whole mesh size and the dense optax Adam (not LAZY_EMBEDDING_ADAM).
+    OPTIMIZER_STATE_SHARDING: str = 'mirror'
     # Embedding tables are padded to a multiple of this many rows so they
     # shard evenly over any model axis that DIVIDES this value (validated at
     # Trainer construction), keeping checkpoint shapes topology-independent.
@@ -248,6 +260,13 @@ class Config:
                             help='train-time CE via the flash-style fused '
                                  'Pallas kernel: no (B, V) logits in HBM '
                                  '(ops/pallas_ce.py, PERF.md)')
+        parser.add_argument('--opt-state-sharding',
+                            dest='opt_state_sharding',
+                            choices=['mirror', 'zero'], default=None,
+                            help="Adam moment layout: 'mirror' copies the "
+                                 "param sharding (replicated along data), "
+                                 "'zero' shards moments over the whole "
+                                 'mesh (ZeRO-1-style)')
         return parser
 
     def load_from_args(self, args=None) -> 'Config':
@@ -295,6 +314,8 @@ class Config:
             self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
         if parsed.fused_ce:
             self.USE_PALLAS_FUSED_CE = True
+        if parsed.opt_state_sharding:
+            self.OPTIMIZER_STATE_SHARDING = parsed.opt_state_sharding
         return self
 
     # ------------------------------------------------------- derived props
@@ -415,6 +436,15 @@ class Config:
                 'config.ADAM_MU_DTYPE applies to the dense optax Adam only; '
                 'LAZY_EMBEDDING_ADAM keeps fp32 moments (the sparse-row '
                 'update does not implement reduced-precision mu).')
+        if self.OPTIMIZER_STATE_SHARDING not in {'mirror', 'zero'}:
+            raise ValueError("config.OPTIMIZER_STATE_SHARDING must be in "
+                             "{'mirror', 'zero'}.")
+        if self.LAZY_EMBEDDING_ADAM and \
+                self.OPTIMIZER_STATE_SHARDING != 'mirror':
+            raise ValueError(
+                "config.OPTIMIZER_STATE_SHARDING='zero' shards the dense "
+                'optax Adam moment tree; LAZY_EMBEDDING_ADAM keeps its own '
+                'state layout.')
 
     def __iter__(self) -> Iterator[Tuple[str, Any]]:
         for field in dataclasses.fields(self):

@@ -105,14 +105,27 @@ def _leaf_name(path) -> str:
     return getattr(last, 'key', None) or getattr(last, 'name', str(last))
 
 
-def sharding_for_tree(tree, mesh: Mesh):
+def sharding_for_tree(tree, mesh: Mesh, zero_partition: bool = False):
     """Shardings for an arbitrary pytree whose leaves either *are* model
     parameters (matched by leaf name, wherever they sit — e.g. inside Adam's
     ``mu``/``nu`` moment trees) or are small scalars/state (replicated).
 
     This is how optimizer state inherits the parameter layout without any
-    per-optimizer code."""
+    per-optimizer code.
+
+    ``zero_partition`` (ZeRO-1-style, ``Config.OPTIMIZER_STATE_SHARDING=
+    'zero'``): leaves that would be row-sharded over ``model`` only are
+    instead row-sharded over the WHOLE mesh ``(data, model)`` — per-device
+    bytes drop by the data-axis size, and XLA turns the consuming update
+    into the reduce-scatter/all-gather pair it places itself. Only
+    meaningful for the moment trees (params must keep their own layout,
+    so never pass it for a parameter pytree)."""
     shardings_by_name = param_sharding(mesh)._asdict()
+    if zero_partition:
+        zero = NamedSharding(mesh, P((DATA_AXIS, MODEL_AXIS), None))
+        shardings_by_name = {
+            name: zero if s.spec == P(MODEL_AXIS, None) else s
+            for name, s in shardings_by_name.items()}
     replicated = NamedSharding(mesh, P())
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
     out = [shardings_by_name.get(_leaf_name(path), replicated)
@@ -120,10 +133,10 @@ def sharding_for_tree(tree, mesh: Mesh):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def attach_shardings(abstract_tree, mesh: Mesh):
+def attach_shardings(abstract_tree, mesh: Mesh, zero_partition: bool = False):
     """ShapeDtypeStruct pytree → same pytree with mesh shardings attached
     (the restore target orbax needs to re-shard onto the *current* mesh)."""
-    shardings = sharding_for_tree(abstract_tree, mesh)
+    shardings = sharding_for_tree(abstract_tree, mesh, zero_partition)
     return jax.tree_util.tree_map(
         lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
                                              sharding=s),

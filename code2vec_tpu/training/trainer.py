@@ -61,6 +61,13 @@ class Trainer:
                 'PARAM_ROW_ALIGNMENT=%d must be divisible by the mesh model '
                 'axis (%d) for even table sharding.'
                 % (config.PARAM_ROW_ALIGNMENT, model_size))
+        self._zero_opt = config.OPTIMIZER_STATE_SHARDING == 'zero'
+        if self._zero_opt and config.PARAM_ROW_ALIGNMENT % self.mesh.size:
+            raise ValueError(
+                "OPTIMIZER_STATE_SHARDING='zero' shards moment-table rows "
+                'over the WHOLE mesh: PARAM_ROW_ALIGNMENT=%d must be '
+                'divisible by data*model = %d.'
+                % (config.PARAM_ROW_ALIGNMENT, self.mesh.size))
         # USE_PALLAS_FUSED_CE on a multi-device mesh routes through the
         # shard_mapped kernel (ops/pallas_ce.py::sharded_fused_weighted_
         # ce_sums): GSPMD cannot partition the opaque pallas_call itself,
@@ -168,7 +175,24 @@ class Trainer:
                     'attention': attention,
                     'code_vectors': code_vectors}
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        # Explicit output shardings for the donated state: inference alone
+        # re-layouts the zero-partitioned moments back toward the grads'
+        # (model-only) sharding after the first update, silently undoing
+        # OPTIMIZER_STATE_SHARDING='zero'. _init_opt_state reuses the
+        # opt_state field so the initialized and stepped layouts cannot
+        # diverge.
+        abstract_params = backend.param_shapes()
+        abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+        replicated = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        self._state_shardings = TrainerState(
+            params=mesh_lib.sharding_for_tree(abstract_params, mesh),
+            opt_state=mesh_lib.sharding_for_tree(
+                abstract_opt, mesh, zero_partition=self._zero_opt),
+            step=replicated, rng=replicated)
+        self._train_step = jax.jit(train_step, donate_argnums=(0,),
+                                   out_shardings=(self._state_shardings,
+                                                  replicated))
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
 
@@ -182,13 +206,13 @@ class Trainer:
                             step=jnp.zeros((), jnp.int32), rng=train_rng)
 
     def _init_opt_state(self, params):
-        # explicit out_shardings: Adam moments must mirror the (row-sharded)
-        # parameter layout — jit alone does not propagate input shardings
-        # to the opt-state outputs
-        abstract_opt = jax.eval_shape(self.optimizer.init, params)
-        opt_shardings = mesh_lib.sharding_for_tree(abstract_opt, self.mesh)
+        # explicit out_shardings: Adam moments must follow the configured
+        # moment layout — jit alone does not propagate input shardings to
+        # the opt-state outputs. Single source of truth with the train
+        # step's donated-output layout (_build_steps).
         return jax.jit(self.optimizer.init,
-                       out_shardings=opt_shardings)(params)
+                       out_shardings=self._state_shardings.opt_state)(
+                           params)
 
     def abstract_state(self) -> Tuple[Any, Any]:
         """(abstract_canonical_params, abstract_opt_state) with
@@ -206,7 +230,8 @@ class Trainer:
         abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
         canonical = self.backend.named_params(abstract_params)._asdict()
         return (mesh_lib.attach_shardings(canonical, self.mesh),
-                mesh_lib.attach_shardings(abstract_opt, self.mesh))
+                mesh_lib.attach_shardings(abstract_opt, self.mesh,
+                                          zero_partition=self._zero_opt))
 
     def state_from_params(self, params, step: int = 0,
                           seed: int = 42) -> TrainerState:

@@ -215,6 +215,35 @@ def test_checkpoint_metadata_mismatch_is_clear_error(tmp_path):
         store2.verify_metadata()
 
 
+def _mu_leaf(state):
+    mu = state.opt_state[0].mu
+    return mu.token_embedding if hasattr(mu, 'token_embedding') \
+        else mu['token_embedding']
+
+
+def test_zero_opt_state_sharding_matches_mirror():
+    """OPTIMIZER_STATE_SHARDING='zero' shards the moment tables over the
+    whole (data, model) mesh: same losses as the mirrored layout, and the
+    zero sharding survives the donated train step (no silent re-layout
+    back to replicated-along-data)."""
+    zero = _trainer(4, 2, PARAM_ROW_ALIGNMENT=8,
+                    OPTIMIZER_STATE_SHARDING='zero')
+    mirror = _trainer(4, 2, PARAM_ROW_ALIGNMENT=8)
+    state_z, losses_z = _run_steps(zero, n=3)
+    _, losses_m = _run_steps(mirror, n=3)
+    np.testing.assert_allclose(losses_z, losses_m, rtol=2e-4, atol=1e-5)
+    assert _mu_leaf(state_z).sharding.spec == P(('data', 'model'), None)
+    # params stay replicated along data (ZeRO-1, not ZeRO-3)
+    named = zero.backend.named_params(state_z.params)
+    assert named.token_embedding.sharding.spec == P('model', None)
+
+
+def test_zero_opt_state_requires_whole_mesh_alignment():
+    with pytest.raises(ValueError, match='data\\*model'):
+        _trainer(4, 2, PARAM_ROW_ALIGNMENT=2,
+                 OPTIMIZER_STATE_SHARDING='zero')
+
+
 def test_fused_ce_changes_target_table_allocation():
     """USE_PALLAS_FUSED_CE (and the mesh model axis under it) grows the
     target-table allocation; the padded row count is what checkpoint
