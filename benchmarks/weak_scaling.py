@@ -50,7 +50,8 @@ ICI_GBPS_PER_LINK = 45e9
 NORTH_STAR_AGG = 18700.0        # BASELINE.json multi-chip reference point
 
 
-def measure(per_device_batch: int = 64) -> None:
+def measure(per_device_batch: int = 64,
+            opt_sharding: str = 'mirror') -> None:
     import jax
 
     from code2vec_tpu import benchlib
@@ -66,7 +67,8 @@ def measure(per_device_batch: int = 64) -> None:
             batch_size=per_device_batch * n)
         config = benchlib.headline_config(
             shapes, COMPUTE_DTYPE='float32', MESH_DATA_AXIS_SIZE=n,
-            MESH_MODEL_AXIS_SIZE=1)
+            MESH_MODEL_AXIS_SIZE=1,
+            OPTIMIZER_STATE_SHARDING=opt_sharding)
         from code2vec_tpu.models.backends import create_backend
         from code2vec_tpu.parallel import mesh as mesh_lib
         from code2vec_tpu.training.trainer import Trainer
@@ -96,6 +98,7 @@ def measure(per_device_batch: int = 64) -> None:
             'measure': 'weak_scaling_virtual_cpu',
             'devices': n,
             'per_device_batch': per_device_batch,
+            'opt_sharding': opt_sharding,
             'step_ms': round(dt * 1e3, 2),
             'partition_overhead_vs_1dev': round(overhead, 4)}), flush=True)
 
@@ -127,11 +130,16 @@ def main() -> None:
     parser.add_argument('--project', action='store_true',
                         help='print the analytic ICI projection only')
     parser.add_argument('--per-device-batch', type=int, default=64)
+    parser.add_argument('--opt-sharding', choices=['mirror', 'zero'],
+                        default='mirror',
+                        help="moment layout (ZeRO-1 'zero' adds the "
+                             'reduce-scatter/all-gather pair this '
+                             'harness then prices)')
     args = parser.parse_args()
     if args.project:
         project()
     else:
-        measure(args.per_device_batch)
+        measure(args.per_device_batch, args.opt_sharding)
         project()
 
 
