@@ -113,6 +113,12 @@ class Config:
     # attention softmax reductions become XLA collectives (SURVEY.md §5
     # 'long-context'). Off by default (MAX_CONTEXTS=200 fits comfortably).
     SHARD_CONTEXTS: bool = False
+    # Rematerialize the encode block (jax.checkpoint): the (B, C, 3d)
+    # activations — gathered context embeddings, dropout output, tanh
+    # input — are recomputed during the backward instead of living in HBM
+    # across the loss. FLOPs-for-memory for long-context configs (large
+    # MAX_CONTEXTS / big batch); pointless at C=200 where they fit easily.
+    REMAT_ENCODE: bool = False
     # Layout of Adam's moment tables over the mesh. 'mirror' (default)
     # copies each parameter's own sharding: row-sharded over 'model',
     # REPLICATED along 'data' — every data shard stores the full ~3.1 GB
@@ -260,6 +266,11 @@ class Config:
                             help='train-time CE via the flash-style fused '
                                  'Pallas kernel: no (B, V) logits in HBM '
                                  '(ops/pallas_ce.py, PERF.md)')
+        parser.add_argument('--remat-encode', dest='remat_encode',
+                            action='store_true',
+                            help='recompute encode activations in the '
+                                 'backward (jax.checkpoint) — memory '
+                                 'headroom for long-context configs')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -314,6 +325,8 @@ class Config:
             self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
         if parsed.fused_ce:
             self.USE_PALLAS_FUSED_CE = True
+        if parsed.remat_encode:
+            self.REMAT_ENCODE = True
         if parsed.opt_state_sharding:
             self.OPTIMIZER_STATE_SHARDING = parsed.opt_state_sharding
         return self

@@ -99,7 +99,8 @@ class JaxBackend:
             dtype=self.dtype, num_valid_targets=self.num_valid_targets,
             embed_grad_impl=self.config.EMBED_GRAD_IMPL,
             use_fused_ce=self.config.USE_PALLAS_FUSED_CE,
-            fused_ce_mesh=mesh)
+            fused_ce_mesh=mesh,
+            remat_encode=self.config.REMAT_ENCODE)
 
     def forward(self, params, arrays):
         source, path, target, mask = arrays[:4]

@@ -240,7 +240,8 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
                  num_valid_targets: Optional[int] = None,
                  embed_grad_impl: str = 'dense',
                  use_fused_ce: bool = False,
-                 fused_ce_mesh=None):
+                 fused_ce_mesh=None,
+                 remat_encode: bool = False):
     """Weighted mean sparse softmax CE (reference tensorflow_model.py:226-230
     divides the CE sum by the dynamic batch size; with static shapes the
     per-example weight plays that role: padded rows have weight 0).
@@ -250,12 +251,24 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
     multi-device mesh the kernel must be shard_mapped (GSPMD would
     replicate the opaque pallas_call), so callers pass ``fused_ce_mesh``;
     a 1-device mesh or None uses the plain kernel.
+
+    ``remat_encode`` wraps the encode block in ``jax.checkpoint``: the
+    (B, C, 3d)-sized activations (gathered context embeddings, dropout
+    output, tanh input) are recomputed in the backward instead of living
+    in HBM across the whole loss — the classic FLOPs-for-memory trade for
+    long-context (large MAX_CONTEXTS) configurations. Numerics unchanged
+    (same fp ops, same dropout PRNG draws in the replay).
     """
-    code_vectors, _ = encode(
-        params, source, path, target, mask, dropout_rng=dropout_rng,
-        dropout_keep_rate=dropout_keep_rate,
-        dropout_prng_impl=dropout_prng_impl, dtype=dtype,
-        embed_grad_impl=embed_grad_impl)
+    def _encode(params_, source_, path_, target_, mask_, rng_):
+        return encode(
+            params_, source_, path_, target_, mask_, dropout_rng=rng_,
+            dropout_keep_rate=dropout_keep_rate,
+            dropout_prng_impl=dropout_prng_impl, dtype=dtype,
+            embed_grad_impl=embed_grad_impl)[0]
+
+    if remat_encode:
+        _encode = jax.checkpoint(_encode)
+    code_vectors = _encode(params, source, path, target, mask, dropout_rng)
     if use_fused_ce:
         from code2vec_tpu.ops import pallas_ce
         if not pallas_ce.PALLAS_AVAILABLE:

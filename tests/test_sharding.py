@@ -238,6 +238,15 @@ def test_zero_opt_state_sharding_matches_mirror():
     assert named.token_embedding.sharding.spec == P('model', None)
 
 
+def test_remat_encode_on_mesh_matches_default():
+    """jax.checkpoint around encode composes with the sharded train step
+    (SHARD_CONTEXTS sequence parallelism included): identical losses."""
+    _, plain = _run_steps(_trainer(4, 2, SHARD_CONTEXTS=True), n=2)
+    _, remat = _run_steps(_trainer(4, 2, SHARD_CONTEXTS=True,
+                                   REMAT_ENCODE=True), n=2)
+    np.testing.assert_allclose(remat, plain, rtol=1e-6)
+
+
 def test_zero_opt_state_requires_whole_mesh_alignment():
     with pytest.raises(ValueError, match='data\\*model'):
         _trainer(4, 2, PARAM_ROW_ALIGNMENT=2,
