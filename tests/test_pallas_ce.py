@@ -22,11 +22,11 @@ def _case(rng, batch=16, dim=8, vocab=40, num_valid=None):
             jnp.asarray(weight))
 
 
-def _reference(code, w, label, weight, num_valid):
+def _reference(code, w, label, weight, num_valid, dtype=jnp.float32):
     params = functional.Code2VecParams(
         token_embedding=None, path_embedding=None, target_embedding=w,
         transform=None, attention=None)
-    logits = functional.compute_logits(params, code,
+    logits = functional.compute_logits(params, code, dtype=dtype,
                                        num_valid_targets=num_valid)
     return functional.weighted_ce_sums(logits, label, weight)
 
@@ -175,6 +175,35 @@ def test_sharded_matches_reference(monkeypatch, num_valid):
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got_dw), np.asarray(want_dw),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_bfloat16_compute_close_to_xla_path():
+    """The on-chip A/B (bench_fused_ce.py) runs the headline bfloat16
+    config: the kernel's bf16 arms must track the XLA path's bf16 CE
+    within bf16 tolerance, value and grads. The arms legitimately differ
+    beyond rounding: compute_logits' bf16 matmul rounds its logits to
+    bf16, while the kernel keeps fp32 accumulation — hence the loose
+    tolerances."""
+    code, w, label, weight = _case(np.random.default_rng(6), num_valid=40)
+
+    def ref_loss(c, t):
+        ce_sum, w_sum = _reference(c, t, label, weight, 40,
+                                   dtype=jnp.bfloat16)
+        return ce_sum / jnp.maximum(w_sum, 1.0)
+
+    def fused_loss(c, t):
+        ce_sum, w_sum = pallas_ce.fused_weighted_ce_sums(
+            t, c, label, weight, 40, dtype=jnp.bfloat16, interpret=True)
+        return ce_sum / jnp.maximum(w_sum, 1.0)
+
+    np.testing.assert_allclose(float(fused_loss(code, w)),
+                               float(ref_loss(code, w)), rtol=2e-2)
+    want_dc, want_dw = jax.grad(ref_loss, argnums=(0, 1))(code, w)
+    got_dc, got_dw = jax.grad(fused_loss, argnums=(0, 1))(code, w)
+    np.testing.assert_allclose(np.asarray(got_dc), np.asarray(want_dc),
+                               rtol=5e-2, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(got_dw), np.asarray(want_dw),
+                               rtol=5e-2, atol=5e-3)
 
 
 @pytest.mark.parametrize('shard_contexts', [False, True])
