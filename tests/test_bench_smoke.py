@@ -23,3 +23,20 @@ def test_bench_smoke_emits_one_json_line():
     assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
     assert record['vs_baseline'] == 0.0
     assert record['value'] > 0
+
+
+def test_bench_fused_ce_smoke_runs_all_arms():
+    """The staged fused-CE A/B harness must survive import/config rot:
+    one healthy tunnel window is too expensive to spend on a crash."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'bench_fused_ce.py')],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line)
+               for line in proc.stdout.splitlines() if line.strip()]
+    measures = {r['measure'] for r in records if 'measure' in r}
+    assert {'step_ms_ce_xla_SMOKE_ONLY', 'step_ms_ce_fused_SMOKE_ONLY',
+            'step_ms_ce_fused_rbg_bf16mu_SMOKE_ONLY'} <= measures
