@@ -1,0 +1,70 @@
+"""Summarize benchmarks/results/*.jsonl captures into one table.
+
+The unattended watcher (watch_and_capture.sh) appends stage-wrapped JSON
+lines ({"stage", "rc", "secs", "data": {...}}) across rare healthy tunnel
+windows; the interactive harnesses emit raw measure lines. This collates
+both shapes so the A/B verdicts (rbg dropout, embed-grad, fused CE,
+bf16-mu, Pallas C=1024) can be read off — and defaults flipped on
+evidence — without re-parsing JSONL by hand.
+
+Run: python benchmarks/summarize_captures.py [--dir benchmarks/results]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def iter_records(path: str):
+    with open(path) as f:
+        for raw in f:
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            stage = rec.get('stage')
+            data = rec.get('data') if isinstance(rec.get('data'), dict) \
+                else (rec if 'stage' not in rec else None)
+            # a stage wrapper with null data is a FAILED stage (run_stage
+            # writes it when the stage produced no JSON) — surface it,
+            # silence here would read as "stage not run yet"
+            if data is None and stage is not None:
+                yield stage, rec.get('rc'), {'measure': 'STAGE FAILED',
+                                             'value': None,
+                                             'secs': rec.get('secs')}
+            elif data is not None:
+                yield stage, rec.get('rc'), data
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dir', default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'results'))
+    args = parser.parse_args()
+
+    names = sorted(n for n in os.listdir(args.dir) if n.endswith('.jsonl'))
+    for name in names:
+        print(f'== {name}')
+        for stage, rc, data in iter_records(os.path.join(args.dir, name)):
+            label = (data.get('measure') or data.get('metric')
+                     or data.get('probe') or next(iter(data), '?'))
+            value = data.get('value')
+            extras = {k: v for k, v in data.items()
+                      if k in ('examples_per_sec', 'unit', 'vs_baseline',
+                               'variant', 'devices', 'opt_sharding',
+                               'speedup', 'verdict', 'distribution',
+                               'step_ms', 'partition_overhead_vs_1dev')}
+            prefix = f'  [{stage}]' if stage else '  '
+            flag = '' if not rc else f'  (rc={rc})'
+            print(f'{prefix} {label}: {value} '
+                  + ' '.join(f'{k}={v}' for k, v in extras.items()) + flag)
+    print('\nDecision rule (PERF.md): a knob flips default only on a '
+          '>=2% measured step-time win at the java14m config; ties keep '
+          'reference-parity behavior.')
+
+
+if __name__ == '__main__':
+    main()

@@ -35,11 +35,6 @@ def _round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
 
 
-def _lcm(a: int, b: int) -> int:
-    import math
-    return a * b // math.gcd(a, b)
-
-
 def target_row_alignment(config: Config) -> int:
     """Row alignment of the TARGET table allocation. Folds in the fused-CE
     tile so the kernel's own pad is a no-op (otherwise every step would
@@ -50,9 +45,11 @@ def target_row_alignment(config: Config) -> int:
     it determines the saved array's shape."""
     align = max(config.PARAM_ROW_ALIGNMENT, 1)
     if config.USE_PALLAS_FUSED_CE:
+        import math
+
         from code2vec_tpu.ops.pallas_ce import VOCAB_TILE
-        align = _lcm(align,
-                     VOCAB_TILE * max(config.MESH_MODEL_AXIS_SIZE, 1))
+        align = math.lcm(align,
+                         VOCAB_TILE * max(config.MESH_MODEL_AXIS_SIZE, 1))
     return align
 
 

@@ -81,10 +81,15 @@ def _fwd_kernel(precision, code_ref, w_ref, label_ref, nv_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + j * block
     # num_valid arrives as a (1, 1) block so it can be a traced, shard-
     # local value under shard_map (a static closure value could not be)
-    logits = jnp.where(col < nv_ref[:], logits, _NEG)
+    valid = col < nv_ref[:]
+    logits = jnp.where(valid, logits, _NEG)
 
-    # label pick: at most one column matches per row across ALL blocks
-    onehot = (col == label_ref[:]).astype(jnp.float32)
+    # label pick: at most one VALID column matches per row across ALL
+    # blocks. The valid gate matters under shard_map: a label owned by the
+    # NEXT shard can collide with this shard's tile-pad window (columns
+    # [vshard, padded_vshard)) — ungated, that match would add the _NEG
+    # sentinel into the psum-merged pick and explode the loss.
+    onehot = jnp.where((col == label_ref[:]) & valid, 1.0, 0.0)
     p_ref[:] += jnp.sum(logits * onehot, axis=1, keepdims=True)
 
     m_old = m_ref[:]

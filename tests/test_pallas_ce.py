@@ -177,6 +177,45 @@ def test_sharded_matches_reference(monkeypatch, num_valid):
                                rtol=1e-4, atol=1e-6)
 
 
+def test_sharded_non_tile_multiple_shards(monkeypatch):
+    """Per-shard rows NOT a VOCAB_TILE multiple (vshard=20, tile=8): the
+    kernel pads each shard's block to 24 columns, so a neighbor shard's
+    VALID weight-1 label (e.g. global 21 on shard 1) collides with shard
+    0's pad window [20, 24) — the forward pick must gate that match out
+    (regression: ungated, shard 0 psums the -1e30 sentinel into picked
+    and the loss explodes)."""
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    from tests.test_sharding import _config
+
+    monkeypatch.setattr(pallas_ce, 'VOCAB_TILE', 8)
+    mesh = mesh_lib.create_mesh(_config(4, 2))
+    rng = np.random.default_rng(8)
+    code, w, _, _ = _case(rng, vocab=40)
+    # every global label index appears somewhere; all rows carry weight 1
+    label = jnp.asarray((np.arange(16) + 14) % 40, dtype=jnp.int32)
+    weight = jnp.ones((16,), jnp.float32)
+    want_ce, _ = _reference(code, w, label, weight, 40)
+    got_ce, _ = pallas_ce.sharded_fused_weighted_ce_sums(
+        w, code, label, weight, 40, mesh, interpret=True)
+    np.testing.assert_allclose(float(got_ce), float(want_ce), rtol=1e-5)
+
+    def fused_loss(c, t):
+        ce_sum, w_sum = pallas_ce.sharded_fused_weighted_ce_sums(
+            t, c, label, weight, 40, mesh, interpret=True)
+        return ce_sum / jnp.maximum(w_sum, 1.0)
+
+    def ref_loss(c, t):
+        ce_sum, w_sum = _reference(c, t, label, weight, 40)
+        return ce_sum / jnp.maximum(w_sum, 1.0)
+
+    want_dc, want_dw = jax.grad(ref_loss, argnums=(0, 1))(code, w)
+    got_dc, got_dw = jax.grad(fused_loss, argnums=(0, 1))(code, w)
+    np.testing.assert_allclose(np.asarray(got_dc), np.asarray(want_dc),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_dw), np.asarray(want_dw),
+                               rtol=1e-4, atol=1e-6)
+
+
 def test_bfloat16_compute_close_to_xla_path():
     """The on-chip A/B (bench_fused_ce.py) runs the headline bfloat16
     config: the kernel's bf16 arms must track the XLA path's bf16 CE
