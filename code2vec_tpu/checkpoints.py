@@ -38,6 +38,60 @@ class RestoredTraining(NamedTuple):
     epoch: int
 
 
+# --------------------------------------------------------------------------
+# Target-table row adaptation (ADVICE r3): the target table's padded row
+# count folds in the fused-CE vocab tile and the mesh model-axis size
+# (backends.target_row_alignment), so a checkpoint written under one
+# topology/fused-CE setting allocates a different row count than a resume
+# under another. The extra rows are pure padding — masked out of the
+# softmax by num_valid_targets and receiving zero gradient (hence zero Adam
+# moments) — so restore can pad with zeros or slice them off exactly. The
+# adapted leaves are identified by keypath name: 'target_embedding' names
+# the table in the canonical params dict, the optax moment NamedTuples, and
+# the flax param dict alike.
+
+_TARGET_ROWS_KEY = 'target_vocab_rows'
+_TARGET_LEAF_NAME = 'target_embedding'
+
+
+def _is_target_path(path) -> bool:
+    last = path[-1]
+    name = getattr(last, 'name', None)
+    if name is None:
+        name = getattr(last, 'key', None)
+    return name == _TARGET_LEAF_NAME
+
+
+def _with_target_rows(abstract_tree, rows: int):
+    """Abstract tree with target-table leaves' leading dim set to ``rows``
+    (the STORED allocation), keeping dtype and current-mesh sharding."""
+    def fix(path, leaf):
+        if not _is_target_path(path) or leaf.shape[0] == rows:
+            return leaf
+        return jax.ShapeDtypeStruct((rows,) + tuple(leaf.shape[1:]),
+                                    leaf.dtype,
+                                    sharding=getattr(leaf, 'sharding', None))
+    return jax.tree_util.tree_map_with_path(fix, abstract_tree)
+
+
+def _resize_target_rows(tree, abstract_tree, rows: int):
+    """Pad (zeros) or slice restored target-table leaves to ``rows`` (the
+    CURRENT allocation), re-laid-out to the abstract leaf's sharding.
+    Slicing is exact because the current allocation always covers the
+    valid vocabulary rows; rows beyond them are masked padding."""
+    def fix(path, leaf, abstract_leaf):
+        if not _is_target_path(path) or leaf.shape[0] == rows:
+            return leaf
+        if leaf.shape[0] > rows:
+            out = leaf[:rows]
+        else:
+            pad = [(0, rows - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+            out = jax.numpy.pad(leaf, pad)
+        sharding = getattr(abstract_leaf, 'sharding', None)
+        return jax.device_put(out, sharding) if sharding is not None else out
+    return jax.tree_util.tree_map_with_path(fix, tree, abstract_tree)
+
+
 class CheckpointStore:
     """Orbax-backed store for one model path prefix."""
 
@@ -73,7 +127,7 @@ class CheckpointStore:
             return
         to_write = dict(self.metadata, checkpoint_layout=self._LAYOUT)
         stored = self._stored_metadata()
-        for key in self._NON_STRICT_KEYS:
+        for key in self._PRESERVE_ON_WRITE:
             # the original writer wins: e.g. --release under another
             # framework must not relabel the training checkpoint's
             # framework, or the resume diagnostic below lies
@@ -82,10 +136,16 @@ class CheckpointStore:
         with open(self.meta_path, 'w') as f:
             json.dump(to_write, f)
 
-    # metadata keys that are informational, not shape-determining: a
-    # mismatch is fine for params-only loads (the canonical checkpoint
-    # layout is backend-agnostic)
-    _NON_STRICT_KEYS = frozenset({'framework'})
+    # identity keys where the ORIGINAL writer wins on re-save
+    _PRESERVE_ON_WRITE = frozenset({'framework'})
+    # metadata keys whose mismatch does not reject a restore: 'framework'
+    # is informational for params-only loads (the canonical checkpoint
+    # layout is backend-agnostic); target_vocab_rows differences are
+    # ADAPTED on restore (pad/slice of masked padding rows), so fused-CE
+    # checkpoints stay loadable across mesh reshapes. Unlike 'framework',
+    # target_vocab_rows must track the NEWEST save — it describes the
+    # saved arrays' actual shape.
+    _NON_STRICT_KEYS = frozenset({'framework', _TARGET_ROWS_KEY})
 
     def verify_metadata(self) -> None:
         if not self.metadata or not os.path.isfile(self.meta_path):
@@ -106,6 +166,13 @@ class CheckpointStore:
             return {}
         with open(self.meta_path, 'r') as f:
             return json.load(f)
+
+    def _stored_target_rows(self) -> Optional[int]:
+        """The target-table row count the checkpoint was SAVED with, when
+        recorded — restore targets must use it, then adapt to the current
+        allocation (see the module-level row-adaptation note)."""
+        rows = self._stored_metadata().get(_TARGET_ROWS_KEY)
+        return int(rows) if rows is not None else None
 
     # ------------------------------------------------------------- manager
     def manager(self) -> ocp.CheckpointManager:
@@ -200,6 +267,12 @@ class CheckpointStore:
             return None
         manager, latest = newest
         self.verify_metadata()
+        stored_rows = self._stored_target_rows()
+        current_params, current_opt = abstract_params, abstract_opt_state
+        if stored_rows is not None:
+            abstract_params = _with_target_rows(abstract_params, stored_rows)
+            abstract_opt_state = _with_target_rows(abstract_opt_state,
+                                                   stored_rows)
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
@@ -225,8 +298,16 @@ class CheckpointStore:
                     'frameworks.' % (self.model_path, current_fw,
                                      stored_fw)) from exc
             raise
+        params, opt_state = restored['params'], restored['opt_state']
+        if stored_rows is not None:
+            current_rows = self.metadata.get(_TARGET_ROWS_KEY)
+            if current_rows is not None and current_rows != stored_rows:
+                params = _resize_target_rows(params, current_params,
+                                             current_rows)
+                opt_state = _resize_target_rows(opt_state, current_opt,
+                                                current_rows)
         return RestoredTraining(
-            params=restored['params'], opt_state=restored['opt_state'],
+            params=params, opt_state=opt_state,
             step=int(restored['step']), epoch=int(restored['epoch']))
 
     def restore_params(self, abstract_params) -> Optional[Any]:
@@ -234,12 +315,25 @@ class CheckpointStore:
         fall back to the newest full checkpoint (reference load order:
         whatever exists under the load path)."""
         self.verify_metadata()
+        current_params = abstract_params
+        stored_rows = self._stored_target_rows()
+        if stored_rows is not None:
+            abstract_params = _with_target_rows(abstract_params, stored_rows)
+
+        def adapt(params):
+            current_rows = self.metadata.get(_TARGET_ROWS_KEY)
+            if (stored_rows is not None and current_rows is not None
+                    and current_rows != stored_rows):
+                return _resize_target_rows(params, current_params,
+                                           current_rows)
+            return params
+
         if os.path.isdir(self.weights_dir):
             checkpointer = ocp.StandardCheckpointer()
             restored = checkpointer.restore(
                 self.weights_dir, {'params': abstract_params})
             checkpointer.close()
-            return restored['params']
+            return adapt(restored['params'])
         newest = self._newest()
         if newest is None:
             return None
@@ -254,7 +348,7 @@ class CheckpointStore:
                     {'params': abstract_params}),
                 partial_restore=True))
         self._check_materialized(restored['params'])
-        return restored['params']
+        return adapt(restored['params'])
 
     def _check_materialized(self, params) -> None:
         """partial_restore=True silently leaves target leaves UNRESTORED

@@ -42,7 +42,10 @@ def target_row_alignment(config: Config) -> int:
     model-sharded mesh the kernel streams PER-SHARD rows, so the no-copy
     condition is V/model_axis % VOCAB_TILE == 0. The resulting padded row
     count is recorded in checkpoint metadata ('target_vocab_rows') since
-    it determines the saved array's shape."""
+    it determines the saved array's shape; restore ADAPTS a differing row
+    count by padding/slicing the masked padding rows (checkpoints.py), so
+    the allocation being topology-dependent does not make checkpoints
+    topology-dependent (ADVICE r3)."""
     align = max(config.PARAM_ROW_ALIGNMENT, 1)
     if config.USE_PALLAS_FUSED_CE:
         import math

