@@ -92,10 +92,13 @@ def measure(use_pallas: bool):
     return per_chip, engaged
 
 
-def main() -> None:
+def run_variant(variant: str) -> None:
+    """Child mode: one A/B arm in this process. Prints the same JSON lines
+    the old single-process harness did."""
     import jax
     benchlib.honor_env_platforms()
     platform = jax.devices()[0].platform.lower()
+    use_pallas = variant == 'pallas'
     if not SMOKE:
         from code2vec_tpu.ops.pallas_encode import tpu_backend_active
         if not tpu_backend_active():
@@ -103,44 +106,87 @@ def main() -> None:
             # anything else would end in a guaranteed-invalid verdict
             # after minutes of compile + measurement.
             print(json.dumps({'error': 'tpu_unavailable',
-                              'detail': f'platform={platform}'}))
-            return
-
-    results = {}
-    for variant, use_pallas in [('xla', False), ('pallas', True)]:
-        try:
-            examples_per_sec, engaged = measure(use_pallas)
-        except Exception as exc:  # a kernel compile failure IS the answer
-            print(json.dumps({'variant': variant, 'error': str(exc)[:300]}))
-            if variant == 'pallas':
-                print(json.dumps({'verdict': 'keep-xla',
-                                  'reason': 'pallas path failed'}))
-                return
-            raise
-        if use_pallas and not engaged and not SMOKE:
-            # (SMOKE runs off-TPU where the kernel routes to the
-            # interpreter or not at all; engagement is a TPU-only check)
-            print(json.dumps({
-                'variant': variant, 'error': 'kernel_not_engaged',
-                'detail': 'compiled eval HLO has no Pallas custom-call; '
-                          'the A/B would compare XLA against itself'}))
-            print(json.dumps({'verdict': 'invalid',
-                              'reason': 'kernel_not_engaged'}))
-            return
-        results[variant] = examples_per_sec
-        metric = ('eval_examples_per_sec_SMOKE_ONLY' if SMOKE
-                  else 'eval_examples_per_sec_per_chip_java14m')
-        if _contexts:
-            metric += f'_c{_contexts}'  # non-headline bag size
+                              'detail': f'platform={platform}'}), flush=True)
+            sys.exit(2)
+    try:
+        examples_per_sec, engaged = measure(use_pallas)
+    except Exception as exc:  # a kernel compile failure IS the answer
+        print(json.dumps({'variant': variant, 'error': str(exc)[:300]}),
+              flush=True)
+        sys.exit(1)
+    if use_pallas and not engaged and not SMOKE:
+        # (SMOKE runs off-TPU where the kernel routes to the
+        # interpreter or not at all; engagement is a TPU-only check)
         print(json.dumps({
-            'metric': metric,
-            'variant': variant,
-            'value': round(examples_per_sec, 1),
-            'unit': 'examples/sec/chip'}))
-    speedup = results['pallas'] / results['xla']
+            'variant': variant, 'error': 'kernel_not_engaged',
+            'detail': 'compiled eval HLO has no Pallas custom-call; '
+                      'the A/B would compare XLA against itself'}),
+            flush=True)
+        sys.exit(3)
+    metric = ('eval_examples_per_sec_SMOKE_ONLY' if SMOKE
+              else 'eval_examples_per_sec_per_chip_java14m')
+    if _contexts:
+        metric += f'_c{_contexts}'  # non-headline bag size
     print(json.dumps({
-        'verdict': 'keep-pallas' if speedup > 1.02 else 'keep-xla',
-        'speedup': round(speedup, 4)}))
+        'metric': metric,
+        'variant': variant,
+        'value': round(examples_per_sec, 1),
+        'unit': 'examples/sec/chip'}), flush=True)
+
+
+def main() -> None:
+    """Parent: each variant in its own subprocess under a per-arm timeout,
+    so a Mosaic compile stall (the observed C=1024 failure mode — 900 s
+    stage timeout burned with nothing to show, round-3 capture log) costs
+    one arm, not the whole healthy window. The parent imports no jax and
+    never touches the tunnel itself."""
+    variant = os.environ.get('BENCH_PALLAS_ENCODE_VARIANT', '')
+    if variant:
+        run_variant(variant)
+        return
+    import subprocess
+    per_arm = float(os.environ.get('BENCH_PALLAS_ARM_TIMEOUT',
+                                   '240' if SMOKE else '780'))
+    results = {}
+    for variant in ('xla', 'pallas'):
+        env = dict(os.environ, BENCH_PALLAS_ENCODE_VARIANT=variant)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=per_arm)
+            out, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout.decode(errors='replace')
+                   if isinstance(e.stdout, bytes) else (e.stdout or ''))
+            rc = -1
+            print(json.dumps({'variant': variant,
+                              'error': 'arm_timeout',
+                              'timeout_s': per_arm}), flush=True)
+        for line in out.splitlines():
+            line = line.strip()
+            if not line.startswith('{'):
+                continue
+            print(line, flush=True)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get('variant') == variant and 'value' in rec:
+                results[variant] = rec['value']
+            if rec.get('error') == 'tpu_unavailable':
+                return
+        if rc != 0 and variant == 'pallas':
+            print(json.dumps({'verdict': 'keep-xla',
+                              'reason': 'pallas arm failed or timed out'}),
+                  flush=True)
+            return
+        if rc != 0:
+            return
+    if 'xla' in results and 'pallas' in results:
+        speedup = results['pallas'] / results['xla']
+        print(json.dumps({
+            'verdict': 'keep-pallas' if speedup > 1.02 else 'keep-xla',
+            'speedup': round(speedup, 4)}), flush=True)
 
 
 if __name__ == '__main__':

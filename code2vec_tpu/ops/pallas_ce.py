@@ -41,6 +41,7 @@ matrix for top-k anyway.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -61,7 +62,10 @@ from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 # tile 1024): fwd ~8 MB, bwd ~11 MB incl. the f32 dlogits block, double-
 # buffered weight blocks and the dcode accumulator — comfortably under the
 # ~16 MB/core budget; 2048 would put the backward at ~18 MB.
-VOCAB_TILE = 1024
+# PALLAS_CE_VOCAB_TILE overrides it (VERDICT r3 #4 contingency: if Mosaic
+# compile stalls at java14m shapes inside a capture window, the bench
+# harness retries with smaller tiles unattended).
+VOCAB_TILE = int(os.environ.get('PALLAS_CE_VOCAB_TILE', '1024'))
 _NEG = -1e30        # finite -inf stand-in (denormal-safe, like _MASK_MIN)
 
 
