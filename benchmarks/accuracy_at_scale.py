@@ -84,7 +84,11 @@ def build_dataset(workdir: str, classes: int, contexts: int) -> str:
                 run([extractor, '--dir', os.path.join(corpus, split),
                      '--max_path_length', '8', '--max_path_width', '2',
                      '--num_threads', '16'], stdout=f)
-    prefix = os.path.join(data, 'acc')
+    # the RAW extraction is contexts-independent and shared; the
+    # preprocessed dataset is keyed by the sampling width so profiles with
+    # different MAX_CONTEXTS never share a cached .c2v (a C=200 profile
+    # silently training on C=32-sampled rows would be a wrong experiment)
+    prefix = os.path.join(data, 'acc_c%d' % contexts)
     if not os.path.isfile(prefix + '.train.c2v'):
         run([sys.executable, '-m', 'code2vec_tpu.data.preprocess',
              '-trd', raw['train'], '-vd', raw['val'], '-ted', raw['test'],
