@@ -70,12 +70,18 @@ class Parser {
     return root;
   }
 
+  // True if member-level error recovery skipped any input: the tree is
+  // usable but incomplete, so a zero-method result must not be trusted
+  // as "this file genuinely has no methods".
+  bool had_recovery() const { return recovered_; }
+
  private:
   std::vector<Token> toks_;
   Arena* arena_;
   size_t i_ = 0;
   std::vector<std::pair<size_t, std::string>> mutations_;
   int depth_ = 0;
+  bool recovered_ = false;
 
   static const std::set<std::string>& modifiers() {
     static const std::set<std::string> kMods = {
@@ -221,7 +227,10 @@ class Parser {
       if (is_punct("{")) skip_balanced("{", "}");
       return nullptr;
     }
-    // unknown top-level construct: skip one token to make progress
+    // unknown top-level construct: skip one token to make progress (and
+    // mark the parse recovered — input was dropped, so "no methods found"
+    // can no longer be trusted as a property of valid Java)
+    recovered_ = true;
     advance();
     return nullptr;
   }
@@ -285,6 +294,7 @@ class Parser {
       } catch (const ParseError&) {
         // recovery: skip this member — to the next ';' at depth 0 or past
         // one balanced '{...}' block
+        recovered_ = true;
         rewind(member_start);
         skip_member();
       }

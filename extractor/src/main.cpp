@@ -124,22 +124,40 @@ c2v::Node* parse_with_retries(const std::string& code, c2v::Arena* arena,
       class_prefix + method_prefix + code + method_suffix + class_suffix,
       class_prefix + code + class_suffix,
   };
+  // a candidate that parses cleanly but holds no methods is NOT a parse
+  // failure: the reference only retries on a parse exception
+  // (FeatureExtractor.java:51-75) and emits nothing, without error, for
+  // valid Java whose only function members are constructors (its visitor
+  // walks MethodDeclaration nodes only). Keep trying later wrappings for
+  // one that yields methods, but remember the first clean parse so such
+  // files produce zero rows instead of a spurious "could not parse".
+  c2v::Node* first_parsed = nullptr;
+  std::string first_parsed_source;
   for (const std::string& candidate : candidates) {
     try {
       c2v::Lexer lexer(candidate);
       c2v::Parser parser(lexer.run(), arena);
       c2v::Node* root = parser.parse_compilation_unit();
-      // a parse that found no methods is treated as failed so the wrapped
-      // retries get their chance
       std::vector<c2v::Node*> methods;
       c2v::find_methods(root, &methods);
       if (!methods.empty()) {
         *parsed_source = candidate;
         return root;
       }
+      // only a RECOVERY-FREE parse proves the file is valid Java with no
+      // methods; a recovered parse of garbage also reaches here with an
+      // empty method list and must still count as a failure
+      if (first_parsed == nullptr && !parser.had_recovery()) {
+        first_parsed = root;
+        first_parsed_source = candidate;
+      }
     } catch (const std::exception&) {
       // fall through to the next wrapping
     }
+  }
+  if (first_parsed != nullptr) {
+    *parsed_source = first_parsed_source;
+    return first_parsed;
   }
   return nullptr;
 }
