@@ -320,3 +320,19 @@ def test_reference_java_sources_extract_cleanly():
     labels = {row.split(' ', 1)[0] for row in rows}
     # spot-check real method names survived subtokenization
     assert 'to|string' in labels and 'get|path' in labels
+
+
+def test_reference_csharp_sources_extract_cleanly():
+    """Real-world C# stress: the reference's CSharpExtractor sources
+    (LINQ, properties, generics, Roslyn API calls) must extract without
+    a parse failure."""
+    ref = '/root/reference/CSharpExtractor'
+    if not os.path.isdir(ref):
+        pytest.skip('reference sources unavailable')
+    proc = run_extractor('--lang', 'csharp', '--dir', ref,
+                         '--num_threads', '4')
+    assert proc.returncode == 0, proc.stderr
+    rows = [line for line in proc.stdout.splitlines() if line.strip()]
+    assert len(rows) >= 20          # the repo holds ~25 real methods
+    labels = {row.split(' ', 1)[0] for row in rows}
+    assert 'find|path' in labels and 'extract|single|file' in labels
