@@ -68,7 +68,12 @@ def run(cmd, **kw):
 
 
 def build_dataset(workdir: str, classes: int, contexts: int) -> str:
-    corpus = os.path.join(workdir, 'corpus')
+    # every cached artifact is keyed by the parameters that shaped it:
+    # the corpus and raw extraction by the class count, the preprocessed
+    # dataset additionally by the sampling width — so profiles sharing a
+    # workdir can never silently train on each other's corpus size or
+    # contexts sampling (either would be a wrong experiment)
+    corpus = os.path.join(workdir, 'corpus_%d' % classes)
     data = os.path.join(workdir, 'data')
     os.makedirs(data, exist_ok=True)
     if not os.path.isdir(corpus):
@@ -78,17 +83,13 @@ def build_dataset(workdir: str, classes: int, contexts: int) -> str:
     extractor = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
     raw = {}
     for split in ('train', 'val', 'test'):
-        raw[split] = os.path.join(data, split + '.raw')
+        raw[split] = os.path.join(data, '%s_%d.raw' % (split, classes))
         if not os.path.isfile(raw[split]):
             with open(raw[split], 'w') as f:
                 run([extractor, '--dir', os.path.join(corpus, split),
                      '--max_path_length', '8', '--max_path_width', '2',
                      '--num_threads', '16'], stdout=f)
-    # the RAW extraction is contexts-independent and shared; the
-    # preprocessed dataset is keyed by the sampling width so profiles with
-    # different MAX_CONTEXTS never share a cached .c2v (a C=200 profile
-    # silently training on C=32-sampled rows would be a wrong experiment)
-    prefix = os.path.join(data, 'acc_c%d' % contexts)
+    prefix = os.path.join(data, 'acc_%d_c%d' % (classes, contexts))
     if not os.path.isfile(prefix + '.train.c2v'):
         run([sys.executable, '-m', 'code2vec_tpu.data.preprocess',
              '-trd', raw['train'], '-vd', raw['val'], '-ted', raw['test'],
@@ -252,7 +253,8 @@ def main() -> None:
     # contexts/method spread vs the reference anchors
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import corpus_stats as corpus_stats_mod
-    raw_train = os.path.join(os.path.dirname(prefix), 'train.raw')
+    raw_train = os.path.join(os.path.dirname(prefix),
+                             'train_%d.raw' % prof['classes'])
     result = {
         'profile': args.profile,
         'dataset': {'word_vocab': WORD_VOCAB, 'path_vocab': PATH_VOCAB,

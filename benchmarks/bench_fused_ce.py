@@ -116,18 +116,26 @@ def main() -> None:
         return
     per_arm = float(os.environ.get('BENCH_FUSED_CE_ARM_TIMEOUT',
                                    '120' if SMOKE else '300'))
-    _spawn('xla', per_arm)
+    ok = _spawn('xla', per_arm)
     # fused arm: shrink the vocab tile and retry if Mosaic compile stalls
+    fused_ok = False
     won_tile = None
     for tile in (None, 512, 256):
         if _spawn('fused', per_arm, tile=tile):
+            fused_ok = True
             won_tile = tile
             if tile is not None:
                 print(json.dumps({'measure': 'fused_ce_tile_fallback',
                                   'tile': tile}), flush=True)
             break
-    # the combined arm inherits whatever tile the fused arm proved
-    _spawn('fused_rbg_bf16mu', per_arm, tile=won_tile)
+    if not fused_ok:
+        # every tile stalled: rerunning the combined arm would hit the
+        # same compile; exit nonzero so the watcher retries the stage in
+        # a later window instead of locking in the xla arm alone
+        sys.exit(4)
+    ok = _spawn('fused_rbg_bf16mu', per_arm, tile=won_tile) and ok
+    if not ok:
+        sys.exit(4)
 
 
 if __name__ == '__main__':

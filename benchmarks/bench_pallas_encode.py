@@ -179,9 +179,12 @@ def main() -> None:
             print(json.dumps({'verdict': 'keep-xla',
                               'reason': 'pallas arm failed or timed out'}),
                   flush=True)
-            return
+            # nonzero exit keeps the watcher stage PENDING: this verdict
+            # is a placeholder, not a measured A/B — a later window must
+            # retry rather than lock it in
+            sys.exit(4)
         if rc != 0:
-            return
+            sys.exit(4)
     if 'xla' in results and 'pallas' in results:
         speedup = results['pallas'] / results['xla']
         print(json.dumps({

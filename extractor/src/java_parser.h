@@ -171,7 +171,9 @@ class Parser {
   }
 
   void skip_annotations() {
-    while (is_punct("@")) {
+    // '@interface' introduces an annotation DECLARATION, not an
+    // annotation use — leave it for parse_type_declaration
+    while (is_punct("@") && !is_ident("interface", 1)) {
       advance();
       expect_ident();
       while (accept_punct(".")) expect_ident();
@@ -222,7 +224,13 @@ class Parser {
     }
     if (is_ident("enum")) return parse_enum();
     if (is_punct("@") || is_ident("record")) {
-      // annotation decl / record: skip body
+      // annotation decl / record: skip body. A record body can hold real
+      // methods, so skipping one counts as recovery — a file whose ONLY
+      // type is a record must not pass as "valid Java with no methods"
+      // (the reference's JavaParser predates records and errors on them).
+      // @interface members are not MethodDeclarations, so that skip drops
+      // nothing the reference would have extracted.
+      if (is_ident("record")) recovered_ = true;
       while (!at_end() && !is_punct("{")) advance();
       if (is_punct("{")) skip_balanced("{", "}");
       return nullptr;
