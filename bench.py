@@ -193,12 +193,14 @@ def _capture_recency(results_dir: str, name: str) -> tuple:
         return (0, 0.0)
 
 
-def _last_known_good():
+def _last_known_good(results_dir: str | None = None):
     """Newest prior capture of the headline metric from
     benchmarks/results/*.jsonl, or None. Scanned newest-file-first; lines
     may be raw ({"metric": ...}) or stage-wrapped ({"data": {...}})."""
-    results_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               'benchmarks', 'results')
+    if results_dir is None:
+        results_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            'benchmarks', 'results')
     try:
         files = sorted(
             os.listdir(results_dir),

@@ -35,6 +35,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import corpus_stats as corpus_stats_mod  # noqa: E402 (sibling module)
 
 # Corpus vocab statistics overflow these on purpose: the 24K-class corpus
 # produces ~8.7K unique tokens and ~6.7K unique target names (measured),
@@ -251,8 +253,6 @@ def main() -> None:
     baseline = majority_baseline(prefix)
     # corpus-shape evidence (VERDICT r3 #6): Zipf slopes, singleton tail,
     # contexts/method spread vs the reference anchors
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import corpus_stats as corpus_stats_mod
     raw_train = os.path.join(os.path.dirname(prefix),
                              'train_%d.raw' % prof['classes'])
     result = {

@@ -88,6 +88,11 @@ def zipf_choice(rng: random.Random, pool, a: float = 1.15):
     return pool[min(max(rank - 1, 0), n - 1)]
 
 
+def capitalized(name: str) -> str:
+    """fieldName -> FieldName (for verbNoun method names)."""
+    return name[0].upper() + name[1:]
+
+
 def camel(*parts: str) -> str:
     head, *tail = [p for p in parts if p]
     return head + ''.join(p.capitalize() for p in tail)
@@ -138,7 +143,7 @@ class ClassGen:
     # partially resolvable — the learnable signal that keeps the val
     # curve climbing past the majority-verb plateau.
     def _getter(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         verb = self.rng.choices(['get', 'fetch', 'read'],
                                 weights=[6, 2, 2])[0]
         if verb == 'get':
@@ -159,7 +164,7 @@ class ClassGen:
 
     def _defaulted_getter(self, ftype, fname):
         # shared-prefix near-duplicate of the getter: getXOrDefault
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         if ftype == 'String':
             return ('%s get%sOrDefault(%s fallback) { return this.%s == '
                     'null ? fallback : this.%s; }'
@@ -172,7 +177,7 @@ class ClassGen:
                 % (ftype, cap, ftype, fname, fname))
 
     def _setter(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         verb = self.rng.choices(['set', 'update', 'assign'],
                                 weights=[6, 2, 2])[0]
         if verb == 'set':
@@ -191,12 +196,12 @@ class ClassGen:
 
     def _guarded_setter(self, ftype, fname):
         # shared-prefix near-duplicate of the setter: setXIfValid
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('void set%sIfValid(%s value) { if (value >= 0) { this.%s '
                 '= value; } }' % (cap, ftype, fname))
 
     def _resetter(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         zero = {'int': '0', 'long': '0L', 'double': '0.0',
                 'boolean': 'false', 'String': '""'}[ftype]
         verb = self.rng.choices(['reset', 'clear'], weights=[6, 4])[0]
@@ -208,7 +213,7 @@ class ClassGen:
                 % (cap, fname, zero, fname, zero, fname))
 
     def _predicate(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         if ftype == 'boolean':
             return 'boolean is%s() { return this.%s; }' % (cap, fname)
         if ftype == 'String':
@@ -217,7 +222,7 @@ class ClassGen:
         return 'boolean has%s() { return this.%s > 0; }' % (cap, fname)
 
     def _validator(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         if ftype in ('int', 'long', 'double'):
             cond = 'this.%s < 0' % fname
         elif ftype == 'boolean':
@@ -241,7 +246,7 @@ class ClassGen:
                 % (ftype, cap, cond, fname, fname))
 
     def _adder(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         verb = self.rng.choices(['addTo', 'increase', 'bump'],
                                 weights=[6, 2, 2])[0]
         if verb == 'addTo':
@@ -258,22 +263,22 @@ class ClassGen:
                 % (cap, fname, fname, one))
 
     def _clamper(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('%s clamp%s(%s low, %s high) { if (this.%s < low) { return '
                 'low; } if (this.%s > high) { return high; } return '
                 'this.%s; }' % (ftype, cap, ftype, ftype, fname, fname,
                                 fname))
 
     def _scaler(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('%s scale%s(%s factor) { return this.%s * factor; }'
                 % (ftype, cap, ftype, fname))
 
     def _computer(self, ftype, fname):
         num = self.numeric_fields()
         (t1, f1), (t2, f2) = self.rng.sample(num, 2)
-        cap1 = f1[0].upper() + f1[1:]
-        cap2 = f2[0].upper() + f2[1:]
+        cap1 = capitalized(f1)
+        cap2 = capitalized(f2)
         op = self.rng.choice(['+', '-', '*'])
         rtype = 'double' if 'double' in (t1, t2) else (
             'long' if 'long' in (t1, t2) else 'int')
@@ -283,13 +288,13 @@ class ClassGen:
     def _comparator(self, ftype, fname):
         num = self.numeric_fields()
         t1, f1 = self.rng.choice(num)
-        cap = f1[0].upper() + f1[1:]
+        cap = capitalized(f1)
         box = {'int': 'Integer', 'long': 'Long', 'double': 'Double'}[t1]
         return ('int compare%s(%s other) { return %s.compare(this.%s, '
                 'other); }' % (cap, t1, box, f1))
 
     def _describer(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         verb = self.rng.choices(['describe', 'format'], weights=[6, 4])[0]
         if verb == 'describe':
             return ('String describe%s() { return "%s=" + this.%s; }'
@@ -299,7 +304,7 @@ class ClassGen:
                 'return text; }' % (cap, fname, fname))
 
     def _checker(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         verb = self.rng.choices(['check', 'verify'], weights=[6, 4])[0]
         if verb == 'check':
             return ('boolean check%sEquals(String expected) { return '
@@ -312,27 +317,27 @@ class ClassGen:
     # --- structural-diversity kinds: new AST shapes (loops, ternaries,
     # swaps) that widen the path vocabulary toward real-Java variety
     def _counter(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('int countUpTo%s(int limit) { int n = 0; for (int i = 0; '
                 'i < limit; i++) { if (i < this.%s) { n = n + 1; } } '
                 'return n; }' % (cap, fname))
 
     def _drainer(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         one = {'int': '1', 'long': '1L', 'double': '1.0'}[ftype]
         return ('void drain%s() { while (this.%s > 0) { this.%s = this.%s '
                 '- %s; } }' % (cap, fname, fname, fname, one))
 
     def _toggler(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('void toggle%s() { this.%s = !this.%s; }'
                 % (cap, fname, fname))
 
     def _picker(self, ftype, fname):
         num = self.numeric_fields()
         (t1, f1), (t2, f2) = self.rng.sample(num, 2)
-        cap1 = f1[0].upper() + f1[1:]
-        cap2 = f2[0].upper() + f2[1:]
+        cap1 = capitalized(f1)
+        cap2 = capitalized(f2)
         rtype = 'double' if 'double' in (t1, t2) else (
             'long' if 'long' in (t1, t2) else 'int')
         which = self.rng.choice(['max', 'min'])
@@ -351,13 +356,13 @@ class ClassGen:
             return self._computer(ftype, fname)
         f1, f2 = self.rng.sample(self.rng.choice(pools), 2)
         t1 = next(t for t, f in num if f == f1)
-        cap1 = f1[0].upper() + f1[1:]
-        cap2 = f2[0].upper() + f2[1:]
+        cap1 = capitalized(f1)
+        cap2 = capitalized(f2)
         return ('void swap%sAnd%s() { %s held = this.%s; this.%s = this.%s; '
                 'this.%s = held; }' % (cap1, cap2, t1, f1, f1, f2, f2))
 
     def _appender(self, ftype, fname):
-        cap = fname[0].upper() + fname[1:]
+        cap = capitalized(fname)
         return ('void appendTo%s(String suffix) { this.%s = this.%s + '
                 'suffix; }' % (cap, fname, fname))
 

@@ -77,7 +77,7 @@ def test_bench_sigterm_flushes_fallback_line(tmp_path):
     assert record['source_file'].endswith('capture_2026-01-01T0000Z_rT.jsonl')
 
 
-def test_last_known_good_prefers_filename_stamp_over_mtime(tmp_path, monkeypatch):
+def test_last_known_good_prefers_filename_stamp_over_mtime(tmp_path):
     """ADVICE r3: git clones don't preserve mtimes, so recency must come
     from the ISO stamp embedded in capture filenames — an older capture
     touched later must not win."""
@@ -93,8 +93,5 @@ def test_last_known_good_prefers_filename_stamp_over_mtime(tmp_path, monkeypatch
     os.utime(results / 'capture_2026-07-29T1349Z_old.jsonl')
     older = os.path.getmtime(results / 'capture_2026-07-29T1349Z_old.jsonl') - 100
     os.utime(results / 'capture_2026-07-30T0100Z_new.jsonl', (older, older))
-    monkeypatch.setattr(
-        bench.os.path, 'abspath',
-        lambda p: str(tmp_path / 'bench.py') if p.endswith('bench.py') else os.path.abspath(p))
-    got = bench._last_known_good()
+    got = bench._last_known_good(str(results))
     assert got['value'] == 222.0
