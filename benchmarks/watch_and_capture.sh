@@ -7,16 +7,18 @@
 #
 #   bash benchmarks/watch_and_capture.sh [max_wait_seconds]
 #
-# Stages (ordered by VERDICT r3 priority — diag/frozen-tables first,
-# it isolates the scatter-add share of the 49->25 ms HBM gap):
+# Stages (ordered by expected payoff — the offline trace decomposition,
+# benchmarks/analyze_trace.py, puts the embedding scatter-add at ~16 ms
+# of the 46 ms step, so embed_grad leads the A/Bs):
 #   headline        a fresh bench.py headline capture (short inner budget —
 #                   the probe loop here already did the waiting)
-#   diag            step breakdown incl. frozen-tables (scatter isolation)
+#   diag            step breakdown incl. frozen-tables (scatter isolation,
+#                   cross-checks the trace-derived number on chip)
+#   embed_grad      dense/sorted/dedup table-gradient A/B, uniform+zipf
 #   fused_ce        flash-CE Pallas kernel A/B (ops/pallas_ce.py) +
 #                   the combined candidate default set; Mosaic-compiles
 #                   fused_lse_and_pick at java14m shapes first
 #   rbg_dropout     threefry-vs-rbg dropout A/B + bf16-mu combos
-#   embed_grad      dense/sorted/dedup table-gradient A/B, uniform+zipf
 #   accuracy_tpu    accuracy-at-scale tpu profile (full dims, C=200)
 #   pallas_c1024    long-context Pallas A/B, 1800 s budget (its 900 s
 #                   stage timed out on compile in the first sweep)
@@ -81,7 +83,7 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   return ${rc}
 }
 
-ALL_STAGES="headline diag fused_ce rbg_dropout embed_grad accuracy_tpu pallas_c1024"
+ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024"
 
 all_captured() {
   local s
@@ -111,13 +113,16 @@ BENCH_TOTAL_BUDGET=600 run_stage headline 700 python bench.py
 probe || { hb "wedged after headline"; exit 3; }
 run_stage diag 1200 python benchmarks/diag_step_breakdown.py
 probe || { hb "wedged after diag"; exit 3; }
+# embed_grad outranks fused_ce since the offline trace decomposition
+# (benchmarks/analyze_trace.py): the embedding gather+scatter is ~16 ms
+# of the 46 ms step — the single biggest lever
+run_stage embed_grad 1500 python benchmarks/bench_embed_grad.py
+probe || { hb "wedged after embed_grad"; exit 3; }
 # worst-case arm ladder: xla + 3 fused tile retries + combined, 5 x 300 s
 run_stage fused_ce 1800 python benchmarks/bench_fused_ce.py
 probe || { hb "wedged after fused_ce"; exit 3; }
 run_stage rbg_dropout 900 python benchmarks/bench_rbg_dropout.py
 probe || { hb "wedged after rbg_dropout"; exit 3; }
-run_stage embed_grad 1500 python benchmarks/bench_embed_grad.py
-probe || { hb "wedged after embed_grad"; exit 3; }
 run_stage accuracy_tpu 3600 \
   python benchmarks/accuracy_at_scale.py --profile tpu --workdir /tmp/acc_r4
 probe || { hb "wedged after accuracy_tpu"; exit 3; }
