@@ -378,3 +378,43 @@ def test_parser_survives_seeded_mutation_fuzz(tmp_path):
         assert proc.returncode in (0, 1), (
             'trial %d: rc=%d\nstderr: %s\nsource: %r'
             % (trial, proc.returncode, proc.stderr[-500:], ''.join(text)))
+
+
+def test_csharp_parser_survives_seeded_mutation_fuzz(tmp_path):
+    """Same bounded fuzz as the Java parser, over the C# frontend's
+    recovery paths (csharp.h is a separate hand-written parser)."""
+    import random
+    rng = random.Random(0xC5)
+    base = ('public class Fz {\n'
+            '  private int count; private string name;\n'
+            '  public int GetCount() { return this.count; }\n'
+            '  public void SetName(string v) { this.name = v; }\n'
+            '  public int Pick(int a, int b) => a > b ? a : b;\n'
+            '  public bool Check(string s) { foreach (var c in s) '
+            '{ if (c == \'x\') { return true; } } return false; }\n'
+            '}\n')
+    asan = BINARY + '-asan'
+    binary = asan if os.path.isfile(asan) else BINARY
+    chars = '{}()<>;,."@|&*+-=/\\\x00\xe4'
+    for trial in range(120):
+        text = list(base)
+        for _ in range(rng.randint(1, 8)):
+            op = rng.random()
+            pos = rng.randrange(len(text))
+            if op < 0.4:
+                text[pos] = rng.choice(chars)
+            elif op < 0.7:
+                del text[pos]
+            else:
+                text.insert(pos, rng.choice(chars))
+        src = tmp_path / ('F%03d.cs' % trial)
+        src.write_text(''.join(text), errors='replace')
+        proc = subprocess.run(
+            [binary, '--lang', 'csharp', '--max_path_length', '8',
+             '--max_path_width', '2', '--file', str(src)],
+            capture_output=True, text=True, timeout=30,
+            env=dict(os.environ,
+                     ASAN_OPTIONS='halt_on_error=1:detect_leaks=1'))
+        assert proc.returncode in (0, 1), (
+            'trial %d: rc=%d\nstderr: %s\nsource: %r'
+            % (trial, proc.returncode, proc.stderr[-500:], ''.join(text)))
