@@ -174,7 +174,11 @@ def main() -> None:
             if rec.get('variant') == variant and 'value' in rec:
                 results[variant] = rec['value']
             if rec.get('error') == 'tpu_unavailable':
-                return
+                # nonzero: the watcher must keep this stage pending.  A
+                # bare return here exited 0, so a wedge between the xla
+                # and pallas arms done-marked a half-captured A/B with
+                # no pallas arm and no verdict (advisor r4, medium).
+                sys.exit(2)
         if rc != 0 and variant == 'pallas':
             print(json.dumps({'verdict': 'keep-xla',
                               'reason': 'pallas arm failed or timed out'}),

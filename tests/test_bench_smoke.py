@@ -70,11 +70,15 @@ def test_bench_sigterm_flushes_fallback_line(tmp_path):
     out, _ = proc.communicate(timeout=30)
     assert proc.returncode == 0
     record = json.loads(out.strip().splitlines()[-1])
-    assert record['value'] == 1234.5
-    assert record['stale'] is True
-    assert record['last_known_good'] == 1234.5
+    # VERDICT r4 #8: headline fields stay honest on a failed fresh run —
+    # value 0.0 + error, the old capture only under last_known_good.
+    assert record['value'] == 0.0
+    assert record['vs_baseline'] == 0.0
+    assert record['error'] == 'tpu_unavailable'
     assert 'killed by signal 15' in record['detail']
-    assert record['source_file'].endswith('capture_2026-01-01T0000Z_rT.jsonl')
+    assert record['last_known_good']['value'] == 1234.5
+    assert record['last_known_good']['source_file'].endswith(
+        'capture_2026-01-01T0000Z_rT.jsonl')
 
 
 def test_last_known_good_prefers_filename_stamp_over_mtime(tmp_path):

@@ -187,6 +187,50 @@ def test_resume_across_fused_ce_and_mesh_reshape(tmp_path):
     assert padded.topk_predicted_words[:m] == after.topk_predicted_words[:m]
 
 
+def test_release_rows_rewrite_does_not_poison_older_checkpoints(tmp_path):
+    """ADVICE r4: one meta.json serves the whole history, and its
+    target_vocab_rows tracks only the NEWEST writer — after a --release
+    under a plain (smaller-rows) config, a resume of the older fused-CE
+    entire-model checkpoint used to build restore targets with the
+    release's row count against the checkpoint's larger arrays. The
+    restore must read the saved row count from the artifact itself
+    (orbax array metadata), not the shared sidecar."""
+    import json
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=1,
+                           PARAM_ROW_ALIGNMENT=8, USE_PALLAS_FUSED_CE=True)
+    model = Code2VecModel(config)
+    model.train()
+    line = 'get|a toka0,pA,toka1 toka1,pB,toka2    '
+    before = model.predict([line])[0]
+    fused_rows = model.backend.sizes['target_vocab_size']
+
+    # --release under a plain config rewrites the sidecar's rows
+    load_path = str(tmp_path / 'models' / 'saved_model')
+    config_r = Config(MODEL_LOAD_PATH=load_path, RELEASE=True,
+                      DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32',
+                      MAX_CONTEXTS=6, VERBOSE_MODE=0,
+                      READER_USE_NATIVE=False, PARAM_ROW_ALIGNMENT=8)
+    Code2VecModel(config_r).release_model()
+    with open(load_path + '.meta.json') as f:
+        sidecar_rows = json.load(f)['target_vocab_rows']
+    assert sidecar_rows < fused_rows
+
+    # resume TRAINING from the fused-CE entire-model checkpoint: its
+    # arrays hold fused_rows rows while the sidecar now says sidecar_rows
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2, PARAM_ROW_ALIGNMENT=8,
+        USE_PALLAS_FUSED_CE=True, MODEL_LOAD_PATH=load_path)
+    model2 = Code2VecModel(config2)
+    assert model2._start_epoch == 1
+    assert (model2.state.params.target_embedding.shape[0] == fused_rows)
+    after = model2.predict([line])[0]
+    assert before.topk_predicted_words == after.topk_predicted_words
+    np.testing.assert_allclose(before.topk_predicted_words_scores,
+                               after.topk_predicted_words_scores, rtol=1e-5)
+    model2.train()  # epoch 1 runs from the restored moments without error
+
+
 def test_step_interval_saves_and_midepoch_resume(tmp_path):
     """SAVE_EVERY_N_STEPS (VERDICT r1 #8): step-keyed async snapshots
     during the epoch bound preemption loss, in their OWN short-retention

@@ -270,3 +270,24 @@ def test_target_table_padded_to_tile():
     backend = JaxBackend(config, SizeOnlyVocabs(40, 12, 24))
     assert backend.sizes['target_vocab_size'] % pallas_ce.VOCAB_TILE == 0
     assert backend.num_valid_targets == 24
+
+
+def test_vocab_tile_override_validation():
+    """ADVICE r4: a bad PALLAS_CE_VOCAB_TILE must degrade to the default
+    with a warning, never crash the import or silently pick an unrunnable
+    tile; oversize tiles are accepted with a VMEM warning (Mosaic gives
+    the real verdict)."""
+    import warnings
+    from code2vec_tpu.ops.pallas_ce import (_DEFAULT_VOCAB_TILE,
+                                            _parse_vocab_tile)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter('always')
+        assert _parse_vocab_tile('abc') == _DEFAULT_VOCAB_TILE
+        assert _parse_vocab_tile('100') == _DEFAULT_VOCAB_TILE
+        assert _parse_vocab_tile('-256') == _DEFAULT_VOCAB_TILE
+        assert _parse_vocab_tile('2048') == 2048
+    assert len(caught) == 4
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')
+        assert _parse_vocab_tile('256') == 256
+        assert _parse_vocab_tile('1024') == 1024
