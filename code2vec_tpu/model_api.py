@@ -116,6 +116,7 @@ class Code2VecModel:
         self.trainer = Trainer(config, self.backend, mesh=self.mesh)
         self.state: Optional[TrainerState] = None
         self.params: Optional[Any] = None
+        self.eval_history: list = []
         self._stores: Dict[str, CheckpointStore] = {}
         self._load_or_create()
 
@@ -290,9 +291,18 @@ class Code2VecModel:
         # ALWAYS the global batch number (mixing epoch and batch steps on
         # one tag corrupts the stream)
         last_eval_batch = [-1]
+        # in-training eval results, in order — callers (and the multi-host
+        # exactness tests) read the merged numbers the training loop saw
+        self.eval_history = []
 
         def _evaluate_and_log(label: str, step: int, params) -> None:
             results = self.evaluate(params=params)
+            self.eval_history.append({
+                'label': label, 'step': step,
+                'topk_acc': [float(x) for x in results.topk_acc],
+                'precision': results.subtoken_precision,
+                'recall': results.subtoken_recall,
+                'f1': results.subtoken_f1, 'loss': results.loss})
             self.log('After %s: %s' % (label, results))
             if writer is not None:
                 writer.scalar('eval/top1_acc', float(results.topk_acc[0]),

@@ -29,8 +29,10 @@ def maybe_initialize_distributed(
     spans all hosts, the mesh spans the pod, each process's reader strides
     the data file (``PathContextReader(process_index, process_count)``) and
     ``parallel.mesh.shard_batch`` assembles the global batch from the
-    process-local shards. Known limitation (documented in
-    ``Code2VecModel.evaluate``): in-training evaluation is single-host only.
+    process-local shards. In-training per-epoch evaluation runs the same
+    fixed-step, counter-merged path as standalone ``Code2VecModel.evaluate``
+    (exactness across process counts:
+    ``tests/test_distributed.py::test_midtrain_eval_matches_single_process``).
     """
     import jax
 

@@ -27,6 +27,9 @@ def main() -> None:
                         help='1 = per-process token cache, 0 = streaming')
     parser.add_argument('--model_axis', type=int, default=1,
                         help='mesh model-axis size (TP across processes)')
+    parser.add_argument('--lr', type=float, default=0.01,
+                        help='0 freezes params: mid-train evals then see '
+                             'the seed-42 init on every process count')
     args = parser.parse_args()
 
     import jax
@@ -50,7 +53,7 @@ def main() -> None:
         MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=8, TEST_BATCH_SIZE=8,
         NUM_TRAIN_EPOCHS=max(args.train_epochs, 1),
         SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
-        READER_USE_NATIVE=False, LEARNING_RATE=0.01,
+        READER_USE_NATIVE=False, LEARNING_RATE=args.lr,
         # 1 exercises the per-process token cache (.tokcache.p<i>of<n>),
         # 0 the streaming fixed-step multi-host path
         TRAIN_DATA_CACHE=bool(args.data_cache),
@@ -69,6 +72,8 @@ def main() -> None:
     if args.train_epochs > 0:
         model.train()  # includes the per-epoch multi-host evaluate
         record['trained_epochs'] = args.train_epochs
+        # the merged in-training eval numbers the training loop itself saw
+        record['eval_history'] = model.eval_history
 
     results = model.evaluate()
     record.update({

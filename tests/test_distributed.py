@@ -68,14 +68,14 @@ def _worker_env() -> dict:
 
 
 def _launch_cluster_once(tmp_path, prefix, num_processes, train_epochs,
-                         timeout, data_cache, model_axis):
+                         timeout, data_cache, model_axis, lr):
     """One cluster attempt. Returns (records, None) or (None, failure_str)."""
     port = _free_port()
     outs = []
     procs = []
     for pid in range(num_processes):
         out = tmp_path / (f'result_p{num_processes}_{pid}_{train_epochs}'
-                          f'_{data_cache}_m{model_axis}.json')
+                          f'_{data_cache}_m{model_axis}_lr{lr}.json')
         outs.append(out)
         procs.append(subprocess.Popen(
             [sys.executable, WORKER,
@@ -86,7 +86,8 @@ def _launch_cluster_once(tmp_path, prefix, num_processes, train_epochs,
              '--out', str(out),
              '--train_epochs', str(train_epochs),
              '--data_cache', str(data_cache),
-             '--model_axis', str(model_axis)],
+             '--model_axis', str(model_axis),
+             '--lr', str(lr)],
             env=_worker_env(), cwd=str(tmp_path),  # eval log.txt goes here
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     failure = None
@@ -120,7 +121,7 @@ def _launch_cluster_once(tmp_path, prefix, num_processes, train_epochs,
 
 def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
                  timeout: float = 420.0, data_cache: int = 1,
-                 model_axis: int = 1) -> list:
+                 model_axis: int = 1, lr: float = 0.01) -> list:
     """Run one cluster under the inter-process lock, retrying the join once.
 
     The only observed flake mode is a worker missing the 120s join barrier
@@ -132,7 +133,7 @@ def _run_cluster(tmp_path, prefix, num_processes: int, train_epochs: int,
         for attempt in (1, 2):
             records, failure = _launch_cluster_once(
                 tmp_path, prefix, num_processes, train_epochs, timeout,
-                data_cache, model_axis)
+                data_cache, model_axis, lr)
             if records is not None:
                 return records
             if attempt == 1:
@@ -185,6 +186,34 @@ def test_two_process_train_and_eval_completes(tmp_path, dataset, data_cache):
     # eval must agree exactly
     assert records[0]['topk_acc'] == records[1]['topk_acc']
     assert records[0]['f1'] == records[1]['f1']
+    # the IN-TRAINING per-epoch evals are the same merged computation:
+    # identical on both processes, and the last one (final params) must
+    # equal the standalone post-train evaluate bit-for-bit
+    history = records[0]['eval_history']
+    assert len(history) == 2
+    assert history == records[1]['eval_history']
+    assert history[-1]['f1'] == records[0]['f1']
+    assert history[-1]['topk_acc'] == records[0]['topk_acc']
+
+
+def test_midtrain_eval_matches_single_process(tmp_path, dataset):
+    """VERDICT r4 #6: the training loop's per-epoch eval must produce the
+    exact single-process numbers, not a process-local approximation. With
+    lr=0 the params stay at the seed-42 init on ANY process count, so the
+    mid-train eval F1 is directly comparable across cluster sizes."""
+    two = _run_cluster(tmp_path, dataset, num_processes=2, train_epochs=1,
+                       lr=0.0)
+    one = _run_cluster(tmp_path, dataset, num_processes=1, train_epochs=1,
+                       lr=0.0)
+    h_two, h_one = two[0]['eval_history'], one[0]['eval_history']
+    assert len(h_two) == len(h_one) == 1
+    assert h_two == two[1]['eval_history']
+    assert h_two[0]['f1'] == h_one[0]['f1']
+    assert h_two[0]['precision'] == h_one[0]['precision']
+    assert h_two[0]['recall'] == h_one[0]['recall']
+    assert h_two[0]['topk_acc'] == h_one[0]['topk_acc']
+    np.testing.assert_allclose(h_two[0]['loss'], h_one[0]['loss'],
+                               rtol=1e-5)
 
 
 def test_two_process_tensor_parallel_eval_matches(tmp_path, dataset):
