@@ -124,7 +124,10 @@ class ClassGen:
         ftype, fname = rng.choice(self.fields)
         num = self.numeric_fields()
         kinds = ['getter', 'setter', 'resetter', 'predicate', 'validator',
-                 'defaulted_getter']
+                 'defaulted_getter',
+                 # combinatorial-nesting families (path-space pressure)
+                 'accumulator', 'scanner', 'normalizer', 'resolver',
+                 'processor']
         if ftype in ('int', 'long', 'double'):
             kinds += ['adder', 'clamper', 'scaler', 'counter', 'drainer',
                       'guarded_setter']
@@ -365,6 +368,162 @@ class ClassGen:
         cap = capitalized(fname)
         return ('void appendTo%s(String suffix) { this.%s = this.%s + '
                 'suffix; }' % (cap, fname, fname))
+
+    # --- combinatorial-nesting kinds (VERDICT r4 #3): the template kinds
+    # above produce a few hundred unique paths total because every body is
+    # a fixed AST shape. These families build bodies from RANDOM expression
+    # trees and statement nestings, so the corpus's path space grows
+    # combinatorially (target: >50K unique paths with a singleton tail,
+    # versus java14m's 911K kept paths) while each family keeps a
+    # learnable verb <-> skeleton correlation and the field noun stays in
+    # the context tokens.
+    NUM_OPS = ['+', '-', '*', '%']
+    CMP_OPS = ['<', '>', '<=', '>=', '==', '!=']
+
+    def _num_expr(self, depth, names):
+        rng = self.rng
+        if depth <= 0 or rng.random() < 0.35:
+            return rng.choice(names + [str(rng.randint(0, 99))])
+        return '(%s %s %s)' % (self._num_expr(depth - 1, names),
+                               rng.choice(self.NUM_OPS),
+                               self._num_expr(depth - 1, names))
+
+    def _cond_expr(self, depth, names):
+        rng = self.rng
+        if depth <= 0 or rng.random() < 0.55:
+            return '%s %s %s' % (self._num_expr(1, names),
+                                 rng.choice(self.CMP_OPS),
+                                 self._num_expr(0, names))
+        return '(%s %s %s)' % (self._cond_expr(depth - 1, names),
+                               rng.choice(['&&', '||']),
+                               self._cond_expr(depth - 1, names))
+
+    def _nested_stmt(self, depth, names):
+        """One statement over int-typed ``names``, recursively nested."""
+        rng = self.rng
+        kinds = ['assign', 'compound']
+        if depth > 0:
+            kinds += ['if', 'ifelse', 'for', 'while', 'ternary', 'block']
+        kind = rng.choice(kinds)
+        target = rng.choice(names)
+        if kind == 'assign':
+            return '%s = %s;' % (target, self._num_expr(2, names))
+        if kind == 'compound':
+            return '%s %s= %s;' % (target, rng.choice(self.NUM_OPS),
+                                   self._num_expr(1, names))
+        if kind == 'ternary':
+            return '%s = %s ? %s : %s;' % (
+                target, self._cond_expr(1, names),
+                self._num_expr(1, names), self._num_expr(1, names))
+        if kind == 'if':
+            return 'if (%s) { %s }' % (self._cond_expr(1, names),
+                                       self._nested_stmt(depth - 1, names))
+        if kind == 'ifelse':
+            return 'if (%s) { %s } else { %s }' % (
+                self._cond_expr(1, names),
+                self._nested_stmt(depth - 1, names),
+                self._nested_stmt(depth - 1, names))
+        if kind == 'for':
+            # per-class counter: nested fors must not redeclare a loop
+            # variable (the corpus stays valid compilable Java)
+            self._loop_seq = getattr(self, '_loop_seq', 0) + 1
+            loop_var = 'i%d' % self._loop_seq
+            inner_names = names + [loop_var]
+            return ('for (int %s = 0; %s < %s; %s++) { %s }'
+                    % (loop_var, loop_var, self._num_expr(0, names),
+                       loop_var, self._nested_stmt(depth - 1, inner_names)))
+        if kind == 'while':
+            return ('while (%s > 0) { %s %s = %s - 1; }'
+                    % (target, self._nested_stmt(depth - 1, names),
+                       target, target))
+        # block: two siblings — widens the path fan-out at one level
+        return '%s %s' % (self._nested_stmt(depth - 1, names),
+                          self._nested_stmt(depth - 1, names))
+
+    def _int_field_names(self):
+        return ['this.' + f for t, f in self.fields if t == 'int']
+
+    def _accumulator(self, ftype, fname):
+        cap = capitalized(fname)
+        rng = self.rng
+        verb = rng.choices(['accumulate', 'tally'], weights=[6, 4])[0]
+        names = ['acc', 'i'] + self._int_field_names()
+        inner = self._nested_stmt(rng.randint(1, 2), names)
+        # tell between the synonyms: tally post-clamps the accumulator
+        tail = ('' if verb == 'accumulate'
+                else ' if (acc < 0) { acc = 0; }')
+        return ('int %s%s(int limit) { int acc = 0; for (int i = 0; i < '
+                'limit; i++) { %s }%s return acc; }'
+                % (verb, cap, inner, tail))
+
+    def _scanner(self, ftype, fname):
+        cap = capitalized(fname)
+        rng = self.rng
+        verb = rng.choices(['scan', 'probe'], weights=[6, 4])[0]
+        names = ['i'] + self._int_field_names()
+        cond = self._cond_expr(rng.randint(1, 2), names)
+        if verb == 'scan':
+            return ('int scan%s(int limit) { for (int i = 0; i < limit; '
+                    'i++) { if (%s) { return i; } } return -1; }'
+                    % (cap, cond))
+        # probe: tell — tracks the last hit instead of returning early
+        return ('int probe%s(int limit) { int hit = -1; for (int i = 0; '
+                'i < limit; i++) { if (%s) { hit = i; } } return hit; }'
+                % (cap, cond))
+
+    def _normalizer(self, ftype, fname):
+        cap = capitalized(fname)
+        rng = self.rng
+        verb = rng.choices(['normalize', 'adjust'], weights=[6, 4])[0]
+        names = ['value'] + self._int_field_names()
+        clauses = ' '.join(
+            'if (%s) { value = %s; }' % (self._cond_expr(1, names),
+                                         self._num_expr(1, names))
+            for _ in range(rng.randint(2, 3)))
+        # adjust: tell — works on a shifted copy
+        if verb == 'adjust':
+            return ('int adjust%s(int raw) { int value = raw + 1; %s '
+                    'return value; }' % (cap, clauses))
+        return ('int normalize%s(int raw) { int value = raw; %s '
+                'return value; }' % (cap, clauses))
+
+    def _resolver(self, ftype, fname):
+        cap = capitalized(fname)
+        rng = self.rng
+        verb = rng.choices(['resolve', 'derive'], weights=[6, 4])[0]
+        names = self._int_field_names() + ['seed0']
+        decls = []
+        locals_so_far = list(names)
+        for k in range(rng.randint(2, 3)):
+            var = 'step%d' % k
+            decls.append('int %s = %s;'
+                         % (var, self._num_expr(rng.randint(1, 2),
+                                                locals_so_far)))
+            locals_so_far.append(var)
+        ret = locals_so_far[-1]
+        # derive: tell — guards the seed first
+        guard = ('if (seed0 < 0) { seed0 = 0; } ' if verb == 'derive'
+                 else '')
+        return ('int %s%s(int seed0) { %s%s return %s; }'
+                % (verb, cap, guard, ' '.join(decls), ret))
+
+    def _processor(self, ftype, fname):
+        cap = capitalized(fname)
+        rng = self.rng
+        verb = rng.choices(['process', 'handle', 'apply'],
+                           weights=[6, 2, 2])[0]
+        names = ['work'] + self._int_field_names()
+        body = ' '.join(self._nested_stmt(rng.randint(1, 3), names)
+                        for _ in range(rng.randint(1, 2)))
+        # tells: handle pre-guards, apply returns an expression over work
+        if verb == 'handle':
+            return ('int handle%s(int work) { if (work == 0) { return 0; } '
+                    '%s return work; }' % (cap, body))
+        if verb == 'apply':
+            return ('int apply%s(int work) { %s return work + 1; }'
+                    % (cap, body))
+        return ('int process%s(int work) { %s return work; }'
+                % (cap, body))
 
 
 def gen_class(rng: random.Random, name: str, noun_pairs,

@@ -185,6 +185,205 @@ namespace Demo
 '''
 
 
+# --------------------------------------------------------------------------
+# Hard-corner goldens (VERDICT r4 #10): the Java constructs a hand-written
+# parser is most likely to diverge on, pinned context-for-context against
+# the reference's javaparser-derived semantics (FeatureExtractor.java:51-75;
+# node names audited against the reference JAR's constant pool in
+# test_extractor_parity.py). All run under ASan/TSan via `make asan`.
+
+def test_lambda_expression_and_block_bodies(tmp_path):
+    src = tmp_path / 'Lambdas.java'
+    src.write_text(
+        'public class Lambdas {\n'
+        '    Runnable makeTask(int count) {\n'
+        '        return () -> { int total = count + 1; use(total); };\n'
+        '    }\n'
+        '    java.util.function.Function<Integer, Integer> '
+        'makeAdder(int delta) {\n'
+        '        return value -> value + delta;\n'
+        '    }\n'
+        '    void use(int v) {}\n'
+        '}\n')
+    lines = extract_file(str(src))
+    labels = [line.split(' ')[0] for line in lines]
+    # `use` has an empty body: no contexts, skipped (reference parity)
+    assert labels == ['make|task', 'make|adder']
+    task = lines[0].split(' ')[1:]
+    # block-bodied lambda: LambdaExpr -> BlockStmt chain, capture of the
+    # enclosing parameter included
+    assert ('METHOD_NAME,(NameExpr1)^(MethodDeclaration)_(BlockStmt)_'
+            '(ReturnStmt)_(LambdaExpr)_(BlockStmt)_(ExpressionStmt)_'
+            '(MethodCallExpr0)_(NameExpr0),use') in task
+    adder = lines[1].split(' ')[1:]
+    # expression-bodied lambda: its parameter pairs with its body leaves
+    assert ('value,(VariableDeclaratorId0)^(Parameter)^(LambdaExpr)_'
+            '(BinaryExpr:plus)_(NameExpr0),value') in adder
+    assert 'value,(NameExpr0)^(BinaryExpr:plus)_(NameExpr1),delta' in adder
+
+
+def test_anonymous_class_methods_extract_separately(tmp_path):
+    """Methods declared inside an anonymous class body are method
+    declarations like any other: the reference visits every
+    MethodDeclaration node, so `run` is its own labeled example, with the
+    enclosing method's captured variable among its leaves."""
+    src = tmp_path / 'Anon.java'
+    src.write_text(
+        'public class Anon {\n'
+        '    Runnable makeWorker(int seed) {\n'
+        '        return new Runnable() {\n'
+        '            public void run() { int local = seed + 2; '
+        'emit(local); }\n'
+        '        };\n'
+        '    }\n'
+        '    void emit(int v) {}\n'
+        '}\n')
+    lines = extract_file(str(src))
+    labels = [line.split(' ')[0] for line in lines]
+    # `emit` has an empty body: no contexts, skipped (reference parity)
+    assert labels == ['make|worker', 'run']
+    run_ctxs = lines[1].split(' ')[1:]
+    assert ('METHOD_NAME,(NameExpr1)^(MethodDeclaration)_(BlockStmt)_'
+            '(ExpressionStmt)_(VariableDeclarationExpr)_'
+            '(VariableDeclarator)_(BinaryExpr:plus)_(NameExpr0),seed'
+            ) in run_ctxs
+    # the outer method sees the anonymous creation; ObjectCreationExpr is
+    # on its paths
+    assert any('ObjectCreationExpr' in c for c in lines[0].split(' ')[1:])
+
+
+def test_nested_generics_with_wildcards(tmp_path):
+    src = tmp_path / 'Generics.java'
+    src.write_text(
+        'import java.util.Map;\n'
+        'import java.util.List;\n'
+        'public class Generics {\n'
+        '    int sumSizes(Map<String, ? extends List<? super Integer>> '
+        'table, List<String>[] buckets) {\n'
+        '        return table.size() + buckets.length;\n'
+        '    }\n'
+        '    <T extends Comparable<T>> T pickLarger(T first, T second) {\n'
+        '        return first.compareTo(second) > 0 ? first : second;\n'
+        '    }\n'
+        '}\n')
+    lines = extract_file(str(src))
+    assert [line.split(' ')[0] for line in lines] == \
+        ['sum|sizes', 'pick|larger']
+    sizes = lines[0].split(' ')[1:]
+    # the doubly-nested wildcard chain, type argument to type argument
+    assert ('string,(ClassOrInterfaceType0)^(ClassOrInterfaceType)_'
+            '(WildcardType)_(ClassOrInterfaceType)_(WildcardType)_'
+            '(PrimitiveType0),int') in sizes
+    # generic-array parameter type
+    assert any('ArrayType' in c for c in sizes)
+    larger = lines[1].split(' ')[1:]
+    # bounded type parameter's use site + ternary over the compareTo call
+    assert ('t,(ClassOrInterfaceType0)^(Parameter)^(MethodDeclaration)_'
+            '(BlockStmt)_(ReturnStmt)_(ConditionalExpr)_'
+            '(BinaryExpr:greater)_(MethodCallExpr0)_(NameExpr1),compareto'
+            ) in larger
+
+
+def test_annotations_with_arguments_are_trivia(tmp_path):
+    """Documented deviation (extractor/README.md): annotation uses
+    contribute no leaves — the annotated member extracts exactly like its
+    unannotated twin — and @interface members are not MethodDeclarations
+    (reference javaparser models them as AnnotationMemberDeclaration, and
+    the reference's visitor only collects MethodDeclaration)."""
+    annotated = tmp_path / 'Annot.java'
+    annotated.write_text(
+        'public class Annot {\n'
+        '    @Deprecated\n'
+        '    @SuppressWarnings({"unchecked", "rawtypes"})\n'
+        '    int legacyCount(@MyTag(limit = 5, name = "rows") int base) {\n'
+        '        return base + 1;\n'
+        '    }\n'
+        '    @interface MyTag { int limit(); String name(); }\n'
+        '}\n')
+    plain = tmp_path / 'Plain.java'
+    plain.write_text(
+        'public class Plain {\n'
+        '    int legacyCount(int base) {\n'
+        '        return base + 1;\n'
+        '    }\n'
+        '}\n')
+    annotated_lines = extract_file(str(annotated))
+    assert annotated_lines == extract_file(str(plain))
+    assert len(annotated_lines) == 1  # @interface members: no examples
+
+
+def test_switch_statement_shapes(tmp_path):
+    """Pre-Java-8 switch: fall-through case labels, default, break —
+    SwitchStmt/SwitchEntryStmt naming per the reference's
+    javaparser-3.0.0-alpha.4 (NOT the post-Java-12 SwitchEntry)."""
+    src = tmp_path / 'Switches.java'
+    src.write_text(
+        'public class Switches {\n'
+        '    int pickWeight(int kind, int fallback) {\n'
+        '        switch (kind) {\n'
+        '            case 0: return 10;\n'
+        '            case 1:\n'
+        '            case 2: return 20;\n'
+        '            default: break;\n'
+        '        }\n'
+        '        int result = fallback;\n'
+        '        switch (kind % 3) { case 1: result += 1; break; '
+        'default: result -= 1; }\n'
+        '        return result;\n'
+        '    }\n'
+        '}\n')
+    ctxs = extract_file(str(src))[0].split(' ')[1:]
+    assert ('int,(PrimitiveType0)^(Parameter)^(MethodDeclaration)_'
+            '(BlockStmt)_(SwitchStmt)_(NameExpr0),kind') in ctxs
+    # case label literal and its entry's return, under the same entry
+    assert ('int,(PrimitiveType0)^(Parameter)^(MethodDeclaration)_'
+            '(BlockStmt)_(SwitchStmt)_(SwitchEntryStmt)_(ReturnStmt)_'
+            '(IntegerLiteralExpr0),10') in ctxs
+    # the selector expression of the second switch is a BinaryExpr
+    assert any('(SwitchStmt)_(BinaryExpr:remainder)' in c for c in ctxs)
+
+
+def test_labeled_loops_arrays_varargs_try_instanceof(tmp_path):
+    """One method exercising labeled continue/break over nested loops,
+    2-D array access, varargs, try/catch/finally, cast + instanceof +
+    ternary — the long-tail statement forms real Java hits constantly."""
+    src = tmp_path / 'Misc.java'
+    src.write_text(
+        'public class Misc {\n'
+        '    int drainMatrix(int[][] grid, int... extras) '
+        'throws Exception {\n'
+        '        int total = 0;\n'
+        '        outer:\n'
+        '        for (int r = 0; r < grid.length; r++) {\n'
+        '            for (int c = 0; c < grid[r].length; c++) {\n'
+        '                if (grid[r][c] < 0) { continue outer; }\n'
+        '                if (grid[r][c] == 99) { break outer; }\n'
+        '                total += grid[r][c];\n'
+        '            }\n'
+        '        }\n'
+        '        try { total += extras[0]; } catch '
+        '(ArrayIndexOutOfBoundsException e) { total = -total; } '
+        'finally { total += 1; }\n'
+        '        Object box = (Object) Integer.valueOf(total);\n'
+        '        return box instanceof Integer ? '
+        '((Integer) box).intValue() : 0;\n'
+        '    }\n'
+        '}\n')
+    lines = extract_file(str(src))
+    assert len(lines) == 1
+    ctxs = lines[0].split(' ')[1:]
+    joined = ' '.join(ctxs)
+    for node in ('(LabeledStmt)', '(ArrayAccessExpr)', '(ArrayType)',
+                 '(TryStmt)', '(CatchClause)', '(InstanceOfExpr)',
+                 '(CastExpr)', '(ConditionalExpr)', '(EnclosedExpr)',
+                 '(UnaryExpr:negative)', '(UnaryExpr:posIncrement)',
+                 '(AssignExpr:plus)', '(FieldAccessExpr)'):
+        assert node in joined, node
+    # varargs parameter: its name is a leaf under the method's Parameter
+    assert any(c.endswith(',extras') and '(Parameter)' in c for c in ctxs)
+    assert all(len(c.split(',')) == 3 for c in ctxs)
+
+
 def test_csharp_extraction(tmp_path):
     src = tmp_path / 'Calc.cs'
     src.write_text(CSHARP_SAMPLE)
