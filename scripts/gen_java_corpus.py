@@ -103,6 +103,7 @@ class ClassGen:
 
     def __init__(self, rng: random.Random, noun_pairs):
         self.rng = rng
+        self._loop_seq = 0  # unique loop-variable counter (nested fors)
         self.fields = []
         used = set()
         for _ in range(rng.randint(3, 6)):
@@ -426,7 +427,7 @@ class ClassGen:
         if kind == 'for':
             # per-class counter: nested fors must not redeclare a loop
             # variable (the corpus stays valid compilable Java)
-            self._loop_seq = getattr(self, '_loop_seq', 0) + 1
+            self._loop_seq += 1
             loop_var = 'i%d' % self._loop_seq
             inner_names = names + [loop_var]
             return ('for (int %s = 0; %s < %s; %s++) { %s }'
