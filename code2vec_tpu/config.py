@@ -61,7 +61,12 @@ class Config:
     # different (still deterministic, seed-keyed) random stream. The
     # checkpointed key stays threefry either way; the rbg key is derived
     # inside the step, so checkpoints are unaffected by this knob.
-    DROPOUT_PRNG_IMPL: str = 'threefry2x32'
+    # DEFAULT 'rbg' per the ≥2% rule: the on-chip A/B measured 43.36 vs
+    # 47.32 ms/step (-8.4%, capture_2026-07-31T0344Z_r5.jsonl), and the
+    # full-dims learning curve under rbg matches the threefry/fp32 twin
+    # (accuracy_cpu_full_bf16.json: F1 0.7487 vs 0.7470). 'threefry2x32'
+    # remains the portable reference behavior.
+    DROPOUT_PRNG_IMPL: str = 'rbg'
     # Mesh shape: (data, model). data axis = DP (gradient psum over ICI);
     # model axis = row-sharded embedding tables + column-sharded softmax.
     MESH_DATA_AXIS_SIZE: int = -1   # -1: all devices on the data axis
@@ -96,8 +101,11 @@ class Config:
     # 'sorted' sorts the index stream so duplicate row hits are adjacent;
     # 'dedup' additionally pre-combines duplicates with a segmented scan so
     # each table row is written at most once. Numerically equivalent up to
-    # fp summation order; default decided by the on-chip A/B
-    # (benchmarks/bench_embed_grad.py, PERF.md).
+    # fp summation order. The on-chip A/B decided for 'dense' on both
+    # uniform and zipf index streams (48.69 vs 54.45 sorted / 65.42 dedup
+    # ms/step zipf, capture_2026-07-31T0344Z_r5.jsonl): XLA's native
+    # scatter-add beats both pre-combine strategies, which break its
+    # fusion the same way lazy Adam does (PERF.md).
     EMBED_GRAD_IMPL: str = 'dense'
     # Route the TRAINING cross-entropy through the flash-style fused Pallas
     # kernel (ops/pallas_ce.py): logsumexp + label pick computed blockwise
@@ -105,8 +113,12 @@ class Config:
     # exists in HBM in either direction (~4.3 GB/step at java14m shapes).
     # Multi-device meshes use the shard_mapped variant (table row-sharded
     # over 'model', batch over 'data', online stats merged over ICI).
-    # Off until the on-chip A/B (benchmarks/bench_fused_ce.py) records a
-    # win. Eval/predict always materialize logits (top-k needs them).
+    # The on-chip A/B measured it NEUTRAL at java14m shapes (47.18 vs
+    # 47.23 ms/step alone; +1.4% on top of the rbg+bf16-mu winner,
+    # capture_2026-07-31T0344Z_r5.jsonl) — below the ≥2% flip rule, so it
+    # stays opt-in: XLA's own CE fusion already avoids most of the logits
+    # round-trip. Eval/predict always materialize logits (top-k needs
+    # them).
     USE_PALLAS_FUSED_CE: bool = False
     # Shard the contexts axis (the 'sequence' analog, MAX_CONTEXTS) over the
     # model mesh axis — order-free sequence parallelism for large bags: the
