@@ -38,6 +38,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import corpus_stats as corpus_stats_mod  # noqa: E402 (sibling module)
 
+
+def _pythonpath() -> str:
+    """REPO prepended to the inherited PYTHONPATH — replacing it outright
+    drops the environment's backend-plugin site dir (the axon TPU plugin
+    registers from PYTHONPATH via sitecustomize), which kills every child
+    that inherits JAX_PLATFORMS=axon before it can initialize a device."""
+    inherited = os.environ.get('PYTHONPATH', '')
+    return REPO + (os.pathsep + inherited if inherited else '')
+
 # Corpus vocab statistics overflow these on purpose: the 24K-class corpus
 # produces ~8.7K unique tokens and ~6.7K unique target names (measured),
 # so these caps truncate the Zipf tail into real OOV pressure the way
@@ -47,18 +56,31 @@ PATH_VOCAB = 30000
 TARGET_VOCAB = 4000
 
 PROFILES = {
-    # java-small-like: full dims, full contexts
+    # Base profiles pin '--adam-mu-dtype float32' explicitly: the config
+    # DEFAULT flipped to bf16 mu on the 2026-07-31 on-chip A/B, and each
+    # *_bf16mu twin below must differ from its base by exactly that one
+    # knob — an unpinned base would silently train the twin's config and
+    # destroy the A/B.
+    # java-small-like: full dims, full contexts. Dropout is pinned 'rbg'
+    # to match the committed accuracy_tpu.json capture (2026-07-31
+    # 04:05Z, which ran after the rbg default flip landed on disk): the
+    # tpu_bf16mu twin below must differ from it by the mu dtype ONLY.
     'tpu': dict(classes=24000, batch=512, contexts=200, epochs=12,
-                extra_args=[]),
+                extra_args=['--dropout-prng', 'rbg',
+                            '--adam-mu-dtype', 'float32']),
     # reduced compute (smaller dims/contexts) so the learning-loop evidence
     # does not need the chip; vocab pressure is unchanged
     'cpu': dict(classes=24000, batch=512, contexts=32, epochs=6,
-                extra_args=['--dtype', 'float32']),
+                extra_args=['--dtype', 'float32',
+                            '--dropout-prng', 'threefry2x32',
+                            '--adam-mu-dtype', 'float32']),
     # VERDICT r3 #5 fallback: FULL model dims (128/128/384) and C=200 on
     # CPU — fewer classes/epochs so it finishes in tens of minutes, but
     # the model being validated is the real one, not the 64-dim stand-in
     'cpu_full': dict(classes=8000, batch=512, contexts=200, epochs=5,
-                     extra_args=['--dtype', 'float32']),
+                     extra_args=['--dtype', 'float32',
+                                 '--dropout-prng', 'threefry2x32',
+                                 '--adam-mu-dtype', 'float32']),
     # VERDICT r4 #2: the EXACT bench recipe (bfloat16 compute + Pallas
     # fused CE, interpreted on CPU + rbg dropout) at full dims, so the
     # 21.7K ex/s configuration is shown to reach the same F1 as its fp32
@@ -66,7 +88,20 @@ PROFILES = {
     'cpu_full_bf16': dict(classes=8000, batch=512, contexts=200, epochs=5,
                           extra_args=['--dtype', 'bfloat16',
                                       '--dropout-prng', 'rbg',
-                                      '--fused-ce']),
+                                      '--fused-ce',
+                                      '--adam-mu-dtype', 'float32']),
+    # ADAM_MU_DTYPE='bfloat16' equivalence twins (the last winning knob
+    # from the 2026-07-31 on-chip A/B, -5.1% step time): identical to the
+    # profile each shadows plus the bf16 first moment, so the F1 curve
+    # pairs 1:1 against accuracy_tpu.json / accuracy_cpu_full_bf16.json.
+    'tpu_bf16mu': dict(classes=24000, batch=512, contexts=200, epochs=12,
+                       extra_args=['--dropout-prng', 'rbg',
+                                   '--adam-mu-dtype', 'bfloat16']),
+    'cpu_full_bf16mu': dict(classes=8000, batch=512, contexts=200, epochs=5,
+                            extra_args=['--dtype', 'bfloat16',
+                                        '--dropout-prng', 'rbg',
+                                        '--fused-ce',
+                                        '--adam-mu-dtype', 'bfloat16']),
 }
 CPU_DIMS = dict(TOKEN_EMBEDDINGS_SIZE=64, PATH_EMBEDDINGS_SIZE=64,
                 CODE_VECTOR_SIZE=192, TARGET_EMBEDDINGS_SIZE=192)
@@ -106,7 +141,7 @@ def build_dataset(workdir: str, classes: int, contexts: int) -> str:
              '-mc', str(contexts), '-wvs', str(WORD_VOCAB),
              '-pvs', str(PATH_VOCAB), '-tvs', str(TARGET_VOCAB),
              '-o', prefix, '--seed', '0'],
-            cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO))
+            cwd=REPO, env=dict(os.environ, PYTHONPATH=_pythonpath()))
     return prefix
 
 
@@ -198,7 +233,7 @@ def main() -> None:
            '--save', os.path.join(model_dir, 'saved_model'),
            '--framework', 'jax', '--epochs', str(epochs),
            '--batch-size', str(prof['batch'])] + prof['extra_args']
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=_pythonpath())
     if args.profile.startswith('cpu'):
         env['JAX_PLATFORMS'] = 'cpu'
         # dims are Config attributes without CLI flags (reference-style):

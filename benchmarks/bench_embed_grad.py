@@ -89,12 +89,17 @@ def main() -> None:
         print(json.dumps({'measure': f'unique_token_rows_frac_{name}',
                           'value': round(len(np.unique(tok)) / tok.size, 4)}),
               flush=True)
+    # Arms pin the threefry + fp32-mu baseline knobs: the config DEFAULTS
+    # flipped to rbg + bf16 mu on the 2026-07-31 capture, and a re-run
+    # must stay comparable with the recorded 2026-07-31 series the
+    # EMBED_GRAD_IMPL='dense' verdict cites (PERF.md).
+    pins = dict(DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32')
     for impl in ('dense', 'sorted', 'dedup'):
         measure(f'step_ms_embed_grad_{impl}_uniform', uniform,
-                EMBED_GRAD_IMPL=impl)
+                EMBED_GRAD_IMPL=impl, **pins)
     for impl in ('dense', 'sorted', 'dedup'):
         measure(f'step_ms_embed_grad_{impl}_zipf', zipf,
-                EMBED_GRAD_IMPL=impl)
+                EMBED_GRAD_IMPL=impl, **pins)
 
 
 if __name__ == '__main__':

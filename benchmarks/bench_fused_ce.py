@@ -64,10 +64,20 @@ def measure(label: str, check_engaged: bool = False, **overrides) -> None:
 
 
 ARMS = {
-    'xla': dict(label='step_ms_ce_xla'),
+    # The xla/fused pair pins threefry + fp32 mu explicitly: the config
+    # DEFAULTS flipped to rbg + bf16 mu on the 2026-07-31 capture, and an
+    # unpinned pair would (a) stop being comparable with the 2026-07-29/31
+    # series PERF.md's fused-CE verdict is built on and (b) make 'fused'
+    # config-identical to 'fused_rbg_bf16mu' (default-vs-default, ~0
+    # delta).
+    'xla': dict(label='step_ms_ce_xla',
+                DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32'),
     'fused': dict(label='step_ms_ce_fused', check_engaged=True,
-                  USE_PALLAS_FUSED_CE=True),
-    # the candidate full default set if every queued A/B wins. No second
+                  USE_PALLAS_FUSED_CE=True,
+                  DROPOUT_PRNG_IMPL='threefry2x32',
+                  ADAM_MU_DTYPE='float32'),
+    # the full round-5 default set plus the kernel (its measured -1.4%
+    # increment rides on top of the rbg+bf16-mu recipe). No second
     # engagement check: same kernel flag as the arm above, and each check
     # costs a full extra AOT compile of the java14m step — real money
     # against the tunnel's stage timeouts.

@@ -55,9 +55,16 @@ def main() -> None:
     benchlib.honor_env_platforms()
     print(json.dumps({'platform': jax.devices()[0].platform.lower()}),
           flush=True)
-    measure('step_ms_dropout_threefry')
-    measure('step_ms_dropout_rbg', DROPOUT_PRNG_IMPL='rbg')
-    measure('step_ms_bf16_mu', ADAM_MU_DTYPE='bfloat16')
+    # Every arm pins BOTH knobs explicitly: the config DEFAULTS are now
+    # 'rbg' + bf16 mu (flipped on this A/B's own 2026-07-31 capture), so
+    # any unpinned "baseline" arm would silently measure default vs
+    # default and report a ~0 delta.
+    measure('step_ms_dropout_threefry', DROPOUT_PRNG_IMPL='threefry2x32',
+            ADAM_MU_DTYPE='float32')
+    measure('step_ms_dropout_rbg', DROPOUT_PRNG_IMPL='rbg',
+            ADAM_MU_DTYPE='float32')
+    measure('step_ms_bf16_mu', DROPOUT_PRNG_IMPL='threefry2x32',
+            ADAM_MU_DTYPE='bfloat16')
     measure('step_ms_rbg_and_bf16_mu',
             DROPOUT_PRNG_IMPL='rbg', ADAM_MU_DTYPE='bfloat16')
 

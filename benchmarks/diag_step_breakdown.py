@@ -52,7 +52,17 @@ def main() -> None:
     print(json.dumps({'measure': 'rtt_trivial_op_ms',
                       'value': round(rtt * 1e3, 2)}), flush=True)
 
-    config = benchlib.headline_config(SHAPES)
+    # The diag ladder's baseline is pinned to threefry dropout + fp32 mu:
+    # the config DEFAULTS flipped to 'rbg' + bf16 mu on this ladder's own
+    # 2026-07-31 capture, and every variant delta below (no_dropout's
+    # ~4.8 ms threefry cost, the rbg_dropout and bf16_mu arms themselves)
+    # is defined relative to the threefry/fp32-mu-era baseline the PERF.md
+    # tables record. Without the pins a variant equal to the new defaults
+    # would measure default-vs-default (~0 delta) and new captures would
+    # be incomparable with the 2026-07-29 series.
+    BASELINE_PINS = dict(DROPOUT_PRNG_IMPL='threefry2x32',
+                         ADAM_MU_DTYPE='float32')
+    config = benchlib.headline_config(SHAPES, **BASELINE_PINS)
     trainer, state = benchlib.build_trainer(config, SHAPES)
     host_batches = benchlib.random_batches(SHAPES, 4)
 
@@ -124,6 +134,8 @@ def main() -> None:
     # variant's 4.6 GB state is freed before the next is built; memory
     # stays within one trainer + one variant at a time.
     state = dev_batches = fresh = trainer = None  # noqa: F841
+    # Each variant = BASELINE_PINS with exactly one knob changed, so every
+    # delta is attributable to its label even as config defaults move.
     variants = [
         # how much of the step is the dropout mask's threefry RNG?
         # (B=1024, C=200, 3d=640 -> 131M bernoulli draws per step)
@@ -144,7 +156,8 @@ def main() -> None:
          dict(ADAM_MU_DTYPE='bfloat16')),
     ]
     for label, overrides in variants:
-        variant_config = benchlib.headline_config(SHAPES, **overrides)
+        variant_config = benchlib.headline_config(
+            SHAPES, **{**BASELINE_PINS, **overrides})
         variant_trainer, variant_state = benchlib.build_trainer(
             variant_config, SHAPES)
         feeds = benchlib.staged(variant_trainer, host_batches)
@@ -160,7 +173,7 @@ def main() -> None:
     # the cost-analysis roofline can't itemize.
     import optax
 
-    frozen_config = benchlib.headline_config(SHAPES)
+    frozen_config = benchlib.headline_config(SHAPES, **BASELINE_PINS)
     frozen_trainer, frozen_state = benchlib.build_trainer(
         frozen_config, SHAPES)
     feeds = benchlib.staged(frozen_trainer, host_batches)

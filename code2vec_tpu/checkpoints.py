@@ -126,6 +126,102 @@ def _target_rows_from_metadata(tree_meta) -> Optional[int]:
     return found[0] if found else None
 
 
+_MU_FIELD = 'mu'
+
+
+def _path_has_field(path, field: str) -> bool:
+    for entry in path:
+        name = getattr(entry, 'name', None)
+        if name is None:
+            name = getattr(entry, 'key', None)
+        if name == field:
+            return True
+    return False
+
+
+def _mu_dtype_from_metadata(tree_meta):
+    """Storage dtype of Adam's first moment in the artifact being
+    restored, from orbax's own saved array metadata. None when the
+    artifact has no mu subtree or its dtypes are non-uniform. Needed
+    because ADAM_MU_DTYPE's default changed ('float32' -> 'bfloat16',
+    2026-07-31): a default-config resume of a pre-flip checkpoint must
+    adapt instead of failing on a dtype mismatch."""
+    tree = getattr(tree_meta, 'tree', tree_meta)
+    dtypes = set()
+
+    def walk(node, under_mu):
+        if isinstance(node, dict):
+            for key, value in node.items():
+                walk(value, under_mu or key == _MU_FIELD)
+        elif isinstance(node, (list, tuple)):
+            for value in node:
+                walk(value, under_mu)
+        elif under_mu:
+            dt = getattr(node, 'dtype', None)
+            if dt is not None and jax.numpy.issubdtype(dt,
+                                                       jax.numpy.floating):
+                dtypes.add(np.dtype(dt))
+
+    walk(tree, False)
+    return dtypes.pop() if len(dtypes) == 1 else None
+
+
+def _mu_dtype_of(abstract_tree):
+    """The (uniform) floating dtype of the mu leaves in an abstract
+    optimizer-state tree, or None."""
+    dtypes = set()
+
+    def visit(path, leaf):
+        if _path_has_field(path, _MU_FIELD) and jax.numpy.issubdtype(
+                leaf.dtype, jax.numpy.floating):
+            dtypes.add(np.dtype(leaf.dtype))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, abstract_tree)
+    return dtypes.pop() if len(dtypes) == 1 else None
+
+
+def _with_mu_dtype(abstract_tree, dtype):
+    """Abstract tree with floating mu leaves set to ``dtype`` (the STORED
+    moment dtype), keeping shape and sharding — the restore target must
+    match what is on disk; the cast back to the configured dtype happens
+    after restore (`_cast_mu`)."""
+    def fix(path, leaf):
+        if not _path_has_field(path, _MU_FIELD):
+            return leaf
+        if not jax.numpy.issubdtype(leaf.dtype, jax.numpy.floating):
+            return leaf
+        if np.dtype(leaf.dtype) == np.dtype(dtype):
+            return leaf
+        return jax.ShapeDtypeStruct(leaf.shape, dtype,
+                                    sharding=getattr(leaf, 'sharding',
+                                                     None))
+    return jax.tree_util.tree_map_with_path(fix, abstract_tree)
+
+
+def _cast_mu(tree, abstract_tree):
+    """Cast restored mu leaves to the configured dtype from the abstract
+    target (fp32 -> bf16 rounds the way the bf16-mu update does every
+    step; bf16 -> fp32 is exact). Runs under ``jax.jit`` with explicit
+    ``out_shardings`` — the legal spelling on non-fully-addressable
+    multi-process arrays (same rationale as `_resize_target_rows`)."""
+    def fix(path, leaf, abstract_leaf):
+        if not _path_has_field(path, _MU_FIELD):
+            return leaf
+        if not hasattr(leaf, 'dtype') or not jax.numpy.issubdtype(
+                leaf.dtype, jax.numpy.floating):
+            return leaf
+        want = np.dtype(abstract_leaf.dtype)
+        if np.dtype(leaf.dtype) == want:
+            return leaf
+        cast = lambda x: x.astype(want)
+        sharding = getattr(abstract_leaf, 'sharding', None)
+        if sharding is None or not isinstance(leaf, jax.Array):
+            return cast(leaf)
+        return jax.jit(cast, out_shardings=sharding)(leaf)
+    return jax.tree_util.tree_map_with_path(fix, tree, abstract_tree)
+
+
 class CheckpointStore:
     """Orbax-backed store for one model path prefix."""
 
@@ -348,13 +444,43 @@ class CheckpointStore:
             return None
         manager, latest = newest
         self.verify_metadata()
-        stored_rows = self._artifact_target_rows(
-            lambda: manager.item_metadata(latest))
+        # One metadata read serves both adaptations (it can be disk/network
+        # I/O on remote checkpoint stores); the cache keeps
+        # _artifact_target_rows' call-on-demand signature.
+        _meta_cache = []
+
+        def read_metadata():
+            if not _meta_cache:
+                _meta_cache.append(manager.item_metadata(latest))
+            return _meta_cache[0]
+
+        stored_rows = self._artifact_target_rows(read_metadata)
+        # Adapt the restore target to the STORED first-moment dtype: the
+        # ADAM_MU_DTYPE default flip (fp32 -> bf16, 2026-07-31) must not
+        # turn a default-config resume of an older checkpoint into an
+        # opaque dtype-mismatch failure. Restored mu is cast back to the
+        # configured dtype below.
+        try:
+            stored_mu = _mu_dtype_from_metadata(read_metadata())
+        except Exception:
+            stored_mu = None
+        configured_mu = _mu_dtype_of(abstract_opt_state)
         current_params, current_opt = abstract_params, abstract_opt_state
         if stored_rows is not None:
             abstract_params = _with_target_rows(abstract_params, stored_rows)
             abstract_opt_state = _with_target_rows(abstract_opt_state,
                                                    stored_rows)
+        if (stored_mu is not None and configured_mu is not None
+                and stored_mu != configured_mu):
+            import logging
+            logging.getLogger(__name__).warning(
+                'checkpoint %s stores Adam mu as %s but the configured '
+                'ADAM_MU_DTYPE is %s: restoring as stored, then casting '
+                '(set --adam-mu-dtype %s to resume bit-exactly)',
+                self.model_path, stored_mu, configured_mu,
+                stored_mu.name)
+            abstract_opt_state = _with_mu_dtype(abstract_opt_state,
+                                                stored_mu)
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
@@ -388,6 +514,9 @@ class CheckpointStore:
                                              current_rows)
                 opt_state = _resize_target_rows(opt_state, current_opt,
                                                 current_rows)
+        if (stored_mu is not None and configured_mu is not None
+                and stored_mu != configured_mu):
+            opt_state = _cast_mu(opt_state, current_opt)
         return RestoredTraining(
             params=params, opt_state=opt_state,
             step=int(restored['step']), epoch=int(restored['epoch']))

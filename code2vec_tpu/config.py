@@ -90,12 +90,17 @@ class Config:
     # Storage dtype for Adam's FIRST moment (optax mu_dtype). 'bfloat16'
     # halves the first-moment HBM traffic (~1.5 GB/step read+write at
     # java14m's 384M params) in the HBM-bound update (PERF.md roofline);
-    # the second moment and params stay fp32. A measured-throughput /
-    # update-precision trade-off, off by default. Changing it changes the
-    # optimizer-state dtype, so training resume requires the same setting
-    # (checkpoint restore targets adapt via eval_shape; a mismatched
-    # resume fails with an explicit shape/dtype error).
-    ADAM_MU_DTYPE: str = 'float32'
+    # the second moment and params stay fp32. DEFAULT 'bfloat16' per the
+    # ≥2% rule: the on-chip A/B measured 44.89 vs 47.32 ms/step (-5.1%
+    # alone; -13.4% combined with rbg dropout,
+    # capture_2026-07-31T0344Z_r5.jsonl); the equivalence twins
+    # (accuracy_*bf16mu*.json) pair its F1 curve against the fp32-moment
+    # runs. Changing it changes the optimizer-state dtype; resuming a
+    # checkpoint written under the OTHER setting adapts automatically
+    # (checkpoints.py restores mu as stored, warns, and casts to the
+    # configured dtype — set --adam-mu-dtype to the stored dtype to
+    # resume bit-exactly).
+    ADAM_MU_DTYPE: str = 'bfloat16'
     # Backward-pass strategy for the token/path table gradients
     # (ops/embed_grad.py): 'dense' leaves the B*C-row scatter-add to XLA;
     # 'sorted' sorts the index stream so duplicate row hits are adjacent;
@@ -456,11 +461,11 @@ class Config:
         if self.ADAM_MU_DTYPE not in {'float32', 'bfloat16'}:
             raise ValueError("config.ADAM_MU_DTYPE must be in "
                              "{'float32', 'bfloat16'}.")
-        if self.LAZY_EMBEDDING_ADAM and self.ADAM_MU_DTYPE != 'float32':
-            raise ValueError(
-                'config.ADAM_MU_DTYPE applies to the dense optax Adam only; '
-                'LAZY_EMBEDDING_ADAM keeps fp32 moments (the sparse-row '
-                'update does not implement reduced-precision mu).')
+        # LAZY_EMBEDDING_ADAM keeps fp32 moments (the sparse-row update
+        # does not implement reduced-precision mu), so ADAM_MU_DTYPE is
+        # simply not consumed on that path. Now that 'bfloat16' is the
+        # DEFAULT, raising here would break lazy users who never touched
+        # the knob — the trainer logs the ignored-knob warning instead.
         if self.OPTIMIZER_STATE_SHARDING not in {'mirror', 'zero'}:
             raise ValueError("config.OPTIMIZER_STATE_SHARDING must be in "
                              "{'mirror', 'zero'}.")

@@ -82,11 +82,15 @@ class Trainer:
         # reference's semantics — see ops/lazy_adam.py); dense params keep
         # optax Adam either way.
         if config.LAZY_EMBEDDING_ADAM:
-            if config.ADAM_MU_DTYPE != 'float32':
-                raise ValueError(
-                    'ADAM_MU_DTYPE applies to the dense optax Adam only; '
-                    'LAZY_EMBEDDING_ADAM keeps fp32 moments.')
             import logging
+            if config.ADAM_MU_DTYPE != 'float32':
+                # bf16 mu is the config DEFAULT; lazy Adam's sparse-row
+                # update keeps fp32 moments and does not consume the knob,
+                # so this must warn, not raise.
+                logging.getLogger(__name__).warning(
+                    'ADAM_MU_DTYPE=%r is ignored: it applies to the dense '
+                    'optax Adam only; LAZY_EMBEDDING_ADAM keeps fp32 '
+                    'moments.', config.ADAM_MU_DTYPE)
             logging.getLogger(__name__).warning(
                 'LAZY_EMBEDDING_ADAM is measured SLOWER on v5e-class chips '
                 '(0.54x the dense step at java14m shapes, PERF.md): the '

@@ -567,6 +567,11 @@ def main() -> None:
     rng.shuffle(noun_pairs)
 
     counts = {'train': 0, 'val': 0, 'test': 0}
+    # Pre-create every split dir: at smoke-scale class counts a split can
+    # draw zero classes, and downstream tooling (c2v-extract --dir) treats
+    # a missing directory as an error while an empty one is fine.
+    for split in counts:
+        os.makedirs(os.path.join(args.out, split), exist_ok=True)
     methods = 0
     for i in range(args.classes):
         r = rng.random()

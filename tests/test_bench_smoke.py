@@ -18,11 +18,13 @@ def test_bench_smoke_emits_one_json_line():
     lines = [line for line in proc.stdout.splitlines() if line.strip()]
     assert len(lines) == 1
     record = json.loads(lines[0])
-    assert set(record) == {'metric', 'value', 'unit', 'vs_baseline'}
+    assert set(record) == {'metric', 'value', 'unit', 'vs_baseline',
+                           'recipe'}
     # a smoke line must never masquerade as the java14m number
     assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
     assert record['vs_baseline'] == 0.0
     assert record['value'] > 0
+    assert record['recipe'] == 'default'
 
 
 def test_bench_fused_ce_smoke_runs_all_arms():

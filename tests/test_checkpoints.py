@@ -110,6 +110,33 @@ def test_resume_training_continues_from_epoch(tmp_path):
     model2.train()  # runs epochs 2..3 without error
 
 
+@pytest.mark.parametrize('saved_mu,resume_mu',
+                         [('float32', 'bfloat16'),
+                          ('bfloat16', 'float32')])
+def test_resume_across_adam_mu_dtype(tmp_path, saved_mu, resume_mu):
+    """ADAM_MU_DTYPE's default flipped fp32 -> bf16 (2026-07-31 A/B):
+    resuming an older checkpoint under the new default (and vice versa)
+    must adapt — restore as stored, cast mu to the configured dtype —
+    not fail with an orbax dtype mismatch (advisor r5)."""
+    import jax
+    import jax.numpy as jnp
+
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=1,
+                           ADAM_MU_DTYPE=saved_mu)
+    Code2VecModel(config).train()
+
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2, ADAM_MU_DTYPE=resume_mu,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert model2._start_epoch == 1
+    mu = model2.state.opt_state[0].mu
+    mu_dtypes = {leaf.dtype for leaf in jax.tree_util.tree_leaves(mu)}
+    assert mu_dtypes == {np.dtype(getattr(jnp, resume_mu))}
+    model2.train()  # epoch 1 runs under the configured mu dtype
+
+
 def test_resume_across_opt_state_sharding_modes(tmp_path):
     """A checkpoint written with the mirrored moment layout resumes under
     OPTIMIZER_STATE_SHARDING='zero' (and the moments land zero-sharded):
