@@ -119,7 +119,7 @@ def run_measurement() -> None:
 
     examples_per_sec = MEASURE_STEPS * SHAPES.batch_size / elapsed
     per_chip = examples_per_sec / n_devices
-    print(json.dumps({
+    line = {
         'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
                    else METRIC_NAME),
         'value': round(per_chip, 1),
@@ -127,7 +127,13 @@ def run_measurement() -> None:
         'vs_baseline': (0.0 if SMOKE else round(
             per_chip / benchlib.V100_BASELINE_EXAMPLES_PER_SEC, 3)),
         'recipe': BENCH_RECIPE,
-    }))
+    }
+    if SMOKE:
+        # echo the RESOLVED knobs so the smoke test can assert the recipe
+        # actually reached the config, not just the label
+        line['knobs'] = {'dropout_prng': config.DROPOUT_PRNG_IMPL,
+                         'adam_mu': config.ADAM_MU_DTYPE}
+    print(json.dumps(line))
 
 
 def run_probe() -> None:
@@ -287,6 +293,9 @@ def _fallback_line(last_failure: str) -> dict:
         'value': 0.0, 'unit': 'examples/sec/chip',
         'vs_baseline': 0.0, 'error': 'tpu_unavailable',
         'detail': str(last_failure)[:500],
+        # which recipe the FAILED run targeted — a consumer refreshing
+        # the parity vs default rows must be able to tell
+        'recipe': BENCH_RECIPE,
     }
     known_good = None if SMOKE else _last_known_good()
     if known_good is not None:
@@ -295,6 +304,10 @@ def _fallback_line(last_failure: str) -> dict:
             'unit': known_good.get('unit'),
             'vs_baseline': known_good.get('vs_baseline'),
             'source_file': known_good['source_file'],
+            # may legitimately differ from the headline recipe (an
+            # other-recipe capture beats none) — labeled so it can never
+            # be mistaken for a same-recipe number
+            'recipe': known_good.get('recipe'),
         }
     return line
 

@@ -8,23 +8,59 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_smoke_emits_one_json_line():
+def run_bench_smoke(**env_overrides):
+    """One bench.py smoke run; returns the parsed final JSON line."""
     env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
-               PYTHONPATH=REPO)
+               PYTHONPATH=REPO, **env_overrides)
     proc = subprocess.run([sys.executable, os.path.join(REPO, 'bench.py')],
                           capture_output=True, text=True, timeout=600,
                           env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [line for line in proc.stdout.splitlines() if line.strip()]
+    assert lines
+    return lines, json.loads(lines[-1])
+
+
+def test_bench_smoke_emits_one_json_line():
+    lines, record = run_bench_smoke()
     assert len(lines) == 1
-    record = json.loads(lines[0])
     assert set(record) == {'metric', 'value', 'unit', 'vs_baseline',
-                           'recipe'}
+                           'recipe', 'knobs'}
     # a smoke line must never masquerade as the java14m number
     assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
     assert record['vs_baseline'] == 0.0
     assert record['value'] > 0
     assert record['recipe'] == 'default'
+    # the shipped defaults (the measured 2026-07-31 winners)
+    assert record['knobs'] == {'dropout_prng': 'rbg',
+                               'adam_mu': 'bfloat16'}
+
+
+def test_bench_recipe_parity_pins_knobs():
+    """BENCH_RECIPE=parity must actually PIN the reference-parity knobs
+    (not just relabel the line): the vs-V100 comparison row is only
+    refreshable if the measured config is threefry + fp32 mu. The knob
+    echo comes from the resolved Config, so a regression that drops the
+    overrides fails here even with the label intact."""
+    _, record = run_bench_smoke(BENCH_CHILD='1', BENCH_RECIPE='parity')
+    assert record['recipe'] == 'parity'
+    assert record['value'] > 0
+    assert record['knobs'] == {'dropout_prng': 'threefry2x32',
+                               'adam_mu': 'float32'}
+
+
+def test_bench_unknown_recipe_resolves_to_default():
+    """An unknown BENCH_RECIPE must fall back to 'default' instead of
+    crashing the driver. Pure import-time string resolution — no
+    measurement subprocess needed."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO,
+               BENCH_RECIPE='no-such-recipe')
+    proc = subprocess.run(
+        [sys.executable, '-c',
+         'import bench; print(bench.BENCH_RECIPE, bench.RECIPE_OVERRIDES)'],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip() == 'default {}'
 
 
 def test_bench_fused_ce_smoke_runs_all_arms():
