@@ -132,7 +132,9 @@ def run_measurement() -> None:
         # echo the RESOLVED knobs so the smoke test can assert the recipe
         # actually reached the config, not just the label
         line['knobs'] = {'dropout_prng': config.DROPOUT_PRNG_IMPL,
-                         'adam_mu': config.ADAM_MU_DTYPE}
+                         'adam_mu': config.ADAM_MU_DTYPE,
+                         'adam_nu': config.ADAM_NU_DTYPE,
+                         'grads': config.GRADS_DTYPE}
     print(json.dumps(line))
 
 

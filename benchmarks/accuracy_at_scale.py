@@ -102,6 +102,29 @@ PROFILES = {
                                         '--dropout-prng', 'rbg',
                                         '--fused-ce',
                                         '--adam-mu-dtype', 'bfloat16']),
+    # ADAM_NU_DTYPE='bfloat16' equivalence twin (flip-rule gate for the
+    # bench_moment_dtypes.py A/B): identical to cpu_full_bf16mu plus the
+    # bf16 second moment, so its F1 curve pairs 1:1 against
+    # accuracy_cpu_full_bf16mu.json — a knob flips only with BOTH a >=2%
+    # measured step-time win and this curve matching its fp32-nu twin.
+    'cpu_full_bf16nu': dict(classes=8000, batch=512, contexts=200, epochs=5,
+                            extra_args=['--dtype', 'bfloat16',
+                                        '--dropout-prng', 'rbg',
+                                        '--fused-ce',
+                                        '--adam-mu-dtype', 'bfloat16',
+                                        '--adam-nu-dtype', 'bfloat16']),
+    # GRADS_DTYPE='bfloat16' equivalence twin: the full combined
+    # candidate recipe (bf16 grads + bf16 nu on top of the shipped
+    # defaults), pairing against cpu_full_bf16nu (grads knob only) and
+    # transitively cpu_full_bf16mu.
+    'cpu_full_bf16grads': dict(classes=8000, batch=512, contexts=200,
+                               epochs=5,
+                               extra_args=['--dtype', 'bfloat16',
+                                           '--dropout-prng', 'rbg',
+                                           '--fused-ce',
+                                           '--adam-mu-dtype', 'bfloat16',
+                                           '--adam-nu-dtype', 'bfloat16',
+                                           '--grads-dtype', 'bfloat16']),
 }
 CPU_DIMS = dict(TOKEN_EMBEDDINGS_SIZE=64, PATH_EMBEDDINGS_SIZE=64,
                 CODE_VECTOR_SIZE=192, TARGET_EMBEDDINGS_SIZE=192)

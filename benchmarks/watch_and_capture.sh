@@ -91,7 +91,7 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   return ${rc}
 }
 
-ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu"
+ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes"
 
 all_captured() {
   local s
@@ -164,6 +164,11 @@ probe || { hb "wedged after headline_v2"; exit 3; }
 run_stage accuracy_tpu_bf16mu 3600 \
   python benchmarks/accuracy_at_scale.py --profile tpu_bf16mu \
   --workdir /tmp/acc_r5_corpus
+probe || { hb "wedged after accuracy_tpu_bf16mu"; exit 3; }
+# ADAM_NU_DTYPE / GRADS_DTYPE ladder (training/adam_dtypes.py +
+# trainer.py cast_for_grads): the last two fp32 streams in the dense
+# update. 5 arms, 2 fresh compiles worst case.
+run_stage moment_dtypes 2400 python benchmarks/bench_moment_dtypes.py
 
 # Exit 0 ONLY when every stage holds a fresh capture — otherwise the
 # supervisor must keep respawning us for the stages still pending (a

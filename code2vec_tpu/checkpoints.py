@@ -126,7 +126,13 @@ def _target_rows_from_metadata(tree_meta) -> Optional[int]:
     return found[0] if found else None
 
 
-_MU_FIELD = 'mu'
+# Adam moment subtrees subject to storage-dtype adaptation on restore:
+# ADAM_MU_DTYPE's default flipped 'float32' -> 'bfloat16' (2026-07-31) and
+# ADAM_NU_DTYPE is A/B-gated the same way, so a resume under either
+# setting of a checkpoint written under the other must adapt instead of
+# failing on a dtype mismatch. Field names follow optax.ScaleByAdamState
+# (training/adam_dtypes.py keeps them for exactly this reason).
+_MOMENT_FIELDS = ('mu', 'nu')
 
 
 def _path_has_field(path, field: str) -> bool:
@@ -139,24 +145,22 @@ def _path_has_field(path, field: str) -> bool:
     return False
 
 
-def _mu_dtype_from_metadata(tree_meta):
-    """Storage dtype of Adam's first moment in the artifact being
-    restored, from orbax's own saved array metadata. None when the
-    artifact has no mu subtree or its dtypes are non-uniform. Needed
-    because ADAM_MU_DTYPE's default changed ('float32' -> 'bfloat16',
-    2026-07-31): a default-config resume of a pre-flip checkpoint must
-    adapt instead of failing on a dtype mismatch."""
+def _moment_dtype_from_metadata(tree_meta, field: str):
+    """Storage dtype of the Adam moment subtree named ``field`` in the
+    artifact being restored, from orbax's own saved array metadata. None
+    when the artifact has no such subtree or its dtypes are
+    non-uniform."""
     tree = getattr(tree_meta, 'tree', tree_meta)
     dtypes = set()
 
-    def walk(node, under_mu):
+    def walk(node, under):
         if isinstance(node, dict):
             for key, value in node.items():
-                walk(value, under_mu or key == _MU_FIELD)
+                walk(value, under or key == field)
         elif isinstance(node, (list, tuple)):
             for value in node:
-                walk(value, under_mu)
-        elif under_mu:
+                walk(value, under)
+        elif under:
             dt = getattr(node, 'dtype', None)
             if dt is not None and jax.numpy.issubdtype(dt,
                                                        jax.numpy.floating):
@@ -166,13 +170,13 @@ def _mu_dtype_from_metadata(tree_meta):
     return dtypes.pop() if len(dtypes) == 1 else None
 
 
-def _mu_dtype_of(abstract_tree):
-    """The (uniform) floating dtype of the mu leaves in an abstract
-    optimizer-state tree, or None."""
+def _moment_dtype_of(abstract_tree, field: str):
+    """The (uniform) floating dtype of the ``field`` moment leaves in an
+    abstract optimizer-state tree, or None."""
     dtypes = set()
 
     def visit(path, leaf):
-        if _path_has_field(path, _MU_FIELD) and jax.numpy.issubdtype(
+        if _path_has_field(path, field) and jax.numpy.issubdtype(
                 leaf.dtype, jax.numpy.floating):
             dtypes.add(np.dtype(leaf.dtype))
         return leaf
@@ -181,13 +185,13 @@ def _mu_dtype_of(abstract_tree):
     return dtypes.pop() if len(dtypes) == 1 else None
 
 
-def _with_mu_dtype(abstract_tree, dtype):
-    """Abstract tree with floating mu leaves set to ``dtype`` (the STORED
-    moment dtype), keeping shape and sharding — the restore target must
-    match what is on disk; the cast back to the configured dtype happens
-    after restore (`_cast_mu`)."""
+def _with_moment_dtype(abstract_tree, dtype, field: str):
+    """Abstract tree with the ``field`` moment's floating leaves set to
+    ``dtype`` (the STORED moment dtype), keeping shape and sharding — the
+    restore target must match what is on disk; the cast back to the
+    configured dtype happens after restore (`_cast_moment`)."""
     def fix(path, leaf):
-        if not _path_has_field(path, _MU_FIELD):
+        if not _path_has_field(path, field):
             return leaf
         if not jax.numpy.issubdtype(leaf.dtype, jax.numpy.floating):
             return leaf
@@ -199,14 +203,15 @@ def _with_mu_dtype(abstract_tree, dtype):
     return jax.tree_util.tree_map_with_path(fix, abstract_tree)
 
 
-def _cast_mu(tree, abstract_tree):
-    """Cast restored mu leaves to the configured dtype from the abstract
-    target (fp32 -> bf16 rounds the way the bf16-mu update does every
-    step; bf16 -> fp32 is exact). Runs under ``jax.jit`` with explicit
-    ``out_shardings`` — the legal spelling on non-fully-addressable
-    multi-process arrays (same rationale as `_resize_target_rows`)."""
+def _cast_moment(tree, abstract_tree, field: str):
+    """Cast restored ``field`` moment leaves to the configured dtype from
+    the abstract target (fp32 -> bf16 rounds the way the bf16-moment
+    update does every step; bf16 -> fp32 is exact). Runs under ``jax.jit``
+    with explicit ``out_shardings`` — the legal spelling on
+    non-fully-addressable multi-process arrays (same rationale as
+    `_resize_target_rows`)."""
     def fix(path, leaf, abstract_leaf):
-        if not _path_has_field(path, _MU_FIELD):
+        if not _path_has_field(path, field):
             return leaf
         if not hasattr(leaf, 'dtype') or not jax.numpy.issubdtype(
                 leaf.dtype, jax.numpy.floating):
@@ -455,32 +460,38 @@ class CheckpointStore:
             return _meta_cache[0]
 
         stored_rows = self._artifact_target_rows(read_metadata)
-        # Adapt the restore target to the STORED first-moment dtype: the
-        # ADAM_MU_DTYPE default flip (fp32 -> bf16, 2026-07-31) must not
-        # turn a default-config resume of an older checkpoint into an
-        # opaque dtype-mismatch failure. Restored mu is cast back to the
-        # configured dtype below.
-        try:
-            stored_mu = _mu_dtype_from_metadata(read_metadata())
-        except Exception:
-            stored_mu = None
-        configured_mu = _mu_dtype_of(abstract_opt_state)
+        # Adapt the restore target to the STORED moment dtypes: the
+        # ADAM_MU_DTYPE default flip (fp32 -> bf16, 2026-07-31) — and the
+        # ADAM_NU_DTYPE knob gated on the same A/B rule — must not turn a
+        # default-config resume of a checkpoint written under the other
+        # setting into an opaque dtype-mismatch failure. Restored moments
+        # are cast back to the configured dtype below.
+        moment_mismatch = {}   # field -> stored dtype
+        for field in _MOMENT_FIELDS:
+            try:
+                stored_dt = _moment_dtype_from_metadata(read_metadata(),
+                                                        field)
+            except Exception:
+                stored_dt = None
+            configured_dt = _moment_dtype_of(abstract_opt_state, field)
+            if (stored_dt is not None and configured_dt is not None
+                    and stored_dt != configured_dt):
+                moment_mismatch[field] = stored_dt
         current_params, current_opt = abstract_params, abstract_opt_state
         if stored_rows is not None:
             abstract_params = _with_target_rows(abstract_params, stored_rows)
             abstract_opt_state = _with_target_rows(abstract_opt_state,
                                                    stored_rows)
-        if (stored_mu is not None and configured_mu is not None
-                and stored_mu != configured_mu):
+        for field, stored_dt in moment_mismatch.items():
             import logging
             logging.getLogger(__name__).warning(
-                'checkpoint %s stores Adam mu as %s but the configured '
-                'ADAM_MU_DTYPE is %s: restoring as stored, then casting '
-                '(set --adam-mu-dtype %s to resume bit-exactly)',
-                self.model_path, stored_mu, configured_mu,
-                stored_mu.name)
-            abstract_opt_state = _with_mu_dtype(abstract_opt_state,
-                                                stored_mu)
+                'checkpoint %s stores Adam %s as %s but the configured '
+                'ADAM_%s_DTYPE differs: restoring as stored, then casting '
+                '(set --adam-%s-dtype %s to resume bit-exactly)',
+                self.model_path, field, stored_dt, field.upper(), field,
+                stored_dt.name)
+            abstract_opt_state = _with_moment_dtype(abstract_opt_state,
+                                                    stored_dt, field)
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
@@ -514,9 +525,8 @@ class CheckpointStore:
                                              current_rows)
                 opt_state = _resize_target_rows(opt_state, current_opt,
                                                 current_rows)
-        if (stored_mu is not None and configured_mu is not None
-                and stored_mu != configured_mu):
-            opt_state = _cast_mu(opt_state, current_opt)
+        for field in moment_mismatch:
+            opt_state = _cast_moment(opt_state, current_opt, field)
         return RestoredTraining(
             params=params, opt_state=opt_state,
             step=int(restored['step']), epoch=int(restored['epoch']))

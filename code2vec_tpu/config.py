@@ -101,6 +101,36 @@ class Config:
     # configured dtype — set --adam-mu-dtype to the stored dtype to
     # resume bit-exactly).
     ADAM_MU_DTYPE: str = 'bfloat16'
+    # Storage dtype for Adam's SECOND moment (training/adam_dtypes.py).
+    # The nu tree is the same-size stream as mu before its flip (~1.54 GB
+    # fp32 at java14m's 384M params, read+write every step of the
+    # HBM-bound dense update): 'bfloat16' halves it (~1.9 ms/step
+    # analytic at the measured ~819 GB/s). Moment math stays fp32 every
+    # step — only HBM storage narrows (the sqrt denominator is formed
+    # after an fp32 upcast). DEFAULT 'float32' until the on-chip A/B
+    # (benchmarks/bench_moment_dtypes.py) records a >=2% step-time win
+    # AND a learning-curve twin (accuracy profile cpu_full_bf16nu)
+    # matches the fp32-nu curve — same flip rule every perf knob here
+    # has cleared (PERF.md). Cross-dtype checkpoint resume adapts
+    # automatically, like ADAM_MU_DTYPE (checkpoints.py).
+    ADAM_NU_DTYPE: str = 'float32'
+    # Dtype the GRADIENTS are produced and streamed in (training/
+    # trainer.py): 'bfloat16' differentiates the loss wrt the pre-cast
+    # bf16 params, so the two table-grad scatter-adds and the full grad
+    # tree cross HBM at half width (~1.54 GB fp32 -> 0.77 GB at java14m
+    # scale, plus the eliminated bf16->fp32 cast of the table
+    # cotangents). Requires COMPUTE_DTYPE='bfloat16' (enforced by
+    # verify()): under bf16 compute the FORWARD is unchanged — every
+    # param is cast to bf16 before use either way — and master params +
+    # Adam
+    # moment MATH stay fp32 (training/adam_dtypes.py upcasts before any
+    # arithmetic; only storage narrows). What changes numerically is one
+    # rounding of each gradient to bf16 — the standard mixed-precision
+    # regime (fp32 master + bf16 grads). DEFAULT 'float32' until the
+    # on-chip A/B (benchmarks/bench_moment_dtypes.py) and the
+    # learning-curve twin (profile cpu_full_bf16grads) clear the >=2%
+    # flip rule, like every perf knob here (PERF.md).
+    GRADS_DTYPE: str = 'float32'
     # Backward-pass strategy for the token/path table gradients
     # (ops/embed_grad.py): 'dense' leaves the B*C-row scatter-add to XLA;
     # 'sorted' sorts the index stream so duplicate row hits are adjacent;
@@ -273,6 +303,16 @@ class Config:
         parser.add_argument('--adam-mu-dtype', dest='adam_mu_dtype',
                             choices=['float32', 'bfloat16'], default=None,
                             help='storage dtype for Adam\'s first moment')
+        parser.add_argument('--adam-nu-dtype', dest='adam_nu_dtype',
+                            choices=['float32', 'bfloat16'], default=None,
+                            help='storage dtype for Adam\'s second moment '
+                                 '(training/adam_dtypes.py, PERF.md)')
+        parser.add_argument('--grads-dtype', dest='grads_dtype',
+                            choices=['float32', 'bfloat16'], default=None,
+                            help='gradient stream dtype; bfloat16 keeps '
+                                 'the table-grad scatters and grad tree '
+                                 'in bf16 (fp32 master params + fp32 '
+                                 'moment math, PERF.md)')
         parser.add_argument('--embed-grad', dest='embed_grad_impl',
                             choices=['dense', 'sorted', 'dedup'],
                             default=None,
@@ -338,6 +378,10 @@ class Config:
             self.DROPOUT_PRNG_IMPL = parsed.dropout_prng_impl
         if parsed.adam_mu_dtype:
             self.ADAM_MU_DTYPE = parsed.adam_mu_dtype
+        if parsed.adam_nu_dtype:
+            self.ADAM_NU_DTYPE = parsed.adam_nu_dtype
+        if parsed.grads_dtype:
+            self.GRADS_DTYPE = parsed.grads_dtype
         if parsed.embed_grad_impl:
             self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
         if parsed.fused_ce:
@@ -461,6 +505,28 @@ class Config:
         if self.ADAM_MU_DTYPE not in {'float32', 'bfloat16'}:
             raise ValueError("config.ADAM_MU_DTYPE must be in "
                              "{'float32', 'bfloat16'}.")
+        if self.ADAM_NU_DTYPE not in {'float32', 'bfloat16'}:
+            raise ValueError("config.ADAM_NU_DTYPE must be in "
+                             "{'float32', 'bfloat16'}.")
+        if self.GRADS_DTYPE not in {'float32', 'bfloat16'}:
+            raise ValueError("config.GRADS_DTYPE must be in "
+                             "{'float32', 'bfloat16'}.")
+        if self.GRADS_DTYPE == 'bfloat16' and self.LAZY_EMBEDDING_ADAM:
+            raise ValueError(
+                'GRADS_DTYPE=\'bfloat16\' requires the dense optax path: '
+                'LAZY_EMBEDDING_ADAM\'s sparse-row update consumes raw '
+                'fp32 gradients.')
+        if self.GRADS_DTYPE == 'bfloat16' \
+                and self.COMPUTE_DTYPE != 'bfloat16':
+            # The knob works by differentiating wrt the PRE-CAST bf16
+            # params; that is only value-preserving for the forward when
+            # the model would cast params to bf16 anyway. Under fp32
+            # compute it would silently bf16-round every weight in the
+            # training forward (and diverge from the uncast eval forward).
+            raise ValueError(
+                "GRADS_DTYPE='bfloat16' requires "
+                "COMPUTE_DTYPE='bfloat16' (the bf16 pre-cast must round "
+                "exactly where the compute cast already would).")
         # LAZY_EMBEDDING_ADAM keeps fp32 moments (the sparse-row update
         # does not implement reduced-precision mu), so ADAM_MU_DTYPE is
         # simply not consumed on that path. Now that 'bfloat16' is the

@@ -1,0 +1,103 @@
+"""Adam with independently reduced-precision moment STORAGE.
+
+``optax.adam`` exposes ``mu_dtype`` (first moment) but stores the second
+moment in the parameter dtype unconditionally. At java14m scale the nu
+tree is another 1.54 GB of fp32 optimizer state streamed read+write every
+step of the HBM-bound dense update (PERF.md roofline: ~1.9 ms/step at the
+measured ~819 GB/s), the same stream the measured ``ADAM_MU_DTYPE`` flip
+already halved for mu. This transform generalizes the trick: moments are
+COMPUTED in fp32 every step (both are upcast before use, and the
+``sqrt(nu)`` denominator is formed in fp32), only their HBM *storage*
+dtype drops to bf16 — identical discipline to optax's own mu_dtype
+handling (optax promotes grads+mu before the update and casts at the end).
+
+State is ``optax.ScaleByAdamState`` — same ``count/mu/nu`` field names and
+tree structure as ``optax.adam`` — so checkpoints remain field-compatible
+and `checkpoints.py`'s moment-dtype adaptation covers cross-dtype resumes
+in both directions.
+
+Reference anchor: the reference trains with a default
+``tf.compat.v1.train.AdamOptimizer`` (fp32 moments) —
+/root/reference/tensorflow_model.py:232. Storage dtype is a TPU-side
+memory-bandwidth knob with an A/B + learning-curve gate, not a semantic
+departure.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _cast_tree(tree: Any, dtype) -> Any:
+    if dtype is None:
+        return tree
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)
+
+
+def scale_by_adam_dtypes(b1: float = 0.9, b2: float = 0.999,
+                         eps: float = 1e-8,
+                         mu_dtype: Optional[Any] = None,
+                         nu_dtype: Optional[Any] = None
+                         ) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` plus a ``nu_dtype`` storage knob.
+
+    ``mu_dtype=None`` / ``nu_dtype=None`` keep the parameter dtype, like
+    optax. With both ``None`` the update is numerically identical to
+    ``optax.scale_by_adam`` (asserted by tests/test_adam_dtypes.py).
+    """
+    mu_dtype = jnp.dtype(mu_dtype) if mu_dtype is not None else None
+    nu_dtype = jnp.dtype(nu_dtype) if nu_dtype is not None else None
+
+    def init_fn(params):
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_int32_increment(state.count)
+
+        # Moment math runs in EXPLICIT fp32, whatever the storage dtypes
+        # of the incoming grads and stored moments: an EMA accumulated in
+        # bf16 silently drops sub-epsilon increments ((1-b2)*g^2 is ~1e-3
+        # of nu), which is precisely the failure mode the storage-only
+        # narrowing must not introduce. fp32 inputs pass through
+        # unchanged, so the None/None path stays a drop-in for
+        # optax.adam; bf16 inputs (GRADS_DTYPE='bfloat16' or narrowed
+        # storage) are upcast before any arithmetic.
+        def f32(x):
+            return x.astype(jnp.float32) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x
+
+        mu = jax.tree_util.tree_map(
+            lambda g, m: b1 * f32(m) + (1.0 - b1) * f32(g),
+            updates, state.mu)
+        nu = jax.tree_util.tree_map(
+            lambda g, v: b2 * f32(v) + (1.0 - b2) * jnp.square(f32(g)),
+            updates, state.nu)
+        b1c = 1.0 - b1 ** count.astype(jnp.float32)
+        b2c = 1.0 - b2 ** count.astype(jnp.float32)
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v: (m / b1c) / (jnp.sqrt(v / b2c) + eps), mu, nu)
+        return new_updates, optax.ScaleByAdamState(
+            count=count,
+            mu=_cast_tree(mu, mu_dtype),
+            nu=_cast_tree(nu, nu_dtype))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8, mu_dtype: Optional[Any] = None,
+         nu_dtype: Optional[Any] = None) -> optax.GradientTransformation:
+    """``optax.adam`` with the extra ``nu_dtype`` storage knob."""
+    return optax.chain(
+        scale_by_adam_dtypes(b1=b1, b2=b2, eps=eps,
+                             mu_dtype=mu_dtype, nu_dtype=nu_dtype),
+        optax.scale(-learning_rate))

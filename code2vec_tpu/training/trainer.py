@@ -83,14 +83,16 @@ class Trainer:
         # optax Adam either way.
         if config.LAZY_EMBEDDING_ADAM:
             import logging
-            if config.ADAM_MU_DTYPE != 'float32':
+            if (config.ADAM_MU_DTYPE != 'float32'
+                    or config.ADAM_NU_DTYPE != 'float32'):
                 # bf16 mu is the config DEFAULT; lazy Adam's sparse-row
-                # update keeps fp32 moments and does not consume the knob,
-                # so this must warn, not raise.
+                # update keeps fp32 moments and does not consume either
+                # dtype knob, so this must warn, not raise.
                 logging.getLogger(__name__).warning(
-                    'ADAM_MU_DTYPE=%r is ignored: it applies to the dense '
-                    'optax Adam only; LAZY_EMBEDDING_ADAM keeps fp32 '
-                    'moments.', config.ADAM_MU_DTYPE)
+                    'ADAM_MU_DTYPE=%r / ADAM_NU_DTYPE=%r are ignored: '
+                    'they apply to the dense optax Adam only; '
+                    'LAZY_EMBEDDING_ADAM keeps fp32 moments.',
+                    config.ADAM_MU_DTYPE, config.ADAM_NU_DTYPE)
             logging.getLogger(__name__).warning(
                 'LAZY_EMBEDDING_ADAM is measured SLOWER on v5e-class chips '
                 '(0.54x the dense step at java14m shapes, PERF.md): the '
@@ -99,13 +101,30 @@ class Trainer:
             from code2vec_tpu.ops.lazy_adam import LazyEmbeddingAdam
             self.optimizer = LazyEmbeddingAdam(config.LEARNING_RATE, backend)
         else:
-            # ADAM_MU_DTYPE='bfloat16' stores the first moment in bf16 —
-            # an HBM-traffic knob for the HBM-bound dense update (config
-            # comment + PERF.md); None keeps optax's param-dtype default
+            # ADAM_MU_DTYPE / ADAM_NU_DTYPE = 'bfloat16' store the
+            # moments in bf16 — HBM-traffic knobs for the HBM-bound dense
+            # update (config comments + PERF.md); None keeps optax's
+            # param-dtype default.
             mu_dtype = (jnp.bfloat16
                         if config.ADAM_MU_DTYPE == 'bfloat16' else None)
-            self.optimizer = optax.adam(config.LEARNING_RATE,
-                                        mu_dtype=mu_dtype)
+            if (config.ADAM_NU_DTYPE == 'bfloat16'
+                    or config.GRADS_DTYPE == 'bfloat16'):
+                # optax.adam has no nu_dtype; the local transform keeps
+                # optax's ScaleByAdamState field names so checkpoints
+                # stay field-compatible (training/adam_dtypes.py). It is
+                # also mandatory under bf16 grads: its moment math is
+                # EXPLICIT fp32, where optax's dtype-promotion rules
+                # would let a bf16 grad meet a bf16-stored mu and
+                # accumulate the EMA in bf16.
+                from code2vec_tpu.training import adam_dtypes
+                nu_dtype = (jnp.bfloat16
+                            if config.ADAM_NU_DTYPE == 'bfloat16' else None)
+                self.optimizer = adam_dtypes.adam(
+                    config.LEARNING_RATE, mu_dtype=mu_dtype,
+                    nu_dtype=nu_dtype)
+            else:
+                self.optimizer = optax.adam(config.LEARNING_RATE,
+                                            mu_dtype=mu_dtype)
         self._build_steps()
 
     # ----------------------------------------------------------- jit steps
@@ -118,6 +137,22 @@ class Trainer:
         # the mesh only matters to the loss when the fused CE must be
         # shard_mapped; None keeps single-device tracing mesh-free
         loss_mesh = self.mesh if self.mesh.size > 1 else None
+        # GRADS_DTYPE='bfloat16': differentiate wrt the PRE-CAST bf16
+        # params so the cotangents — above all the two table-grad
+        # scatter-adds and the (B, V) logits backward — are produced and
+        # streamed through HBM in bf16 instead of fp32 (config comment +
+        # PERF.md). Config.verify() pins COMPUTE_DTYPE='bfloat16' with
+        # it, which makes the forward bit-identical either way: the
+        # model casts every param to bf16 before use, so casting first
+        # changes only the dtype the gradients come back in. Master
+        # params stay fp32; adam_dtypes upcasts the bf16 grads to fp32
+        # before any moment math.
+        grads_bf16 = self.config.GRADS_DTYPE == 'bfloat16'
+
+        def cast_for_grads(params):
+            return jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
 
         def train_step(state: TrainerState, arrays) -> Tuple[TrainerState, jax.Array]:
             dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -127,7 +162,9 @@ class Trainer:
                                              mesh=loss_mesh)
                 return loss
 
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            diff_params = (cast_for_grads(state.params) if grads_bf16
+                           else state.params)
+            loss, grads = jax.value_and_grad(loss_fn)(diff_params)
             if lazy:
                 source, path, target = arrays[0], arrays[1], arrays[2]
                 new_params, new_opt_state = optimizer.update_sparse(

@@ -33,7 +33,9 @@ def test_bench_smoke_emits_one_json_line():
     assert record['recipe'] == 'default'
     # the shipped defaults (the measured 2026-07-31 winners)
     assert record['knobs'] == {'dropout_prng': 'rbg',
-                               'adam_mu': 'bfloat16'}
+                               'adam_mu': 'bfloat16',
+                               'adam_nu': 'float32',
+                               'grads': 'float32'}
 
 
 def test_bench_recipe_parity_pins_knobs():
@@ -46,7 +48,9 @@ def test_bench_recipe_parity_pins_knobs():
     assert record['recipe'] == 'parity'
     assert record['value'] > 0
     assert record['knobs'] == {'dropout_prng': 'threefry2x32',
-                               'adam_mu': 'float32'}
+                               'adam_mu': 'float32',
+                               'adam_nu': 'float32',
+                               'grads': 'float32'}
 
 
 def test_bench_unknown_recipe_resolves_to_default():

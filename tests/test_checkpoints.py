@@ -137,6 +137,33 @@ def test_resume_across_adam_mu_dtype(tmp_path, saved_mu, resume_mu):
     model2.train()  # epoch 1 runs under the configured mu dtype
 
 
+@pytest.mark.parametrize('saved_nu,resume_nu',
+                         [('float32', 'bfloat16'),
+                          ('bfloat16', 'float32')])
+def test_resume_across_adam_nu_dtype(tmp_path, saved_nu, resume_nu):
+    """ADAM_NU_DTYPE is gated on the same flip rule as mu was: cross-dtype
+    resume must adapt in both directions — restore the second moment as
+    stored, cast to the configured dtype (checkpoints._MOMENT_FIELDS
+    covers both moments)."""
+    import jax
+    import jax.numpy as jnp
+
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=1,
+                           ADAM_NU_DTYPE=saved_nu)
+    Code2VecModel(config).train()
+
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2, ADAM_NU_DTYPE=resume_nu,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert model2._start_epoch == 1
+    nu = model2.state.opt_state[0].nu
+    nu_dtypes = {leaf.dtype for leaf in jax.tree_util.tree_leaves(nu)}
+    assert nu_dtypes == {np.dtype(getattr(jnp, resume_nu))}
+    model2.train()  # epoch 1 runs under the configured nu dtype
+
+
 def test_resume_across_opt_state_sharding_modes(tmp_path):
     """A checkpoint written with the mirrored moment layout resumes under
     OPTIMIZER_STATE_SHARDING='zero' (and the moments land zero-sharded):
