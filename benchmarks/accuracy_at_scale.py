@@ -67,20 +67,26 @@ PROFILES = {
     # tpu_bf16mu twin below must differ from it by the mu dtype ONLY.
     'tpu': dict(classes=24000, batch=512, contexts=200, epochs=12,
                 extra_args=['--dropout-prng', 'rbg',
-                            '--adam-mu-dtype', 'float32']),
+                            '--adam-mu-dtype', 'float32',
+                            '--adam-nu-dtype', 'float32',
+                            '--grads-dtype', 'float32']),
     # reduced compute (smaller dims/contexts) so the learning-loop evidence
     # does not need the chip; vocab pressure is unchanged
     'cpu': dict(classes=24000, batch=512, contexts=32, epochs=6,
                 extra_args=['--dtype', 'float32',
                             '--dropout-prng', 'threefry2x32',
-                            '--adam-mu-dtype', 'float32']),
+                            '--adam-mu-dtype', 'float32',
+                            '--adam-nu-dtype', 'float32',
+                            '--grads-dtype', 'float32']),
     # VERDICT r3 #5 fallback: FULL model dims (128/128/384) and C=200 on
     # CPU — fewer classes/epochs so it finishes in tens of minutes, but
     # the model being validated is the real one, not the 64-dim stand-in
     'cpu_full': dict(classes=8000, batch=512, contexts=200, epochs=5,
                      extra_args=['--dtype', 'float32',
                                  '--dropout-prng', 'threefry2x32',
-                                 '--adam-mu-dtype', 'float32']),
+                                 '--adam-mu-dtype', 'float32',
+                                 '--adam-nu-dtype', 'float32',
+                                 '--grads-dtype', 'float32']),
     # VERDICT r4 #2: the EXACT bench recipe (bfloat16 compute + Pallas
     # fused CE, interpreted on CPU + rbg dropout) at full dims, so the
     # 21.7K ex/s configuration is shown to reach the same F1 as its fp32
@@ -89,19 +95,25 @@ PROFILES = {
                           extra_args=['--dtype', 'bfloat16',
                                       '--dropout-prng', 'rbg',
                                       '--fused-ce',
-                                      '--adam-mu-dtype', 'float32']),
+                                      '--adam-mu-dtype', 'float32',
+                                      '--adam-nu-dtype', 'float32',
+                                      '--grads-dtype', 'float32']),
     # ADAM_MU_DTYPE='bfloat16' equivalence twins (the last winning knob
     # from the 2026-07-31 on-chip A/B, -5.1% step time): identical to the
     # profile each shadows plus the bf16 first moment, so the F1 curve
     # pairs 1:1 against accuracy_tpu.json / accuracy_cpu_full_bf16.json.
     'tpu_bf16mu': dict(classes=24000, batch=512, contexts=200, epochs=12,
                        extra_args=['--dropout-prng', 'rbg',
-                                   '--adam-mu-dtype', 'bfloat16']),
+                                   '--adam-mu-dtype', 'bfloat16',
+                                   '--adam-nu-dtype', 'float32',
+                                   '--grads-dtype', 'float32']),
     'cpu_full_bf16mu': dict(classes=8000, batch=512, contexts=200, epochs=5,
                             extra_args=['--dtype', 'bfloat16',
                                         '--dropout-prng', 'rbg',
                                         '--fused-ce',
-                                        '--adam-mu-dtype', 'bfloat16']),
+                                        '--adam-mu-dtype', 'bfloat16',
+                                        '--adam-nu-dtype', 'float32',
+                                        '--grads-dtype', 'float32']),
     # ADAM_NU_DTYPE='bfloat16' equivalence twin (flip-rule gate for the
     # bench_moment_dtypes.py A/B): identical to cpu_full_bf16mu plus the
     # bf16 second moment, so its F1 curve pairs 1:1 against
@@ -112,7 +124,8 @@ PROFILES = {
                                         '--dropout-prng', 'rbg',
                                         '--fused-ce',
                                         '--adam-mu-dtype', 'bfloat16',
-                                        '--adam-nu-dtype', 'bfloat16']),
+                                        '--adam-nu-dtype', 'bfloat16',
+                                        '--grads-dtype', 'float32']),
     # GRADS_DTYPE='bfloat16' equivalence twin: the full combined
     # candidate recipe (bf16 grads + bf16 nu on top of the shipped
     # defaults), pairing against cpu_full_bf16nu (grads knob only) and

@@ -93,7 +93,8 @@ def main() -> None:
     # flipped to rbg + bf16 mu on the 2026-07-31 capture, and a re-run
     # must stay comparable with the recorded 2026-07-31 series the
     # EMBED_GRAD_IMPL='dense' verdict cites (PERF.md).
-    pins = dict(DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32')
+    pins = dict(DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32',
+                ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32')
     for impl in ('dense', 'sorted', 'dedup'):
         measure(f'step_ms_embed_grad_{impl}_uniform', uniform,
                 EMBED_GRAD_IMPL=impl, **pins)

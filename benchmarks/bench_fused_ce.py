@@ -71,11 +71,13 @@ ARMS = {
     # config-identical to 'fused_rbg_bf16mu' (default-vs-default, ~0
     # delta).
     'xla': dict(label='step_ms_ce_xla',
-                DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32'),
+                DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32',
+                ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32'),
     'fused': dict(label='step_ms_ce_fused', check_engaged=True,
                   USE_PALLAS_FUSED_CE=True,
                   DROPOUT_PRNG_IMPL='threefry2x32',
-                  ADAM_MU_DTYPE='float32'),
+                  ADAM_MU_DTYPE='float32',
+                  ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32'),
     # the full round-5 default set plus the kernel (its measured -1.4%
     # increment rides on top of the rbg+bf16-mu recipe). No second
     # engagement check: same kernel flag as the arm above, and each check
@@ -84,7 +86,9 @@ ARMS = {
     'fused_rbg_bf16mu': dict(label='step_ms_ce_fused_rbg_bf16mu',
                              USE_PALLAS_FUSED_CE=True,
                              DROPOUT_PRNG_IMPL='rbg',
-                             ADAM_MU_DTYPE='bfloat16'),
+                             ADAM_MU_DTYPE='bfloat16',
+                             ADAM_NU_DTYPE='float32',
+                             GRADS_DTYPE='float32'),
 }
 
 

@@ -59,14 +59,15 @@ def main() -> None:
     # 'rbg' + bf16 mu (flipped on this A/B's own 2026-07-31 capture), so
     # any unpinned "baseline" arm would silently measure default vs
     # default and report a ~0 delta.
+    pins = dict(ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32')
     measure('step_ms_dropout_threefry', DROPOUT_PRNG_IMPL='threefry2x32',
-            ADAM_MU_DTYPE='float32')
+            ADAM_MU_DTYPE='float32', **pins)
     measure('step_ms_dropout_rbg', DROPOUT_PRNG_IMPL='rbg',
-            ADAM_MU_DTYPE='float32')
+            ADAM_MU_DTYPE='float32', **pins)
     measure('step_ms_bf16_mu', DROPOUT_PRNG_IMPL='threefry2x32',
-            ADAM_MU_DTYPE='bfloat16')
+            ADAM_MU_DTYPE='bfloat16', **pins)
     measure('step_ms_rbg_and_bf16_mu',
-            DROPOUT_PRNG_IMPL='rbg', ADAM_MU_DTYPE='bfloat16')
+            DROPOUT_PRNG_IMPL='rbg', ADAM_MU_DTYPE='bfloat16', **pins)
 
 
 if __name__ == '__main__':

@@ -61,7 +61,8 @@ def main() -> None:
     # would measure default-vs-default (~0 delta) and new captures would
     # be incomparable with the 2026-07-29 series.
     BASELINE_PINS = dict(DROPOUT_PRNG_IMPL='threefry2x32',
-                         ADAM_MU_DTYPE='float32')
+                         ADAM_MU_DTYPE='float32',
+                         ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32')
     config = benchlib.headline_config(SHAPES, **BASELINE_PINS)
     trainer, state = benchlib.build_trainer(config, SHAPES)
     host_batches = benchlib.random_batches(SHAPES, 4)

@@ -91,7 +91,7 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   return ${rc}
 }
 
-ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes"
+ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes headline_v3"
 
 all_captured() {
   local s
@@ -159,7 +159,10 @@ probe || { hb "wedged after pallas_c1024"; exit 3; }
 # accuracy_tpu_bf16mu pairs the on-chip F1 curve against accuracy_tpu.json
 # with the bf16 first moment engaged — the last knob lacking an on-device
 # learning-curve twin.
-BENCH_TOTAL_BUDGET=600 BENCH_RECIPE=default run_stage headline_v2 700 python bench.py
+# default_v2 pins the rbg+bf16-mu/fp32-nu recipe this stage was defined
+# for: the shipped default moved on (bf16 nu), and an unpinned re-run
+# would measure the newer recipe under this stage's label
+BENCH_TOTAL_BUDGET=600 BENCH_RECIPE=default_v2 run_stage headline_v2 700 python bench.py
 probe || { hb "wedged after headline_v2"; exit 3; }
 run_stage accuracy_tpu_bf16mu 3600 \
   python benchmarks/accuracy_at_scale.py --profile tpu_bf16mu \
@@ -169,6 +172,10 @@ probe || { hb "wedged after accuracy_tpu_bf16mu"; exit 3; }
 # trainer.py cast_for_grads): the last two fp32 streams in the dense
 # update. 5 arms, 2 fresh compiles worst case.
 run_stage moment_dtypes 2400 python benchmarks/bench_moment_dtypes.py
+probe || { hb "wedged after moment_dtypes"; exit 3; }
+# headline under the post-nu-flip defaults (rbg + bf16 mu + bf16 nu;
+# the manual 07:16Z capture predicts ~26,777 ex/s/chip)
+BENCH_TOTAL_BUDGET=600 BENCH_RECIPE=default run_stage headline_v3 700 python bench.py
 
 # Exit 0 ONLY when every stage holds a fresh capture — otherwise the
 # supervisor must keep respawning us for the stages still pending (a

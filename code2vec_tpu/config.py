@@ -107,13 +107,15 @@ class Config:
     # HBM-bound dense update): 'bfloat16' halves it (~1.9 ms/step
     # analytic at the measured ~819 GB/s). Moment math stays fp32 every
     # step — only HBM storage narrows (the sqrt denominator is formed
-    # after an fp32 upcast). DEFAULT 'float32' until the on-chip A/B
-    # (benchmarks/bench_moment_dtypes.py) records a >=2% step-time win
-    # AND a learning-curve twin (accuracy profile cpu_full_bf16nu)
-    # matches the fp32-nu curve — same flip rule every perf knob here
-    # has cleared (PERF.md). Cross-dtype checkpoint resume adapts
+    # after an fp32 upcast). DEFAULT 'bfloat16' per the >=2% flip rule
+    # (PERF.md): the on-chip A/B measured 38.24 vs 41.10 ms/step on the
+    # default recipe (-7.0%, 26,777 ex/s/chip;
+    # moment_dtypes_manual_2026-07-31T0716Z.jsonl) and the learning-curve
+    # twin matches — best F1 0.5606 (accuracy_cpu_full_bf16nu.json) vs
+    # 0.5565/0.5566 for the bf16-mu and fp32-moment twins on the
+    # identical dataset. Cross-dtype checkpoint resume adapts
     # automatically, like ADAM_MU_DTYPE (checkpoints.py).
-    ADAM_NU_DTYPE: str = 'float32'
+    ADAM_NU_DTYPE: str = 'bfloat16'
     # Dtype the GRADIENTS are produced and streamed in (training/
     # trainer.py): 'bfloat16' differentiates the loss wrt the pre-cast
     # bf16 params, so the two table-grad scatter-adds and the full grad

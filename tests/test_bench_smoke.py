@@ -34,7 +34,7 @@ def test_bench_smoke_emits_one_json_line():
     # the shipped defaults (the measured 2026-07-31 winners)
     assert record['knobs'] == {'dropout_prng': 'rbg',
                                'adam_mu': 'bfloat16',
-                               'adam_nu': 'float32',
+                               'adam_nu': 'bfloat16',
                                'grads': 'float32'}
 
 
