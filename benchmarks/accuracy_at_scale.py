@@ -107,6 +107,14 @@ PROFILES = {
                                    '--adam-mu-dtype', 'bfloat16',
                                    '--adam-nu-dtype', 'float32',
                                    '--grads-dtype', 'float32']),
+    # the SHIPPED default recipe on the device (rbg + bf16 mu + bf16 nu
+    # after the 2026-07-31 nu flip): pairs 1:1 against
+    # accuracy_tpu_bf16mu.json (nu knob only) and accuracy_tpu.json
+    'tpu_bf16nu': dict(classes=24000, batch=512, contexts=200, epochs=12,
+                       extra_args=['--dropout-prng', 'rbg',
+                                   '--adam-mu-dtype', 'bfloat16',
+                                   '--adam-nu-dtype', 'bfloat16',
+                                   '--grads-dtype', 'float32']),
     'cpu_full_bf16mu': dict(classes=8000, batch=512, contexts=200, epochs=5,
                             extra_args=['--dtype', 'bfloat16',
                                         '--dropout-prng', 'rbg',

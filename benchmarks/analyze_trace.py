@@ -32,15 +32,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def load_xspace(trace_dir: str):
+    """Newest capture under ``trace_dir`` (the timestamped dir names sort
+    chronologically, so [-1] is the latest — [0] would silently pin the
+    analysis to the OLDEST committed trace forever once a re-capture
+    lands, e.g. the post-flip profile_v2 stage writing next to the
+    round-2 trace)."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
     paths = sorted(glob.glob(os.path.join(
         trace_dir, 'plugins', 'profile', '*', '*.xplane.pb')))
     if not paths:
         raise FileNotFoundError('no *.xplane.pb under %s' % trace_dir)
     xs = xplane_pb2.XSpace()
-    with open(paths[0], 'rb') as f:
+    with open(paths[-1], 'rb') as f:
         xs.ParseFromString(f.read())
-    return xs, paths[0]
+    return xs, paths[-1]
 
 
 def decompose(xs, steps: int) -> dict:

@@ -32,6 +32,11 @@ def main() -> None:
     benchlib.honor_env_platforms()
     print(json.dumps({'platform': jax.devices()[0].platform.lower()}),
           flush=True)
+    # A failed artifact must fail the STAGE: the watcher done-marks on
+    # rc=0 + any fresh JSON line, and the platform line above would
+    # otherwise done-mark a capture whose trace/cost analysis both died
+    # (advisor finding, round 5).
+    failed = []
 
     config = benchlib.headline_config(SHAPES)
     trainer, state = benchlib.build_trainer(config, SHAPES)
@@ -51,6 +56,7 @@ def main() -> None:
             'gbytes_accessed_per_step': round(bytes_accessed / 1e9, 2)}),
             flush=True)
     except Exception as exc:
+        failed.append('cost_analysis')
         print(json.dumps({'artifact': 'train_step_cost_analysis',
                           'error': str(exc)[:200]}), flush=True)
 
@@ -74,6 +80,7 @@ def main() -> None:
                           'n_files': len(files),
                           'files': sorted(files)[:8]}), flush=True)
     except Exception as exc:
+        failed.append('profiler_trace')
         print(json.dumps({'artifact': 'profiler_trace',
                           'error': str(exc)[:300]}), flush=True)
 
@@ -85,6 +92,8 @@ def main() -> None:
     step_ms = (time.perf_counter() - start) / 20 * 1e3
     print(json.dumps({'artifact': 'step_time_ms',
                       'value': round(step_ms, 2)}), flush=True)
+    if failed:
+        sys.exit(2)   # keep the stage pending for a later healthy window
 
 
 if __name__ == '__main__':

@@ -91,7 +91,7 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   return ${rc}
 }
 
-ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes headline_v3"
+ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes headline_v3 accuracy_tpu_bf16nu profile_v2"
 
 all_captured() {
   local s
@@ -176,6 +176,17 @@ probe || { hb "wedged after moment_dtypes"; exit 3; }
 # headline under the post-nu-flip defaults (rbg + bf16 mu + bf16 nu;
 # the manual 07:16Z capture predicts ~26,777 ex/s/chip)
 BENCH_TOTAL_BUDGET=600 BENCH_RECIPE=default run_stage headline_v3 700 python bench.py
+probe || { hb "wedged after headline_v3"; exit 3; }
+# the shipped default recipe's on-device learning curve (nu-knob-only
+# twin of accuracy_tpu_bf16mu)
+run_stage accuracy_tpu_bf16nu 3600 \
+  python benchmarks/accuracy_at_scale.py --profile tpu_bf16nu \
+  --workdir /tmp/acc_r5_corpus
+probe || { hb "wedged after accuracy_tpu_bf16nu"; exit 3; }
+# fresh jax.profiler trace + XLA cost analysis under the shipped
+# defaults (capture_profile.py uses the default recipe): updates the
+# roofline decomposition from the 49 ms era to the post-flip step
+run_stage profile_v2 1200 python benchmarks/capture_profile.py
 
 # Exit 0 ONLY when every stage holds a fresh capture — otherwise the
 # supervisor must keep respawning us for the stages still pending (a

@@ -90,7 +90,8 @@ class Config:
     # Storage dtype for Adam's FIRST moment (optax mu_dtype). 'bfloat16'
     # halves the first-moment HBM traffic (~1.5 GB/step read+write at
     # java14m's 384M params) in the HBM-bound update (PERF.md roofline);
-    # the second moment and params stay fp32. DEFAULT 'bfloat16' per the
+    # params stay fp32 (the second moment has its own knob below).
+    # DEFAULT 'bfloat16' per the
     # ≥2% rule: the on-chip A/B measured 44.89 vs 47.32 ms/step (-5.1%
     # alone; -13.4% combined with rbg dropout,
     # capture_2026-07-31T0344Z_r5.jsonl); the equivalence twins

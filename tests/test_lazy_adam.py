@@ -218,13 +218,16 @@ def test_lazy_checkpoint_resume(tmp_path):
 
 def test_bf16_mu_adam_trains():
     """ADAM_MU_DTYPE='bfloat16' (dense Adam only) stores the first moment
-    in bf16 and still reduces the loss; the second moment stays fp32, and
-    checkpoint restore targets carry the same dtypes."""
+    in bf16 and still reduces the loss; the second moment is PINNED fp32
+    here (ADAM_NU_DTYPE has its own default and tests —
+    test_adam_dtypes.py), and checkpoint restore targets carry the same
+    dtypes."""
     import jax
     import jax.numpy as jnp
 
     trainer = make_trainer(LAZY_EMBEDDING_ADAM=False,
-                           ADAM_MU_DTYPE='bfloat16')
+                           ADAM_MU_DTYPE='bfloat16',
+                           ADAM_NU_DTYPE='float32')
     state = trainer.init_state(seed=0)
     mu_dtypes = {leaf.dtype for leaf in jax.tree_util.tree_leaves(
         state.opt_state[0].mu)}
