@@ -438,6 +438,24 @@ class CsParser {
   // --------------------------------------------------------------- types
   Node* parse_type() {
     DepthGuard depth_guard(&depth_);
+    if (is_punct("(")) {
+      // tuple type `(int, string name)` — Roslyn TupleType with
+      // TupleElement children (element name is an identifier token)
+      advance();
+      Node* tuple = arena_->make("TupleType");
+      do {
+        Node* element = arena_->make("TupleElement");
+        element->add(parse_type());
+        if (cur().kind == Tok::kIdent &&
+            (is_punct(",", 1) || is_punct(")", 1)))
+          add_token(element, expect_ident(), true, false, false);
+        tuple->add(element);
+      } while (accept_punct(","));
+      expect_punct(")");
+      if (tuple->children.size() < 2)
+        throw ParseError("tuple type needs >= 2 elements");
+      return maybe_type_suffix(tuple);
+    }
     if (cur().kind == Tok::kIdent && predefined_types().count(cur().text)) {
       Node* type = arena_->make("PredefinedType");
       add_token(type, cur().text, false, false, /*predefined=*/true);
@@ -544,6 +562,18 @@ class CsParser {
       stmt->add(parse_statement());
       return stmt;
     }
+    if (is_ident("using") && ahead(1).kind == Tok::kIdent) {
+      // C# 8 using DECLARATION `using var f = Open(p);` — Roslyn kind is
+      // still LocalDeclarationStatement (using is just a token on it)
+      advance();
+      Node* decl = try_parse_variable_declaration();
+      if (decl && accept_punct(";")) {
+        Node* stmt = arena_->make("LocalDeclarationStatement", "", true);
+        stmt->add(decl);
+        return stmt;
+      }
+      throw ParseError("malformed using declaration");
+    }
     if (is_ident("lock")) {
       advance();
       Node* stmt = arena_->make("LockStatement", "", true);
@@ -553,8 +583,11 @@ class CsParser {
       stmt->add(parse_statement());
       return stmt;
     }
-    if (is_ident("var") || cur().kind == Tok::kIdent) {
+    if (is_ident("var") || cur().kind == Tok::kIdent || is_punct("(")) {
       size_t m = mark();
+      Node* fn = try_parse_local_function();
+      if (fn) return fn;
+      rewind(m);
       Node* decl = try_parse_variable_declaration();
       if (decl && accept_punct(";")) {
         Node* stmt = arena_->make("LocalDeclarationStatement", "", true);
@@ -569,17 +602,56 @@ class CsParser {
     return stmt;
   }
 
+  // Local function `int Local(int k) { ... }` inside a block — Roslyn
+  // LocalFunctionStatement: NOT a MethodDeclaration, so its leaves stay
+  // inside the enclosing method's bag (the reference's visitor descends
+  // MethodDeclarationSyntax only). Returns nullptr (caller rewinds) when
+  // the statement is not a local function.
+  Node* try_parse_local_function() {
+    try {
+      while (is_ident("async") || is_ident("static") || is_ident("unsafe"))
+        advance();
+      if (cur().kind != Tok::kIdent && !is_punct("(")) return nullptr;
+      Node* type;
+      if (is_ident("var")) return nullptr;  // `var f = ...` is a decl
+      type = parse_type();
+      if (cur().kind != Tok::kIdent) return nullptr;
+      if (!is_punct("(", 1) && !is_punct("<", 1)) return nullptr;
+      std::string name = expect_ident();
+      skip_generic_args();
+      if (!is_punct("(")) return nullptr;
+      Node* fn = arena_->make("LocalFunctionStatement", name, true);
+      fn->add(type);
+      add_token(fn, name, /*ident=*/true, false, false);
+      parse_parameter_list(fn);
+      skip_where_clauses();
+      if (is_punct("{")) {
+        fn->add(parse_block());
+      } else if (accept_punct("=>")) {
+        Node* arrow = arena_->make("ArrowExpressionClause");
+        arrow->add(parse_expression());
+        fn->add(arrow);
+        expect_punct(";");
+      } else {
+        return nullptr;
+      }
+      return fn;
+    } catch (const ParseError&) {
+      return nullptr;
+    }
+  }
+
   // VariableDeclaration: [type, VariableDeclarator...]; 'var' is NOT a
   // leaf token (reference Tree.cs:168-175)
   Node* try_parse_variable_declaration() {
     try {
-      if (cur().kind != Tok::kIdent) return nullptr;
+      if (cur().kind != Tok::kIdent && !is_punct("(")) return nullptr;
       Node* type;
       if (is_ident("var") && ahead(1).kind == Tok::kIdent) {
         advance();
         type = arena_->make("IdentifierName", "var");  // no leaf token
       } else {
-        type = parse_type();
+        type = parse_type();  // handles tuple types `(int, string) p`
       }
       if (cur().kind != Tok::kIdent) return nullptr;
       const Token& after = ahead(1);
@@ -672,11 +744,63 @@ class CsParser {
 
   Node* parse_foreach() {
     advance();
+    // `foreach (var (a, b) in ...)` — Roslyn ForEachVariableStatement
+    // with a ParenthesizedVariableDesignation holding the names
     Node* stmt = arena_->make("ForEachStatement", "", true);
     expect_punct("(");
     if (is_ident("var")) {
       advance();
+      if (is_punct("(")) {
+        stmt->raw_type = "ForEachVariableStatement";
+        stmt->type = "ForEachVariableStatement";
+        advance();
+        Node* designation =
+            arena_->make("ParenthesizedVariableDesignation");
+        do {
+          Node* single = arena_->make("SingleVariableDesignation");
+          add_token(single, expect_ident(), true, false, false);
+          designation->add(single);
+        } while (accept_punct(","));
+        expect_punct(")");
+        stmt->add(designation);
+        if (!accept_ident("in")) throw ParseError("expected in");
+        stmt->add(parse_expression());
+        expect_punct(")");
+        stmt->add(parse_statement());
+        return stmt;
+      }
     } else {
+      if (is_punct("(")) {
+        // explicitly-typed deconstruction `foreach ((int a, int b) in
+        // xs)` — Roslyn: ForEachVariableStatement whose variable is a
+        // TupleExpression of DeclarationExpressions
+        size_t m = mark();
+        try {
+          advance();
+          Node* tuple = arena_->make("TupleExpression");
+          do {
+            Node* argument = arena_->make("Argument");
+            Node* declaration = arena_->make("DeclarationExpression");
+            declaration->add(parse_type());
+            Node* single = arena_->make("SingleVariableDesignation");
+            add_token(single, expect_ident(), true, false, false);
+            declaration->add(single);
+            argument->add(declaration);
+            tuple->add(argument);
+          } while (accept_punct(","));
+          expect_punct(")");
+          if (!accept_ident("in")) throw ParseError("expected in");
+          stmt->raw_type = "ForEachVariableStatement";
+          stmt->type = "ForEachVariableStatement";
+          stmt->add(tuple);
+          stmt->add(parse_expression());
+          expect_punct(")");
+          stmt->add(parse_statement());
+          return stmt;
+        } catch (const ParseError&) {
+          rewind(m);
+        }
+      }
       stmt->add(parse_type());
     }
     std::string name = expect_ident();
@@ -747,6 +871,175 @@ class CsParser {
     return stmt;
   }
 
+  // SwitchExpressionArm patterns — the pragmatic subset the corpus
+  // actually hits (Roslyn kinds): DiscardPattern `_`, RelationalPattern
+  // `> 5`, NotPattern `not null`, DeclarationPattern `int n`,
+  // ConstantPattern everything-else.
+  Node* parse_switch_pattern() {
+    if (is_ident("_") && (is_punct("=>", 1) || is_ident("when", 1)))
+      { advance(); return arena_->make("DiscardPattern"); }
+    static const char* kRel[] = {">=", "<=", ">", "<"};
+    for (const char* op : kRel) {
+      if (is_punct(op)) {
+        advance();
+        Node* rel = arena_->make("RelationalPattern");
+        rel->add(parse_binary(0));
+        return rel;
+      }
+    }
+    if (is_ident("not")) {
+      advance();
+      Node* not_pattern = arena_->make("NotPattern");
+      not_pattern->add(parse_switch_pattern());
+      return not_pattern;
+    }
+    size_t m = mark();
+    try {
+      Node* type = parse_type();
+      if (cur().kind == Tok::kIdent && !is_ident("when") &&
+          !predefined_types().count(cur().text)) {
+        Node* decl_pattern = arena_->make("DeclarationPattern");
+        decl_pattern->add(type);
+        add_token(decl_pattern, expect_ident(), true, false, false);
+        return decl_pattern;
+      }
+      throw ParseError("not a declaration pattern");
+    } catch (const ParseError&) {
+      rewind(m);
+    }
+    Node* constant = arena_->make("ConstantPattern");
+    constant->add(parse_binary(0));
+    return constant;
+  }
+
+  Node* parse_switch_expression(Node* governed) {
+    advance();  // 'switch'
+    Node* sw = arena_->make("SwitchExpression");
+    sw->add(governed);
+    expect_punct("{");
+    while (!at_end() && !is_punct("}")) {
+      Node* arm = arena_->make("SwitchExpressionArm");
+      arm->add(parse_switch_pattern());
+      if (accept_ident("when")) {
+        Node* when = arena_->make("WhenClause");
+        when->add(parse_expression());
+        arm->add(when);
+      }
+      expect_punct("=>");
+      arm->add(parse_expression());
+      sw->add(arm);
+      if (!accept_punct(",")) break;
+    }
+    expect_punct("}");
+    return sw;
+  }
+
+  // LINQ query syntax — Roslyn QueryExpression: FromClause + QueryBody
+  // holding Where/Let/OrderBy/Join/Select/Group clauses (and
+  // QueryContinuation after `into`). The reference's Roslyn parse puts
+  // all of these node kinds on paths; clause keywords are contextual,
+  // so this is only entered behind parse_primary's from-lookahead.
+  Node* parse_from_clause() {
+    advance();  // 'from'
+    Node* from = arena_->make("FromClause");
+    if (!(cur().kind == Tok::kIdent && is_ident("in", 1)))
+      from->add(parse_type());  // `from int x in ...`
+    add_token(from, expect_ident(), true, false, false);
+    if (!accept_ident("in")) throw ParseError("expected 'in' in query");
+    from->add(parse_expression());
+    return from;
+  }
+
+  Node* parse_query_expression() {
+    Node* query = arena_->make("QueryExpression");
+    query->add(parse_from_clause());
+    Node* body = arena_->make("QueryBody");
+    query->add(body);
+    while (true) {
+      if (is_ident("from") && ahead(1).kind == Tok::kIdent) {
+        body->add(parse_from_clause());
+      } else if (is_ident("where")) {
+        advance();
+        Node* where = arena_->make("WhereClause");
+        where->add(parse_expression());
+        body->add(where);
+      } else if (is_ident("let")) {
+        advance();
+        Node* let = arena_->make("LetClause");
+        add_token(let, expect_ident(), true, false, false);
+        expect_punct("=");
+        let->add(parse_expression());
+        body->add(let);
+      } else if (is_ident("orderby")) {
+        advance();
+        Node* orderby = arena_->make("OrderByClause");
+        do {
+          Node* key = parse_expression();
+          const char* kind = "AscendingOrdering";
+          if (accept_ident("descending")) kind = "DescendingOrdering";
+          else accept_ident("ascending");
+          Node* ordering = arena_->make(kind);
+          ordering->add(key);
+          orderby->add(ordering);
+        } while (accept_punct(","));
+        body->add(orderby);
+      } else if (is_ident("join")) {
+        advance();
+        Node* join = arena_->make("JoinClause");
+        if (!(cur().kind == Tok::kIdent && is_ident("in", 1)))
+          join->add(parse_type());
+        add_token(join, expect_ident(), true, false, false);
+        if (!accept_ident("in")) throw ParseError("join needs 'in'");
+        join->add(parse_expression());
+        if (!accept_ident("on")) throw ParseError("join needs 'on'");
+        join->add(parse_expression());
+        if (!accept_ident("equals")) throw ParseError("join needs 'equals'");
+        join->add(parse_expression());
+        if (accept_ident("into")) {
+          Node* into = arena_->make("JoinIntoClause");
+          add_token(into, expect_ident(), true, false, false);
+          join->add(into);
+        }
+        body->add(join);
+      } else if (is_ident("select")) {
+        advance();
+        Node* select = arena_->make("SelectClause");
+        select->add(parse_expression());
+        body->add(select);
+        if (accept_ident("into")) {
+          // Roslyn nests post-`into` clauses under the continuation's
+          // OWN QueryBody — mirror that so `into` paths match
+          Node* continuation = arena_->make("QueryContinuation");
+          add_token(continuation, expect_ident(), true, false, false);
+          body->add(continuation);
+          body = arena_->make("QueryBody");
+          continuation->add(body);
+          continue;
+        }
+        break;
+      } else if (is_ident("group")) {
+        advance();
+        Node* group = arena_->make("GroupClause");
+        group->add(parse_expression());
+        if (!accept_ident("by")) throw ParseError("group needs 'by'");
+        group->add(parse_expression());
+        body->add(group);
+        if (accept_ident("into")) {
+          Node* continuation = arena_->make("QueryContinuation");
+          add_token(continuation, expect_ident(), true, false, false);
+          body->add(continuation);
+          body = arena_->make("QueryBody");
+          continuation->add(body);
+          continue;
+        }
+        break;
+      } else {
+        break;
+      }
+    }
+    return query;
+  }
+
   Node* parse_array_initializer() {
     expect_punct("{");
     Node* init = arena_->make("InitializerExpression");
@@ -793,6 +1086,10 @@ class CsParser {
 
   Node* parse_ternary() {
     Node* condition = parse_binary(0);
+    // postfix `expr switch { pattern => value, ... }` (C# 8) — Roslyn
+    // SwitchExpression; binds tighter than ?: and assignment
+    while (is_ident("switch") && is_punct("{", 1))
+      condition = parse_switch_expression(condition);
     if (is_punct("?") && !is_punct("?.")) {
       advance();
       Node* ternary = arena_->make("ConditionalExpression");
@@ -872,6 +1169,26 @@ class CsParser {
 
   Node* parse_unary() {
     DepthGuard depth_guard(&depth_);
+    // `await expr` — contextual keyword: only when a unary expression
+    // can actually start at the next token (a bare `await;` or
+    // `await + 1` where await is a variable keeps parsing as an
+    // identifier use)
+    if (is_ident("await")) {
+      const Token& next = ahead(1);
+      bool starts_unary =
+          next.kind == Tok::kIdent || next.kind == Tok::kIntLit ||
+          next.kind == Tok::kFloatLit || next.kind == Tok::kStringLit ||
+          next.kind == Tok::kCharLit ||
+          (next.kind == Tok::kPunct &&
+           (next.text == "(" || next.text == "!" || next.text == "~" ||
+            next.text == "++" || next.text == "--"));
+      if (starts_unary) {
+        advance();
+        Node* await_expr = arena_->make("AwaitExpression");
+        await_expr->add(parse_unary());
+        return await_expr;
+      }
+    }
     static const std::pair<const char*, const char*> kPrefix[] = {
         {"+", "UnaryPlusExpression"},
         {"-", "UnaryMinusExpression"},
@@ -1049,6 +1366,19 @@ class CsParser {
 
   Node* parse_primary() {
     if (lambda_ahead()) return parse_lambda();
+    // LINQ query: `from [Type] x in ...` — tentative parse so a plain
+    // identifier named `from` keeps parsing as an identifier, while
+    // arbitrarily-shaped range-variable types (qualified, generic,
+    // array) still enter the query path (parse_from_clause throws when
+    // no `in` follows, which rewinds us out)
+    if (is_ident("from") && ahead(1).kind == Tok::kIdent) {
+      size_t m = mark();
+      try {
+        return parse_query_expression();
+      } catch (const ParseError&) {
+        rewind(m);
+      }
+    }
     const Token& token = cur();
     switch (token.kind) {
       case Tok::kIntLit:
@@ -1075,8 +1405,24 @@ class CsParser {
       case Tok::kPunct:
         if (is_punct("(")) {
           advance();
+          Node* first = parse_expression();
+          if (is_punct(",")) {
+            // tuple literal `(a, b)` — Roslyn TupleExpression with
+            // Argument children
+            Node* tuple = arena_->make("TupleExpression");
+            Node* first_arg = arena_->make("Argument");
+            first_arg->add(first);
+            tuple->add(first_arg);
+            while (accept_punct(",")) {
+              Node* argument = arena_->make("Argument");
+              argument->add(parse_expression());
+              tuple->add(argument);
+            }
+            expect_punct(")");
+            return tuple;
+          }
           Node* enclosed = arena_->make("ParenthesizedExpression");
-          enclosed->add(parse_expression());
+          enclosed->add(first);
           expect_punct(")");
           return enclosed;
         }

@@ -452,6 +452,182 @@ class T {
     assert 'SimpleLambdaExpression' in lines[0]
 
 
+def test_csharp_linq_query_syntax(tmp_path):
+    """LINQ query syntax parses into Roslyn query-clause kinds
+    (QueryExpression/FromClause/WhereClause/OrderByClause/SelectClause —
+    the reference's Roslyn parse puts these on paths; Extractor.cs
+    renders whatever Kind() says)."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  int[] Query(int[] xs) {
+    var q = from x in xs where x > 0 orderby x descending select x * 2;
+    return q.ToArray();
+  }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['query']
+    line = lines[0]
+    for kind in ('QueryExpression', 'FromClause', 'WhereClause',
+                 'OrderByClause', 'DescendingOrdering', 'SelectClause',
+                 'QueryBody'):
+        assert kind in line, kind
+    # the range variable x is a leaf grouped with its uses
+    assert 'x,' in line and ',x' in line
+
+
+def test_csharp_await_and_async_method(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { async Task<int> FetchAsync(int id) '
+                   '{ var r = await client.GetAsync(id); return r.Value; } }')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['fetch|async']
+    assert 'AwaitExpression' in lines[0]
+
+
+def test_csharp_local_function_stays_in_outer_method(tmp_path):
+    """Roslyn models `int Local(..) {..}` inside a body as a
+    LocalFunctionStatement, NOT a MethodDeclaration — the reference's
+    visitor extracts MethodDeclarationSyntax only, so the local
+    function's leaves belong to the OUTER method's bag."""
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { int Outer(int n) '
+                   '{ int Local(int k) { return k * k; } '
+                   'return Local(n) + 1; } }')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['outer']  # ONE method
+    line = lines[0]
+    assert 'LocalFunctionStatement' in line
+    # the local function's k*k self-pair is inside outer's bag
+    assert any(c.startswith('k,') and c.endswith(',k')
+               for c in line.split(' ')[1:])
+
+
+def test_csharp_switch_expression(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { string Describe(int code) { return code '
+                   'switch { 0 => "zero", 1 => "one", _ => "many" }; } }')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['describe']
+    line = lines[0]
+    for kind in ('SwitchExpression', 'SwitchExpressionArm',
+                 'ConstantPattern'):
+        assert kind in line, kind
+    # constants route through the NUM whitelist: 0 and 1 are kept
+    assert '0,' in line or ',0' in line
+
+
+def test_csharp_tuple_types_and_literals(tmp_path):
+    src = tmp_path / 'T.cs'
+    src.write_text('class T { (int, string) Pair(int k) '
+                   '{ return (k, k.ToString()); } }')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['pair']
+    line = lines[0]
+    for kind in ('TupleType', 'TupleElement', 'TupleExpression'):
+        assert kind in line, kind
+
+
+def test_csharp_members_without_bodies_skip_cleanly(tmp_path):
+    """Indexers, events and delegate declarations are not methods: they
+    must parse (or skip) without dropping the sibling method."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  public int this[int i] { get { return data[i]; } }
+  public event EventHandler Changed;
+  delegate int Op(int a, int b);
+  int After(int x) { return x; }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['after']
+
+
+def test_csharp_using_declaration_and_deconstruction(tmp_path):
+    """C# 8 using declarations (`using var f = ...;` — Roslyn kind stays
+    LocalDeclarationStatement) and foreach tuple deconstruction
+    (`foreach (var (a, b) in ...)` — ForEachVariableStatement with
+    SingleVariableDesignation leaves)."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  void UseDecl(string path) { using var f = Open(path); f.Read(); }
+  int Deconstruct(List<(int, int)> pairs) {
+    int s = 0;
+    foreach (var (a, b) in pairs) { s += a * b; }
+    return s;
+  }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['use|decl', 'deconstruct']
+    assert 'LocalDeclarationStatement' in lines[0]
+    assert 'ForEachVariableStatement' in lines[1]
+    assert 'SingleVariableDesignation' in lines[1]
+    # the designation names pair with their uses: a*b gives the short
+    # IdentifierName^MultiplyExpression_IdentifierName path (the
+    # designation-to-use self-pair is legitimately length-8-pruned)
+    assert any(c.startswith('a,') and c.endswith(',b')
+               and 'MultiplyExpression' in c
+               for c in lines[1].split(' ')[1:])
+
+
+def test_csharp_verbatim_interp_generics_constraints(tmp_path):
+    """Verbatim strings, interpolation format specifiers, nested generic
+    arguments (the >> ambiguity), and generic methods with where-clauses
+    all parse without dropping methods."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  string Verbatim(string p) { return @"C:%temp%" + p; }
+  string Fmt(double v) { return $"val {v:F2} end"; }
+  List<Dictionary<string, int>> Nested(int n) {
+    return Make<Dictionary<string, int>>(n);
+  }
+  T Constrained<T>(T x) where T : class, new() { return x; }
+  int Shifty(int x) { return x >> 2; }
+}
+''')
+    labels = [l.split(' ')[0] for l in extract_file(str(src))]
+    assert labels == ['verbatim', 'fmt', 'nested', 'constrained', 'shifty']
+
+
+def test_csharp_review_hardening_corners(tmp_path):
+    """Round-5 review reproductions: typed foreach deconstruction,
+    await-of-unary, qualified query range-variable types, and `into`
+    continuations nesting under QueryContinuation's own QueryBody
+    (Roslyn's shape) — each previously dropped the method or diverged
+    from the reference parse."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  int TypedDecon(List<(int, int)> xs) {
+    foreach ((int a, int b) in xs) { return a + b; } return 0;
+  }
+  async Task<bool> AwaitNot(Task<bool> t) { return !(await t); }
+  int QualifiedQuery(int[] xs) {
+    var q = from System.Int32 x in xs select x; return q.Count();
+  }
+  string GroupInto(int[] xs) {
+    var q = from x in xs group x by x into g select g.Key;
+    return q.ToString();
+  }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == [
+        'typed|decon', 'await|not', 'qualified|query', 'group|into']
+    assert 'ForEachVariableStatement' in lines[0]
+    assert 'DeclarationExpression' in lines[0]
+    assert 'AwaitExpression' in lines[1]
+    assert 'QueryExpression' in lines[2]
+    # post-`into` select nests under the continuation's own QueryBody
+    assert 'QueryBody^QueryContinuation' in lines[3] \
+        or 'QueryContinuation_QueryBody' in lines[3]
+
+
 def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
     """End-to-end: real binary feeds the REPL (reference flow:
     interactive_predict.py + extractor.py + JAR)."""
