@@ -134,6 +134,17 @@ PROFILES = {
                                         '--adam-mu-dtype', 'bfloat16',
                                         '--adam-nu-dtype', 'bfloat16',
                                         '--grads-dtype', 'float32']),
+    # the C# pipeline at scale (VERDICT-style end-to-end evidence for the
+    # second language frontend): gen_csharp_corpus -> c2v-extract --dir
+    # over .cs -> preprocess -> train. Same dims/recipe as cpu_full so
+    # the two languages' curves compare 1:1.
+    'cpu_csharp': dict(classes=8000, batch=512, contexts=200, epochs=5,
+                       lang='csharp',
+                       extra_args=['--dtype', 'float32',
+                                   '--dropout-prng', 'threefry2x32',
+                                   '--adam-mu-dtype', 'float32',
+                                   '--adam-nu-dtype', 'float32',
+                                   '--grads-dtype', 'float32']),
     # GRADS_DTYPE='bfloat16' equivalence twin: the full combined
     # candidate recipe (bf16 grads + bf16 nu on top of the shipped
     # defaults), pairing against cpu_full_bf16nu (grads knob only) and
@@ -156,29 +167,34 @@ def run(cmd, **kw):
     subprocess.run(cmd, check=True, **kw)
 
 
-def build_dataset(workdir: str, classes: int, contexts: int) -> str:
+def build_dataset(workdir: str, classes: int, contexts: int,
+                  lang: str = 'java') -> str:
     # every cached artifact is keyed by the parameters that shaped it:
-    # the corpus and raw extraction by the class count, the preprocessed
-    # dataset additionally by the sampling width — so profiles sharing a
-    # workdir can never silently train on each other's corpus size or
-    # contexts sampling (either would be a wrong experiment)
-    corpus = os.path.join(workdir, 'corpus_%d' % classes)
+    # the corpus and raw extraction by the class count (and language —
+    # java keeps its legacy key so committed workdirs stay warm), the
+    # preprocessed dataset additionally by the sampling width — so
+    # profiles sharing a workdir can never silently train on each
+    # other's corpus size or contexts sampling (either would be a wrong
+    # experiment)
+    tag = '%d' % classes if lang == 'java' else 'cs_%d' % classes
+    corpus = os.path.join(workdir, 'corpus_%s' % tag)
     data = os.path.join(workdir, 'data')
     os.makedirs(data, exist_ok=True)
     if not os.path.isdir(corpus):
-        run([sys.executable, os.path.join(REPO, 'scripts',
-                                          'gen_java_corpus.py'),
+        generator = ('gen_java_corpus.py' if lang == 'java'
+                     else 'gen_csharp_corpus.py')
+        run([sys.executable, os.path.join(REPO, 'scripts', generator),
              '-o', corpus, '--classes', str(classes)])
     extractor = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
     raw = {}
     for split in ('train', 'val', 'test'):
-        raw[split] = os.path.join(data, '%s_%d.raw' % (split, classes))
+        raw[split] = os.path.join(data, '%s_%s.raw' % (split, tag))
         if not os.path.isfile(raw[split]):
             with open(raw[split], 'w') as f:
                 run([extractor, '--dir', os.path.join(corpus, split),
                      '--max_path_length', '8', '--max_path_width', '2',
                      '--num_threads', '16'], stdout=f)
-    prefix = os.path.join(data, 'acc_%d_c%d' % (classes, contexts))
+    prefix = os.path.join(data, 'acc_%s_c%d' % (tag, contexts))
     if not os.path.isfile(prefix + '.train.c2v'):
         run([sys.executable, '-m', 'code2vec_tpu.data.preprocess',
              '-trd', raw['train'], '-vd', raw['val'], '-ted', raw['test'],
@@ -269,7 +285,8 @@ def main() -> None:
         prof['classes'] = args.classes
 
     os.makedirs(args.workdir, exist_ok=True)
-    prefix = build_dataset(args.workdir, prof['classes'], prof['contexts'])
+    prefix = build_dataset(args.workdir, prof['classes'], prof['contexts'],
+                           lang=prof.get('lang', 'java'))
 
     model_dir = os.path.join(args.workdir, 'model_%s' % args.profile)
     cmd = [sys.executable, '-m', 'code2vec_tpu.cli',

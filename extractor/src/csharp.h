@@ -754,6 +754,11 @@ class CsParser {
         stmt->raw_type = "ForEachVariableStatement";
         stmt->type = "ForEachVariableStatement";
         advance();
+        // Roslyn wraps the designation in a DeclarationExpression whose
+        // type is IdentifierName("var") — `var` is not a leaf token
+        // (reference Tree.cs:168-175), matching the typed branch's shape
+        Node* declaration = arena_->make("DeclarationExpression");
+        declaration->add(arena_->make("IdentifierName", "var"));
         Node* designation =
             arena_->make("ParenthesizedVariableDesignation");
         do {
@@ -762,7 +767,8 @@ class CsParser {
           designation->add(single);
         } while (accept_punct(","));
         expect_punct(")");
-        stmt->add(designation);
+        declaration->add(designation);
+        stmt->add(declaration);
         if (!accept_ident("in")) throw ParseError("expected in");
         stmt->add(parse_expression());
         expect_punct(")");
@@ -892,6 +898,24 @@ class CsParser {
       Node* not_pattern = arena_->make("NotPattern");
       not_pattern->add(parse_switch_pattern());
       return not_pattern;
+    }
+    if (is_punct("(")) {
+      // positional pattern `(0, 0)` — Roslyn RecursivePattern with a
+      // PositionalPatternClause of Subpatterns. MUST be handled here:
+      // the ConstantPattern fallback's expression parse would see
+      // `(0, 0) =>` as a parenthesized LAMBDA and die on the literal
+      // "parameters", dropping the method.
+      advance();
+      Node* recursive = arena_->make("RecursivePattern");
+      Node* positional = arena_->make("PositionalPatternClause");
+      do {
+        Node* sub = arena_->make("Subpattern");
+        sub->add(parse_switch_pattern());
+        positional->add(sub);
+      } while (accept_punct(","));
+      expect_punct(")");
+      recursive->add(positional);
+      return recursive;
     }
     size_t m = mark();
     try {
@@ -1086,10 +1110,6 @@ class CsParser {
 
   Node* parse_ternary() {
     Node* condition = parse_binary(0);
-    // postfix `expr switch { pattern => value, ... }` (C# 8) — Roslyn
-    // SwitchExpression; binds tighter than ?: and assignment
-    while (is_ident("switch") && is_punct("{", 1))
-      condition = parse_switch_expression(condition);
     if (is_punct("?") && !is_punct("?.")) {
       advance();
       Node* ternary = arena_->make("ConditionalExpression");
@@ -1142,6 +1162,11 @@ class CsParser {
 
   Node* parse_binary(int min_prec) {
     Node* left = parse_unary();
+    // postfix `expr switch { pattern => value, ... }` (C# 8) — Roslyn
+    // binds the switch to the UNARY operand (`a + b switch {...}` is
+    // `a + (b switch {...})`), so the hook sits before the binary loop
+    while (is_ident("switch") && is_punct("{", 1))
+      left = parse_switch_expression(left);
     while (true) {
       if (is_ident("is") || is_ident("as")) {
         bool is_is = is_ident("is");
@@ -1215,6 +1240,14 @@ class CsParser {
                         cur().kind == Tok::kFloatLit ||
                         cur().kind == Tok::kStringLit ||
                         cur().kind == Tok::kCharLit || is_punct("(");
+          // `(a, b)` parses as a TupleType of identifier "types", so a
+          // tuple LITERAL followed by a contextual keyword (`(a, b)
+          // switch {...}`, `(a, b) is ...`) would commit as a cast and
+          // blow up at the keyword, dropping the method. Tuple casts
+          // require double parens and are vanishingly rare; never
+          // commit a cast from a TupleType — the rewind lands in the
+          // tuple-literal path below.
+          if (type->raw_type == "TupleType") target = false;
           if (target) {
             Node* cast = arena_->make("CastExpression");
             cast->add(type);

@@ -1,0 +1,145 @@
+"""Generate a synthetic C# corpus for accuracy-at-scale validation of the
+C# extraction pipeline (the C# analog of scripts/gen_java_corpus.py —
+reference pipeline: preprocess_csharp.sh over a real C# tree).
+
+Reuses the Java generator's corpus machinery (noun pools, Zipfian draws,
+body families with verb-synonym tells, combinatorial nesting) and maps
+the emitted bodies to C# syntax — the families are deliberately C-like,
+so the mapping is a handful of lexical rules:
+
+- ``boolean`` -> ``bool``, ``String`` -> ``string``;
+- ``Integer/Long/Double.compare(a, b)`` -> ``a.CompareTo(b)``;
+- ``.equals(`` -> ``.Equals(``.
+
+On top of the transliterated families, a fraction of classes gain
+C#-NATIVE members (expression-bodied properties, switch-expression
+methods, tuple-returning methods) so the corpus exercises the parser
+paths that only exist in C# (csharp.h: SwitchExpression, TupleType,
+ArrowExpressionClause) and the path vocabulary carries their kinds at
+corpus scale, not just in golden tests.
+
+Deterministic under --seed. Output: one .cs file per class under
+<out>/{train,val,test}/, ready for `c2v-extract --dir`.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import random
+import re
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    'gen_java_corpus', os.path.join(_HERE, 'gen_java_corpus.py'))
+gjc = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(gjc)
+
+
+_COMPARE_RE = re.compile(
+    r'\b(?:Integer|Long|Double)\.compare\(([^,]+), ([^)]+)\)')
+
+
+def to_csharp(java_src: str) -> str:
+    src = re.sub(r'\bboolean\b', 'bool', java_src)
+    src = re.sub(r'\bString\b', 'string', src)
+    src = _COMPARE_RE.sub(r'\1.CompareTo(\2)', src)
+    src = src.replace('.equals(', '.Equals(')
+    return src
+
+
+def csharp_native_members(rng: random.Random, cls: 'gjc.ClassGen') -> list:
+    """C#-only member templates over the class's fields (names stay
+    camelCase like the transliterated families — the extractor's
+    subtoken split produces identical labels either way)."""
+    members = []
+    ftype, fname = rng.choice(cls.fields)
+    cap = gjc.capitalized(fname)
+    if rng.random() < 0.5:
+        # expression-bodied property (ArrowExpressionClause paths);
+        # properties are not methods, so this also exercises the
+        # member-skip path at scale
+        members.append('public string %sTag => "%s" + this.%s;'
+                       % (cap, fname, fname))
+    num = cls.numeric_fields()
+    if num and rng.random() < 0.6:
+        t1, f1 = rng.choice(num)
+        cap1 = gjc.capitalized(f1)
+        members.append(
+            'public string describe%sBand() { return this.%s switch '
+            '{ 0 => "zero", 1 => "one", _ => "many" }; }'
+            % (cap1, f1))
+    if len(num) >= 2 and rng.random() < 0.6:
+        (t1, f1), (t2, f2) = rng.sample(num, 2)
+        cap1, cap2 = gjc.capitalized(f1), gjc.capitalized(f2)
+        members.append(
+            'public (%s, %s) pairOf%sAnd%s() { return (this.%s, this.%s); }'
+            % (t1 if t1 != 'boolean' else 'bool',
+               t2 if t2 != 'boolean' else 'bool', cap1, cap2, f1, f2))
+    return members
+
+
+def gen_csharp_class(rng: random.Random, name: str, noun_pairs,
+                     methods_per_class) -> str:
+    cls = gjc.ClassGen(rng, noun_pairs)
+    lines = ['public class %s {' % name]
+    for ftype, fname in cls.fields:
+        lines.append('    private %s %s;'
+                     % ({'boolean': 'bool', 'String': 'string'}.get(
+                         ftype, ftype), fname))
+    n_methods = rng.randint(*methods_per_class)
+    seen = set()
+    for _ in range(n_methods):
+        m = to_csharp(cls.method())
+        sig = m.split('(')[0]
+        if sig in seen:
+            continue
+        seen.add(sig)
+        lines.append('    public ' + m)
+    for member in csharp_native_members(rng, cls):
+        lines.append('    ' + member)
+    lines.append('}')
+    return '\n'.join(lines) + '\n'
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('-o', '--out', required=True)
+    parser.add_argument('--classes', type=int, default=8000)
+    parser.add_argument('--methods-per-class', type=int, nargs=2,
+                        default=(3, 6))
+    parser.add_argument('--val-frac', type=float, default=0.025)
+    parser.add_argument('--test-frac', type=float, default=0.025)
+    parser.add_argument('--files-per-dir', type=int, default=2000)
+    parser.add_argument('--seed', type=int, default=11)
+    args = parser.parse_args()
+
+    rng = random.Random(args.seed)
+    noun_pairs = ([(a, n) for a in gjc.ADJS for n in gjc.NOUNS]
+                  + [(n1, n2) for n1 in gjc.NOUNS for n2 in gjc.NOUNS
+                     if n1 != n2])
+    rng.shuffle(noun_pairs)
+
+    counts = {'train': 0, 'val': 0, 'test': 0}
+    for split in counts:
+        os.makedirs(os.path.join(args.out, split), exist_ok=True)
+    methods = 0
+    for i in range(args.classes):
+        r = rng.random()
+        split = ('val' if r < args.val_frac else
+                 'test' if r < args.val_frac + args.test_frac else 'train')
+        sub = 'p%03d' % (counts[split] // args.files_per_dir)
+        d = os.path.join(args.out, split, sub)
+        os.makedirs(d, exist_ok=True)
+        name = 'C%05d' % i
+        src = gen_csharp_class(rng, name, noun_pairs,
+                               args.methods_per_class)
+        with open(os.path.join(d, name + '.cs'), 'w') as f:
+            f.write(src)
+        counts[split] += 1
+        methods += src.count('public ') - 1
+    print('classes: %s  methods: ~%d' % (counts, methods))
+
+
+if __name__ == '__main__':
+    main()

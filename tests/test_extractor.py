@@ -628,6 +628,59 @@ class T {
         or 'QueryContinuation_QueryBody' in lines[3]
 
 
+def test_csharp_tuple_switch_and_precedence(tmp_path):
+    """Second review round: tuple-governed switch with positional
+    patterns (`(x, y) switch { (0, 0) => ... }` — Roslyn
+    RecursivePattern/PositionalPatternClause; previously the `(0, 0) =>`
+    arm matched the lambda lookahead and the cast path committed on the
+    TupleType), and switch binding tighter than binary
+    (`a + b switch {...}` is `a + (b switch)` — the SwitchExpression
+    must sit UNDER the AddExpression, not above it)."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  string Origin(int x, int y) {
+    return (x, y) switch { (0, 0) => "origin", _ => "other" };
+  }
+  int Bind(int a, int b) { return a + b switch { 0 => 1, _ => 2 }; }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['origin', 'bind']
+    assert 'RecursivePattern' in lines[0]
+    assert 'PositionalPatternClause' in lines[0]
+    assert 'SwitchExpression_AddExpression' not in lines[1]
+    assert 'AddExpression' in lines[1] and 'SwitchExpression' in lines[1]
+
+
+def test_csharp_corpus_generator_roundtrip(tmp_path):
+    """scripts/gen_csharp_corpus.py emits parseable C# at smoke scale:
+    every generated file extracts with zero stderr errors, labels carry
+    the generator's verb vocabulary, and the C#-native members put the
+    new parser kinds (SwitchExpression / TupleType) into the corpus's
+    path space — the at-scale analog run by the cpu_csharp accuracy
+    profile (benchmarks/accuracy_at_scale.py)."""
+    import subprocess
+    import sys as _sys
+    out = tmp_path / 'corpus'
+    subprocess.run([_sys.executable,
+                    os.path.join(REPO, 'scripts', 'gen_csharp_corpus.py'),
+                    '-o', str(out), '--classes', '40', '--seed', '3'],
+                   check=True, capture_output=True)
+    proc = run_extractor('--dir', str(out / 'train'), '--num_threads', '4',
+                         '--no_hash')
+    assert proc.returncode == 0
+    assert not proc.stderr.strip(), proc.stderr[:500]
+    lines = proc.stdout.splitlines()
+    assert len(lines) > 50
+    joined = '\n'.join(lines)
+    assert 'SwitchExpression' in joined
+    assert 'TupleType' in joined
+    labels = {line.split(' ')[0] for line in lines}
+    assert any(l.startswith('get|') for l in labels)
+    assert any(l.startswith('describe|') for l in labels)
+
+
 def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
     """End-to-end: real binary feeds the REPL (reference flow:
     interactive_predict.py + extractor.py + JAR)."""
