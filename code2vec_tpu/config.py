@@ -221,6 +221,12 @@ class Config:
 
     # ---- run-mode flags (filled from CLI; reference config.py:72-87) ----
     PREDICT: bool = False
+    # Source file the interactive shell (re)reads each turn. The
+    # reference hardcodes Input.java (interactive_predict.py:8); making
+    # it a flag lets the SAME REPL serve the C# frontend — the extractor
+    # dispatches by file extension, so `--input-file Input.cs` predicts
+    # over Roslyn-kind paths with a C#-trained model.
+    PREDICT_INPUT_PATH: str = 'Input.java'
     MODEL_SAVE_PATH: Optional[str] = None
     MODEL_LOAD_PATH: Optional[str] = None
     TRAIN_DATA_PATH_PREFIX: Optional[str] = None
@@ -267,6 +273,10 @@ class Config:
                                  'for a smaller artifact')
         parser.add_argument('--predict', action='store_true',
                             help='run the interactive prediction shell')
+        parser.add_argument('--input-file', dest='predict_input_path',
+                            default=None, metavar='PATH',
+                            help='source file the prediction shell reads '
+                                 '(.java or .cs; default Input.java)')
         parser.add_argument('-fw', '--framework', dest='dl_framework',
                             choices=['flax', 'jax'], default='flax',
                             help='model backend to use')
@@ -343,6 +353,8 @@ class Config:
     def load_from_args(self, args=None) -> 'Config':
         parsed = self.arguments_parser().parse_args(args)
         self.PREDICT = parsed.predict
+        if parsed.predict_input_path:
+            self.PREDICT_INPUT_PATH = parsed.predict_input_path
         self.MODEL_SAVE_PATH = parsed.save_path
         self.MODEL_LOAD_PATH = parsed.load_path
         self.TRAIN_DATA_PATH_PREFIX = parsed.data_path

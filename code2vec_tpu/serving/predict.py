@@ -19,7 +19,9 @@ from code2vec_tpu.config import Config
 from code2vec_tpu.serving.extractor_bridge import Extractor
 
 SHOW_TOP_CONTEXTS = 10           # reference interactive_predict.py:6
-DEFAULT_INPUT_FILENAME = 'Input.java'
+# single source of truth: Config.PREDICT_INPUT_PATH (the --input-file
+# flag's default) — duplicating the literal here let the two drift
+DEFAULT_INPUT_FILENAME = Config.PREDICT_INPUT_PATH
 QUIT_WORDS = frozenset({'exit', 'quit', 'q'})
 
 

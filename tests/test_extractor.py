@@ -714,6 +714,44 @@ def test_interactive_repl_with_real_extractor(tmp_path, monkeypatch, capsys):
     assert '(BinaryExpr:times)' in out
 
 
+def test_interactive_repl_serves_csharp_input(tmp_path, monkeypatch,
+                                              capsys):
+    """The REPL serves the C# frontend through the same bridge: the
+    extractor dispatches on the .cs extension and the attention display
+    shows un-hashed Roslyn-kind paths. The model here is UNTRAINED over
+    a synthetic vocab — this covers the REPL-to-C#-extractor bridge and
+    display contract, not C# prediction quality (that is the cpu_csharp
+    accuracy profile's job)."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.serving.extractor_bridge import Extractor
+    from code2vec_tpu.serving.predict import InteractivePredictor
+    from tests.test_train_overfit import make_dataset
+
+    prefix = make_dataset(tmp_path)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False)
+    model = Code2VecModel(config)
+
+    input_file = tmp_path / 'Input.cs'
+    input_file.write_text('class X { int GetSquare(int x) '
+                          '{ return x * x; } }')
+    extractor = Extractor(config, extractor_command=[BINARY])
+    predictor = InteractivePredictor(config, model, extractor=extractor,
+                                     input_filename=str(input_file))
+    answers = iter(['', 'q'])
+    monkeypatch.setattr('builtins.input', lambda: next(answers))
+    predictor.predict()
+    out = capsys.readouterr().out
+    assert 'Original name:\tget|square' in out
+    assert 'Attention:' in out
+    # C# paths display un-hashed with Roslyn kind names
+    assert 'MultiplyExpression' in out
+
+
 def test_constructor_only_class_emits_nothing_without_error(tmp_path):
     """Reference parity (FeatureExtractor.java:51-75 + FunctionVisitor):
     constructors are not MethodDeclarations, so a valid class whose only
@@ -820,6 +858,21 @@ def test_csharp_parser_survives_seeded_mutation_fuzz(tmp_path):
             '  public int Pick(int a, int b) => a > b ? a : b;\n'
             '  public bool Check(string s) { foreach (var c in s) '
             '{ if (c == \'x\') { return true; } } return false; }\n'
+            # round-5 grammar: mutations must stress the NEW recovery
+            # paths too (queries, switch expressions + positional
+            # patterns, tuples, await, local functions, deconstruction)
+            '  public int Sum(int[] xs) { var q = from x in xs '
+            'where x > 0 select x * 2; return q.Count(); }\n'
+            '  public string Band(int x, int y) { return (x, y) switch '
+            '{ (0, 0) => "o", _ => "m" }; }\n'
+            '  public async Task<int> Go(int id) '
+            '{ return await Fetch(id); }\n'
+            '  public int Outer(int n) { int Local(int k) '
+            '{ return k * k; } return Local(n); }\n'
+            '  public (int, string) Pair(int k) '
+            '{ return (k, k.ToString()); }\n'
+            '  public int Decon(List<(int, int)> ps) { foreach '
+            '(var (a, b) in ps) { return a + b; } return 0; }\n'
             '}\n')
     asan = BINARY + '-asan'
     binary = asan if os.path.isfile(asan) else BINARY
