@@ -65,10 +65,17 @@ def measure(per_device_batch: int = 64,
             break
         shapes = benchlib.SMOKE_SHAPES._replace(
             batch_size=per_device_batch * n)
+        # dtype knobs pinned to the values the committed r3/r5 artifacts
+        # were measured under, so re-runs stay comparable as config
+        # defaults move (a clean-host isolate showed the nu flip itself
+        # is step-time-neutral on virtual CPU meshes —
+        # weak_scaling_r5_postflip_note.jsonl)
         config = benchlib.headline_config(
             shapes, COMPUTE_DTYPE='float32', MESH_DATA_AXIS_SIZE=n,
             MESH_MODEL_AXIS_SIZE=1,
-            OPTIMIZER_STATE_SHARDING=opt_sharding)
+            OPTIMIZER_STATE_SHARDING=opt_sharding,
+            DROPOUT_PRNG_IMPL='threefry2x32', ADAM_MU_DTYPE='float32',
+            ADAM_NU_DTYPE='float32', GRADS_DTYPE='float32')
         from code2vec_tpu.models.backends import create_backend
         from code2vec_tpu.parallel import mesh as mesh_lib
         from code2vec_tpu.training.trainer import Trainer

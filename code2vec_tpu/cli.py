@@ -61,8 +61,7 @@ def main(args=None) -> None:
                 config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION)))
     if config.PREDICT:
         from code2vec_tpu.serving.predict import InteractivePredictor
-        predictor = InteractivePredictor(
-            config, model, input_filename=config.PREDICT_INPUT_PATH)
+        predictor = InteractivePredictor(config, model)
         predictor.predict()
     if config.RELEASE and config.is_loading:
         model.release_model()

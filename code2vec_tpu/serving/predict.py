@@ -71,11 +71,15 @@ class InteractivePredictor:
 
     def __init__(self, config: Config, model,
                  extractor: Optional[Extractor] = None,
-                 input_filename: str = DEFAULT_INPUT_FILENAME):
+                 input_filename: Optional[str] = None):
         self.config = config
         self.model = model
         self.path_extractor = extractor or Extractor(config)
-        self.input_filename = input_filename
+        # config is the single source of truth for the input file
+        # (--input-file -> Config.PREDICT_INPUT_PATH); the kwarg remains
+        # an explicit override for tests and embedding callers
+        self.input_filename = (input_filename
+                               or config.PREDICT_INPUT_PATH)
 
     def predict(self) -> None:
         print('Starting interactive prediction...')
