@@ -125,6 +125,14 @@ def run_measurement() -> None:
 
     examples_per_sec = MEASURE_STEPS * SHAPES.batch_size / elapsed
     per_chip = examples_per_sec / n_devices
+    # bytes/batch each wire format would put on the host->device link at
+    # the realistic java14m fill (the timed loop above is device-resident
+    # by design, so this is a computed property, not a timing)
+    filled = benchlib.random_batches(SHAPES, 1, seed=2,
+                                     fill=benchlib.JAVA14M_FILL)
+    wire = {'planes': benchlib.wire_bytes(filled[0]),
+            'packed': benchlib.wire_bytes(
+                benchlib.pack_batches(filled, trainer)[0])}
     line = {
         'metric': ('train_examples_per_sec_SMOKE_ONLY' if SMOKE
                    else METRIC_NAME),
@@ -133,6 +141,7 @@ def run_measurement() -> None:
         'vs_baseline': (0.0 if SMOKE else round(
             per_chip / benchlib.V100_BASELINE_EXAMPLES_PER_SEC, 3)),
         'recipe': BENCH_RECIPE,
+        'wire_bytes_per_batch': wire,
     }
     if SMOKE:
         # echo the RESOLVED knobs so the smoke test can assert the recipe

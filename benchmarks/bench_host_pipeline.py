@@ -37,7 +37,12 @@ NORTH_STAR_EXAMPLES_PER_SEC = 18700.0
 def synthesize_dataset(prefix: str, rows: int, contexts: int,
                        n_tokens: int = 2000, n_paths: int = 3000,
                        n_labels: int = 500, seed: int = 0) -> None:
-    """java14m-shaped rows: space-padded to exactly ``contexts`` fields."""
+    """java14m-shaped rows: space-padded to exactly ``contexts`` fields.
+
+    Row lengths draw from [C/8, C/2] — most slots padding, like the real
+    corpus (contexts/method p50 28 of 200, corpus_stats_r4.json); the
+    wire-format byte comparison below is only honest at a realistic
+    fill."""
     import pickle
     rng = random.Random(seed)
     tokens = [f'tok{i}' for i in range(n_tokens)]
@@ -45,7 +50,7 @@ def synthesize_dataset(prefix: str, rows: int, contexts: int,
     labels = [f'do|thing|{i}' for i in range(n_labels)]
     with open(prefix + '.train.c2v', 'w') as f:
         for _ in range(rows):
-            n = rng.randint(contexts // 2, contexts)
+            n = rng.randint(max(1, contexts // 8), max(2, contexts // 2))
             ctxs = ' '.join(
                 f'{rng.choice(tokens)},{rng.choice(paths)},{rng.choice(tokens)}'
                 for _ in range(n))
@@ -69,7 +74,7 @@ def main() -> None:
     parser.add_argument('--rows', type=int, default=20000)
     parser.add_argument('--contexts', type=int, default=200)
     parser.add_argument('--batch-size', type=int, default=1024)
-    parser.add_argument('--variants', default='python,native,cache')
+    parser.add_argument('--variants', default='python,native,cache,wire')
     args = parser.parse_args()
 
     from code2vec_tpu.config import Config
@@ -126,6 +131,33 @@ def main() -> None:
                 'vs_north_star': round(
                     examples_per_sec / NORTH_STAR_EXAMPLES_PER_SEC, 3),
             }))
+
+        if 'wire' in variants:
+            # bytes/batch each wire format puts on the host->device link
+            # over this corpus — the CPU-provable half of the packed
+            # format's transfer win (tests/test_host_pipeline_bench.py
+            # guards packed <= 50% of planes so it can't silently
+            # regress without a TPU)
+            from code2vec_tpu.data import packed as packed_lib
+            config, vocabs, reader = make(use_native=False)
+            totals = {'planes': 0, 'packed': 0}
+            batches = 0
+            for batch in reader.iter_epoch(shuffle=False):
+                totals['planes'] += packed_lib.wire_bytes(batch)
+                totals['packed'] += packed_lib.wire_bytes(
+                    packed_lib.pack_batch(
+                        batch, vocabs.token_vocab.pad_index,
+                        vocabs.path_vocab.pad_index))
+                batches += 1
+            for fmt in ('planes', 'packed'):
+                print(json.dumps({
+                    'metric': 'wire_bytes_per_batch',
+                    'variant': fmt,
+                    'value': round(totals[fmt] / max(batches, 1), 1),
+                    'unit': 'bytes/batch',
+                    'vs_planes': round(totals[fmt] / max(totals['planes'],
+                                                         1), 3),
+                }))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

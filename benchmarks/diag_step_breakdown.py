@@ -27,9 +27,12 @@ if REPO not in sys.path:
 
 from code2vec_tpu import benchlib  # noqa: E402
 
-SHAPES = benchlib.JAVA14M
-WARMUP = 5
-STEPS = 20
+# BENCH_SMOKE=1: tiny shapes so the ladder itself can be validated on
+# CPU (same convention as bench.py); real captures use java14m shapes.
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+WARMUP = 1 if SMOKE else 5
+STEPS = 4 if SMOKE else 20
 
 
 def main() -> None:
@@ -74,6 +77,44 @@ def main() -> None:
     h2d = (time.perf_counter() - t0) / len(host_batches)
     print(json.dumps({'measure': 'h2d_one_batch_ms',
                       'value': round(h2d * 1e3, 2)}), flush=True)
+
+    # --- wire format: bytes/batch + upload cost, planes vs packed, at
+    # the REALISTIC java14m fill (full-fill batches would hide the win —
+    # the packed size tracks the corpus fill rate; the compute numbers
+    # above keep full batches for comparability with prior captures)
+    filled = benchlib.random_batches(SHAPES, 4, seed=2,
+                                     fill=benchlib.JAVA14M_FILL)
+    for wire_label, wire_batches in (
+            ('planes', filled),
+            ('packed', benchlib.pack_batches(filled, trainer))):
+        print(json.dumps({'measure': 'wire_bytes_per_batch',
+                          'format': wire_label,
+                          'value': benchlib.wire_bytes(wire_batches[0])}),
+              flush=True)
+        t0 = time.perf_counter()
+        for arrays, _b in trainer.stage_batches(iter(wire_batches)):
+            jax.block_until_ready(arrays)
+        dt = (time.perf_counter() - t0) / len(wire_batches)
+        print(json.dumps({'measure': 'h2d_one_batch_%s_ms' % wire_label,
+                          'value': round(dt * 1e3, 2)}), flush=True)
+
+    # --- per-shard h2d: each data shard's slice of the packed ctx buffer
+    # timed onto its own device (the direct placement stage_batches uses)
+    from jax.sharding import NamedSharding
+
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    ctx = benchlib.pack_batches(filled[:1], trainer)[0].ctx
+    sharding = NamedSharding(trainer.mesh, mesh_lib.batch_spec(ctx.ndim))
+    per_shard = []
+    for device, index in sharding.addressable_devices_indices_map(
+            ctx.shape).items():
+        piece = np.ascontiguousarray(ctx[index])
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(piece, device))
+        per_shard.append(round((time.perf_counter() - t0) * 1e3, 2))
+    print(json.dumps({'measure': 'h2d_per_shard_ms', 'format': 'packed',
+                      'n_shards': len(per_shard), 'values': per_shard}),
+          flush=True)
 
     def timed(label, step_fn, init_state, feeds, sync_each):
         """Warmup + measure one step function; returns the final state so
@@ -130,6 +171,32 @@ def main() -> None:
         {'measure': 'step_ms_staged_hostargs_end_to_end',
          'value': round(dt * 1e3, 2),
          'examples_per_sec': round(SHAPES.batch_size / dt, 1)}), flush=True)
+
+    # --- the same end-to-end staging at REALISTIC fill, both wire
+    # formats: (filled - packed) is the transfer time the packed wire
+    # buys per step in the transfer-bound regime. The packed arm warms
+    # its program (the jitted unpack+step twin) outside the timed window.
+    filled_feed = benchlib.random_batches(SHAPES, STEPS, seed=3,
+                                          fill=benchlib.JAVA14M_FILL)
+    packed_feed = benchlib.pack_batches(filled_feed, trainer)
+    # warm with a batch from the SAME feed: pack_batches pins one shared
+    # capacity, so this is the exact program the timed loop runs
+    for arrays, _b in trainer.stage_batches(iter(packed_feed[:1])):
+        state, last = trainer.train_step_placed(state, arrays)
+    float(last)
+    for wire_label, feed in (('filled', filled_feed),
+                             ('packed', packed_feed)):
+        last = None
+        t0 = time.perf_counter()
+        for arrays, _b in trainer.stage_batches(iter(feed)):
+            state, last = trainer.train_step_placed(state, arrays)
+        float(last)
+        dt = (time.perf_counter() - t0) / STEPS
+        print(json.dumps(
+            {'measure': 'step_ms_staged_hostargs_%s' % wire_label,
+             'value': round(dt * 1e3, 2),
+             'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
+            flush=True)
 
     # --- config-variant A/Bs, one fresh trainer each. The previous
     # variant's 4.6 GB state is freed before the next is built; memory

@@ -20,6 +20,7 @@ shards (async-capable), and restore re-shards to the current mesh.
 """
 from __future__ import annotations
 
+import inspect
 import json
 import os
 from typing import Any, Dict, NamedTuple, Optional, Tuple
@@ -29,6 +30,16 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from code2vec_tpu.config import Config
+
+# orbax version split for the params-only partial restore: newer orbax
+# has PyTreeRestore(partial_restore=True) dispatched through the
+# manager's handler registry; 0.7.x (this image's toolchain) has neither
+# — there the equivalent is a standalone PyTreeCheckpointHandler with
+# the transforms={} mechanism, and registering a SECOND handler instance
+# for the same item corrupts saves (each instance finalizes its own tmp
+# dir onto the item path — reproduced on 0.7.0).
+_PYTREE_PARTIAL_RESTORE = 'partial_restore' in inspect.signature(
+    ocp.args.PyTreeRestore.__init__).parameters
 
 
 class RestoredTraining(NamedTuple):
@@ -351,8 +362,13 @@ class CheckpointStore:
         standard = ocp.StandardCheckpointHandler()
         registry.add('default', ocp.args.StandardSave, standard)
         registry.add('default', ocp.args.StandardRestore, standard)
-        registry.add('default', ocp.args.PyTreeRestore,
-                     ocp.PyTreeCheckpointHandler())
+        if _PYTREE_PARTIAL_RESTORE:
+            # newer orbax routes the params-only partial restore through
+            # this registration; on 0.7.x it goes through a standalone
+            # handler instead (module comment) — and the extra handler
+            # instance here would corrupt saves
+            registry.add('default', ocp.args.PyTreeRestore,
+                         ocp.PyTreeCheckpointHandler())
         return registry
 
     def manager(self) -> ocp.CheckpointManager:
@@ -553,9 +569,14 @@ class CheckpointStore:
 
         if os.path.isdir(self.weights_dir):
             checkpointer = ocp.StandardCheckpointer()
-            stored_rows = self._artifact_target_rows(
-                lambda: checkpointer.metadata(
-                    self.weights_dir).item_metadata)
+
+            def read_weights_metadata():
+                # newer orbax wraps the tree in .item_metadata; 0.7.x
+                # returns the metadata tree directly
+                meta = checkpointer.metadata(self.weights_dir)
+                return getattr(meta, 'item_metadata', meta)
+
+            stored_rows = self._artifact_target_rows(read_weights_metadata)
             restored = checkpointer.restore(
                 self.weights_dir, {'params': with_rows(stored_rows)})
             checkpointer.close()
@@ -570,12 +591,26 @@ class CheckpointStore:
         # partial restore: pull only the params subtree out of a full
         # training checkpoint (the reference's load-for-eval path similarly
         # ignores optimizer slots)
-        restored = manager.restore(
-            latest, args=ocp.args.PyTreeRestore(
-                item={'params': abstract_params},
-                restore_args=ocp.checkpoint_utils.construct_restore_args(
-                    {'params': abstract_params}),
-                partial_restore=True))
+        item = {'params': abstract_params}
+        restore_args = ocp.checkpoint_utils.construct_restore_args(item)
+        if _PYTREE_PARTIAL_RESTORE:
+            restored = manager.restore(
+                latest, args=ocp.args.PyTreeRestore(
+                    item=item, restore_args=restore_args,
+                    partial_restore=True))
+        else:
+            # orbax 0.7.x: standalone handler on the step's item dir with
+            # the transforms={} partial-restore mechanism (module comment)
+            item_dir = os.path.join(str(manager.directory), str(latest),
+                                    'default')
+            checkpointer = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+            try:
+                restored = checkpointer.restore(
+                    item_dir, args=ocp.args.PyTreeRestore(
+                        item=item, transforms={},
+                        restore_args=restore_args))
+            finally:
+                checkpointer.close()
         self._check_materialized(restored['params'])
         return adapt(restored['params'], stored_rows)
 

@@ -195,6 +195,25 @@ class Config:
     # steps' compute (jax transfers are async; without staging, each
     # step's dispatch serializes behind its own upload). 0 disables.
     DEVICE_PREFETCH_BATCHES: int = 2
+    # What crosses the host->device wire per batch (data/packed.py).
+    # 'planes' is the v1 format: six padded arrays, 16 bytes per context
+    # SLOT — at the java14m fill rate (contexts/method p50 28 of 200)
+    # mostly padding. 'packed' (default) densifies each example's
+    # contexts to its effective length: 12 bytes per RETAINED slot + 12
+    # per example (~3-5x fewer bytes/batch at java14m shape), with a
+    # jitted device-side unpack that reproduces the v1 planes
+    # BIT-exactly (tests/test_packed.py), so the model and its numerics
+    # are untouched. Multi-host runs fall back to 'planes'
+    # (wire_format_for): per-shard capacities are data-dependent and
+    # processes cannot agree on them without communication.
+    BATCH_WIRE_FORMAT: str = 'packed'
+    # Donate staged batch buffers to the consuming train/eval step so
+    # XLA may reuse their device memory for intermediates while the
+    # staging ring (DEVICE_PREFETCH_BATCHES) holds the next uploads.
+    # fit()/evaluate() consume each staged batch exactly once; harnesses
+    # that re-feed the same placed arrays across steps must disable this
+    # (benchlib.headline_config pins it off).
+    DONATE_STAGED_BATCHES: bool = True
     READER_USE_NATIVE: bool = True  # use the C++ tokenizer when available
     # Tokenize the train split once into a binary cache
     # (<data>.train.c2v.tokcache/, ~12 bytes/context on disk) and stream
@@ -341,6 +360,17 @@ class Config:
                             help='recompute encode activations in the '
                                  'backward (jax.checkpoint) — memory '
                                  'headroom for long-context configs')
+        parser.add_argument('--wire-format', dest='wire_format',
+                            choices=['planes', 'packed'], default=None,
+                            help='host->device batch wire format: packed '
+                                 'densifies ragged contexts (~3-5x fewer '
+                                 'bytes/batch, bit-identical batches after '
+                                 'the device-side unpack; data/packed.py)')
+        parser.add_argument('--device-prefetch', dest='device_prefetch',
+                            type=int, default=None, metavar='N',
+                            help='staging-ring depth: batches placed on '
+                                 'device ahead of the consuming step '
+                                 '(DEVICE_PREFETCH_BATCHES; 0 disables)')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -405,6 +435,10 @@ class Config:
             self.REMAT_ENCODE = True
         if parsed.opt_state_sharding:
             self.OPTIMIZER_STATE_SHARDING = parsed.opt_state_sharding
+        if parsed.wire_format:
+            self.BATCH_WIRE_FORMAT = parsed.wire_format
+        if parsed.device_prefetch is not None:
+            self.DEVICE_PREFETCH_BATCHES = parsed.device_prefetch
         return self
 
     # ------------------------------------------------------- derived props
@@ -445,6 +479,15 @@ class Config:
 
     def batch_size(self, is_evaluating: bool = False) -> int:
         return self.TEST_BATCH_SIZE if is_evaluating else self.TRAIN_BATCH_SIZE
+
+    def wire_format_for(self, process_count: int) -> str:
+        """The EFFECTIVE batch wire format for a run of ``process_count``
+        hosts. Multi-host runs always use 'planes': the packed format's
+        per-shard capacity is data-dependent per batch, and processes
+        cannot agree on one global shape without a host round-trip."""
+        if process_count > 1:
+            return 'planes'
+        return self.BATCH_WIRE_FORMAT
 
     # -------------------------------------- file-naming contract (parity)
     @property
@@ -547,6 +590,9 @@ class Config:
         # simply not consumed on that path. Now that 'bfloat16' is the
         # DEFAULT, raising here would break lazy users who never touched
         # the knob — the trainer logs the ignored-knob warning instead.
+        if self.BATCH_WIRE_FORMAT not in {'planes', 'packed'}:
+            raise ValueError("config.BATCH_WIRE_FORMAT must be in "
+                             "{'planes', 'packed'}.")
         if self.OPTIMIZER_STATE_SHARDING not in {'mirror', 'zero'}:
             raise ValueError("config.OPTIMIZER_STATE_SHARDING must be in "
                              "{'mirror', 'zero'}.")

@@ -1,4 +1,6 @@
+from code2vec_tpu.data.packed import PackedBatch
 from code2vec_tpu.data.reader import (
     Batch, EstimatorAction, PathContextReader, parse_c2v_line)
 
-__all__ = ['Batch', 'EstimatorAction', 'PathContextReader', 'parse_c2v_line']
+__all__ = ['Batch', 'EstimatorAction', 'PackedBatch', 'PathContextReader',
+           'parse_c2v_line']

@@ -8,14 +8,25 @@ arrays next to the dataset; subsequent epochs are sequential disk reads with
 chunk-level shuffling (permute chunk order, permute rows within a chunk) —
 both faster and a better shuffle than a 10K-row reservoir.
 
-Layout of ``<data>.train.c2v.tokcache/``:
-  source.bin path.bin target.bin  int32 (N, C) row-major
-  label.bin                       int32 (N,)
-  meta.json                       row count, max_contexts, vocab fingerprint
+Format v2 (current) stores the PACKED wire layout (data/packed.py): each
+example's contexts densified to its effective length, so the cache on
+disk shrinks with the corpus fill rate exactly like the wire does (~12
+bytes per retained context + 8 per example, vs v1's 12 bytes for every
+one of the C slots). Layout of ``<data>.train.c2v.tokcache/``:
 
-The mask is recomputed from indices (valid iff any part != PAD) instead of
-stored — a third of the cache size for one vectorized compare. Only the
-train split is cached (eval/predict keep strings for host-side metrics).
+  ctx.bin    int32 (num_contexts, 3) — (source, path, target) triples
+  count.bin  int32 (N,) — per-example effective lengths
+  label.bin  int32 (N,)
+  meta.json  version, row/context counts, max_contexts, vocab fingerprint
+
+Format v1 (``source.bin``/``path.bin``/``target.bin`` padded planes) is
+still READ transparently — a fresh v1 cache is used as-is, never
+rebuilt; delete the directory to re-materialize it as v2 (MIGRATION.md).
+``iter_epoch`` emits either wire format from either on-disk version.
+
+The mask is never stored — recomputed from indices (valid iff any part
+!= PAD). Only the train split is cached (eval/predict keep strings for
+host-side metrics).
 """
 from __future__ import annotations
 
@@ -28,9 +39,12 @@ from typing import Iterator, Optional
 import numpy as np
 
 from code2vec_tpu.config import Config
+from code2vec_tpu.data import packed as packed_lib
 from code2vec_tpu.data.reader import (Batch, PathContextReader,
                                       context_valid_mask)
 from code2vec_tpu.vocab import Code2VecVocabs
+
+CACHE_FORMAT_VERSION = 2
 
 
 @contextlib.contextmanager
@@ -45,7 +59,7 @@ def _build_lock(lock_path: str):
         finally:
             fcntl.flock(lock_file, fcntl.LOCK_UN)
 
-_FILES = ('source.bin', 'path.bin', 'target.bin', 'label.bin')
+_FILES_V2 = ('ctx.bin', 'count.bin', 'label.bin')
 
 
 def _fingerprint(config: Config, vocabs: Code2VecVocabs,
@@ -76,17 +90,39 @@ class TokenCache:
         with open(meta_path, 'r') as f:
             self.meta = json.load(f)
         self.num_rows = self.meta['num_rows']
+        # pre-v2 metas carry no version key — that IS the v1 marker
+        self.version = int(self.meta.get('version', 1))
         max_contexts = self.meta['max_contexts']
-        shape2 = (self.num_rows, max_contexts)
-        self.source = np.memmap(os.path.join(cache_dir, 'source.bin'),
-                                dtype=np.int32, mode='r', shape=shape2)
-        self.path = np.memmap(os.path.join(cache_dir, 'path.bin'),
-                              dtype=np.int32, mode='r', shape=shape2)
-        self.target = np.memmap(os.path.join(cache_dir, 'target.bin'),
-                                dtype=np.int32, mode='r', shape=shape2)
+        if self.version >= 2:
+            self.num_contexts = self.meta['num_contexts']
+            self.ctx = np.memmap(os.path.join(cache_dir, 'ctx.bin'),
+                                 dtype=np.int32, mode='r',
+                                 shape=(self.num_contexts, 3))
+            self.count = np.memmap(os.path.join(cache_dir, 'count.bin'),
+                                   dtype=np.int32, mode='r',
+                                   shape=(self.num_rows,))
+        else:
+            shape2 = (self.num_rows, max_contexts)
+            self.source = np.memmap(os.path.join(cache_dir, 'source.bin'),
+                                    dtype=np.int32, mode='r', shape=shape2)
+            self.path = np.memmap(os.path.join(cache_dir, 'path.bin'),
+                                  dtype=np.int32, mode='r', shape=shape2)
+            self.target = np.memmap(os.path.join(cache_dir, 'target.bin'),
+                                    dtype=np.int32, mode='r', shape=shape2)
         self.label = np.memmap(os.path.join(cache_dir, 'label.bin'),
                                dtype=np.int32, mode='r',
                                shape=(self.num_rows,))
+        # sticky packed-capacity state (packed.StickyPacker): grows
+        # monotonically across batches AND epochs so the jitted packed
+        # step specializes a handful of times per run, not per batch
+        self._packer = None
+
+    def _packer_for(self, data_shards: int) -> packed_lib.StickyPacker:
+        if self._packer is None or self._packer.data_shards != data_shards:
+            self._packer = packed_lib.StickyPacker(
+                self.vocabs.token_vocab.pad_index,
+                self.vocabs.path_vocab.pad_index, data_shards=data_shards)
+        return self._packer
 
     # ------------------------------------------------------------ building
     @classmethod
@@ -112,6 +148,10 @@ class TokenCache:
         meta_path = os.path.join(cache_dir, 'meta.json')
 
         def is_fresh() -> bool:
+            # the format version is deliberately NOT part of the
+            # freshness check: a fresh v1 cache keeps serving (read
+            # compatibility), it is only ever REPLACED when the data or
+            # vocab fingerprint changes
             if not os.path.isfile(meta_path):
                 return False
             with open(meta_path, 'r') as f:
@@ -131,24 +171,30 @@ class TokenCache:
                cache_dir: str, fingerprint: dict) -> None:
         tmp_dir = cache_dir + '.building.%d' % os.getpid()
         os.makedirs(tmp_dir, exist_ok=True)
-        config.log('Building token cache at `%s` ...' % cache_dir)
+        config.log('Building token cache at `%s` (format v%d) ...'
+                   % (cache_dir, CACHE_FORMAT_VERSION))
         num_rows = 0
+        num_contexts = 0
         handles = {name: open(os.path.join(tmp_dir, name), 'wb')
-                   for name in _FILES}
+                   for name in _FILES_V2}
         try:
             # one filtered, UNSHUFFLED pass; batches here are fixed-shape
             # with a zero-weight padded tail we must drop
-            for batch in reader.iter_epoch(shuffle=False):
+            for batch in reader.iter_epoch(shuffle=False,
+                                           wire_format='planes'):
                 valid = batch.weight > 0
-                handles['source.bin'].write(
-                    np.ascontiguousarray(batch.source[valid]).tobytes())
-                handles['path.bin'].write(
-                    np.ascontiguousarray(batch.path[valid]).tobytes())
-                handles['target.bin'].write(
-                    np.ascontiguousarray(batch.target[valid]).tobytes())
+                triples, lengths = packed_lib.ragged_from_planes(
+                    np.ascontiguousarray(batch.source[valid]),
+                    np.ascontiguousarray(batch.path[valid]),
+                    np.ascontiguousarray(batch.target[valid]),
+                    batch.mask[valid])
+                handles['ctx.bin'].write(
+                    np.ascontiguousarray(triples).tobytes())
+                handles['count.bin'].write(lengths.tobytes())
                 handles['label.bin'].write(
                     np.ascontiguousarray(batch.label[valid]).tobytes())
                 num_rows += int(valid.sum())
+                num_contexts += int(lengths.sum())
         finally:
             for handle in handles.values():
                 handle.close()
@@ -161,6 +207,8 @@ class TokenCache:
                 % reader.data_path)
         meta = dict(fingerprint)
         meta['num_rows'] = num_rows
+        meta['num_contexts'] = num_contexts
+        meta['version'] = CACHE_FORMAT_VERSION
         with open(os.path.join(tmp_dir, 'meta.json'), 'w') as f:
             json.dump(meta, f)
         # atomic publish
@@ -168,14 +216,121 @@ class TokenCache:
             import shutil
             shutil.rmtree(cache_dir)
         os.replace(tmp_dir, cache_dir)
-        config.log('Token cache built: %d rows.' % num_rows)
+        config.log('Token cache built: %d rows, %d contexts (%.1f avg).'
+                   % (num_rows, num_contexts, num_contexts / num_rows))
 
     # ----------------------------------------------------------- iteration
     def iter_epoch(self, batch_size: int, shuffle: bool = True,
                    seed: Optional[int] = None,
-                   chunk_rows: int = 1 << 16) -> Iterator[Batch]:
+                   chunk_rows: int = 1 << 16,
+                   wire_format: Optional[str] = None,
+                   data_shards: int = 1) -> Iterator[Batch]:
         """Fixed-shape batches from the cache. Shuffle = permuted chunk
-        order + in-chunk row permutation (sequential disk reads)."""
+        order + in-chunk row permutation (sequential disk reads).
+
+        ``wire_format`` ('planes' default / 'packed') selects the emitted
+        batch type independently of the ON-DISK version — a v1 cache can
+        feed the packed wire and vice versa."""
+        wire_format = wire_format or 'planes'
+        if self.version >= 2:
+            yield from self._iter_epoch_v2(batch_size, shuffle, seed,
+                                           chunk_rows, wire_format,
+                                           data_shards)
+            return
+        batches = self._iter_epoch_v1(batch_size, shuffle, seed, chunk_rows)
+        if wire_format == 'packed':
+            packer = self._packer_for(data_shards)
+            for batch in batches:
+                yield packer.pack_batch(batch)
+        else:
+            yield from batches
+
+    # ------------------------------------------------------------ v2 path
+    def _emit_v2(self, ctx_rows: np.ndarray, count: np.ndarray,
+                 label: np.ndarray, weight: Optional[np.ndarray],
+                 wire_format: str, data_shards: int):
+        token_pad = self.vocabs.token_vocab.pad_index
+        path_pad = self.vocabs.path_vocab.pad_index
+        if weight is None:
+            weight = np.ones((count.shape[0],), np.float32)
+        if wire_format == 'packed':
+            ctx = self._packer_for(data_shards).pack_ragged(ctx_rows, count)
+            return packed_lib.PackedBatch(ctx=ctx, count=count, label=label,
+                                          weight=weight)
+        source, path, target = packed_lib.unpack_ragged_np(
+            ctx_rows, count, self.meta['max_contexts'], token_pad, path_pad)
+        mask = context_valid_mask(source, path, target, token_pad, path_pad)
+        return Batch(source=source, path=path, target=target, mask=mask,
+                     label=label, weight=weight)
+
+    def _iter_epoch_v2(self, batch_size: int, shuffle: bool,
+                       seed: Optional[int], chunk_rows: int,
+                       wire_format: str, data_shards: int):
+        rng = np.random.default_rng(seed)
+        num_chunks = max(1, -(-self.num_rows // chunk_rows))
+        # context-row offset of each chunk boundary: one cheap pass over
+        # the count memmap instead of materializing all N example offsets
+        chunk_ctx_bounds = np.zeros(num_chunks + 1, np.int64)
+        for i in range(num_chunks):
+            begin = i * chunk_rows
+            end = min(self.num_rows, begin + chunk_rows)
+            chunk_ctx_bounds[i + 1] = chunk_ctx_bounds[i] + \
+                np.asarray(self.count[begin:end]).sum(dtype=np.int64)
+        chunk_order = np.arange(num_chunks)
+        if shuffle:
+            rng.shuffle(chunk_order)
+
+        pend_ctx = np.zeros((0, 3), np.int32)
+        pend_count = np.zeros((0,), np.int32)
+        pend_label = np.zeros((0,), np.int32)
+
+        for chunk_idx in chunk_order:
+            begin = int(chunk_idx) * chunk_rows
+            end = min(self.num_rows, begin + chunk_rows)
+            count = np.asarray(self.count[begin:end])
+            label = np.asarray(self.label[begin:end])
+            ctx_rows = np.asarray(
+                self.ctx[chunk_ctx_bounds[chunk_idx]:
+                         chunk_ctx_bounds[chunk_idx + 1]])
+            if shuffle:
+                perm = rng.permutation(end - begin)
+                starts = np.cumsum(count) - count
+                sel = np.repeat(starts[perm], count[perm]) + \
+                    (np.arange(count[perm].sum(), dtype=np.int64)
+                     - np.repeat(np.cumsum(count[perm]) - count[perm],
+                                 count[perm]))
+                ctx_rows = ctx_rows[sel]
+                count, label = count[perm], label[perm]
+            if pend_count.shape[0]:
+                ctx_rows = np.concatenate([pend_ctx, ctx_rows])
+                count = np.concatenate([pend_count, count])
+                label = np.concatenate([pend_label, label])
+            bounds = np.concatenate([[0], np.cumsum(count, dtype=np.int64)])
+            n_full = (count.shape[0] // batch_size) * batch_size
+            for start in range(0, n_full, batch_size):
+                stop = start + batch_size
+                yield self._emit_v2(
+                    ctx_rows[bounds[start]:bounds[stop]],
+                    count[start:stop], label[start:stop], None,
+                    wire_format, data_shards)
+            pend_ctx = ctx_rows[bounds[n_full]:]
+            pend_count = count[n_full:]
+            pend_label = label[n_full:]
+
+        if pend_count.shape[0]:
+            pad = batch_size - pend_count.shape[0]
+            yield self._emit_v2(
+                pend_ctx,
+                np.concatenate([pend_count, np.zeros((pad,), np.int32)]),
+                np.concatenate([pend_label, np.zeros((pad,), np.int32)]),
+                np.concatenate([np.ones((pend_count.shape[0],), np.float32),
+                                np.zeros((pad,), np.float32)]),
+                wire_format, data_shards)
+
+    # ------------------------------------------------------------ v1 path
+    def _iter_epoch_v1(self, batch_size: int, shuffle: bool,
+                       seed: Optional[int],
+                       chunk_rows: int) -> Iterator[Batch]:
         rng = np.random.default_rng(seed)
         token_pad = self.vocabs.token_vocab.pad_index
         path_pad = self.vocabs.path_vocab.pad_index

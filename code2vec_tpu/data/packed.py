@@ -1,0 +1,280 @@
+"""Compact wire format for path-context batches ("packed", format v2).
+
+The plane format ships six padded arrays per batch — source/path/target
+``(B, C)`` int32, mask ``(B, C)`` float32, label/weight ``(B,)`` — 16
+bytes for every context SLOT whether or not it holds a context. At the
+java14m corpus shape most of the 200 slots per example are padding
+(contexts/method p50 is 28, benchmarks/results/corpus_stats_r4.json), so
+on a transfer-bound link (PERF.md: 246 ms to upload one 3.3 MB batch vs
+a 49 ms device step through this environment's tunnel) the wire is
+mostly zeros.
+
+The packed format densifies each example's leading ``length`` context
+slots — ``length`` = index of the LAST valid context + 1 — into a
+contiguous stream of ``(source, path, target)`` int32 triples:
+
+  ctx     (data_shards, capacity, 3) int32 — per-shard dense triples,
+          tail-padded with (token_pad, path_pad, token_pad)
+  count   (B,) int32   — per-example effective lengths
+  label   (B,) int32
+  weight  (B,) float32
+
+12 bytes per RETAINED slot + 12 bytes per example. Keeping everything up
+to the last valid slot (not only the mask-valid slots) is what makes the
+round trip BIT-exact: an interior all-PAD hole (e.g. a ``,,`` context in
+the source file) stays in the stream at its position, and every slot
+past ``length`` is provably the PAD triple, so scattering the stream
+back and filling the tail with PAD reproduces the v1 planes — and the
+mask, recomputed from them with the same parity-critical predicate
+(reader.context_valid_mask) — exactly.
+
+Sharding-awareness: with ``data_shards > 1`` each data-parallel shard's
+examples are packed into its own ``capacity`` rows, so the staged
+``ctx`` array shards over the mesh data axis on its leading dim and each
+device receives exactly its shard's bytes (parallel/mesh.py
+shard_batch). All shards share one bucketed capacity so the array stays
+rectangular.
+
+``capacity`` is bucketed (``bucketed_capacity``) so the jitted unpack +
+step program specializes on a handful of capacities per run instead of
+one per batch.
+
+Host-side code here is pure numpy; the device unpack imports jax lazily
+so the data layer stays importable without it.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+WIRE_FORMATS = ('planes', 'packed')
+
+# Floor for the bucketed capacity. Small enough that tiny (test/smoke)
+# batches still see a byte win; large batches are governed by the
+# total/8 bucket below.
+MIN_CAPACITY = 64
+
+
+class PackedBatch(NamedTuple):
+    """One device-ready batch in the packed wire format. Mirrors
+    ``reader.Batch``'s host-only string ride-alongs (eval/predict)."""
+    ctx: np.ndarray                  # (D, cap, 3) int32 — see module doc
+    count: np.ndarray                # (B,) int32 — effective lengths
+    label: np.ndarray                # (B,) int32 — target-name index
+    weight: np.ndarray               # (B,) float32 — example validity
+    label_strings: Optional[np.ndarray] = None     # (B,) object
+    source_strings: Optional[np.ndarray] = None    # (B, C) object
+    path_strings: Optional[np.ndarray] = None      # (B, C) object
+    target_strings: Optional[np.ndarray] = None    # (B, C) object
+
+    @property
+    def num_valid_examples(self) -> int:
+        return int(self.weight.sum())
+
+    def device_arrays(self):
+        """The arrays the jitted packed step functions consume, in a
+        fixed order (the host-only strings never ship)."""
+        return (self.ctx, self.count, self.label, self.weight)
+
+
+def wire_bytes(batch) -> int:
+    """Bytes this batch puts on the host->device wire (either format)."""
+    return int(sum(np.asarray(a).nbytes for a in batch.device_arrays()))
+
+
+def bucketed_capacity(total: int, minimum: int = MIN_CAPACITY) -> int:
+    """Round a context total up to a bucket of ~total/8 (power of two),
+    bounding both the padding waste (<12.5%) and the number of distinct
+    jit specializations per run (a handful: totals cluster per corpus)."""
+    cap = max(int(total), minimum)
+    bucket = max(minimum, 1 << max(cap.bit_length() - 3, 0))
+    return -(-cap // bucket) * bucket
+
+
+def effective_lengths(mask: np.ndarray) -> np.ndarray:
+    """(B,) int32 of per-example effective lengths: index of the last
+    mask-valid slot + 1, or 0 for all-padding rows."""
+    valid = mask > 0
+    any_valid = valid.any(axis=1)
+    last = mask.shape[1] - np.argmax(valid[:, ::-1], axis=1)
+    return np.where(any_valid, last, 0).astype(np.int32)
+
+
+def ragged_gather_indices(lengths: np.ndarray, stride: int) -> np.ndarray:
+    """Flat indices selecting slots [0, lengths[r]) of each row r from a
+    row-major (B, stride) array."""
+    total = int(lengths.sum())
+    starts = np.cumsum(lengths) - lengths
+    intra = np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
+    return np.repeat(np.arange(lengths.shape[0], dtype=np.int64) * stride,
+                     lengths) + intra
+
+
+def pack_ragged(ctx_rows: np.ndarray, count: np.ndarray, token_pad: int,
+                path_pad: int, data_shards: int = 1,
+                capacity_minimum: int = MIN_CAPACITY) -> np.ndarray:
+    """(total, 3) ragged triple stream + per-example counts -> the
+    rectangular (data_shards, capacity, 3) wire array."""
+    n = count.shape[0]
+    if n % data_shards:
+        raise ValueError('batch size %d not divisible by data_shards %d'
+                         % (n, data_shards))
+    count2 = count.reshape(data_shards, n // data_shards)
+    shard_totals = count2.sum(axis=1, dtype=np.int64)
+    cap = bucketed_capacity(int(shard_totals.max(initial=0)),
+                            capacity_minimum)
+    ctx = np.empty((data_shards, cap, 3), np.int32)
+    ctx[..., 0] = token_pad
+    ctx[..., 1] = path_pad
+    ctx[..., 2] = token_pad
+    bounds = np.concatenate([[0], np.cumsum(shard_totals)])
+    for d in range(data_shards):
+        ctx[d, :shard_totals[d]] = ctx_rows[bounds[d]:bounds[d + 1]]
+    return ctx
+
+
+def ragged_from_planes(source: np.ndarray, path: np.ndarray,
+                       target: np.ndarray, mask: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Plane arrays -> ((total, 3) int32 triple stream, (B,) effective
+    lengths) — the single definition of the wire/cache triple layout."""
+    lengths = effective_lengths(mask)
+    flat = ragged_gather_indices(lengths, source.shape[1])
+    return np.stack([source.ravel()[flat], path.ravel()[flat],
+                     target.ravel()[flat]],
+                    axis=1).astype(np.int32, copy=False), lengths
+
+
+def pack_batch(batch, token_pad: int, path_pad: int, data_shards: int = 1,
+               capacity_minimum: int = MIN_CAPACITY) -> PackedBatch:
+    """reader.Batch (plane format) -> PackedBatch. Host-only string
+    fields ride along untouched."""
+    ctx_rows, lengths = ragged_from_planes(batch.source, batch.path,
+                                           batch.target, batch.mask)
+    ctx = pack_ragged(ctx_rows, lengths, token_pad, path_pad, data_shards,
+                      capacity_minimum)
+    return PackedBatch(ctx=ctx, count=lengths,
+                       label=np.ascontiguousarray(batch.label),
+                       weight=np.ascontiguousarray(batch.weight),
+                       label_strings=batch.label_strings,
+                       source_strings=batch.source_strings,
+                       path_strings=batch.path_strings,
+                       target_strings=batch.target_strings)
+
+
+class StickyPacker:
+    """Packs a stream of batches under a monotonically GROWING capacity:
+    totals that straddle a bucket boundary reuse the larger jitted
+    program instead of ping-ponging specializations. One instance per
+    data source (reader / cache), living across epochs."""
+
+    def __init__(self, token_pad: int, path_pad: int, data_shards: int = 1,
+                 minimum: int = MIN_CAPACITY):
+        self.token_pad = token_pad
+        self.path_pad = path_pad
+        self.data_shards = data_shards
+        self.capacity = minimum
+
+    def pack_batch(self, batch) -> PackedBatch:
+        packed = pack_batch(batch, self.token_pad, self.path_pad,
+                            data_shards=self.data_shards,
+                            capacity_minimum=self.capacity)
+        self.capacity = max(self.capacity, packed.ctx.shape[1])
+        return packed
+
+    def pack_ragged(self, ctx_rows: np.ndarray,
+                    count: np.ndarray) -> np.ndarray:
+        ctx = pack_ragged(ctx_rows, count, self.token_pad, self.path_pad,
+                          self.data_shards, capacity_minimum=self.capacity)
+        self.capacity = max(self.capacity, ctx.shape[1])
+        return ctx
+
+
+def unpack_ragged_np(ctx_rows: np.ndarray, count: np.ndarray,
+                     max_contexts: int, token_pad: int, path_pad: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(total, 3) triple stream + counts -> PAD-filled (B, C) planes."""
+    n = count.shape[0]
+    flat = ragged_gather_indices(count.astype(np.int64), max_contexts)
+    planes = []
+    for column, fill in ((0, token_pad), (1, path_pad), (2, token_pad)):
+        plane = np.full((n * max_contexts,), fill, np.int32)
+        plane[flat] = ctx_rows[:, column]
+        planes.append(plane.reshape(n, max_contexts))
+    return planes[0], planes[1], planes[2]
+
+
+def unpack_batch_host(packed: PackedBatch, max_contexts: int,
+                      token_pad: int, path_pad: int):
+    """Numpy reference inverse of ``pack_batch`` — the ground truth the
+    device unpack is property-tested against, and the planes-emission
+    path for v2 token caches read under the planes wire format."""
+    from code2vec_tpu.data.reader import Batch, context_valid_mask
+    shards, cap, _ = packed.ctx.shape
+    count2 = packed.count.reshape(shards, -1)
+    keep = ragged_gather_indices(
+        count2.sum(axis=1, dtype=np.int64).astype(np.int64), cap)
+    ctx_rows = packed.ctx.reshape(shards * cap, 3)[keep]
+    source, path, target = unpack_ragged_np(
+        ctx_rows, packed.count, max_contexts, token_pad, path_pad)
+    mask = context_valid_mask(source, path, target, token_pad, path_pad)
+    return Batch(source=source, path=path, target=target, mask=mask,
+                 label=packed.label, weight=packed.weight,
+                 label_strings=packed.label_strings,
+                 source_strings=packed.source_strings,
+                 path_strings=packed.path_strings,
+                 target_strings=packed.target_strings)
+
+
+def unpack_device(ctx, count, max_contexts: int, token_pad: int,
+                  path_pad: int):
+    """Jitted device-side inverse of ``pack_batch``: segment-scatter the
+    dense triples back to the exact (B, C) planes + mask the model
+    consumes.
+
+    Shard-structured: every op batches along the leading ``data_shards``
+    dim that the mesh data axis shards, so GSPMD partitions the unpack
+    per shard. Capacity-padding rows hold the PAD triple and land either
+    on out-of-range slots (dropped) or on tail slots whose expected
+    value IS the PAD fill — bit-exactness is unconditional (property-
+    tested against ``unpack_batch_host`` in tests/test_packed.py).
+
+    The mask predicate mirrors reader.context_valid_mask — the
+    parity-critical single definition for the host side; keep in sync.
+    """
+    import jax.numpy as jnp
+
+    shards, cap, _ = ctx.shape
+    batch = count.shape[0]
+    per_shard = batch // shards
+    count2 = count.reshape(shards, per_shard)
+    starts = jnp.cumsum(count2, axis=1) - count2            # (D, Bs)
+    shard_idx = jnp.broadcast_to(
+        jnp.arange(shards, dtype=jnp.int32)[:, None], (shards, cap))
+    # segment ids: +1 at each example's start offset, cumsummed; repeated
+    # starts (zero-length examples) accumulate, rows past the shard's
+    # total all map to the last example and scatter onto its PAD tail.
+    # The row index must be shaped like starts[:, 1:] — (D, Bs-1), NOT a
+    # slice of the (D, cap) grid: per-shard batch can exceed capacity.
+    inc = jnp.zeros((shards, cap), jnp.int32)
+    if per_shard > 1:
+        row_idx = jnp.broadcast_to(
+            jnp.arange(shards, dtype=jnp.int32)[:, None],
+            (shards, per_shard - 1))
+        inc = inc.at[row_idx, starts[:, 1:]].add(1, mode='drop')
+    seg = jnp.cumsum(inc, axis=1)                           # (D, cap)
+    pos = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+           - jnp.take_along_axis(starts, seg, axis=1))      # (D, cap)
+
+    def scatter(vals, fill):
+        out = jnp.full((shards, per_shard, max_contexts), fill, jnp.int32)
+        out = out.at[shard_idx, seg, pos].set(vals, mode='drop')
+        return out.reshape(batch, max_contexts)
+
+    source = scatter(ctx[..., 0], token_pad)
+    path = scatter(ctx[..., 1], path_pad)
+    target = scatter(ctx[..., 2], token_pad)
+    mask = ((source != token_pad) | (target != token_pad)
+            | (path != path_pad)).astype(jnp.float32)
+    return source, path, target, mask

@@ -183,7 +183,8 @@ class PathContextReader:
                  estimator_action: EstimatorAction,
                  data_path: Optional[str] = None,
                  keep_strings: Optional[bool] = None,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 data_shards: int = 1):
         self.vocabs = vocabs
         self.config = config
         self.estimator_action = estimator_action
@@ -193,6 +194,13 @@ class PathContextReader:
         # its 1/process_count share of the GLOBAL batch
         self.process_index = process_index
         self.process_count = max(1, process_count)
+        # mesh data-axis size: packed-wire batches are packed PER data
+        # shard so each device's slice transfers directly to it
+        # (data/packed.py; parallel/mesh.py shard_batch)
+        self.data_shards = max(1, data_shards)
+        # sticky packed-capacity state (packed.StickyPacker), created on
+        # first packed emission and kept across epochs
+        self._packer = None
         # Eval keeps only the label strings (host-side metric decode);
         # predict additionally keeps per-context strings (attention
         # display) — reference kept string tensors in the graph,
@@ -423,14 +431,27 @@ class PathContextReader:
         return padded
 
     # ----------------------------------------------------------- public API
+    def wire_format(self) -> str:
+        """The wire format this reader emits from ``iter_epoch`` (the
+        multi-host fallback lives in Config.wire_format_for)."""
+        return self.config.wire_format_for(self.process_count)
+
     def iter_epoch(self, shuffle: Optional[bool] = None,
-                   seed: Optional[int] = None) -> Iterator[Batch]:
+                   seed: Optional[int] = None,
+                   wire_format: Optional[str] = None) -> Iterator[Batch]:
         """One pass over the data file as fixed-shape batches.
 
         The trainer drives epochs explicitly (the reference baked
         ``repeat(NUM_TRAIN_EPOCHS)`` into the dataset and trained until
         ``OutOfRangeError``, tensorflow_model.py:74-102 — with JAX's explicit
         stepping we keep the loop in charge).
+
+        ``wire_format`` selects the emitted batch type: 'planes' (the
+        default, and what every introspection/test contract reads) or
+        'packed' (``data/packed.py::PackedBatch`` — the compact wire
+        format whose device-side unpack reproduces the plane batches
+        bit-exactly). Training/eval pass ``self.wire_format()`` so the
+        config default governs the product path.
         """
         if shuffle is None:
             shuffle = self.estimator_action.is_train
@@ -447,13 +468,27 @@ class PathContextReader:
                 'so process-local shards assemble into the global batch.'
                 % (global_batch, self.process_count))
         batch_size = global_batch // self.process_count
-        yield from self._filtered_batches(lines, batch_size)
+        batches = self._filtered_batches(lines, batch_size)
+        if wire_format == 'packed':
+            from code2vec_tpu.data import packed as packed_lib
+            if self._packer is None:
+                self._packer = packed_lib.StickyPacker(
+                    self.vocabs.token_vocab.pad_index,
+                    self.vocabs.path_vocab.pad_index,
+                    data_shards=self.data_shards)
+            for batch in batches:
+                yield self._packer.pack_batch(batch)
+        else:
+            yield from batches
 
     def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,
-                              seed: Optional[int] = None) -> Iterator[Batch]:
+                              seed: Optional[int] = None,
+                              wire_format: Optional[str] = None
+                              ) -> Iterator[Batch]:
         """``iter_epoch`` behind a background prefetch thread."""
         yield from prefetch_iterator(
-            lambda: self.iter_epoch(shuffle=shuffle, seed=seed),
+            lambda: self.iter_epoch(shuffle=shuffle, seed=seed,
+                                    wire_format=wire_format),
             self.config.READER_PREFETCH_BATCHES)
 
     def process_input_rows(self, input_lines: Iterable[str]) -> Batch:

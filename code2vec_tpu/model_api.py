@@ -226,9 +226,16 @@ class Code2VecModel:
         config = self.config
         assert config.is_training
         process_count = jax.process_count()
+        # packed wire: batches are packed per data-parallel shard so each
+        # device's slice uploads directly to it; multi-host falls back to
+        # planes (Config.wire_format_for, via reader.wire_format())
+        data_shards = (self.mesh.shape[mesh_lib.DATA_AXIS]
+                       if process_count == 1 else 1)
         reader = PathContextReader(self.vocabs, config, EstimatorAction.Train,
                                    process_index=jax.process_index(),
-                                   process_count=process_count)
+                                   process_count=process_count,
+                                   data_shards=data_shards)
+        wire_format = reader.wire_format()
         save_store = (self._store_for(config.MODEL_SAVE_PATH)
                       if config.is_saving else None)
         writer = metrics_writer.maybe_create(config)
@@ -267,7 +274,9 @@ class Code2VecModel:
                 # training thread, like the streaming path
                 def local_batches():
                     return cache.iter_epoch(local_batch_size, shuffle=True,
-                                            seed=epoch)
+                                            seed=epoch,
+                                            wire_format=wire_format,
+                                            data_shards=data_shards)
                 if process_count == 1:
                     return prefetch_iterator(local_batches,
                                              config.READER_PREFETCH_BATCHES)
@@ -280,7 +289,8 @@ class Code2VecModel:
                     lambda: reader.iter_epoch(shuffle=True, seed=epoch))
         else:
             def epoch_batches(epoch: int):
-                return reader.iter_epoch_prefetched(shuffle=True, seed=epoch)
+                return reader.iter_epoch_prefetched(shuffle=True, seed=epoch,
+                                                    wire_format=wire_format)
 
         def on_log(step: int, avg_loss: float, throughput: float) -> None:
             if writer is not None:
@@ -429,7 +439,11 @@ class Code2VecModel:
         reader = PathContextReader(self.vocabs, config,
                                    EstimatorAction.Evaluate,
                                    process_index=process_index,
-                                   process_count=process_count)
+                                   process_count=process_count,
+                                   data_shards=(
+                                       self.mesh.shape[mesh_lib.DATA_AXIS]
+                                       if process_count == 1 else 1))
+        wire_format = reader.wire_format()
         oov = self.vocabs.target_vocab.special_words.OOV
         topk_metric = TopKAccuracyEvaluationMetric(
             config.TOP_K_WORDS_CONSIDERED_DURING_PREDICTION, oov)
@@ -461,7 +475,8 @@ class Code2VecModel:
 
         def eval_batches():
             steps = 0
-            for batch in reader.iter_epoch_prefetched(shuffle=False):
+            for batch in reader.iter_epoch_prefetched(
+                    shuffle=False, wire_format=wire_format):
                 steps += 1
                 if fixed_steps is not None and steps > fixed_steps:
                     raise RuntimeError(

@@ -72,6 +72,12 @@ class JaxBackend:
         # token/path rows are never gathered, padded target columns are
         # masked out of the softmax via num_valid_targets
         self.num_valid_targets = vocabs.target_vocab.size
+        # PAD indices for the packed wire format's device-side unpack
+        # (data/packed.py): must match the reader's pack-time fill.
+        # SizeOnlyVocabs (benchmarks/graft) carries no pad_index — the
+        # joined PAD==OOV policy puts both at 0 there.
+        self.token_pad_index = getattr(vocabs.token_vocab, 'pad_index', 0)
+        self.path_pad_index = getattr(vocabs.path_vocab, 'pad_index', 0)
         self.sizes = dict(
             token_vocab_size=_round_up(vocabs.token_vocab.size, align),
             path_vocab_size=_round_up(vocabs.path_vocab.size, align),
@@ -132,6 +138,8 @@ class FlaxBackend:
         self._jax_twin = JaxBackend(config, vocabs)
         sizes = self.sizes = self._jax_twin.sizes
         self.num_valid_targets = self._jax_twin.num_valid_targets
+        self.token_pad_index = self._jax_twin.token_pad_index
+        self.path_pad_index = self._jax_twin.path_pad_index
         self.module = Code2VecModule(
             token_vocab_size=sizes['token_vocab_size'],
             path_vocab_size=sizes['path_vocab_size'],

@@ -1,0 +1,26 @@
+"""One import of ``shard_map`` that works across jax versions.
+
+jax promoted shard_map out of ``jax.experimental`` around 0.5 (first as
+a ``jax.shard_map`` module attribute, then as a top-level function) and
+renamed its replication-check kwarg ``check_rep`` -> ``check_vma``; the
+toolchain baked into this image carries 0.4.x where only the
+experimental path and the old kwarg exist. Every in-repo user imports
+from here (spelling the NEW kwarg name) so the version dance has a
+single definition.
+"""
+import inspect
+
+try:
+    from jax import shard_map as _impl  # jax >= 0.5
+    # module in some versions, function in others
+    _impl = getattr(_impl, 'shard_map', _impl)
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _impl
+
+_KWARGS = inspect.signature(_impl).parameters
+
+
+def shard_map(f, **kwargs):
+    if 'check_vma' in kwargs and 'check_vma' not in _KWARGS:
+        kwargs['check_rep'] = kwargs.pop('check_vma')
+    return _impl(f, **kwargs)

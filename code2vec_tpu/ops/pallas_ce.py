@@ -55,6 +55,7 @@ try:  # pallas is TPU-oriented; keep the import soft for CPU-only installs
 except ImportError:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
+from code2vec_tpu.ops._shard_map import shard_map
 from code2vec_tpu.ops.pallas_encode import tpu_backend_active
 from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
@@ -343,7 +344,7 @@ def _sharded_forward(code, w, label, num_valid, mesh, interpret):
     # check_vma=False: outputs ARE replicated along 'model' after the
     # psum/pmax merge, but the static checker can't prove it (same as
     # ops/topk.py::sharded_top_k)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None), P(DATA_AXIS)),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
@@ -390,7 +391,7 @@ def _sharded_vjp_bwd(num_valid, mesh, interpret, residuals, cotangents):
         return (jax.lax.psum(dcode_p, MODEL_AXIS),
                 jax.lax.psum(dw_l, DATA_AXIS))
 
-    dcode, dw = jax.shard_map(
+    dcode, dw = shard_map(
         local, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(MODEL_AXIS, None), P(DATA_AXIS),
                   P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),

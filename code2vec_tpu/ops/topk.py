@@ -20,7 +20,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from code2vec_tpu.ops._shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS

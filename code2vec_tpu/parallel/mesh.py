@@ -70,8 +70,12 @@ def batch_spec(ndim: int = 1, shard_contexts: bool = False) -> P:
     """Per-example arrays shard over the batch (data) axis; with
     ``shard_contexts``, 2-D (batch, contexts) arrays additionally shard the
     contexts axis over the model axis — order-free sequence parallelism for
-    large bags (the attention reductions compile to XLA collectives)."""
-    if ndim >= 2 and shard_contexts:
+    large bags (the attention reductions compile to XLA collectives).
+
+    Exactly 2-D: the 3-D packed ctx buffer (data/packed.py) is per-shard
+    data whose capacity dim must NOT split over the model axis — each
+    device holds its own shard's full context stream."""
+    if ndim == 2 and shard_contexts:
         return P(DATA_AXIS, MODEL_AXIS)
     return P(DATA_AXIS)
 
@@ -172,9 +176,19 @@ def local_rows(array: jax.Array) -> np.ndarray:
     return np.concatenate(row_blocks, axis=0)
 
 
-def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False):
+def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False,
+                direct: bool = False):
     """Place a tuple of per-example numpy arrays onto the mesh: batch over
     ``data``; optionally contexts over ``model`` for 2-D arrays.
+
+    ``direct=True`` (the trainer's staging ring) slices each array into
+    its per-device shards on the host and issues one batched
+    ``device_put`` of the slices straight to their devices, then stitches
+    the global array with ``make_array_from_single_device_arrays`` — each
+    data-parallel shard crosses the wire exactly once, to its own device,
+    instead of relying on the runtime's whole-array placement (which may
+    replicate-then-slice through a transfer-bound link). Equal values
+    and shardings either way (tests/test_packed.py).
 
     Multi-host: each process holds its LOCAL 1/process_count share of the
     global batch (the reader strides the data file per process);
@@ -189,6 +203,20 @@ def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False):
                             + tuple(a.shape[1:]))
             out.append(jax.make_array_from_process_local_data(
                 sharding, np.asarray(a), global_shape))
+        return tuple(out)
+    if direct and mesh.size > 1:
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            sharding = NamedSharding(mesh,
+                                     batch_spec(a.ndim, shard_contexts))
+            index_map = sharding.addressable_devices_indices_map(a.shape)
+            devices = list(index_map)
+            pieces = jax.device_put(
+                [np.ascontiguousarray(a[index_map[d]]) for d in devices],
+                devices)
+            out.append(jax.make_array_from_single_device_arrays(
+                a.shape, sharding, pieces))
         return tuple(out)
     return tuple(
         jax.device_put(a, NamedSharding(

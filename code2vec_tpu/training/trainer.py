@@ -26,6 +26,7 @@ import numpy as np
 import optax
 
 from code2vec_tpu.config import Config
+from code2vec_tpu.data import packed as packed_lib
 from code2vec_tpu.data.reader import Batch
 from code2vec_tpu.models import functional
 from code2vec_tpu.ops.topk import sharded_top_k
@@ -231,11 +232,58 @@ class Trainer:
             opt_state=mesh_lib.sharding_for_tree(
                 abstract_opt, mesh, zero_partition=self._zero_opt),
             step=replicated, rng=replicated)
-        self._train_step = jax.jit(train_step, donate_argnums=(0,),
+
+        # Packed-wire twins: the same step functions behind the jitted
+        # device-side unpack (data/packed.py) — the unpack scatters the
+        # dense context stream back to the exact (B, C) planes + mask
+        # INSIDE the compiled program, so the model sees bit-identical
+        # batches and the wire carries 3-5x fewer bytes. PAD indices
+        # must match the reader's pack-time fill (models/backends.py).
+        token_pad = getattr(backend, 'token_pad_index', 0)
+        path_pad = getattr(backend, 'path_pad_index', 0)
+        max_contexts = self.config.MAX_CONTEXTS
+
+        def unpack(packed_arrays):
+            ctx, count, label, weight = packed_arrays
+            source, path, target, mask = packed_lib.unpack_device(
+                ctx, count, max_contexts, token_pad, path_pad)
+            return (source, path, target, mask, label, weight)
+
+        def train_step_packed(state, packed_arrays):
+            return train_step(state, unpack(packed_arrays))
+
+        def eval_step_packed(params, packed_arrays):
+            return eval_step(params, unpack(packed_arrays))
+
+        def predict_step_packed(params, packed_arrays):
+            return predict_step(params, unpack(packed_arrays))
+
+        # donate the consumed staging buffers alongside the state: the
+        # ring (stage_batches) keeps DEVICE_PREFETCH_BATCHES uploads in
+        # flight, so freeing each batch's memory into the step bounds
+        # the staging footprint. Harnesses that re-feed placed arrays
+        # must disable it (config comment; benchlib pins it off).
+        # Backends that cannot alias a given buffer (CPU; int inputs
+        # with no matching output) emit jax's "donated buffers were not
+        # usable" notice once per compile — expected, deliberately NOT
+        # filtered (a global warnings filter would also hide genuinely
+        # broken donations in the embedding program).
+        donate_train = ((0, 1) if self.config.DONATE_STAGED_BATCHES
+                        else (0,))
+        donate_eval = (1,) if self.config.DONATE_STAGED_BATCHES else ()
+        self._train_step = jax.jit(train_step, donate_argnums=donate_train,
                                    out_shardings=(self._state_shardings,
                                                   replicated))
-        self._eval_step = jax.jit(eval_step)
+        self._train_step_packed = jax.jit(
+            train_step_packed, donate_argnums=donate_train,
+            out_shardings=(self._state_shardings, replicated))
+        self._eval_step = jax.jit(eval_step, donate_argnums=donate_eval)
+        self._eval_step_packed = jax.jit(eval_step_packed,
+                                         donate_argnums=donate_eval)
         self._predict_step = jax.jit(predict_step)
+        self._predict_step_packed = jax.jit(predict_step_packed)
+        self._token_pad = token_pad
+        self._path_pad = path_pad
 
     # --------------------------------------------------------------- state
     def init_state(self, seed: int = 42) -> TrainerState:
@@ -283,24 +331,43 @@ class Trainer:
                             rng=jax.random.PRNGKey(seed))
 
     # --------------------------------------------------------------- steps
+    def _check_packed(self, arrays) -> None:
+        data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
+        if arrays[0].shape[0] != data_axis:
+            raise ValueError(
+                'packed batch was built for %d data shard(s) but the mesh '
+                'data axis is %d — pack with data_shards=%d '
+                '(data/packed.py).'
+                % (arrays[0].shape[0], data_axis, data_axis))
+
     def train_step(self, state: TrainerState, batch: Batch
                    ) -> Tuple[TrainerState, jax.Array]:
-        arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
+        host_arrays = batch.device_arrays()
+        if len(host_arrays) == 4:
+            self._check_packed(host_arrays)  # clear error BEFORE placement
+        arrays = mesh_lib.shard_batch(host_arrays, self.mesh,
                                       self.config.SHARD_CONTEXTS)
-        return self._train_step(state, arrays)
+        return self.train_step_placed(state, arrays)
 
     def stage_batches(self, batches: Iterable[Batch]):
-        """Place batches on the device ahead of the step consuming them,
-        yielding ``(placed_arrays, batch)`` (the host Batch rides along for
-        consumers that need its strings/weights, e.g. eval decode).
+        """The device staging ring: place batches ahead of the step
+        consuming them, yielding ``(placed_arrays, batch)`` (the host
+        batch rides along for consumers that need its strings/weights,
+        e.g. eval decode). Accepts either wire format — a batch is placed
+        via its own ``device_arrays()``.
 
         jax transfers are async, so staging the next batch while the
         current step computes overlaps the host->device copy with device
         work instead of serializing upload -> step -> upload (through this
         environment's device tunnel one batch upload costs ~290 ms against
         a ~51 ms step — see benchmarks/diag_step_breakdown.py).
-        ``DEVICE_PREFETCH_BATCHES`` bounds the device memory held by staged
-        batches; 0 degenerates to place-then-consume."""
+        ``DEVICE_PREFETCH_BATCHES`` bounds the ring depth (device memory
+        held by staged batches; 0 degenerates to place-then-consume), and
+        placement is per-device direct (shard_batch ``direct=True``): each
+        data shard's slice transfers straight to its device instead of
+        replicate-then-slice. The consuming step donates the buffers back
+        (DONATE_STAGED_BATCHES), so the ring's footprint stays ~depth
+        batches."""
         depth = max(0, self.config.DEVICE_PREFETCH_BATCHES)
         if self.mesh.devices.flat[0].platform.lower() == 'cpu':
             # XLA:CPU's in-process collectives can deadlock their 40s
@@ -313,7 +380,8 @@ class Trainer:
         staged = collections.deque()
         for batch in batches:
             staged.append((mesh_lib.shard_batch(batch.device_arrays(),
-                                                self.mesh, shard_contexts),
+                                                self.mesh, shard_contexts,
+                                                direct=True),
                            batch))
             if len(staged) > depth:
                 yield staged.popleft()
@@ -322,21 +390,41 @@ class Trainer:
 
     def train_step_placed(self, state: TrainerState, arrays
                           ) -> Tuple[TrainerState, jax.Array]:
-        """train_step over arrays already placed by ``stage_batches``."""
+        """train_step over arrays already placed by ``stage_batches`` —
+        either wire format, dispatched on the tuple's arity (packed = 4
+        arrays, planes = 6)."""
+        if len(arrays) == 4:
+            self._check_packed(arrays)
+            return self._train_step_packed(state, arrays)
         return self._train_step(state, arrays)
 
     def eval_step_placed(self, params, arrays) -> dict:
         """eval_step over arrays already placed by ``stage_batches``."""
+        if len(arrays) == 4:
+            self._check_packed(arrays)
+            return self._eval_step_packed(params, arrays)
         return self._eval_step(params, arrays)
 
     def eval_step(self, params, batch: Batch) -> dict:
         arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
                                       self.config.SHARD_CONTEXTS)
-        return self._eval_step(params, arrays)
+        return self.eval_step_placed(params, arrays)
 
     def predict_step(self, params, batch: Batch) -> dict:
+        """Predict over a host batch. Plane batches follow the configured
+        wire format: under 'packed' the batch is packed here (the REPL
+        keeps its plane/strings view) so prediction exercises the same
+        wire + device-unpack path as training."""
+        if isinstance(batch, Batch) and \
+                self.config.wire_format_for(jax.process_count()) == 'packed':
+            batch = packed_lib.pack_batch(
+                batch, self._token_pad, self._path_pad,
+                data_shards=self.mesh.shape[mesh_lib.DATA_AXIS])
         arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
                                       self.config.SHARD_CONTEXTS)
+        if len(arrays) == 4:
+            self._check_packed(arrays)
+            return self._predict_step_packed(params, arrays)
         return self._predict_step(params, arrays)
 
     # ----------------------------------------------------------- main loop
@@ -406,7 +494,7 @@ class Trainer:
                         profile_done = True
                         config.log('Profiler trace written to `%s`.'
                                    % config.PROFILE_DIR)
-                state, loss = self._train_step(state, arrays)
+                state, loss = self.train_step_placed(state, arrays)
                 batch_num += 1
                 window_losses.append(loss)
                 window_examples += host_batch.num_valid_examples

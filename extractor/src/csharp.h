@@ -882,7 +882,12 @@ class CsParser {
   // `> 5`, NotPattern `not null`, DeclarationPattern `int n`,
   // ConstantPattern everything-else.
   Node* parse_switch_pattern() {
-    if (is_ident("_") && (is_punct("=>", 1) || is_ident("when", 1)))
+    // `,` / `)` lookahead: a discard inside a positional pattern —
+    // `(_, 0) => ...` — is a DiscardPattern subpattern (Roslyn emits no
+    // identifier leaf for it); without these it fell through to
+    // ConstantPattern with a spurious `_` leaf (ADVICE r5).
+    if (is_ident("_") && (is_punct("=>", 1) || is_ident("when", 1) ||
+                          is_punct(",", 1) || is_punct(")", 1)))
       { advance(); return arena_->make("DiscardPattern"); }
     static const char* kRel[] = {">=", "<=", ">", "<"};
     for (const char* op : kRel) {
@@ -1206,7 +1211,13 @@ class CsParser {
           next.kind == Tok::kCharLit ||
           (next.kind == Tok::kPunct &&
            (next.text == "(" || next.text == "!" || next.text == "~" ||
-            next.text == "++" || next.text == "--"));
+            next.text == "++" || next.text == "--" ||
+            // prefix sign: `await -Fetch(id)` is
+            // AwaitExpression(UnaryMinus(...)), not a SubtractExpression
+            // with an `await` identifier leaf (ADVICE r5). The traded-
+            // away reading — a VARIABLE named await in `await - x` — is
+            // far rarer than the keyword in async-heavy corpora.
+            next.text == "-" || next.text == "+"));
       if (starts_unary) {
         advance();
         Node* await_expr = arena_->make("AwaitExpression");

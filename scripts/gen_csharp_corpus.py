@@ -137,8 +137,15 @@ def main() -> None:
         with open(os.path.join(d, name + '.cs'), 'w') as f:
             f.write(src)
         counts[split] += 1
-        methods += src.count('public ') - 1
-    print('classes: %s  methods: ~%d' % (counts, methods))
+        # count only method-shaped members: a parameter list before any
+        # `=>`. Expression-bodied properties (`public string XTag => ...`)
+        # are skipped by the extractor, so they must not inflate the
+        # count; the class declaration line has no parens either.
+        methods += sum(
+            1 for line in src.splitlines()
+            if line.lstrip().startswith('public ')
+            and '(' in line.split('=>')[0])
+    print('classes: %s  methods: %d' % (counts, methods))
 
 
 if __name__ == '__main__':

@@ -19,3 +19,10 @@ if '--xla_force_host_platform_device_count' not in _flags:
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
+# Newer jax (the toolchain this repo was grown on) defaults the
+# partitionable threefry; 0.4.x defaults it off. The partitionable
+# generator is counter-based PER ELEMENT, so a (N, d) draw's first rows
+# equal a smaller (n, d) draw's — the property the cross-allocation
+# parity tests (fused-CE padded table vs plain; mesh vs single-device)
+# rely on to get identical initial params from differently-padded shapes.
+jax.config.update('jax_threefry_partitionable', True)

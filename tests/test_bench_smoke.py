@@ -25,7 +25,10 @@ def test_bench_smoke_emits_one_json_line():
     lines, record = run_bench_smoke()
     assert len(lines) == 1
     assert set(record) == {'metric', 'value', 'unit', 'vs_baseline',
-                           'recipe', 'knobs'}
+                           'recipe', 'knobs', 'wire_bytes_per_batch'}
+    # the packed wire format must be strictly smaller at realistic fill
+    wire = record['wire_bytes_per_batch']
+    assert 0 < wire['packed'] < wire['planes']
     # a smoke line must never masquerade as the java14m number
     assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
     assert record['vs_baseline'] == 0.0

@@ -129,6 +129,109 @@ def test_cache_shuffle_is_epoch_dependent_permutation(small_setup):  # noqa: F81
     assert rows0 != rows1                  # different order
 
 
+def _write_v1_cache(cache_dir, config, vocabs, reader):
+    """Materialize the v1 (padded-plane) on-disk layout for the
+    read-compatibility tests — byte-for-byte what the pre-v2 builder
+    wrote: source/path/target planes + labels + a meta without a
+    version key."""
+    import json
+    import os
+
+    from code2vec_tpu.data.cache import _fingerprint
+    os.makedirs(cache_dir, exist_ok=True)
+    handles = {name: open(os.path.join(cache_dir, name), 'wb')
+               for name in ('source.bin', 'path.bin', 'target.bin',
+                            'label.bin')}
+    num_rows = 0
+    for batch in reader.iter_epoch(shuffle=False, wire_format='planes'):
+        valid = batch.weight > 0
+        handles['source.bin'].write(
+            np.ascontiguousarray(batch.source[valid]).tobytes())
+        handles['path.bin'].write(
+            np.ascontiguousarray(batch.path[valid]).tobytes())
+        handles['target.bin'].write(
+            np.ascontiguousarray(batch.target[valid]).tobytes())
+        handles['label.bin'].write(
+            np.ascontiguousarray(batch.label[valid]).tobytes())
+        num_rows += int(valid.sum())
+    for handle in handles.values():
+        handle.close()
+    meta = _fingerprint(config, vocabs, reader.data_path)
+    meta['num_rows'] = num_rows
+    with open(os.path.join(cache_dir, 'meta.json'), 'w') as f:
+        json.dump(meta, f)
+
+
+def test_new_cache_builds_v2_packed_on_disk(small_setup):  # noqa: F811
+    """A fresh build writes format v2 (ragged ctx triples): smaller than
+    the v1 planes at any fill < 3/4, same rows back out."""
+    import os
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1 s2,p2,t1', 'lbl2 s2,p2,t1'] * 3)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache.version == 2
+    assert os.path.isfile(os.path.join(cache.cache_dir, 'ctx.bin'))
+    assert not os.path.exists(os.path.join(cache.cache_dir, 'source.bin'))
+    # 6 rows, lengths {2, 1} alternating -> 9 context triples
+    assert cache.meta['num_contexts'] == 9
+    streamed = _rows_from_batches(reader.iter_epoch(shuffle=False))
+    assert _rows_from_batches(cache.iter_epoch(2, shuffle=False)) == streamed
+
+
+def test_v1_cache_reads_compatibly_and_is_not_rebuilt(small_setup):  # noqa: F811
+    """tokcache v1 -> v2 read compatibility: a fresh v1 directory keeps
+    serving under the v2 code — identical batches to the streaming
+    reader, no rebuild on build_or_load, and it can feed the packed wire
+    via host-side packing."""
+    from code2vec_tpu.data import packed as packed_lib
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1 zzz,p2,t1', 'lbl2 s2,p2,t1'] * 4)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache_dir = str(prefix) + '.train.c2v.tokcache'
+    _write_v1_cache(cache_dir, config, vocabs, reader)
+
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache.cache_dir == cache_dir
+    assert cache.version == 1          # served as-is, not rebuilt
+    streamed = _rows_from_batches(reader.iter_epoch(shuffle=False))
+    assert _rows_from_batches(cache.iter_epoch(2, shuffle=False)) == streamed
+    packed = list(cache.iter_epoch(2, shuffle=False, wire_format='packed'))
+    assert all(isinstance(p, packed_lib.PackedBatch) for p in packed)
+    unpacked = [packed_lib.unpack_batch_host(
+        p, config.MAX_CONTEXTS, vocabs.token_vocab.pad_index,
+        vocabs.path_vocab.pad_index) for p in packed]
+    assert _rows_from_batches(unpacked) == streamed
+
+
+def test_v2_cache_packed_emission_matches_planes(small_setup):  # noqa: F811
+    """One v2 cache, both wire formats, shuffled: identical example
+    multiset, and the packed batches unpack bit-exactly to the plane
+    batches of the same epoch seed."""
+    from code2vec_tpu.data import packed as packed_lib
+    config, vocabs, prefix = small_setup
+    lines = ['lbl1 s1,p1,t1', 'lbl2 s2,p2,t1 s1,p1,t1', 'lbl1 s2,p1,t1',
+             'lbl2 s1,p2,t1'] * 4
+    _write_train(prefix, lines)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    assert cache.version == 2
+    planes = list(cache.iter_epoch(4, shuffle=True, seed=3, chunk_rows=8))
+    packed = list(cache.iter_epoch(4, shuffle=True, seed=3, chunk_rows=8,
+                                   wire_format='packed', data_shards=2))
+    assert len(planes) == len(packed)
+    for plane_batch, packed_batch in zip(planes, packed):
+        assert packed_batch.ctx.shape[0] == 2  # data_shards honored
+        restored = packed_lib.unpack_batch_host(
+            packed_batch, config.MAX_CONTEXTS,
+            vocabs.token_vocab.pad_index, vocabs.path_vocab.pad_index)
+        for field in ('source', 'path', 'target', 'mask', 'label',
+                      'weight'):
+            np.testing.assert_array_equal(getattr(plane_batch, field),
+                                          getattr(restored, field),
+                                          err_msg=field)
+
+
 def test_cache_partial_final_batch_padded(small_setup):  # noqa: F811
     config, vocabs, prefix = small_setup
     _write_train(prefix, ['lbl1 s1,p1,t1'] * 5)

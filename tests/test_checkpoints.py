@@ -226,6 +226,13 @@ def test_resume_across_fused_ce_and_mesh_reshape(tmp_path):
                                after.topk_predicted_words_scores[:n],
                                rtol=1e-5)
     model2.train()  # epoch 1 runs with the sliced moments without error
+    # train() wrote a NEWER checkpoint — the state model3 restores below.
+    # Compare against a fresh prediction of THAT state: the pre-train
+    # `after` only matches when the extra epoch happens to move nothing
+    # (it did on the original toolchain, by convergence luck, but the
+    # pad-direction claim is about the restore, not about training being
+    # a no-op).
+    after_train = model2.predict([line])[0]
 
     # params-only load back UNDER fused CE (pad direction)
     config3 = Config(
@@ -237,8 +244,10 @@ def test_resume_across_fused_ce_and_mesh_reshape(tmp_path):
     assert model3.backend.sizes['target_vocab_size'] > \
         model2.backend.sizes['target_vocab_size']
     padded = model3.predict([line])[0]
-    m = min(len(padded.topk_predicted_words), len(after.topk_predicted_words))
-    assert padded.topk_predicted_words[:m] == after.topk_predicted_words[:m]
+    m = min(len(padded.topk_predicted_words),
+            len(after_train.topk_predicted_words))
+    assert padded.topk_predicted_words[:m] == \
+        after_train.topk_predicted_words[:m]
 
 
 def test_release_rows_rewrite_does_not_poison_older_checkpoints(tmp_path):

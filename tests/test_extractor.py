@@ -653,6 +653,57 @@ class T {
     assert 'AddExpression' in lines[1] and 'SwitchExpression' in lines[1]
 
 
+def test_csharp_positional_discard_pattern_has_no_leaf(tmp_path):
+    """ADVICE r5 csharp.h:885: `_` inside a positional pattern —
+    `(_, 0) => ...` — is a DiscardPattern (Roslyn emits NO identifier
+    leaf for it; being leafless it also contributes no path contexts).
+    Before the `,`/`)` lookahead fix it fell through to ConstantPattern
+    and a spurious `_` identifier leaf appeared in the bag."""
+    src = tmp_path / 'T.cs'
+    src.write_text('''
+class T {
+  string Axis(int x, int y) {
+    return (x, y) switch { (_, 0) => "xaxis", (0, _) => "yaxis",
+                           _ => "other" };
+  }
+}
+''')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['axis']
+    contexts = lines[0].split(' ')[1:]
+    leaves = {piece for ctx in contexts
+              for piece in (ctx.split(',')[0], ctx.split(',')[-1])}
+    assert '_' not in leaves
+    # the positional pattern itself still parses as Roslyn's shape, and
+    # the sibling constant subpatterns keep their Subpattern ancestry
+    assert 'RecursivePattern' in lines[0]
+    assert 'Subpattern^PositionalPatternClause' in lines[0]
+
+
+def test_csharp_await_of_signed_expression(tmp_path):
+    """ADVICE r5 csharp.h:1203: `await -Fetch(id)` / `await +Fetch(id)`
+    are AwaitExpression(UnaryMinus/Plus(...)) — before the starts_unary
+    fix the prefix sign demoted `await` to an identifier leaf inside a
+    Subtract/AddExpression."""
+    src = tmp_path / 'T.cs'
+    src.write_text('class T {\n'
+                   '  async Task<int> Neg(int id) '
+                   '{ return await -Fetch(id); }\n'
+                   '  async Task<int> Pos(int id) '
+                   '{ return await +Fetch(id); }\n'
+                   '}\n')
+    lines = extract_file(str(src))
+    assert [l.split(' ')[0] for l in lines] == ['neg', 'pos']
+    assert 'AwaitExpression_UnaryMinusExpression' in lines[0]
+    assert 'AwaitExpression_UnaryPlusExpression' in lines[1]
+    for line in lines:
+        leaves = {piece for ctx in line.split(' ')[1:]
+                  for piece in (ctx.split(',')[0], ctx.split(',')[-1])}
+        assert 'await' not in leaves
+        assert 'SubtractExpression' not in line
+        assert 'AddExpression' not in line
+
+
 def test_csharp_corpus_generator_roundtrip(tmp_path):
     """scripts/gen_csharp_corpus.py emits parseable C# at smoke scale:
     every generated file extracts with zero stderr errors, labels carry

@@ -1,6 +1,11 @@
 """Smoke for the host input-pipeline benchmark (VERDICT r1 #7): guards
 the script against import/config rot; the real numbers are captured by
-running it at full size (see PARITY.md 'Host pipeline throughput')."""
+running it at full size (see PARITY.md 'Host pipeline throughput').
+
+Also the CPU-only guard on the packed wire format's byte win: on the
+java14m-shaped synthetic corpus the packed bytes/batch must stay <= 50%
+of the plane format's, so the transfer-bound optimization (ISSUE 1,
+PERF.md 'Wire format') cannot silently regress without a TPU."""
 import json
 import os
 import subprocess
@@ -10,18 +15,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, 'benchmarks', 'bench_host_pipeline.py')
 
 
-def test_host_pipeline_bench_emits_json_lines():
+def run_bench(*extra_args):
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS='cpu')
     proc = subprocess.run(
-        [sys.executable, SCRIPT, '--rows', '400', '--contexts', '8',
-         '--batch-size', '64'],
+        [sys.executable, SCRIPT, *extra_args],
         capture_output=True, text=True, timeout=300, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    records = [json.loads(line) for line in proc.stdout.splitlines()
-               if line.strip()]
-    variants = {r['variant'] for r in records}
+    return [json.loads(line) for line in proc.stdout.splitlines()
+            if line.strip()]
+
+
+def test_host_pipeline_bench_emits_json_lines():
+    records = run_bench('--rows', '400', '--contexts', '8',
+                        '--batch-size', '64')
+    throughput = [r for r in records
+                  if r['metric'] == 'host_pipeline_examples_per_sec']
+    variants = {r['variant'] for r in throughput}
     assert 'python' in variants and 'cache' in variants
-    for record in records:
-        assert record['metric'] == 'host_pipeline_examples_per_sec'
+    for record in throughput:
         assert record['value'] > 0
         assert 'vs_north_star' in record
+
+
+def test_packed_wire_bytes_at_most_half_of_planes():
+    """The acceptance floor for the packed format: >= 2x fewer bytes per
+    batch on a java14m-shaped corpus (row lengths [C/8, C/2] — see
+    synthesize_dataset). C and B are large enough that the capacity
+    bucketing overhead cannot mask the fill-rate win."""
+    records = run_bench('--rows', '2000', '--contexts', '64',
+                        '--batch-size', '256', '--variants', 'wire')
+    wire = {r['variant']: r for r in records
+            if r['metric'] == 'wire_bytes_per_batch'}
+    assert set(wire) == {'planes', 'packed'}
+    assert wire['planes']['value'] > 0
+    assert wire['packed']['value'] <= 0.5 * wire['planes']['value'], wire

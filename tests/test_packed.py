@@ -1,0 +1,307 @@
+"""Packed wire format (data/packed.py): the round trip is BIT-exact.
+
+Property tests over randomized plane batches — interior all-PAD holes,
+PAD-filled tails, zero-weight padding rows, nonzero PAD indices
+(SEPARATE_OOV_AND_PAD-style), per-shard packing — against both the numpy
+reference inverse and the jitted device unpack; plus the trainer
+integration (packed vs plane steps produce identical losses, params and
+eval outputs on the 8-virtual-device mesh) and the direct per-device
+placement path of shard_batch."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.data import packed as packed_lib
+from code2vec_tpu.data.reader import (Batch, EstimatorAction,
+                                      PathContextReader, context_valid_mask)
+
+from tests.test_reader import small_setup, _write_train  # noqa: F401
+from tests.test_stage_batches import make_batches, make_trainer
+
+
+def random_plane_batch(rng, batch_size, contexts, token_pad=0, path_pad=0,
+                       hole_rate=0.3, pad_row_rate=0.2):
+    """A Batch with every structural corner the reader can produce:
+    random per-row effective lengths, interior holes (slots whose three
+    parts are all PAD — mask 0 mid-row), and zero-weight padding rows
+    filled exactly like reader._pad_batch fills them."""
+    source = rng.integers(0, 30, (batch_size, contexts)).astype(np.int32)
+    path = rng.integers(0, 14, (batch_size, contexts)).astype(np.int32)
+    target = rng.integers(0, 30, (batch_size, contexts)).astype(np.int32)
+    holes = rng.random((batch_size, contexts)) < hole_rate
+    lengths = rng.integers(0, contexts + 1, (batch_size,))
+    tail = np.arange(contexts)[None, :] >= lengths[:, None]
+    weight = (rng.random((batch_size,)) > pad_row_rate).astype(np.float32)
+    label = rng.integers(0, 10, (batch_size,)).astype(np.int32)
+    for dead in (holes, tail, (weight == 0)[:, None] & np.ones(
+            (1, contexts), bool)):
+        source[dead] = token_pad
+        path[dead] = path_pad
+        target[dead] = token_pad
+    label[weight == 0] = 0
+    mask = context_valid_mask(source, path, target, token_pad, path_pad)
+    return Batch(source=source, path=path, target=target, mask=mask,
+                 label=label, weight=weight)
+
+
+def assert_batches_bit_equal(a: Batch, b: Batch):
+    for name in ('source', 'path', 'target', 'mask', 'label', 'weight'):
+        np.testing.assert_array_equal(getattr(a, name), getattr(b, name),
+                                      err_msg=name)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize('token_pad,path_pad', [(0, 0), (1, 2)])
+    @pytest.mark.parametrize('data_shards', [1, 2, 4])
+    def test_host_round_trip_property(self, token_pad, path_pad,
+                                      data_shards):
+        rng = np.random.default_rng(7)
+        for trial in range(25):
+            contexts = int(rng.choice([3, 5, 8, 13]))
+            batch = random_plane_batch(rng, 8, contexts, token_pad,
+                                       path_pad)
+            packed = packed_lib.pack_batch(batch, token_pad, path_pad,
+                                           data_shards=data_shards,
+                                           capacity_minimum=4)
+            restored = packed_lib.unpack_batch_host(packed, contexts,
+                                                    token_pad, path_pad)
+            assert_batches_bit_equal(batch, restored)
+
+    @pytest.mark.parametrize('data_shards', [1, 4])
+    def test_device_unpack_matches_planes_bit_exactly(self, data_shards):
+        import jax
+
+        rng = np.random.default_rng(11)
+        for trial in range(10):
+            batch = random_plane_batch(rng, 8, 6, 1, 2)
+            packed = packed_lib.pack_batch(batch, 1, 2,
+                                           data_shards=data_shards,
+                                           capacity_minimum=4)
+            unpack = jax.jit(lambda c, n: packed_lib.unpack_device(
+                c, n, 6, 1, 2))
+            source, path, target, mask = unpack(packed.ctx, packed.count)
+            np.testing.assert_array_equal(np.asarray(source), batch.source)
+            np.testing.assert_array_equal(np.asarray(path), batch.path)
+            np.testing.assert_array_equal(np.asarray(target), batch.target)
+            np.testing.assert_array_equal(np.asarray(mask), batch.mask)
+
+    def test_capacity_smaller_than_batch(self):
+        """More examples than context rows (sparse batch: most rows
+        empty) — the unpack's index bookkeeping must follow the (B,)
+        example axis, not the capacity axis (regression: eval of a tiny
+        corpus at B=1024 crashed the packed unpack)."""
+        import jax
+
+        contexts = 6
+        batch_size = 64
+        rng = np.random.default_rng(2)
+        batch = random_plane_batch(rng, batch_size, contexts)
+        lengths = np.zeros((batch_size,), np.int64)
+        lengths[:4] = [1, 2, 0, 3]  # everything else fully empty
+        dead = np.arange(contexts)[None, :] >= lengths[:, None]
+        source = batch.source.copy(); source[dead] = 0
+        path = batch.path.copy(); path[dead] = 0
+        target = batch.target.copy(); target[dead] = 0
+        mask = context_valid_mask(source, path, target, 0, 0)
+        batch = batch._replace(source=source, path=path, target=target,
+                               mask=mask)
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+        assert packed.ctx.shape[1] < batch_size
+        restored = packed_lib.unpack_batch_host(packed, contexts, 0, 0)
+        assert_batches_bit_equal(batch, restored)
+        out = jax.jit(lambda c, n: packed_lib.unpack_device(
+            c, n, contexts, 0, 0))(packed.ctx, packed.count)
+        np.testing.assert_array_equal(np.asarray(out[0]), batch.source)
+        np.testing.assert_array_equal(np.asarray(out[3]), batch.mask)
+
+    def test_all_padding_batch(self):
+        """The multi-host eval filler shape: every row weight 0."""
+        contexts = 5
+        zero = Batch(source=np.zeros((4, contexts), np.int32),
+                     path=np.zeros((4, contexts), np.int32),
+                     target=np.zeros((4, contexts), np.int32),
+                     mask=np.zeros((4, contexts), np.float32),
+                     label=np.zeros((4,), np.int32),
+                     weight=np.zeros((4,), np.float32))
+        packed = packed_lib.pack_batch(zero, 0, 0, capacity_minimum=4)
+        assert packed.num_valid_examples == 0
+        restored = packed_lib.unpack_batch_host(packed, contexts, 0, 0)
+        assert_batches_bit_equal(zero, restored)
+
+    def test_string_fields_ride_along(self):
+        rng = np.random.default_rng(3)
+        batch = random_plane_batch(rng, 4, 3)._replace(
+            label_strings=np.array(['a', 'b', 'c', 'd'], dtype=object))
+        packed = packed_lib.pack_batch(batch, 0, 0)
+        assert packed.label_strings is batch.label_strings
+        restored = packed_lib.unpack_batch_host(packed, 3, 0, 0)
+        assert restored.label_strings is batch.label_strings
+
+
+def test_bucketed_capacity_properties():
+    minimum = 64
+    for total in (0, 1, 63, 64, 65, 511, 512, 8191, 30720, 1 << 20):
+        cap = packed_lib.bucketed_capacity(total, minimum)
+        assert cap >= max(total, minimum)
+        # waste bounded: bucket is ~total/8
+        assert cap <= max(total * 1.25 + minimum, minimum)
+    # bucketing collapses nearby totals to one capacity (bounded jit
+    # specializations)
+    caps = {packed_lib.bucketed_capacity(t) for t in range(30000, 33000)}
+    assert len(caps) <= 2
+
+
+def test_wire_bytes_shrink_at_realistic_fill():
+    from code2vec_tpu import benchlib
+    shapes = benchlib.BenchShapes(token_vocab=1000, path_vocab=1000,
+                                  target_vocab=500, batch_size=256,
+                                  max_contexts=64)
+    batch = benchlib.random_batches(shapes, 1, seed=0, fill=0.25)[0]
+    packed = packed_lib.pack_batch(batch, 0, 0)
+    assert packed_lib.wire_bytes(packed) <= \
+        0.5 * packed_lib.wire_bytes(batch)
+
+
+class TestTrainerIntegration:
+    """Packed and plane wires must be indistinguishable past the device
+    unpack: identical losses, updated params, and eval/predict outputs,
+    on the full 8-virtual-device data-parallel mesh."""
+
+    def _batches_and_packed(self, trainer, n=3):
+        rng = np.random.default_rng(5)
+        batches = []
+        for _ in range(n):
+            batch = random_plane_batch(rng, 8, 4, pad_row_rate=0.1)
+            # trainer vocab sizes are small; clamp labels into range
+            batch = batch._replace(
+                label=np.clip(batch.label, 0, 15).astype(np.int32))
+            batches.append(batch)
+        shards = trainer.mesh.shape['data']
+        packed = [packed_lib.pack_batch(b, 0, 0, data_shards=shards,
+                                        capacity_minimum=4)
+                  for b in batches]
+        return batches, packed
+
+    def test_train_steps_bit_equal(self):
+        import jax
+
+        trainer = make_trainer()
+        batches, packed = self._batches_and_packed(trainer)
+        state_a = trainer.init_state(seed=0)
+        state_b = trainer.init_state(seed=0)
+        for batch, pb in zip(batches, packed):
+            state_a, loss_a = trainer.train_step(state_a, batch)
+            state_b, loss_b = trainer.train_step(state_b, pb)
+            assert float(loss_a) == float(loss_b)
+        for leaf_a, leaf_b in zip(
+                jax.tree_util.tree_leaves(state_a.params),
+                jax.tree_util.tree_leaves(state_b.params)):
+            np.testing.assert_array_equal(np.asarray(leaf_a),
+                                          np.asarray(leaf_b))
+
+    def test_eval_and_predict_outputs_equal(self):
+        trainer = make_trainer()
+        batches, packed = self._batches_and_packed(trainer, n=1)
+        params = trainer.init_state(seed=1).params
+        out_planes = trainer.eval_step(params, batches[0])
+        out_packed = trainer.eval_step(params, packed[0])
+        np.testing.assert_array_equal(
+            np.asarray(out_planes['topk_indices']),
+            np.asarray(out_packed['topk_indices']))
+        assert float(out_planes['loss_sum']) == \
+            float(out_packed['loss_sum'])
+        assert float(out_planes['weight_sum']) == \
+            float(out_packed['weight_sum'])
+        pred_planes = trainer.predict_step(params, batches[0])
+        pred_packed = trainer.predict_step(params, packed[0])
+        # the two packed programs differ in capacity (predict_step packs
+        # with the default bucket) — XLA may fuse the float softmax a ulp
+        # apart across programs even though the unpacked int planes are
+        # bit-equal (asserted in TestRoundTrip); compare to float32 ulp
+        np.testing.assert_allclose(
+            np.asarray(pred_planes['attention']),
+            np.asarray(pred_packed['attention']), rtol=1e-6, atol=0)
+
+    def test_staged_fit_loop_runs_on_packed(self):
+        """stage_batches -> train_step_placed end to end over packed
+        batches (the fit() hot path), donation enabled (the default)."""
+        trainer = make_trainer(DEVICE_PREFETCH_BATCHES=2)
+        _batches, packed = self._batches_and_packed(trainer, n=4)
+        state = trainer.init_state(seed=0)
+        steps = 0
+        for arrays, host_batch in trainer.stage_batches(iter(packed)):
+            assert len(arrays) == 4
+            assert host_batch.num_valid_examples >= 0
+            state, loss = trainer.train_step_placed(state, arrays)
+            steps += 1
+        assert steps == 4
+        assert np.isfinite(float(loss))
+
+    def test_mismatched_shard_count_raises(self):
+        trainer = make_trainer()
+        rng = np.random.default_rng(9)
+        batch = random_plane_batch(rng, 8, 4)
+        wrong = packed_lib.pack_batch(batch, 0, 0, data_shards=2,
+                                      capacity_minimum=4)
+        with pytest.raises(ValueError, match='data_shards'):
+            trainer.train_step(trainer.init_state(seed=0), wrong)
+
+
+def test_shard_batch_direct_matches_default():
+    """The staging ring's per-device direct placement must produce the
+    same values and shardings as the whole-array path."""
+    import jax
+
+    from code2vec_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.create_mesh()
+    rng = np.random.default_rng(0)
+    arrays = (rng.integers(0, 99, (16, 4)).astype(np.int32),       # planes
+              rng.integers(0, 99, (8, 12, 3)).astype(np.int32),    # packed
+              rng.random((16,)).astype(np.float32))
+    default = mesh_lib.shard_batch(arrays, mesh)
+    direct = mesh_lib.shard_batch(arrays, mesh, direct=True)
+    for a, b in zip(default, direct):
+        assert a.sharding.is_equivalent_to(b.sharding, np.ndim(a))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # placed arrays behave as jit inputs identically
+    summed = jax.jit(lambda x: x.sum())(direct[1])
+    assert int(summed) == int(arrays[1].sum())
+
+
+def test_reader_emits_packed_wire(small_setup):  # noqa: F811
+    """reader.iter_epoch(wire_format='packed') must mirror the planes
+    stream batch-for-batch (same filter semantics, same short-final-batch
+    padding) through the host unpack."""
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, [
+        'lbl1 s1,p1,t1 zzz,p2,t1',   # kept (train filter)
+        'unknown s1,p1,t1',          # dropped: OOV target
+        'lbl2 zz,zz,zz',             # dropped: no valid contexts
+        'lbl2 s2,p2,t1',             # kept
+        'lbl1 s1,p2,t1',             # kept -> short final batch, padded
+    ])
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    planes = list(reader.iter_epoch(shuffle=False))
+    packed = list(reader.iter_epoch(shuffle=False, wire_format='packed'))
+    assert len(planes) == len(packed)
+    assert all(isinstance(p, packed_lib.PackedBatch) for p in packed)
+    token_pad = vocabs.token_vocab.pad_index
+    path_pad = vocabs.path_vocab.pad_index
+    for plane_batch, packed_batch in zip(planes, packed):
+        assert_batches_bit_equal(
+            plane_batch,
+            packed_lib.unpack_batch_host(packed_batch, config.MAX_CONTEXTS,
+                                         token_pad, path_pad))
+    # the padded tail row survives as weight 0 / count 0
+    assert packed[-1].weight[-1] == 0.0
+    assert packed[-1].count[-1] == 0
+
+
+def test_eval_reader_packed_keeps_label_strings(small_setup):  # noqa: F811
+    config, vocabs, prefix = small_setup
+    with open(str(prefix) + '.test.c2v', 'w') as f:
+        f.write('lbl1 s1,p1,t1\nlbl2 s2,p2,t1\n')
+    config.TEST_DATA_PATH = str(prefix) + '.test.c2v'
+    reader = PathContextReader(vocabs, config, EstimatorAction.Evaluate)
+    packed = list(reader.iter_epoch(shuffle=False, wire_format='packed'))
+    assert packed and packed[0].label_strings is not None
+    assert list(packed[0].label_strings) == ['lbl1', 'lbl2']

@@ -59,9 +59,9 @@ def test_stage_batches_cpu_lookahead_is_disabled():
     from code2vec_tpu.parallel import mesh as mesh_lib
     real_shard_batch = mesh_lib.shard_batch
 
-    def counting_shard_batch(arrays, mesh, shard_contexts):
+    def counting_shard_batch(arrays, mesh, shard_contexts, **kwargs):
         placed_log.append(1)
-        return real_shard_batch(arrays, mesh, shard_contexts)
+        return real_shard_batch(arrays, mesh, shard_contexts, **kwargs)
 
     mesh_lib.shard_batch, saved = counting_shard_batch, real_shard_batch
     try:
