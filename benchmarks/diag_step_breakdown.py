@@ -19,7 +19,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -48,10 +47,11 @@ def main() -> None:
     tiny = jax.jit(lambda x: x + 1)
     v = tiny(jax.numpy.zeros(()))
     float(v)
-    t0 = time.perf_counter()
-    for _ in range(20):
-        float(tiny(v))
-    rtt = (time.perf_counter() - t0) / 20
+    sw = benchlib.bench_timer('rtt')
+    with sw.time():
+        for _ in range(20):
+            float(tiny(v))
+    rtt = sw.last / 20
     print(json.dumps({'measure': 'rtt_trivial_op_ms',
                       'value': round(rtt * 1e3, 2)}), flush=True)
 
@@ -71,10 +71,11 @@ def main() -> None:
     host_batches = benchlib.random_batches(SHAPES, 4)
 
     # --- upload cost for one batch
-    t0 = time.perf_counter()
-    dev_batches = [jax.block_until_ready(arrays) for arrays, _ in
-                   trainer.stage_batches(iter(host_batches))]
-    h2d = (time.perf_counter() - t0) / len(host_batches)
+    sw = benchlib.bench_timer('h2d')
+    with sw.time():
+        dev_batches = [jax.block_until_ready(arrays) for arrays, _ in
+                       trainer.stage_batches(iter(host_batches))]
+    h2d = sw.last / len(host_batches)
     print(json.dumps({'measure': 'h2d_one_batch_ms',
                       'value': round(h2d * 1e3, 2)}), flush=True)
 
@@ -91,10 +92,10 @@ def main() -> None:
                           'format': wire_label,
                           'value': benchlib.wire_bytes(wire_batches[0])}),
               flush=True)
-        t0 = time.perf_counter()
-        for arrays, _b in trainer.stage_batches(iter(wire_batches)):
-            jax.block_until_ready(arrays)
-        dt = (time.perf_counter() - t0) / len(wire_batches)
+        with sw.time():
+            for arrays, _b in trainer.stage_batches(iter(wire_batches)):
+                jax.block_until_ready(arrays)
+        dt = sw.last / len(wire_batches)
         print(json.dumps({'measure': 'h2d_one_batch_%s_ms' % wire_label,
                           'value': round(dt * 1e3, 2)}), flush=True)
 
@@ -109,29 +110,37 @@ def main() -> None:
     for device, index in sharding.addressable_devices_indices_map(
             ctx.shape).items():
         piece = np.ascontiguousarray(ctx[index])
-        t0 = time.perf_counter()
-        jax.block_until_ready(jax.device_put(piece, device))
-        per_shard.append(round((time.perf_counter() - t0) * 1e3, 2))
+        with sw.time():
+            jax.block_until_ready(jax.device_put(piece, device))
+        per_shard.append(round(sw.last * 1e3, 2))
     print(json.dumps({'measure': 'h2d_per_shard_ms', 'format': 'packed',
                       'n_shards': len(per_shard), 'values': per_shard}),
           flush=True)
 
     def timed(label, step_fn, init_state, feeds, sync_each):
         """Warmup + measure one step function; returns the final state so
-        variants can keep training off their own state."""
+        variants can keep training off their own state.  sync_each times
+        every step individually (per-step stats via the shared Timer);
+        sync_end times the whole enqueued window and amortizes the one
+        blocking sync."""
         st = init_state
         for i in range(WARMUP):
             st, loss = step_fn(st, feeds[i % len(feeds)])
             float(loss)
-        t0 = time.perf_counter()
+        timer = benchlib.bench_timer(label)
         last = None
-        for i in range(STEPS):
-            st, last = step_fn(st, feeds[i % len(feeds)])
-            if sync_each:
+        if sync_each:
+            for i in range(STEPS):
+                with timer.time():
+                    st, last = step_fn(st, feeds[i % len(feeds)])
+                    float(last)
+            dt = timer.total / STEPS
+        else:
+            with timer.time():
+                for i in range(STEPS):
+                    st, last = step_fn(st, feeds[i % len(feeds)])
                 float(last)
-        if not sync_each:
-            float(last)
-        dt = (time.perf_counter() - t0) / STEPS
+            dt = timer.last / STEPS
         print(json.dumps(
             {'measure': label, 'value': round(dt * 1e3, 2),
              'examples_per_sec': round(SHAPES.batch_size / dt, 1)}),
@@ -152,21 +161,20 @@ def main() -> None:
     total_bytes = sum(np.asarray(a).nbytes for a in host_batches[0])
     flat = np.zeros(total_bytes // 4, np.int32)
     jax.block_until_ready(jax.device_put(flat))
-    t0 = time.perf_counter()
-    for _ in range(5):
-        jax.block_until_ready(jax.device_put(flat))
+    with sw.time():
+        for _ in range(5):
+            jax.block_until_ready(jax.device_put(flat))
     print(json.dumps({'measure': 'h2d_packed_same_bytes_ms',
-                      'value': round((time.perf_counter() - t0) / 5 * 1e3,
-                                     2)}), flush=True)
+                      'value': round(sw.last / 5 * 1e3, 2)}), flush=True)
 
     # --- does stage_batches overlap uploads behind compute end-to-end?
     fresh = benchlib.random_batches(SHAPES, STEPS, seed=1)
     last = None
-    t0 = time.perf_counter()
-    for arrays, _b in trainer.stage_batches(iter(fresh)):
-        state, last = trainer.train_step_placed(state, arrays)
-    float(last)
-    dt = (time.perf_counter() - t0) / STEPS
+    with sw.time():
+        for arrays, _b in trainer.stage_batches(iter(fresh)):
+            state, last = trainer.train_step_placed(state, arrays)
+        float(last)
+    dt = sw.last / STEPS
     print(json.dumps(
         {'measure': 'step_ms_staged_hostargs_end_to_end',
          'value': round(dt * 1e3, 2),
@@ -187,11 +195,11 @@ def main() -> None:
     for wire_label, feed in (('filled', filled_feed),
                              ('packed', packed_feed)):
         last = None
-        t0 = time.perf_counter()
-        for arrays, _b in trainer.stage_batches(iter(feed)):
-            state, last = trainer.train_step_placed(state, arrays)
-        float(last)
-        dt = (time.perf_counter() - t0) / STEPS
+        with sw.time():
+            for arrays, _b in trainer.stage_batches(iter(feed)):
+                state, last = trainer.train_step_placed(state, arrays)
+            float(last)
+        dt = sw.last / STEPS
         print(json.dumps(
             {'measure': 'step_ms_staged_hostargs_%s' % wire_label,
              'value': round(dt * 1e3, 2),
@@ -287,13 +295,14 @@ def main() -> None:
             values, _ = stepped(logits, token)
             token = values[0, 0]
         float(token)
-        t0 = time.perf_counter()
-        token = jnp.zeros((), jnp.float32)
-        for _ in range(10):
-            values, _ = stepped(logits, token)
-            token = values[0, 0]
-        float(token)
-        dt = (time.perf_counter() - t0) / 10
+        topk_sw = benchlib.bench_timer(label)
+        with topk_sw.time():
+            token = jnp.zeros((), jnp.float32)
+            for _ in range(10):
+                values, _ = stepped(logits, token)
+                token = values[0, 0]
+            float(token)
+        dt = topk_sw.last / 10
         print(json.dumps({'measure': label, 'value': round(dt * 1e3, 2)}),
               flush=True)
 

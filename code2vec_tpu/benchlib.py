@@ -38,6 +38,23 @@ def smoke_requested() -> bool:
     return os.environ.get('BENCH_SMOKE', '') not in ('', '0', 'false')
 
 
+def bench_timer(name: str = 'bench', window: int = 1024):
+    """A telemetry ``Timer`` for benchmark loops — the shared timer API
+    (code2vec_tpu/telemetry/core.py) the timed harnesses use instead of
+    hand-rolled ``time.perf_counter`` arithmetic:
+
+        sw = benchlib.bench_timer()
+        with sw.time():
+            <timed region>
+        seconds = sw.last          # or .total / .snapshot() for stats
+
+    Standalone instrument, NOT registered in the process-global registry:
+    benchmark timings must never leak into a live run's exported
+    metrics."""
+    from code2vec_tpu.telemetry.core import Timer
+    return Timer(name, window=window)
+
+
 def bench_steps(smoke: bool):
     """(warmup_steps, measure_steps) shared by every timed harness.
     60 measure steps keep the one amortized tunnel round-trip <2.5% at

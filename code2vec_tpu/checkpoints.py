@@ -22,12 +22,17 @@ from __future__ import annotations
 
 import inspect
 import json
+import logging
 import os
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+# package logger: 'code2vec_tpu.checkpoints' — propagates to the
+# 'code2vec_tpu' root logger Config.get_logger configures
+logger = logging.getLogger(__name__)
 
 from code2vec_tpu.config import Config
 
@@ -340,8 +345,7 @@ class CheckpointStore:
             return rows
         sidecar = self._stored_target_rows()
         if sidecar is not None:
-            import logging
-            logging.getLogger(__name__).warning(
+            logger.warning(
                 'checkpoint %s: per-artifact row metadata unavailable '
                 '(%s); falling back to the shared sidecar value %d, which '
                 'may be wrong for older artifacts', self.model_path,
@@ -499,8 +503,7 @@ class CheckpointStore:
             abstract_opt_state = _with_target_rows(abstract_opt_state,
                                                    stored_rows)
         for field, stored_dt in moment_mismatch.items():
-            import logging
-            logging.getLogger(__name__).warning(
+            logger.warning(
                 'checkpoint %s stores Adam %s as %s but the configured '
                 'ADAM_%s_DTYPE differs: restoring as stored, then casting '
                 '(set --adam-%s-dtype %s to resume bit-exactly)',

@@ -233,6 +233,30 @@ class Config:
     PROFILE_DIR: Optional[str] = None
     PROFILE_START_STEP: int = 10
     PROFILE_NUM_STEPS: int = 5
+    # ---- telemetry (code2vec_tpu/telemetry/, OBSERVABILITY.md) ----
+    # Master switch for the step-phase/pipeline telemetry layer: phase
+    # timers (batch-wait / h2d / dispatch / sync), throughput counters,
+    # staging-ring occupancy, jit-compile tracking, and the JSONL /
+    # Prometheus-textfile / console exporters. Off by default: the hot
+    # loop then carries only `is None` checks (measured <1% either way,
+    # benchmarks/bench_telemetry_overhead.py).
+    TELEMETRY: bool = False
+    # Where telemetry artifacts (metrics.jsonl, metrics.prom, traces/)
+    # land; None resolves next to the model artifacts like the
+    # metrics_writer 'summaries' convention (telemetry/stepwatch.py).
+    TELEMETRY_DIR: Optional[str] = None
+    # Exporter flush cadence, in train steps. Rates (examples/sec) are
+    # computed per flush window.
+    TELEMETRY_FLUSH_EVERY_STEPS: int = 50
+    # Minimum seconds between telemetry console progress lines.
+    TELEMETRY_CONSOLE_EVERY_SECS: float = 30.0
+    # On-demand jax.profiler capture: start a TELEMETRY_TRACE_NUM_STEPS
+    # trace when this global step is reached (-1: disabled; the
+    # TELEMETRY_TRACE_AT_STEP env var fills in when the field is unset,
+    # and `touch <telemetry_dir>/TRACE_NOW` triggers a capture from a
+    # LIVE run with no restart — telemetry/trace.py).
+    TELEMETRY_TRACE_AT_STEP: int = -1
+    TELEMETRY_TRACE_NUM_STEPS: int = 5
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -371,6 +395,21 @@ class Config:
                             help='staging-ring depth: batches placed on '
                                  'device ahead of the consuming step '
                                  '(DEVICE_PREFETCH_BATCHES; 0 disables)')
+        parser.add_argument('--telemetry', dest='telemetry',
+                            action='store_true',
+                            help='enable the telemetry layer: step-phase '
+                                 'timers, throughput counters, JSONL + '
+                                 'Prometheus exporters (OBSERVABILITY.md)')
+        parser.add_argument('--telemetry-dir', dest='telemetry_dir',
+                            default=None, metavar='DIR',
+                            help='directory for telemetry artifacts '
+                                 '(default: next to the model artifacts)')
+        parser.add_argument('--trace-at-step', dest='trace_at_step',
+                            type=int, default=None, metavar='N',
+                            help='capture an on-demand jax.profiler trace '
+                                 'when global step N is reached (implies '
+                                 '--telemetry; live runs can instead touch '
+                                 '<telemetry_dir>/TRACE_NOW)')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -439,6 +478,25 @@ class Config:
             self.BATCH_WIRE_FORMAT = parsed.wire_format
         if parsed.device_prefetch is not None:
             self.DEVICE_PREFETCH_BATCHES = parsed.device_prefetch
+        if parsed.telemetry:
+            self.TELEMETRY = True
+        if parsed.telemetry_dir:
+            self.TELEMETRY_DIR = parsed.telemetry_dir
+        if parsed.trace_at_step is not None:
+            self.TELEMETRY_TRACE_AT_STEP = parsed.trace_at_step
+            self.TELEMETRY = True  # a trace request implies the layer
+        elif self.TELEMETRY_TRACE_AT_STEP < 0:
+            # the env var is for runs launched by scripts you can't edit
+            # (OBSERVABILITY.md) — so it must imply the telemetry layer
+            # exactly like the flag does, or it is silently inert
+            try:
+                env_step = int(os.environ.get('TELEMETRY_TRACE_AT_STEP',
+                                              '-1'))
+            except ValueError:
+                env_step = -1
+            if env_step >= 0:
+                self.TELEMETRY_TRACE_AT_STEP = env_step
+                self.TELEMETRY = True
         return self
 
     # ------------------------------------------------------- derived props
@@ -590,6 +648,12 @@ class Config:
         # simply not consumed on that path. Now that 'bfloat16' is the
         # DEFAULT, raising here would break lazy users who never touched
         # the knob — the trainer logs the ignored-knob warning instead.
+        if self.TELEMETRY_FLUSH_EVERY_STEPS < 1:
+            raise ValueError(
+                'config.TELEMETRY_FLUSH_EVERY_STEPS must be >= 1.')
+        if self.TELEMETRY_TRACE_NUM_STEPS < 1:
+            raise ValueError(
+                'config.TELEMETRY_TRACE_NUM_STEPS must be >= 1.')
         if self.BATCH_WIRE_FORMAT not in {'planes', 'packed'}:
             raise ValueError("config.BATCH_WIRE_FORMAT must be in "
                              "{'planes', 'packed'}.")

@@ -158,12 +158,21 @@ class TokenCache:
                 meta = json.load(f)
             return all(meta.get(k) == v for k, v in expected.items())
 
+        from code2vec_tpu.telemetry import core as tele_core
         if is_fresh():
+            if tele_core.enabled():
+                tele_core.registry().counter('input/cache_hit_total').inc()
             return cls(cache_dir, config, vocabs)
         with _build_lock(cache_dir + '.lock'):
             # another process may have built it while we waited
             if not is_fresh():
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'input/cache_miss_total').inc()
                 cls._build(config, reader, cache_dir, expected)
+            elif tele_core.enabled():
+                # a concurrent trainer built it while we held the lock
+                tele_core.registry().counter('input/cache_hit_total').inc()
             return cls(cache_dir, config, vocabs)
 
     @classmethod
@@ -231,19 +240,18 @@ class TokenCache:
         ``wire_format`` ('planes' default / 'packed') selects the emitted
         batch type independently of the ON-DISK version — a v1 cache can
         feed the packed wire and vice versa."""
+        from code2vec_tpu.data.reader import _counted_batches
         wire_format = wire_format or 'planes'
         if self.version >= 2:
-            yield from self._iter_epoch_v2(batch_size, shuffle, seed,
-                                           chunk_rows, wire_format,
-                                           data_shards)
+            yield from _counted_batches(
+                self._iter_epoch_v2(batch_size, shuffle, seed, chunk_rows,
+                                    wire_format, data_shards))
             return
         batches = self._iter_epoch_v1(batch_size, shuffle, seed, chunk_rows)
         if wire_format == 'packed':
             packer = self._packer_for(data_shards)
-            for batch in batches:
-                yield packer.pack_batch(batch)
-        else:
-            yield from batches
+            batches = (packer.pack_batch(batch) for batch in batches)
+        yield from _counted_batches(batches)
 
     # ------------------------------------------------------------ v2 path
     def _emit_v2(self, ctx_rows: np.ndarray, count: np.ndarray,

@@ -44,6 +44,7 @@ so the data layer stays importable without it.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -167,7 +168,13 @@ class StickyPacker:
     """Packs a stream of batches under a monotonically GROWING capacity:
     totals that straddle a bucket boundary reuse the larger jitted
     program instead of ping-ponging specializations. One instance per
-    data source (reader / cache), living across epochs."""
+    data source (reader / cache), living across epochs.
+
+    Instrumented (telemetry enabled only — one bool read otherwise):
+    pack time (``step/pack_ms``, recorded from whichever reader/prefetch
+    thread packs) and the packed fill rate (retained slots / wire
+    capacity — the padding waste the capacity buckets trade for fewer
+    jit specializations)."""
 
     def __init__(self, token_pad: int, path_pad: int, data_shards: int = 1,
                  minimum: int = MIN_CAPACITY):
@@ -176,18 +183,36 @@ class StickyPacker:
         self.data_shards = data_shards
         self.capacity = minimum
 
+    @staticmethod
+    def _record(seconds: float, ctx: np.ndarray, retained: int) -> None:
+        from code2vec_tpu.telemetry import core
+        reg = core.registry()
+        reg.timer('step/pack_ms').record(seconds)
+        slots = int(ctx.shape[0]) * int(ctx.shape[1])
+        reg.gauge('input/packed_fill_rate').set(retained / max(slots, 1))
+
     def pack_batch(self, batch) -> PackedBatch:
+        from code2vec_tpu.telemetry import core
+        t0 = _time.perf_counter() if core.enabled() else 0.0
         packed = pack_batch(batch, self.token_pad, self.path_pad,
                             data_shards=self.data_shards,
                             capacity_minimum=self.capacity)
         self.capacity = max(self.capacity, packed.ctx.shape[1])
+        if core.enabled():
+            self._record(_time.perf_counter() - t0, packed.ctx,
+                         int(packed.count.sum()))
         return packed
 
     def pack_ragged(self, ctx_rows: np.ndarray,
                     count: np.ndarray) -> np.ndarray:
+        from code2vec_tpu.telemetry import core
+        t0 = _time.perf_counter() if core.enabled() else 0.0
         ctx = pack_ragged(ctx_rows, count, self.token_pad, self.path_pad,
                           self.data_shards, capacity_minimum=self.capacity)
         self.capacity = max(self.capacity, ctx.shape[1])
+        if core.enabled():
+            self._record(_time.perf_counter() - t0, ctx,
+                         int(count.sum()))
         return ctx
 
 

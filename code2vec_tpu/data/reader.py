@@ -72,6 +72,16 @@ def context_valid_mask(source: np.ndarray, path: np.ndarray,
             | (path != path_pad)).astype(np.float32)
 
 
+def _counted_batches(batches):
+    """Pass-through that counts emitted batches into the telemetry
+    pipeline counter (one bool read per batch when telemetry is off)."""
+    from code2vec_tpu.telemetry import core
+    for batch in batches:
+        if core.enabled():
+            core.registry().counter('input/batches_total').inc()
+        yield batch
+
+
 def prefetch_iterator(make_iterator, depth: int):
     """Run ``make_iterator()`` in a background thread with a bounded queue
     (the reference's ``prefetch``, path_context_reader.py:150). Safe to
@@ -476,10 +486,8 @@ class PathContextReader:
                     self.vocabs.token_vocab.pad_index,
                     self.vocabs.path_vocab.pad_index,
                     data_shards=self.data_shards)
-            for batch in batches:
-                yield self._packer.pack_batch(batch)
-        else:
-            yield from batches
+            batches = (self._packer.pack_batch(batch) for batch in batches)
+        yield from _counted_batches(batches)
 
     def iter_epoch_prefetched(self, shuffle: Optional[bool] = None,
                               seed: Optional[int] = None,

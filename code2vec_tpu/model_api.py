@@ -297,6 +297,13 @@ class Code2VecModel:
                 writer.scalar('train/loss', avg_loss, step)
                 writer.scalar('train/examples_per_sec', throughput, step)
 
+        def on_epoch_time(epoch: int, batch_num: int, seconds: float
+                          ) -> None:
+            # epoch wall time on the same (global batch) step axis as
+            # every other scalar stream
+            if writer is not None:
+                writer.scalar('train/epoch_wall_time_s', seconds, batch_num)
+
         # one eval+log helper for both callbacks; the metric step axis is
         # ALWAYS the global batch number (mixing epoch and batch steps on
         # one tag corrupts the stream)
@@ -306,7 +313,9 @@ class Code2VecModel:
         self.eval_history = []
 
         def _evaluate_and_log(label: str, step: int, params) -> None:
+            eval_t0 = time.time()
             results = self.evaluate(params=params)
+            eval_wall = time.time() - eval_t0
             self.eval_history.append({
                 'label': label, 'step': step,
                 'topk_acc': [float(x) for x in results.topk_acc],
@@ -322,6 +331,11 @@ class Code2VecModel:
                               results.subtoken_precision, step)
                 writer.scalar('eval/subtoken_recall',
                               results.subtoken_recall, step)
+                writer.scalar('eval/wall_time_s', eval_wall, step)
+                # eval scalars arrive at most once per eval interval:
+                # make them durable now rather than at the next buffer
+                # fill (writes are buffered, metrics_writer.py)
+                writer.flush()
 
         # both save cadences funnel through one guard: an epoch boundary
         # save must not be duplicated by the interval firing at the top of
@@ -374,7 +388,8 @@ class Code2VecModel:
                 on_eval_interval=(on_eval_interval
                                   if run_evals else None),
                 on_save_interval=(on_save_interval
-                                  if save_store is not None else None))
+                                  if save_store is not None else None),
+                on_epoch_time=on_epoch_time)
         finally:
             # drain in-flight async checkpoint saves even when training
             # raises: a commenced save must end up durable

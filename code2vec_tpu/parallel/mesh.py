@@ -205,6 +205,7 @@ def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False,
                 sharding, np.asarray(a), global_shape))
         return tuple(out)
     if direct and mesh.size > 1:
+        from code2vec_tpu.telemetry import core as tele_core
         out = []
         for a in arrays:
             a = np.asarray(a)
@@ -212,9 +213,16 @@ def shard_batch(arrays, mesh: Mesh, shard_contexts: bool = False,
                                      batch_spec(a.ndim, shard_contexts))
             index_map = sharding.addressable_devices_indices_map(a.shape)
             devices = list(index_map)
-            pieces = jax.device_put(
-                [np.ascontiguousarray(a[index_map[d]]) for d in devices],
-                devices)
+            if tele_core.enabled():
+                # named scope so per-shard placement slicing shows up
+                # against the device lanes in a profiler capture
+                with jax.profiler.TraceAnnotation('host/shard_slice'):
+                    slices = [np.ascontiguousarray(a[index_map[d]])
+                              for d in devices]
+            else:
+                slices = [np.ascontiguousarray(a[index_map[d]])
+                          for d in devices]
+            pieces = jax.device_put(slices, devices)
             out.append(jax.make_array_from_single_device_arrays(
                 a.shape, sharding, pieces))
         return tuple(out)

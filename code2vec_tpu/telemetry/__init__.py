@@ -1,0 +1,41 @@
+"""Unified telemetry layer: counters, gauges, windowed timers, exporters,
+and the trainer's step-phase instrumentation.
+
+The reference's only observability was a Keras TensorBoard callback
+(config.py:42-43, keras_model.py:158-163); this package is the
+MLPerf-style telemetry layer the north-star workloads need — continuous
+throughput/latency accounting in the hot loop, not one-off bench scripts.
+
+Layout:
+
+- ``core``        — Counter / Gauge / Timer instruments + the process-global
+                    thread-safe Registry.  Dependency-free (stdlib only).
+- ``catalog``     — the metric catalog (names, units, help); the single
+                    source of truth ``scripts/check_metrics_schema.py``
+                    lints emission sites against.
+- ``exporters``   — JSONL sink, rate-limited console line, Prometheus
+                    textfile.
+- ``jit_tracker`` — jax.monitoring compile listener + the packed-capacity
+                    re-specialization tracker.
+- ``trace``       — on-demand ``jax.profiler`` capture (config step or
+                    touch-file trigger).
+- ``stepwatch``   — ``StepTelemetry``, the trainer-facing bundle wiring
+                    the above together.
+
+Everything imports jax lazily (same policy as ``data/packed.py``) so the
+core stays importable — and testable — without an accelerator stack.
+
+Cost model: one process-global ``enabled()`` flag.  When off (the
+default), instrumented call sites reduce to a single ``is None`` /
+``enabled()`` check — no clocks are read, no instruments are touched, no
+files are opened.
+"""
+from __future__ import annotations
+
+from code2vec_tpu.telemetry.core import (Counter, Gauge, Registry, Timer,
+                                         disable, enable, enabled, registry,
+                                         reset)
+from code2vec_tpu.telemetry.stepwatch import StepTelemetry
+
+__all__ = ['Counter', 'Gauge', 'Registry', 'Timer', 'StepTelemetry',
+           'disable', 'enable', 'enabled', 'registry', 'reset']

@@ -1,0 +1,105 @@
+"""The metric catalog: every metric name this codebase may emit, with
+type, unit, and help text.
+
+Single source of truth, three consumers:
+
+- ``scripts/check_metrics_schema.py`` lints every emission site (telemetry
+  instruments AND ``MetricsWriter.scalar`` tags) against this table, so a
+  typo'd or renamed metric fails tier-1 instead of silently forking the
+  time series;
+- the Prometheus exporter derives the ``# HELP`` / ``# TYPE`` header from
+  it;
+- ``OBSERVABILITY.md`` documents it (keep in sync — the lint checks the
+  doc mentions every name).
+
+Naming: ``<subsystem>/<metric>[_<unit>]``.  Units in names: ``_ms``
+(milliseconds), ``_s`` (seconds), ``_total`` (monotonic counts),
+``_per_sec`` (rates).  Prometheus names are derived as
+``code2vec_<name with / -> _>``.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+COUNTER = 'counter'
+GAUGE = 'gauge'
+TIMER = 'timer'
+SCALAR = 'scalar'   # MetricsWriter.scalar tags (per-step JSONL series)
+
+
+def _m(mtype: str, unit: str, help_text: str) -> Dict[str, str]:
+    return {'type': mtype, 'unit': unit, 'help': help_text}
+
+
+CATALOG: Dict[str, Dict[str, str]] = {
+    # ---- step-phase breakdown (trainer hot loop) ----
+    'step/batch_wait_ms': _m(TIMER, 'ms', 'Host wait for the next staged '
+                             'batch (input pipeline starvation).'),
+    'step/h2d_ms': _m(TIMER, 'ms', 'Dispatch of the async host->device '
+                      'placement of one batch (staging ring).'),
+    'step/dispatch_ms': _m(TIMER, 'ms', 'Enqueue of the jitted train step '
+                           '(async; device time only on blocking backends).'),
+    'step/sync_ms': _m(TIMER, 'ms', 'Blocking device->host sync at the log '
+                       'window (drains the dispatched window).'),
+    'step/total_ms': _m(TIMER, 'ms', 'Full hot-loop iteration (wait + '
+                        'dispatch + callbacks).'),
+    'step/pack_ms': _m(TIMER, 'ms', 'Host-side packing of one batch into '
+                       'the packed wire format (reader/cache thread).'),
+    # ---- throughput ----
+    'train/steps_total': _m(COUNTER, 'steps', 'Train steps dispatched.'),
+    'train/examples_total': _m(COUNTER, 'examples', 'Valid (weight>0) '
+                               'examples consumed by train steps.'),
+    'train/contexts_total': _m(COUNTER, 'contexts', 'Valid path-contexts '
+                               'consumed by train steps.'),
+    'train/examples_per_sec': _m(GAUGE, 'examples/s', 'Windowed training '
+                                 'throughput (since last telemetry flush).'),
+    'train/contexts_per_sec': _m(GAUGE, 'contexts/s', 'Windowed context '
+                                 'throughput (since last telemetry flush).'),
+    'train/epoch_wall_time_s': _m(GAUGE, 's', 'Wall time of the last '
+                                  "epoch's training loop (includes interval "
+                                  'evals; excludes epoch-end eval/save).'),
+    # ---- staging ring ----
+    'staging/ring_occupancy': _m(GAUGE, 'batches', 'Batches currently held '
+                                 'in the device staging ring.'),
+    'staging/ring_depth': _m(GAUGE, 'batches', 'Configured staging-ring '
+                             'depth (DEVICE_PREFETCH_BATCHES, after the '
+                             'platform clamp).'),
+    # ---- jit compilation ----
+    'jit/compiles_total': _m(COUNTER, 'compiles', 'XLA backend compiles in '
+                             'this process (jax.monitoring).'),
+    'jit/compile_ms': _m(TIMER, 'ms', 'XLA backend compile durations.'),
+    'jit/respecializations_total': _m(COUNTER, 'compiles', 'Packed-capacity '
+                                      're-specializations of the step '
+                                      'program observed by the trainer.'),
+    'jit/packed_capacity': _m(GAUGE, 'slots', 'Current packed-wire context '
+                              'capacity bucket feeding the step.'),
+    # ---- input pipeline ----
+    'input/cache_hit_total': _m(COUNTER, 'caches', 'Token-cache opens that '
+                                'found a fresh on-disk cache.'),
+    'input/cache_miss_total': _m(COUNTER, 'caches', 'Token-cache opens that '
+                                 'had to (re)build the cache.'),
+    'input/batches_total': _m(COUNTER, 'batches', 'Batches emitted by the '
+                              'host input pipeline.'),
+    'input/packed_fill_rate': _m(GAUGE, 'fraction', 'Retained context slots '
+                                 '/ packed wire capacity of the last packed '
+                                 'batch (padding waste = 1 - this).'),
+    # ---- profiler capture ----
+    'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
+                               'trace captures completed.'),
+    # ---- MetricsWriter scalar tags (per-step JSONL series) ----
+    'train/loss': _m(SCALAR, 'nats', 'Windowed average training loss.'),
+    'eval/top1_acc': _m(SCALAR, 'fraction', 'Top-1 exact-match accuracy.'),
+    'eval/subtoken_f1': _m(SCALAR, 'fraction', 'Subtoken F1.'),
+    'eval/subtoken_precision': _m(SCALAR, 'fraction', 'Subtoken precision.'),
+    'eval/subtoken_recall': _m(SCALAR, 'fraction', 'Subtoken recall.'),
+    'eval/wall_time_s': _m(SCALAR, 's', 'Wall time of one full evaluation '
+                           'pass.'),
+}
+# train/examples_per_sec and train/epoch_wall_time_s double as
+# MetricsWriter scalar tags (model_api.train's on_log / on_epoch_time);
+# the lint accepts either emission form for any cataloged name.
+
+
+def prometheus_name(name: str) -> str:
+    """Catalog name -> Prometheus metric name."""
+    return 'code2vec_' + name.replace('/', '_').replace('.', '_')

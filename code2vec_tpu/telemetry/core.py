@@ -1,0 +1,244 @@
+"""Telemetry core: counters, gauges, windowed timers, and the
+process-global registry.
+
+Design constraints (ISSUE 2 tentpole (a)):
+
+- **Dependency-free** — stdlib only, importable from the data layer and
+  the benchmarks without jax.
+- **Thread-safe** — the input pipeline records from its prefetch thread
+  while the training thread records step phases.  Each instrument guards
+  its state with one lock; the registry guards get-or-create.
+- **Near-zero cost when disabled** — call sites gate on ``enabled()``
+  (one module-global bool read); nothing here allocates or reads clocks
+  until a site decides to record.
+
+Instruments are identified by catalog names (``telemetry/catalog.py``);
+``scripts/check_metrics_schema.py`` lints every emission site against the
+catalog so names cannot silently drift.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, Iterator, Optional, Tuple
+
+__all__ = ['Counter', 'Gauge', 'Timer', 'Registry', 'registry', 'reset',
+           'enable', 'disable', 'enabled']
+
+# Module-global enablement. One bool read is the entire disabled-path
+# cost at instrumented call sites.
+_ENABLED = False
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; resets only via ``Registry.reset``."""
+
+    __slots__ = ('name', '_value', '_lock')
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (ring occupancy, fill rate, rates)."""
+
+    __slots__ = ('name', '_value', '_lock')
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> float:
+        return self._value
+
+
+class _TimerContext:
+    """Re-usable ``with timer.time():`` context. A fresh tiny object per
+    entry keeps the timer itself re-entrant across threads."""
+
+    __slots__ = ('_timer', '_t0')
+
+    def __init__(self, timer: 'Timer'):
+        self._timer = timer
+        self._t0 = 0.0
+
+    def __enter__(self) -> '_TimerContext':
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._timer.record(time.perf_counter() - self._t0)
+
+
+class Timer:
+    """Windowed duration statistics.
+
+    Records durations in SECONDS; snapshots report milliseconds (metric
+    names carry the ``_ms`` suffix).  Keeps cumulative ``count``/``total``
+    plus a bounded window of recent samples; mean/percentiles/max are all
+    computed over the window, so a long-running trainer's stats track the
+    CURRENT regime, not the all-time mix (a warmup compile would
+    otherwise poison the tail — and the max — forever).
+    """
+
+    __slots__ = ('name', 'window', '_samples', '_count', '_total',
+                 '_last', '_lock')
+
+    def __init__(self, name: str = '', window: int = 512):
+        self.name = name
+        self.window = window
+        self._samples: Deque[float] = collections.deque(maxlen=window)
+        self._count = 0
+        self._total = 0.0
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def time(self) -> _TimerContext:
+        return _TimerContext(self)
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(seconds)
+            self._count += 1
+            self._total += seconds
+            self._last = seconds
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> float:
+        """Cumulative seconds across ALL samples (not just the window)."""
+        return self._total
+
+    @property
+    def last(self) -> float:
+        """Most recent sample, in seconds."""
+        return self._last
+
+    def snapshot(self) -> Dict[str, float]:
+        """{count, mean_ms, p50_ms, p95_ms, max_ms, last_ms, total_s} —
+        mean/percentiles/max over the recent window, count/total
+        cumulative."""
+        with self._lock:
+            samples = sorted(self._samples)
+            count, total = self._count, self._total
+            last = self._last
+        if not samples:
+            return {'count': 0, 'mean_ms': 0.0, 'p50_ms': 0.0, 'p95_ms': 0.0,
+                    'max_ms': 0.0, 'last_ms': 0.0, 'total_s': 0.0}
+
+        def pct(q: float) -> float:
+            # nearest-rank on the sorted window
+            idx = min(len(samples) - 1, max(0, int(q * len(samples))))
+            return samples[idx] * 1e3
+
+        return {'count': count,
+                'mean_ms': sum(samples) / len(samples) * 1e3,
+                'p50_ms': pct(0.50), 'p95_ms': pct(0.95),
+                'max_ms': samples[-1] * 1e3, 'last_ms': last * 1e3,
+                'total_s': total}
+
+
+class Registry:
+    """Thread-safe name -> instrument map with get-or-create accessors.
+
+    One process-global instance (``registry()``): the input pipeline, the
+    trainer, and the exporters all see the same instruments without
+    threading a handle through every layer.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, object] = {}
+
+    def _get_or_create(self, name: str, cls, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, **kwargs)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    'metric %r is already registered as %s, not %s'
+                    % (name, type(inst).__name__, cls.__name__))
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def timer(self, name: str, window: int = 512) -> Timer:
+        return self._get_or_create(name, Timer, window=window)
+
+    def items(self) -> Iterator[Tuple[str, object]]:
+        with self._lock:
+            return iter(sorted(self._instruments.items()))
+
+    def get(self, name: str) -> Optional[object]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def snapshot(self) -> Dict[str, object]:
+        """{name: scalar | timer-stat dict} for every instrument, in name
+        order — the exporters' input."""
+        return {name: inst.snapshot() for name, inst in self.items()}
+
+    def reset(self) -> None:
+        """Drop every instrument (test isolation; a fresh run re-creates
+        what it touches)."""
+        with self._lock:
+            self._instruments.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry() -> Registry:
+    """The process-global registry."""
+    return _REGISTRY
+
+
+def reset() -> None:
+    """Clear the process-global registry (use between tests)."""
+    _REGISTRY.reset()

@@ -1,0 +1,128 @@
+"""Telemetry exporters: JSONL sink, rate-limited console line, and
+Prometheus textfile.
+
+All three consume ``Registry.snapshot()`` — one walk of the instruments
+per flush, not per record.  Flushing is periodic (every
+``TELEMETRY_FLUSH_EVERY_STEPS`` steps from the trainer), so the hot loop
+never touches a file.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from code2vec_tpu.telemetry import catalog
+from code2vec_tpu.telemetry.core import Counter, Gauge, Registry, Timer
+
+
+class JsonlExporter:
+    """Append registry snapshots to ``<dir>/metrics.jsonl`` — the same
+    ``{tag, value, step, time}`` schema as ``MetricsWriter`` (timers add
+    their stat fields), so one plotting script reads both streams.
+
+    Opens the file per flush (append mode): no long-lived handle to leak,
+    and flushes are infrequent by design.
+    """
+
+    def __init__(self, logdir: str, filename: str = 'metrics.jsonl'):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self.path = os.path.join(logdir, filename)
+
+    def flush(self, registry: Registry, step: int) -> None:
+        now = time.time()
+        lines = []
+        for name, inst in registry.items():
+            record = {'tag': name, 'step': int(step), 'time': now}
+            if isinstance(inst, Timer):
+                stats = inst.snapshot()
+                if not stats['count']:
+                    continue
+                record['value'] = stats['mean_ms']
+                record.update(stats)
+            else:
+                record['value'] = inst.snapshot()
+            lines.append(json.dumps(record))
+        if not lines:
+            return
+        with open(self.path, 'a') as f:
+            f.write('\n'.join(lines) + '\n')
+
+
+class PrometheusExporter:
+    """Textfile export for scraping (node_exporter textfile collector or a
+    sidecar): the CURRENT state, rewritten atomically each flush."""
+
+    def __init__(self, logdir: str, filename: str = 'metrics.prom'):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self.path = os.path.join(logdir, filename)
+
+    def flush(self, registry: Registry, step: int) -> None:
+        out = []
+        for name, inst in registry.items():
+            prom = catalog.prometheus_name(name)
+            meta = catalog.CATALOG.get(name)
+            if meta is not None:
+                out.append('# HELP %s %s' % (prom, meta['help']))
+            if isinstance(inst, Counter):
+                out.append('# TYPE %s counter' % prom)
+                out.append('%s %d' % (prom, inst.snapshot()))
+            elif isinstance(inst, Gauge):
+                out.append('# TYPE %s gauge' % prom)
+                out.append('%s %.17g' % (prom, inst.snapshot()))
+            elif isinstance(inst, Timer):
+                # per-stat gauge families, NOT a 'summary': the summary
+                # exposition requires {quantile=...} + _sum series, and
+                # strict expfmt parsers drop the whole file on violation
+                stats = inst.snapshot()
+                for stat in ('mean_ms', 'p50_ms', 'p95_ms', 'max_ms'):
+                    out.append('# TYPE %s_%s gauge' % (prom, stat))
+                    out.append('%s_%s %.17g' % (prom, stat, stats[stat]))
+                out.append('# TYPE %s_count counter' % prom)
+                out.append('%s_count %d' % (prom, stats['count']))
+        tmp = self.path + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write('\n'.join(out) + '\n')
+        os.replace(tmp, self.path)  # scrapers never see a torn file
+
+
+class ConsoleExporter:
+    """One compact progress line through the run logger, rate-limited so a
+    fast step loop cannot flood the console."""
+
+    def __init__(self, log, min_interval_s: float = 30.0):
+        self.log = log
+        self.min_interval_s = min_interval_s
+        self._last_emit = 0.0
+
+    @staticmethod
+    def _ms(registry: Registry, name: str) -> float:
+        inst = registry.get(name)
+        return inst.snapshot()['mean_ms'] if isinstance(inst, Timer) else 0.0
+
+    def flush(self, registry: Registry, step: int) -> None:
+        now = time.monotonic()
+        if now - self._last_emit < self.min_interval_s:
+            return
+        self._last_emit = now
+
+        def gauge(name: str) -> float:
+            inst = registry.get(name)
+            return inst.snapshot() if isinstance(inst, Gauge) else 0.0
+
+        def count(name: str) -> int:
+            inst = registry.get(name)
+            return inst.snapshot() if isinstance(inst, Counter) else 0
+
+        self.log('telemetry step %d | %.0f ex/s | wait %.1f h2d %.1f '
+                 'dispatch %.1f sync %.1f ms | ring %d | %d compiles'
+                 % (step, gauge('train/examples_per_sec'),
+                    self._ms(registry, 'step/batch_wait_ms'),
+                    self._ms(registry, 'step/h2d_ms'),
+                    self._ms(registry, 'step/dispatch_ms'),
+                    self._ms(registry, 'step/sync_ms'),
+                    int(gauge('staging/ring_occupancy')),
+                    count('jit/compiles_total')))

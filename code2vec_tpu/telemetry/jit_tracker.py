@@ -1,0 +1,83 @@
+"""jit-compilation accounting.
+
+Two complementary signals:
+
+- ``install_compile_listener()`` hooks ``jax.monitoring`` (the duration
+  stream every backend compile reports,
+  ``/jax/core/compile/backend_compile_duration``) into the telemetry
+  registry — compile COUNT and TIME, including compiles the trainer
+  never sees (eval twins, checkpoint init, collective warmup).
+- ``CapacityTracker`` watches the packed-wire context capacity feeding
+  the step: every NEW capacity is one more specialization of the whole
+  train-step program (data/packed.py buckets capacities precisely to
+  bound these), so each first sight is counted AND logged with its
+  bucket — the "silent jit re-specialization" PR 1 made possible and
+  this PR makes visible.
+
+The monitoring listener is installed once per process and kept — jax has
+no unregister API stable across versions — but it forwards through
+``core.enabled()``, so with telemetry off its cost is one bool read per
+compile (compiles are seconds-scale; this is nothing).
+"""
+from __future__ import annotations
+
+from code2vec_tpu.telemetry import core
+
+_LISTENER_INSTALLED = False
+
+# Event-name suffixes across jax versions (0.4.x uses *_duration; older
+# releases used *_time_sec).
+_COMPILE_EVENT_SUFFIXES = ('backend_compile_duration',
+                           'backend_compile_time_sec')
+
+
+def _on_event_duration(name: str, secs: float, **_kwargs) -> None:
+    if not core.enabled():
+        return
+    if name.endswith(_COMPILE_EVENT_SUFFIXES):
+        reg = core.registry()
+        reg.counter('jit/compiles_total').inc()
+        reg.timer('jit/compile_ms').record(secs)
+
+
+def install_compile_listener() -> bool:
+    """Idempotently register the jax.monitoring compile listener.
+    Returns False when jax (or its monitoring API) is unavailable."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:
+        return False
+    _LISTENER_INSTALLED = True
+    return True
+
+
+class CapacityTracker:
+    """Counts and logs packed-capacity re-specializations of the step
+    program.  One instance per trainer; single-threaded (hot loop only)."""
+
+    def __init__(self, log=None):
+        self._log = log
+        self._seen = set()
+
+    def observe(self, capacity: int, step: int) -> None:
+        reg = core.registry()
+        reg.gauge('jit/packed_capacity').set(capacity)
+        if capacity in self._seen:
+            return
+        first = not self._seen
+        self._seen.add(capacity)
+        if not first:
+            # the first capacity is the program's initial specialization,
+            # already billed by the compile listener — only GROWTH beyond
+            # it is a re-specialization
+            reg.counter('jit/respecializations_total').inc()
+        if self._log is not None:
+            self._log('telemetry: packed-capacity %s at step %d '
+                      '(bucket %d; %d seen) — new step-program '
+                      'specialization'
+                      % ('re-specialization' if not first else
+                         'specialization', step, capacity, len(self._seen)))

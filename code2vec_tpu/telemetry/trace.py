@@ -1,0 +1,112 @@
+"""On-demand ``jax.profiler`` trace capture from a live run.
+
+``Config.PROFILE_DIR`` (the pre-existing knob) captures one fixed window
+near the start of a run; this controller adds captures that need no
+restart:
+
+- ``TELEMETRY_TRACE_AT_STEP`` (config field, CLI ``--trace-at-step``, or
+  the environment variable of the same name): capture
+  ``TELEMETRY_TRACE_NUM_STEPS`` steps once that global step is reached.
+- Touch-file trigger: ``touch <telemetry_dir>/TRACE_NOW`` in a live run;
+  the trainer polls for it every ``poll_every`` steps (one ``stat`` call
+  per poll — nothing per step), consumes the file, and captures the next
+  window.  Repeatable: touch again for another capture.
+
+Each capture lands in its own ``<telemetry_dir>/traces/step<N>`` dir
+(viewable with TensorBoard/Perfetto; decomposable offline with
+``benchmarks/analyze_trace.py --trace <dir>``).  jax.profiler cannot nest
+captures, so the controller is inert while ``Config.PROFILE_DIR``'s
+window is active — the trainer gates on that.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from code2vec_tpu.telemetry import core
+
+ENV_TRACE_AT_STEP = 'TELEMETRY_TRACE_AT_STEP'
+TOUCH_FILE_NAME = 'TRACE_NOW'
+
+
+class TraceController:
+    def __init__(self, trace_root: str, trace_at_step: int = -1,
+                 num_steps: int = 5, poll_every: int = 25,
+                 log=None):
+        self.trace_root = trace_root
+        # config < 0 means unset; the env var then takes over, so a live
+        # run launched without the flag can still be told where to look
+        if trace_at_step < 0:
+            trace_at_step = int(os.environ.get(ENV_TRACE_AT_STEP, -1))
+        self.trace_at_step = trace_at_step
+        self.num_steps = max(1, num_steps)
+        self.poll_every = max(1, poll_every)
+        self.touch_path = os.path.join(trace_root, TOUCH_FILE_NAME)
+        self._log = log or (lambda msg: None)
+        self._active_dir: Optional[str] = None
+        self._stop_at = -1
+        self._armed_at = -1   # step the touch trigger armed for (-1: none)
+
+    @property
+    def active(self) -> bool:
+        return self._active_dir is not None
+
+    def _should_start(self, step: int) -> bool:
+        if step == self.trace_at_step or step == self._armed_at:
+            return True
+        if step % self.poll_every == 0 and os.path.exists(self.touch_path):
+            try:
+                os.remove(self.touch_path)  # consume: one capture per touch
+            except OSError:
+                pass
+            self._armed_at = step  # start on THIS step
+            return True
+        return False
+
+    def maybe_update(self, step: int, sync_tree=None) -> None:
+        """Advance the capture state machine at the top of step ``step``.
+        ``sync_tree`` (typically the train state's params) is blocked on
+        before stopping so the traced window contains completed device
+        work, not just dispatches."""
+        if self._active_dir is None:
+            if not self._should_start(step):
+                return
+            import jax
+            trace_dir = os.path.join(self.trace_root, 'traces',
+                                     'step%d' % step)
+            os.makedirs(trace_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception as exc:  # another trace active, backend quirk
+                self._log('telemetry: trace capture at step %d failed to '
+                          'start: %s' % (step, exc))
+                self._armed_at = -1
+                return
+            self._active_dir = trace_dir
+            self._stop_at = step + self.num_steps
+            self._armed_at = -1
+            self._log('telemetry: profiler capture started at step %d '
+                      '(%d steps) -> %s' % (step, self.num_steps, trace_dir))
+        elif step >= self._stop_at:
+            import jax
+            if sync_tree is not None:
+                jax.block_until_ready(sync_tree)
+            jax.profiler.stop_trace()
+            core.registry().counter('trace/captures_total').inc()
+            self._log('telemetry: profiler capture written to `%s` '
+                      '(analyze: python benchmarks/analyze_trace.py '
+                      '--trace %s --steps %d)'
+                      % (self._active_dir, self._active_dir, self.num_steps))
+            self._active_dir = None
+            self._stop_at = -1
+
+    def shutdown(self) -> None:
+        """Stop a capture left active (fit teardown/exception path)."""
+        if self._active_dir is not None:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active_dir = None
+            self._stop_at = -1

@@ -17,6 +17,7 @@ sharded-table gathers, sharded softmax) with no collective written by hand.
 from __future__ import annotations
 
 import collections
+import logging
 import time
 from typing import Any, Callable, Iterable, NamedTuple, Optional, Tuple
 
@@ -31,6 +32,10 @@ from code2vec_tpu.data.reader import Batch
 from code2vec_tpu.models import functional
 from code2vec_tpu.ops.topk import sharded_top_k
 from code2vec_tpu.parallel import mesh as mesh_lib
+
+# package logger: 'code2vec_tpu.training.trainer' — propagates to the
+# 'code2vec_tpu' root logger Config.get_logger configures
+logger = logging.getLogger(__name__)
 
 
 class TrainerState(NamedTuple):
@@ -83,18 +88,17 @@ class Trainer:
         # reference's semantics — see ops/lazy_adam.py); dense params keep
         # optax Adam either way.
         if config.LAZY_EMBEDDING_ADAM:
-            import logging
             if (config.ADAM_MU_DTYPE != 'float32'
                     or config.ADAM_NU_DTYPE != 'float32'):
                 # bf16 mu is the config DEFAULT; lazy Adam's sparse-row
                 # update keeps fp32 moments and does not consume either
                 # dtype knob, so this must warn, not raise.
-                logging.getLogger(__name__).warning(
+                logger.warning(
                     'ADAM_MU_DTYPE=%r / ADAM_NU_DTYPE=%r are ignored: '
                     'they apply to the dense optax Adam only; '
                     'LAZY_EMBEDDING_ADAM keeps fp32 moments.',
                     config.ADAM_MU_DTYPE, config.ADAM_NU_DTYPE)
-            logging.getLogger(__name__).warning(
+            logger.warning(
                 'LAZY_EMBEDDING_ADAM is measured SLOWER on v5e-class chips '
                 '(0.54x the dense step at java14m shapes, PERF.md): the '
                 'scatter update serializes against the fused dense update. '
@@ -126,6 +130,14 @@ class Trainer:
             else:
                 self.optimizer = optax.adam(config.LEARNING_RATE,
                                             mu_dtype=mu_dtype)
+        # Telemetry (OBSERVABILITY.md): None when disabled — every
+        # instrumented site below is then a single `is None` check.
+        self._telemetry = None
+        if getattr(config, 'TELEMETRY', False):
+            from code2vec_tpu.telemetry import StepTelemetry
+            self._telemetry = StepTelemetry(
+                config, log=config.log,
+                process_index=jax.process_index())
         self._build_steps()
 
     # ----------------------------------------------------------- jit steps
@@ -378,14 +390,30 @@ class Trainer:
             depth = 0
         shard_contexts = self.config.SHARD_CONTEXTS
         staged = collections.deque()
+        tele = self._telemetry
+        if tele is not None:
+            tele.registry.gauge('staging/ring_depth').set(depth)
         for batch in batches:
-            staged.append((mesh_lib.shard_batch(batch.device_arrays(),
-                                                self.mesh, shard_contexts,
-                                                direct=True),
-                           batch))
+            if tele is not None:
+                # the DISPATCH cost of the async per-device placement —
+                # jax transfers complete in the background, so a spike
+                # here means host-side slicing/copy, not wire time
+                with jax.profiler.TraceAnnotation('host/h2d_place'), \
+                        tele.h2d.time():
+                    placed = mesh_lib.shard_batch(batch.device_arrays(),
+                                                  self.mesh, shard_contexts,
+                                                  direct=True)
+                tele.ring_occupancy.set(len(staged) + 1)
+            else:
+                placed = mesh_lib.shard_batch(batch.device_arrays(),
+                                              self.mesh, shard_contexts,
+                                              direct=True)
+            staged.append((placed, batch))
             if len(staged) > depth:
                 yield staged.popleft()
         while staged:
+            if tele is not None:
+                tele.ring_occupancy.set(len(staged) - 1)
             yield staged.popleft()
 
     def train_step_placed(self, state: TrainerState, arrays
@@ -437,10 +465,17 @@ class Trainer:
             on_eval_interval: Optional[Callable[[int, TrainerState],
                                                 None]] = None,
             on_save_interval: Optional[Callable[[int, int, TrainerState],
-                                                None]] = None
+                                                None]] = None,
+            on_epoch_time: Optional[Callable[[int, int, float],
+                                             None]] = None
             ) -> TrainerState:
         """Epoch-driven loop with the reference's windowed throughput trace
-        (tensorflow_model.py:74-101, 424-430)."""
+        (tensorflow_model.py:74-101, 424-430).
+
+        ``on_epoch_time(epoch, batch_num, seconds)`` receives each epoch's
+        training wall time (the loop over its batches, including interval
+        evals; excluding ``on_epoch_end``'s eval/save) — model_api routes
+        it into the metrics writer."""
         config = self.config
         log_every = config.NUM_BATCHES_TO_LOG_PROGRESS
         # resumed runs continue the step axis instead of restarting at 0
@@ -453,17 +488,36 @@ class Trainer:
             state = self._fit_loop(
                 state, epoch_batches, start_epoch, on_epoch_end, on_log,
                 on_eval_interval, on_save_interval, batch_num, window_losses,
-                window_examples, window_start, log_every)
+                window_examples, window_start, log_every, on_epoch_time)
         finally:
             if getattr(self, '_profiling', False):
                 jax.profiler.stop_trace()
                 self._profiling = False
+            if self._telemetry is not None:
+                # final flush + stop any live on-demand capture, so a
+                # crashing run still leaves metrics.jsonl current
+                self._telemetry.shutdown(getattr(self, '_last_batch_num', 0))
         return state
+
+    @staticmethod
+    def _num_valid_contexts(host_batch) -> int:
+        """Contexts a batch feeds the step: retained slots for the packed
+        wire (count), mask-valid slots for planes. Telemetry-path only.
+        NB: on plane batches ``.count`` resolves to the tuple METHOD, so
+        probe by array-ness, not truthiness."""
+        count = getattr(host_batch, 'count', None)
+        if isinstance(count, np.ndarray):
+            return int(count.sum())
+        return int(host_batch.mask.sum())
 
     def _fit_loop(self, state, epoch_batches, start_epoch, on_epoch_end,
                   on_log, on_eval_interval, on_save_interval, batch_num,
-                  window_losses, window_examples, window_start, log_every):
+                  window_losses, window_examples, window_start, log_every,
+                  on_epoch_time=None):
         config = self.config
+        tele = self._telemetry
+        if tele is not None:
+            tele.resume()  # shutdown() in fit's finally disables globally
         self._profiling = False
         profile_done = False
         # profile window is relative to THIS run's first batch so resumed
@@ -472,7 +526,27 @@ class Trainer:
         profile_start = first_batch + config.PROFILE_START_STEP
         profile_stop_step = profile_start + config.PROFILE_NUM_STEPS
         for epoch in range(start_epoch, config.NUM_TRAIN_EPOCHS):
-            for arrays, host_batch in self.stage_batches(epoch_batches(epoch)):
+            epoch_start = time.time()
+            staged = iter(self.stage_batches(epoch_batches(epoch)))
+            while True:
+                # batch-wait: host time blocked on the input pipeline for
+                # the next staged batch (the starvation signal). The
+                # generator's h2d placement runs INSIDE this next() and is
+                # timed separately (stage_batches) — subtract it so wait
+                # measures pipeline starvation, not placement.
+                if tele is not None:
+                    h2d_before = tele.h2d.total
+                    iter_t0 = time.perf_counter()
+                    with jax.profiler.TraceAnnotation('host/batch_wait'):
+                        item = next(staged, None)
+                    tele.batch_wait.record(max(
+                        0.0, (time.perf_counter() - iter_t0)
+                        - (tele.h2d.total - h2d_before)))
+                else:
+                    item = next(staged, None)
+                if item is None:
+                    break
+                arrays, host_batch = item
                 # step-interval checkpointing fires at the TOP of the next
                 # iteration (state reflects batch_num completed steps): an
                 # interval landing on an epoch's final step must not
@@ -484,7 +558,13 @@ class Trainer:
                         batch_num % config.SAVE_EVERY_N_STEPS == 0:
                     on_save_interval(epoch, batch_num, state)
                 if config.PROFILE_DIR and not profile_done:
-                    if batch_num >= profile_start and not self._profiling:
+                    # jax.profiler cannot nest: the fixed window must also
+                    # yield to a live on-demand capture (the controller
+                    # already yields to _profiling — both directions)
+                    on_demand_active = (tele is not None
+                                        and tele.trace.active)
+                    if batch_num >= profile_start and not self._profiling \
+                            and not on_demand_active:
                         jax.profiler.start_trace(config.PROFILE_DIR)
                         self._profiling = True
                     elif batch_num >= profile_stop_step and self._profiling:
@@ -494,14 +574,43 @@ class Trainer:
                         profile_done = True
                         config.log('Profiler trace written to `%s`.'
                                    % config.PROFILE_DIR)
-                state, loss = self.train_step_placed(state, arrays)
+                if tele is not None:
+                    if not self._profiling:
+                        # on-demand capture (TELEMETRY_TRACE_AT_STEP /
+                        # touch file); inert while PROFILE_DIR's fixed
+                        # window holds the profiler
+                        tele.trace.maybe_update(batch_num,
+                                                sync_tree=state.params)
+                    if len(arrays) == 4:
+                        # each NEW packed capacity = one more jit
+                        # specialization of the whole step program
+                        tele.capacity.observe(int(arrays[0].shape[1]),
+                                              batch_num)
+                    with jax.profiler.StepTraceAnnotation(
+                            'train', step_num=batch_num), \
+                            tele.dispatch.time():
+                        state, loss = self.train_step_placed(state, arrays)
+                else:
+                    state, loss = self.train_step_placed(state, arrays)
                 batch_num += 1
                 window_losses.append(loss)
-                window_examples += host_batch.num_valid_examples
+                n_valid = host_batch.num_valid_examples
+                window_examples += n_valid
+                if tele is not None:
+                    tele.count_batch(n_valid,
+                                     self._num_valid_contexts(host_batch))
                 if batch_num % log_every == 0:
                     # device_get, not eager jnp ops: stacking mesh-sharded
                     # scalars eagerly aborts in jaxlib on CPU meshes
-                    sum_loss = float(np.sum(jax.device_get(window_losses)))
+                    if tele is not None:
+                        sync_t0 = time.perf_counter()
+                        with jax.profiler.TraceAnnotation('host/sync'):
+                            losses = jax.device_get(window_losses)
+                        tele.sync.record(time.perf_counter() - sync_t0)
+                        sum_loss = float(np.sum(losses))
+                    else:
+                        sum_loss = float(np.sum(
+                            jax.device_get(window_losses)))
                     elapsed = time.time() - window_start
                     throughput = window_examples / max(elapsed, 1e-9)
                     config.log(
@@ -527,6 +636,24 @@ class Trainer:
                     window_losses = []
                     window_examples = 0
                     window_start = time.time()
+                if tele is not None:
+                    tele.step_total.record(time.perf_counter() - iter_t0)
+                    tele.after_step(batch_num)
+                    self._last_batch_num = batch_num
+            if tele is not None and window_losses:
+                # short runs may never hit a log window: sync the partial
+                # window here so step/sync_ms is recorded at least once
+                # per epoch (the losses stay in the window — this only
+                # drains the dispatched work, it does not consume them)
+                sync_t0 = time.perf_counter()
+                jax.device_get(window_losses)
+                tele.sync.record(time.perf_counter() - sync_t0)
+            epoch_wall = time.time() - epoch_start
+            if tele is not None:
+                tele.registry.gauge('train/epoch_wall_time_s').set(
+                    epoch_wall)
+            if on_epoch_time is not None:
+                on_epoch_time(epoch, batch_num, epoch_wall)
             if on_epoch_end is not None:
                 # pass the ACTUAL global batch number: estimates from the
                 # unfiltered line count would put eval metrics on a

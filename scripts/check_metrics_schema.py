@@ -1,0 +1,115 @@
+"""Lint: every metric name emitted anywhere in the codebase must exist in
+the telemetry catalog (code2vec_tpu/telemetry/catalog.py), and every
+cataloged name must be documented in OBSERVABILITY.md — so metric names
+cannot silently drift from the catalog/doc (ISSUE 2 satellite; runs in
+tier-1 via tests/test_metrics_schema.py).
+
+Grep-based by design: emission sites are method calls with a string
+literal —
+
+    registry.counter('train/steps_total')   .gauge(...)   .timer(...)
+    writer.scalar('eval/top1_acc', ...)     registry.get('step/h2d_ms')
+
+A literal only counts as a metric name if it contains '/' (the catalog's
+``subsystem/metric`` shape), which keeps ordinary ``dict.get`` calls out.
+
+Exit status: 0 clean, 1 on unknown emissions or undocumented catalog
+entries.  ``--list`` prints every discovered emission with its site.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# Directories scanned for emission sites. tests/ is deliberately out:
+# tests mint throwaway names to exercise the instruments themselves.
+SCAN_DIRS = ('code2vec_tpu', 'benchmarks', 'scripts')
+SCAN_FILES = ('bench.py',)
+
+# \s* spans newlines: emission calls wrap across lines under the
+# 79-column style, so matching is against whole-file content
+EMIT_RE = re.compile(
+    r"""\.(?:counter|gauge|timer|scalar|get)\(\s*['"]([^'"]*/[^'"]*)['"]""")
+
+
+def iter_python_files():
+    for rel in SCAN_DIRS:
+        for dirpath, _dirnames, filenames in os.walk(os.path.join(REPO, rel)):
+            if '__pycache__' in dirpath:
+                continue
+            for name in sorted(filenames):
+                if name.endswith('.py'):
+                    yield os.path.join(dirpath, name)
+    for rel in SCAN_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.isfile(path):
+            yield path
+
+
+def find_emissions():
+    """[(relpath, lineno, metric_name)] across the scanned tree."""
+    out = []
+    for path in iter_python_files():
+        rel = os.path.relpath(path, REPO)
+        with open(path, 'r') as f:
+            content = f.read()
+        for match in EMIT_RE.finditer(content):
+            lineno = content.count('\n', 0, match.start()) + 1
+            out.append((rel, lineno, match.group(1)))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from code2vec_tpu.telemetry.catalog import CATALOG
+
+    emissions = find_emissions()
+    if '--list' in argv:
+        for rel, lineno, name in emissions:
+            print('%s:%d: %s' % (rel, lineno, name))
+
+    failures = []
+    for rel, lineno, name in emissions:
+        if name not in CATALOG:
+            failures.append(
+                '%s:%d: metric %r is not in the catalog '
+                '(code2vec_tpu/telemetry/catalog.py) — add it there and to '
+                'OBSERVABILITY.md, or fix the name' % (rel, lineno, name))
+
+    doc_path = os.path.join(REPO, 'OBSERVABILITY.md')
+    if os.path.isfile(doc_path):
+        with open(doc_path, 'r') as f:
+            doc = f.read()
+        for name in sorted(CATALOG):
+            if name not in doc:
+                failures.append(
+                    'OBSERVABILITY.md: cataloged metric %r is undocumented'
+                    % name)
+    else:
+        failures.append('OBSERVABILITY.md is missing (the metric catalog '
+                        'must be documented)')
+
+    emitted = {name for _rel, _lineno, name in emissions}
+    for name in sorted(set(CATALOG) - emitted):
+        # informational only: names can be emitted dynamically or be
+        # reserved ahead of an integration landing
+        print('note: cataloged metric %r has no static emission site'
+              % name)
+
+    if failures:
+        print('\n'.join(failures), file=sys.stderr)
+        print('%d metric-schema violation(s).' % len(failures),
+              file=sys.stderr)
+        return 1
+    print('metrics schema OK: %d emission sites, %d cataloged names.'
+          % (len(emissions), len(CATALOG)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

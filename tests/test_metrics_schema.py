@@ -1,0 +1,39 @@
+"""tier-1 guard: metric names cannot drift from the catalog/doc
+(scripts/check_metrics_schema.py; ISSUE 2 satellite)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, 'scripts'))
+
+import check_metrics_schema  # noqa: E402
+
+
+def test_emission_regex_matches_wrapped_calls():
+    content = ("reg.counter(\n    'input/cache_miss_total').inc()\n"
+               "writer.scalar('train/loss', x, step)\n"
+               "registry.get('step/h2d_ms')\n"
+               "meta.get(k)  # no literal: ignored\n"
+               "os.environ.get('TELEMETRY_TRACE_AT_STEP')  # no slash\n")
+    names = [m.group(1)
+             for m in check_metrics_schema.EMIT_RE.finditer(content)]
+    assert names == ['input/cache_miss_total', 'train/loss', 'step/h2d_ms']
+
+
+def test_every_emitted_metric_is_cataloged_and_documented():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts',
+                                      'check_metrics_schema.py')],
+        capture_output=True, text=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_unknown_metric_is_flagged():
+    from code2vec_tpu.telemetry.catalog import CATALOG
+    emissions = check_metrics_schema.find_emissions()
+    assert emissions, 'lint found no emission sites — regex broke'
+    assert all(name in CATALOG for _rel, _line, name in emissions)
+    # and the failure path actually fires on a bogus name
+    assert 'definitely/not_a_metric' not in CATALOG

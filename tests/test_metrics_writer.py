@@ -38,3 +38,44 @@ def test_append_mode_survives_reopen(tmp_path):
     w2.close()
     lines = (tmp_path / 'logs' / 'metrics.jsonl').read_text().splitlines()
     assert len(lines) == 2
+
+
+def test_writes_are_buffered_until_threshold_or_flush(tmp_path):
+    path = tmp_path / 'logs' / 'metrics.jsonl'
+    writer = MetricsWriter(str(tmp_path / 'logs'), buffer_records=3)
+    writer.scalar('a', 1.0, 1)
+    writer.scalar('a', 2.0, 2)
+    assert not path.exists()          # buffered: no per-scalar I/O
+    writer.scalar('a', 3.0, 3)        # hits the threshold
+    assert len(path.read_text().splitlines()) == 3
+    writer.scalar('a', 4.0, 4)
+    writer.flush()                    # explicit flush drains the tail
+    assert len(path.read_text().splitlines()) == 4
+    writer.close()
+
+
+def test_context_manager_flushes_on_exit(tmp_path):
+    path = tmp_path / 'logs' / 'metrics.jsonl'
+    with MetricsWriter(str(tmp_path / 'logs')) as writer:
+        writer.scalar('a', 1.0, 1)
+        assert not path.exists()
+    assert len(path.read_text().splitlines()) == 1
+
+
+def test_close_is_idempotent(tmp_path):
+    writer = MetricsWriter(str(tmp_path / 'logs'))
+    writer.scalar('a', 1.0, 1)
+    writer.close()
+    writer.close()
+    lines = (tmp_path / 'logs' / 'metrics.jsonl').read_text().splitlines()
+    assert len(lines) == 1
+
+
+def test_atexit_flush_covers_unclosed_writers(tmp_path):
+    path = tmp_path / 'logs' / 'metrics.jsonl'
+    writer = MetricsWriter(str(tmp_path / 'logs'))
+    writer.scalar('a', 1.0, 1)
+    assert not path.exists()
+    writer._atexit_flush()            # what interpreter exit would run
+    assert len(path.read_text().splitlines()) == 1
+    writer.close()

@@ -1,0 +1,354 @@
+"""Telemetry layer tests (ISSUE 2): core instrument semantics, windowed
+percentiles, registry isolation, exporter round-trips, the jit trackers,
+the on-demand trace controller, and the trainer's step-phase breakdown on
+a tiny synthetic corpus."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry.core import Timer
+from code2vec_tpu.telemetry.exporters import (ConsoleExporter, JsonlExporter,
+                                              PrometheusExporter)
+from code2vec_tpu.telemetry.jit_tracker import (CapacityTracker,
+                                                install_compile_listener)
+from code2vec_tpu.telemetry.trace import TraceController
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Registry reset between tests: telemetry state is process-global by
+    design, so every test starts and ends clean."""
+    core.reset()
+    core.disable()
+    yield
+    core.reset()
+    core.disable()
+
+
+# ------------------------------------------------------------- instruments
+def test_counter_semantics():
+    counter = core.registry().counter('t/c')
+    counter.inc()
+    counter.inc(5)
+    assert counter.value == 6
+
+
+def test_counter_thread_safety():
+    counter = core.registry().counter('t/c')
+
+    def spin():
+        for _ in range(1000):
+            counter.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(4)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert counter.value == 4000
+
+
+def test_gauge_last_write_wins():
+    gauge = core.registry().gauge('t/g')
+    gauge.set(3.5)
+    gauge.set(1.25)
+    assert gauge.value == 1.25
+
+
+def test_timer_stats_and_percentiles():
+    timer = Timer('t/ms')
+    for ms in (1, 2, 3, 4, 5, 6, 7, 8, 9, 100):
+        timer.record(ms / 1e3)
+    stats = timer.snapshot()
+    assert stats['count'] == 10
+    assert stats['last_ms'] == pytest.approx(100.0)
+    assert stats['max_ms'] == pytest.approx(100.0)
+    assert stats['mean_ms'] == pytest.approx(14.5)
+    assert 5.0 <= stats['p50_ms'] <= 6.0
+    assert stats['p95_ms'] >= 9.0
+    assert stats['total_s'] == pytest.approx(0.145)
+
+
+def test_timer_window_bounds_stats_not_count():
+    timer = Timer('t/ms', window=4)
+    for ms in (1000, 1000, 1000, 1, 1, 1, 1):  # old spikes roll out
+        timer.record(ms / 1e3)
+    stats = timer.snapshot()
+    assert stats['count'] == 7          # cumulative
+    assert stats['p95_ms'] == pytest.approx(1.0)   # window forgot spikes
+    # max is windowed too: a warmup compile must not pin the exported
+    # max for the rest of a multi-hour run
+    assert stats['max_ms'] == pytest.approx(1.0)
+
+
+def test_timer_context_manager_records():
+    timer = Timer('t/ms')
+    with timer.time():
+        time.sleep(0.01)
+    assert timer.count == 1
+    assert timer.last >= 0.009
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_get_or_create_identity_and_type_check():
+    reg = core.registry()
+    assert reg.counter('t/a') is reg.counter('t/a')
+    with pytest.raises(TypeError):
+        reg.gauge('t/a')
+
+
+def test_registry_reset_clears():
+    reg = core.registry()
+    reg.counter('t/a').inc()
+    core.reset()
+    assert reg.counter('t/a').value == 0
+
+
+def test_enable_disable_flag():
+    assert not core.enabled()
+    core.enable()
+    assert core.enabled()
+    core.disable()
+    assert not core.enabled()
+
+
+# --------------------------------------------------------------- exporters
+def test_jsonl_round_trip(tmp_path):
+    reg = core.registry()
+    reg.counter('train/steps_total').inc(7)
+    reg.gauge('train/examples_per_sec').set(123.5)
+    timer = reg.timer('step/dispatch_ms')
+    timer.record(0.002)
+    timer.record(0.004)
+    exporter = JsonlExporter(str(tmp_path))
+    exporter.flush(reg, step=42)
+    exporter.flush(reg, step=43)
+    records = [json.loads(line) for line in
+               (tmp_path / 'metrics.jsonl').read_text().splitlines()]
+    by_tag = {}
+    for record in records:
+        by_tag.setdefault(record['tag'], []).append(record)
+    assert [r['value'] for r in by_tag['train/steps_total']] == [7, 7]
+    assert by_tag['train/examples_per_sec'][0]['value'] == 123.5
+    timer_rec = by_tag['step/dispatch_ms'][0]
+    assert timer_rec['count'] == 2
+    assert timer_rec['value'] == pytest.approx(3.0)       # mean ms
+    assert timer_rec['p50_ms'] > 0 and timer_rec['max_ms'] >= 4.0
+    assert all(r['step'] in (42, 43) for r in records)
+
+
+def test_jsonl_skips_empty_timers(tmp_path):
+    reg = core.registry()
+    reg.timer('step/sync_ms')  # created, never recorded
+    JsonlExporter(str(tmp_path)).flush(reg, step=0)
+    assert not (tmp_path / 'metrics.jsonl').exists()
+
+
+def test_prometheus_textfile(tmp_path):
+    reg = core.registry()
+    reg.counter('jit/compiles_total').inc(3)
+    reg.gauge('input/packed_fill_rate').set(0.75)
+    reg.timer('step/h2d_ms').record(0.001)
+    PrometheusExporter(str(tmp_path)).flush(reg, step=1)
+    text = (tmp_path / 'metrics.prom').read_text()
+    assert 'code2vec_jit_compiles_total 3' in text
+    assert '# TYPE code2vec_jit_compiles_total counter' in text
+    assert 'code2vec_input_packed_fill_rate 0.75' in text
+    # timers export per-stat gauges (a real 'summary' family needs
+    # quantile labels + _sum; strict parsers drop the file otherwise)
+    assert '# TYPE code2vec_step_h2d_ms_p50_ms gauge' in text
+    assert 'code2vec_step_h2d_ms_mean_ms 1' in text
+    assert 'code2vec_step_h2d_ms_count 1' in text
+    assert 'summary' not in text
+    assert not (tmp_path / 'metrics.prom.tmp').exists()  # atomic rename
+
+
+def test_console_exporter_rate_limited():
+    lines = []
+    exporter = ConsoleExporter(lines.append, min_interval_s=3600.0)
+    reg = core.registry()
+    exporter.flush(reg, step=1)
+    exporter.flush(reg, step=2)  # inside the interval: suppressed
+    assert len(lines) == 1
+    assert 'telemetry step 1' in lines[0]
+
+
+# ------------------------------------------------------------ jit tracking
+def test_capacity_tracker_counts_respecializations_once_per_bucket():
+    lines = []
+    tracker = CapacityTracker(log=lines.append)
+    tracker.observe(64, step=0)    # initial specialization: not a re-spec
+    tracker.observe(64, step=1)
+    tracker.observe(128, step=2)   # growth: one re-spec
+    tracker.observe(128, step=3)
+    reg = core.registry()
+    assert reg.counter('jit/respecializations_total').value == 1
+    assert reg.gauge('jit/packed_capacity').value == 128
+    assert len(lines) == 2 and 'bucket 128' in lines[1]
+
+
+def test_compile_listener_counts_jax_compiles():
+    import jax
+    import jax.numpy as jnp
+    assert install_compile_listener()
+    core.enable()
+    before = core.registry().counter('jit/compiles_total').value
+    # a shape this process has certainly not compiled yet
+    jax.jit(lambda x: x * 3 + 1)(jnp.ones((17, 3))).block_until_ready()
+    after = core.registry().counter('jit/compiles_total').value
+    assert after > before
+    assert core.registry().timer('jit/compile_ms').count > 0
+
+
+# ----------------------------------------------------------- trace control
+class _FakeProfiler:
+    def __init__(self, monkeypatch):
+        import jax
+        self.calls = []
+        monkeypatch.setattr(jax.profiler, 'start_trace',
+                            lambda d: self.calls.append(('start', d)))
+        monkeypatch.setattr(jax.profiler, 'stop_trace',
+                            lambda: self.calls.append(('stop', None)))
+
+
+def test_trace_controller_at_step(tmp_path, monkeypatch):
+    fake = _FakeProfiler(monkeypatch)
+    ctl = TraceController(str(tmp_path), trace_at_step=3, num_steps=2)
+    for step in range(8):
+        ctl.maybe_update(step)
+    assert [c[0] for c in fake.calls] == ['start', 'stop']
+    assert fake.calls[0][1].endswith(os.path.join('traces', 'step3'))
+    assert core.registry().counter('trace/captures_total').value == 1
+
+
+def test_trace_controller_touch_file(tmp_path, monkeypatch):
+    fake = _FakeProfiler(monkeypatch)
+    ctl = TraceController(str(tmp_path), trace_at_step=-1, num_steps=1,
+                          poll_every=2)
+    ctl.maybe_update(0)
+    assert not fake.calls
+    (tmp_path / 'TRACE_NOW').touch()
+    ctl.maybe_update(1)          # off-poll step: not yet seen
+    assert not fake.calls
+    ctl.maybe_update(2)          # poll step: consume + start
+    assert fake.calls == [('start', str(tmp_path / 'traces' / 'step2'))]
+    assert not (tmp_path / 'TRACE_NOW').exists()
+    ctl.maybe_update(3)
+    assert [c[0] for c in fake.calls] == ['start', 'stop']
+    # repeatable: touch again for another capture
+    (tmp_path / 'TRACE_NOW').touch()
+    ctl.maybe_update(4)
+    assert [c[0] for c in fake.calls] == ['start', 'stop', 'start']
+
+
+def test_trace_controller_env_var(tmp_path, monkeypatch):
+    monkeypatch.setenv('TELEMETRY_TRACE_AT_STEP', '5')
+    ctl = TraceController(str(tmp_path), trace_at_step=-1)
+    assert ctl.trace_at_step == 5
+    # an explicit config value wins over the env
+    ctl2 = TraceController(str(tmp_path), trace_at_step=9)
+    assert ctl2.trace_at_step == 9
+
+
+def test_env_trace_var_implies_telemetry_layer(monkeypatch):
+    """TELEMETRY_TRACE_AT_STEP exists for runs launched by scripts you
+    can't edit — without implying TELEMETRY it would be silently inert
+    (no TraceController is ever built)."""
+    from code2vec_tpu.config import Config
+    monkeypatch.setenv('TELEMETRY_TRACE_AT_STEP', '500')
+    config = Config().load_from_args(['--data', 'x'])
+    assert config.TELEMETRY
+    assert config.TELEMETRY_TRACE_AT_STEP == 500
+    # the explicit flag wins over the env var
+    config2 = Config().load_from_args(['--data', 'x',
+                                       '--trace-at-step', '9'])
+    assert config2.TELEMETRY_TRACE_AT_STEP == 9
+    monkeypatch.delenv('TELEMETRY_TRACE_AT_STEP')
+    config3 = Config().load_from_args(['--data', 'x'])
+    assert not config3.TELEMETRY
+
+
+# ------------------------------------------- trainer phase breakdown (e2e)
+def _read_tags(path):
+    records = [json.loads(line) for line in
+               open(path).read().splitlines()]
+    by_tag = {}
+    for record in records:
+        by_tag.setdefault(record['tag'], []).append(record)
+    return by_tag
+
+
+def test_fit_phase_breakdown_tiny_corpus(tmp_path):
+    """The ISSUE 2 acceptance smoke: a CPU fit with telemetry enabled
+    must produce a metrics.jsonl with per-step phase timings, throughput
+    counters, and at least one jit-compilation event — plus epoch/eval
+    wall-time through the MetricsWriter."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from tests.test_train_overfit import make_dataset
+
+    prefix = make_dataset(tmp_path)
+    tele_dir = tmp_path / 'tele'
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix),
+        TEST_DATA_PATH=str(tmp_path / 'tiny.val.c2v'),
+        MODEL_SAVE_PATH=str(tmp_path / 'model' / 'saved'),
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=2,
+        SAVE_EVERY_EPOCHS=1000, SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0,
+        READER_USE_NATIVE=False, NUM_BATCHES_TO_LOG_PROGRESS=2,
+        USE_TENSORBOARD=True,
+        TELEMETRY=True, TELEMETRY_DIR=str(tele_dir),
+        TELEMETRY_FLUSH_EVERY_STEPS=2, TELEMETRY_CONSOLE_EVERY_SECS=0.0)
+    model = Code2VecModel(config)
+    model.train()
+
+    by_tag = _read_tags(tele_dir / 'metrics.jsonl')
+    # per-step phase timings (batch-wait, h2d, step, sync)
+    for phase in ('step/batch_wait_ms', 'step/h2d_ms', 'step/dispatch_ms',
+                  'step/sync_ms', 'step/total_ms'):
+        assert phase in by_tag, sorted(by_tag)
+        assert by_tag[phase][-1]['count'] > 0
+    # throughput counters and rates
+    assert by_tag['train/steps_total'][-1]['value'] >= 6  # 60/16*2 epochs
+    assert by_tag['train/examples_total'][-1]['value'] >= 100
+    assert by_tag['train/contexts_total'][-1]['value'] > 0
+    assert any(r['value'] > 0 for r in by_tag['train/examples_per_sec'])
+    # at least one jit-compilation event
+    assert by_tag['jit/compiles_total'][-1]['value'] >= 1
+    assert by_tag['jit/compile_ms'][-1]['count'] >= 1
+    # packed wire: capacity gauge + pipeline health
+    assert by_tag['jit/packed_capacity'][-1]['value'] > 0
+    assert by_tag['input/batches_total'][-1]['value'] > 0
+    assert 0 < by_tag['input/packed_fill_rate'][-1]['value'] <= 1.0
+    assert by_tag['input/cache_miss_total'][-1]['value'] == 1
+    assert by_tag['train/epoch_wall_time_s'][-1]['value'] > 0
+    # the Prometheus textfile tracks the same registry
+    prom = (tele_dir / 'metrics.prom').read_text()
+    assert 'code2vec_train_steps_total' in prom
+
+    # epoch + eval wall time through the MetricsWriter (satellite 2)
+    writer_tags = _read_tags(tmp_path / 'model' / 'summaries'
+                             / 'metrics.jsonl')
+    assert len(writer_tags['train/epoch_wall_time_s']) == 2  # one/epoch
+    assert all(r['value'] > 0
+               for r in writer_tags['train/epoch_wall_time_s'])
+    assert 'eval/wall_time_s' in writer_tags
+    assert writer_tags['eval/wall_time_s'][-1]['value'] > 0
+
+    # fit's teardown must drop the process-global flag: later
+    # non-telemetry runs in this process must not keep recording
+    assert not core.enabled()
+
+    # a second open of the same dataset hits the token cache (no need to
+    # train a whole second model for the counter)
+    from code2vec_tpu.data.cache import TokenCache
+    from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+    reader = PathContextReader(model.vocabs, config, EstimatorAction.Train)
+    core.enable()  # as a live telemetry run would be
+    TokenCache.build_or_load(config, model.vocabs, reader)
+    assert core.registry().counter('input/cache_hit_total').value >= 1
