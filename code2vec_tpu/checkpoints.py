@@ -35,6 +35,14 @@ import orbax.checkpoint as ocp
 logger = logging.getLogger(__name__)
 
 from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+
+
+class CheckpointLayoutError(ValueError):
+    """Permanent, store-wide restore failure (pre-canonical layout or a
+    cross-framework training resume): every artifact under the store
+    shares the cause, so the corruption fallback must re-raise instead
+    of quarantining its way through good data."""
 
 # orbax version split for the params-only partial restore: newer orbax
 # has PyTreeRestore(partial_restore=True) dispatched through the
@@ -393,6 +401,16 @@ class CheckpointStore:
                 handler_registry=self._handler_registry())
         return self._snapshot_manager
 
+    def wait_until_finished(self) -> None:
+        """Drain any in-flight async save on either manager WITHOUT
+        closing it (preemption's final save must be durable inside the
+        signal grace window; the divergence rewind reads the newest
+        snapshot right after a possible interval save)."""
+        if self._manager is not None:
+            self._manager.wait_until_finished()
+        if self._snapshot_manager is not None:
+            self._snapshot_manager.wait_until_finished()
+
     def close(self) -> None:
         # exception-safe: a failure draining one manager must not abandon
         # the other's in-flight async save
@@ -410,7 +428,7 @@ class CheckpointStore:
     # ---------------------------------------------------------------- save
     def save_training(self, *, params, opt_state, step: int,
                       epoch: int, wait: bool = False,
-                      snapshot: bool = False) -> None:
+                      snapshot: bool = False) -> bool:
         """Async by default: orbax copies device arrays to host
         synchronously (<1 train step of stall), then persists in the
         background while training continues (SURVEY.md §5's 'orbax async
@@ -425,10 +443,26 @@ class CheckpointStore:
                  'step': np.asarray(step, np.int32),
                  'epoch': np.asarray(epoch, np.int32)}
         manager = self.snapshot_manager() if snapshot else self.manager()
-        manager.save(step, args=ocp.args.StandardSave(state))
+        saved = manager.save(step, args=ocp.args.StandardSave(state))
+        if saved is False:
+            # orbax silently skips step <= latest_step: with rewind
+            # hygiene (purge_steps_newer_than) this should not happen —
+            # a skipped save the caller believes durable is lost work
+            logger.warning(
+                'checkpoint %s: orbax SKIPPED the save at step %d '
+                '(a retained step with an equal or newer key exists) — '
+                'this state was NOT persisted', self.model_path, step)
         if wait:
             manager.wait_until_finished()
+        if snapshot and faults.maybe_fire('corrupt_snapshot'):
+            # fault drill (ROBUSTNESS.md): finalize the async write, then
+            # truncate the artifact — the exact on-disk state a disk-full
+            # or killed writer leaves, which restore must fall back past
+            manager.wait_until_finished()
+            faults.corrupt_directory(
+                os.path.join(str(manager.directory), str(step)))
         self._write_metadata()
+        return saved is not False
 
     def save_release(self, params) -> None:
         """Params-only artifact (the reference's ``--release``)."""
@@ -443,32 +477,161 @@ class CheckpointStore:
         self._write_metadata()
 
     # ------------------------------------------------------------- restore
-    def _newest(self) -> Optional[Tuple[ocp.CheckpointManager, int]]:
-        """(manager, step) of the newest checkpoint across the epoch and
-        snapshot managers.  Keys are global steps (older checkpoints were
-        keyed by epoch — restore handles either, the stored state carries
-        both numbers)."""
+    def _restore_candidates(self) -> list:
+        """Every retained (manager, step) across the epoch and snapshot
+        managers, NEWEST step first — the corruption-fallback order.
+        Keys are global steps (older checkpoints were keyed by epoch —
+        restore handles either, the stored state carries both numbers)."""
         candidates = []
         if os.path.isdir(self.entire_dir):
-            latest = self.manager().latest_step()
-            if latest is not None:
-                candidates.append((self.manager(), latest))
+            for step in self.manager().all_steps():
+                candidates.append((self.manager(), int(step)))
         if os.path.isdir(self.snapshot_dir):
-            latest = self.snapshot_manager().latest_step()
-            if latest is not None:
-                candidates.append((self.snapshot_manager(), latest))
-        return max(candidates, key=lambda c: c[1]) if candidates else None
+            for step in self.snapshot_manager().all_steps():
+                candidates.append((self.snapshot_manager(), int(step)))
+        return sorted(candidates, key=lambda c: c[1], reverse=True)
 
-    def restore_training(self, abstract_params, abstract_opt_state
+    def _newest(self) -> Optional[Tuple[ocp.CheckpointManager, int]]:
+        """(manager, step) of the newest checkpoint across both stores."""
+        candidates = self._restore_candidates()
+        return candidates[0] if candidates else None
+
+    def has_step(self, step: int) -> bool:
+        """True when a retained checkpoint in either store holds
+        ``step`` (preemption save verification)."""
+        return any(s == step for _m, s in self._restore_candidates())
+
+    def _quarantine(self, manager, step: int,
+                    suffix: str = '.corrupt') -> None:
+        """Move a step directory ASIDE (rename to ``<step><suffix>``) so
+        neither retention nor the next restore trips over it again.
+        Best-effort and reversible: a false positive (e.g. a transient
+        read error) is recovered by renaming the directory back."""
+        step_dir = os.path.join(str(manager.directory), str(step))
+        try:
+            if os.path.isdir(step_dir):
+                # unique destination: a REPEAT rewind can quarantine the
+                # same step number again (re-saved after the first
+                # purge), and os.replace onto an existing non-empty dir
+                # would fail, leaving the poisoned artifact in place
+                dest = step_dir + suffix
+                serial = 1
+                while os.path.exists(dest):
+                    serial += 1
+                    dest = '%s%s.%d' % (step_dir, suffix, serial)
+                os.replace(step_dir, dest)
+                logger.warning(
+                    'checkpoint %s: quarantined step %d to `%s`',
+                    self.model_path, step, dest)
+        except OSError as exc:
+            logger.warning('checkpoint %s: could not quarantine step %d '
+                           '(%s)', self.model_path, step, exc)
+
+    def purge_steps_newer_than(self, step: int) -> None:
+        """Quarantine every retained step NEWER than ``step``, across
+        both stores (suffix ``.rewound``).  Divergence-rewind hygiene:
+        artifacts saved inside the poisoned window (a) would shadow the
+        rewound state as 'newest' for a crash-resume, and (b) hold their
+        step keys, which makes orbax silently no-op any later re-save at
+        or below them (``manager.save`` returns False for
+        ``step <= latest_step``)."""
+        for manager, retained in self._restore_candidates():
+            if retained > step:
+                self._quarantine(manager, retained, suffix='.rewound')
+        # the managers' in-memory checkpoint lists still name the purged
+        # steps; reopening on next use resyncs them with the directory
+        self.close()
+
+    def _raise_if_permanent(self, exc: Exception) -> None:
+        """Re-raise a restore failure as a clear, store-wide error when
+        the sidecar says it cannot be corruption: a pre-canonical layout
+        or a cross-framework training resume affects EVERY retained step,
+        so falling back to older artifacts cannot help."""
+        stored = self._stored_metadata()
+        if stored and stored.get('checkpoint_layout') != self._LAYOUT:
+            raise CheckpointLayoutError(
+                'Checkpoint at `%s` predates the canonical parameter '
+                'layout (no checkpoint_layout marker); it cannot be '
+                'restored by this version. Re-save it from the version '
+                'that wrote it.' % self.model_path) from exc
+        stored_fw = stored.get('framework') if stored else None
+        current_fw = self.metadata.get('framework')
+        if stored_fw and current_fw and stored_fw != current_fw:
+            raise CheckpointLayoutError(
+                'Cannot resume TRAINING from `%s` with framework=%r: '
+                'the checkpoint was written by framework=%r and '
+                'optimizer state is backend-specific. Params-only '
+                'loads (evaluate / predict / --release) work across '
+                'frameworks.' % (self.model_path, current_fw,
+                                 stored_fw)) from exc
+
+    def restore_training(self, abstract_params, abstract_opt_state,
+                         max_step: Optional[int] = None
                          ) -> Optional[RestoredTraining]:
-        """Restore the newest full training state (epoch checkpoint or
-        step-interval snapshot, whichever is newer), re-sharded to match
-        the abstract target (shapes + shardings)."""
-        newest = self._newest()
-        if newest is None:
+        """Restore the newest RESTORABLE full training state (epoch
+        checkpoint or step-interval snapshot), re-sharded to match the
+        abstract target (shapes + shardings).  ``max_step`` excludes
+        newer steps (the divergence guard passes its last KNOWN-FINITE
+        step so it never rewinds into a snapshot saved after the
+        divergence began).
+
+        A step that fails to restore (partial/corrupt write: disk-full,
+        preemption mid-finalize) is logged and skipped in favor of the
+        next-older retained step — losing one save interval beats losing
+        the run.  Quarantine (rename to ``<step>.corrupt``) is DEFERRED
+        until some older step actually restores: a failure shared by
+        every candidate is a config/environment problem, and renaming the
+        whole history aside would destroy good data — that case raises
+        with the newest failure instead."""
+        candidates = self._restore_candidates()
+        if max_step is not None:
+            candidates = [c for c in candidates if c[1] <= max_step]
+        if not candidates:
             return None
-        manager, latest = newest
         self.verify_metadata()
+        return self._restore_with_fallback(
+            candidates,
+            lambda manager, step: self._restore_training_at(
+                manager, step, abstract_params, abstract_opt_state),
+            what='restore')
+
+    def _restore_with_fallback(self, candidates, attempt, what: str):
+        """The shared corruption-fallback policy (restore_training and
+        restore_params): try ``attempt(manager, step)`` newest first;
+        store-wide failures (CheckpointLayoutError / sidecar-permanent)
+        re-raise immediately; others fall back to the next older step.
+        Quarantine of failed steps is DEFERRED until some step actually
+        restores — when every candidate fails the error re-raises and
+        nothing is renamed (a shared failure is a config/environment
+        cause, not corruption)."""
+        failed: list = []   # (manager, step, exc) awaiting quarantine
+        for manager, step in candidates:
+            try:
+                restored = attempt(manager, step)
+            except CheckpointLayoutError:
+                raise
+            except Exception as exc:
+                self._raise_if_permanent(exc)
+                logger.warning(
+                    'checkpoint %s: %s of step %d failed (%r); falling '
+                    'back to the next older retained step',
+                    self.model_path, what, step, exc)
+                failed.append((manager, step, exc))
+                continue
+            for failed_manager, failed_step, _exc in failed:
+                self._quarantine(failed_manager, failed_step)
+            return restored
+        last_exc = failed[-1][2]
+        raise ValueError(
+            'No retained checkpoint under `%s` could be restored (all %d '
+            'candidate step(s) failed identically-or-worse, so nothing '
+            'was quarantined — suspect a config/environment cause); '
+            'newest failure: %r' % (self.model_path, len(candidates),
+                                    last_exc)) from last_exc
+
+    def _restore_training_at(self, manager, latest: int, abstract_params,
+                             abstract_opt_state) -> RestoredTraining:
+        """One restore attempt against one (manager, step) artifact."""
         # One metadata read serves both adaptations (it can be disk/network
         # I/O on remote checkpoint stores); the cache keeps
         # _artifact_target_rows' call-on-demand signature.
@@ -514,28 +677,11 @@ class CheckpointStore:
         target = {'params': abstract_params, 'opt_state': abstract_opt_state,
                   'step': np.asarray(0, np.int32),
                   'epoch': np.asarray(0, np.int32)}
-        try:
-            restored = manager.restore(
-                latest, args=ocp.args.StandardRestore(target))
-        except Exception as exc:
-            stored = self._stored_metadata()
-            if stored and stored.get('checkpoint_layout') != self._LAYOUT:
-                raise ValueError(
-                    'Checkpoint at `%s` predates the canonical parameter '
-                    'layout (no checkpoint_layout marker); it cannot be '
-                    'restored by this version. Re-save it from the version '
-                    'that wrote it.' % self.model_path) from exc
-            stored_fw = stored.get('framework')
-            current_fw = self.metadata.get('framework')
-            if stored_fw and current_fw and stored_fw != current_fw:
-                raise ValueError(
-                    'Cannot resume TRAINING from `%s` with framework=%r: '
-                    'the checkpoint was written by framework=%r and '
-                    'optimizer state is backend-specific. Params-only '
-                    'loads (evaluate / predict / --release) work across '
-                    'frameworks.' % (self.model_path, current_fw,
-                                     stored_fw)) from exc
-            raise
+        # failures propagate to restore_training's candidate loop, which
+        # distinguishes store-wide config errors (_raise_if_permanent)
+        # from per-artifact corruption (quarantine + fall back)
+        restored = manager.restore(
+            latest, args=ocp.args.StandardRestore(target))
         params, opt_state = restored['params'], restored['opt_state']
         if stored_rows is not None:
             current_rows = self.metadata.get(_TARGET_ROWS_KEY)
@@ -584,10 +730,17 @@ class CheckpointStore:
                 self.weights_dir, {'params': with_rows(stored_rows)})
             checkpointer.close()
             return adapt(restored['params'], stored_rows)
-        newest = self._newest()
-        if newest is None:
+        candidates = self._restore_candidates()
+        if not candidates:
             return None
-        manager, latest = newest
+        return self._restore_with_fallback(
+            candidates,
+            lambda manager, step: self._restore_params_at(manager, step,
+                                                          with_rows, adapt),
+            what='params-only restore')
+
+    def _restore_params_at(self, manager, latest: int, with_rows, adapt):
+        """One params-only restore attempt against one (manager, step)."""
         stored_rows = self._artifact_target_rows(
             lambda: manager.item_metadata(latest))
         abstract_params = with_rows(stored_rows)
@@ -629,13 +782,16 @@ class CheckpointStore:
         if not unrestored:
             return
         stored = self._stored_metadata()
+        # CheckpointLayoutError: layout mismatches are store-wide — the
+        # corruption fallback must re-raise them, not quarantine through
+        # every retained step
         if stored and stored.get('checkpoint_layout') != self._LAYOUT:
-            raise ValueError(
+            raise CheckpointLayoutError(
                 'Checkpoint at `%s` predates the canonical parameter '
                 'layout (no checkpoint_layout marker); it cannot be '
                 'restored by this version. Re-save it from the version '
                 'that wrote it.' % self.model_path)
-        raise ValueError(
+        raise CheckpointLayoutError(
             'Checkpoint at `%s` did not contain these parameters: %s — '
             'the stored tree does not match the expected canonical '
             'layout.' % (self.model_path, ', '.join(unrestored)))

@@ -257,6 +257,37 @@ class Config:
     # LIVE run with no restart — telemetry/trace.py).
     TELEMETRY_TRACE_AT_STEP: int = -1
     TELEMETRY_TRACE_NUM_STEPS: int = 5
+    # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
+    # Divergence guard: check the windowed losses for NaN/Inf at each
+    # log-window sync (zero extra host syncs — the losses come to host
+    # there anyway); on divergence rewind to the newest checkpoint and
+    # skip the offending data window. On by default: with no checkpoint
+    # to rewind to it degrades to abort-with-diagnostics, which still
+    # beats silently training on NaN.
+    DIVERGENCE_GUARD: bool = True
+    # Rewinds the guard attempts before declaring the run systematically
+    # divergent and aborting with a diagnostic dump.
+    MAX_DIVERGENCE_REWINDS: int = 3
+    # Hang watchdog deadline in seconds for the hot loop's two blocking
+    # waits (next staged batch; log-window device sync). Past it the run
+    # dumps all thread stacks and hard-aborts (SIGABRT) so a wedged
+    # multi-host collective fails loud. 0 disables. Size it well above
+    # the slowest legitimate wait — at least first-step jit compile plus
+    # a full eval interval on multi-host meshes (minutes, not seconds).
+    HANG_WATCHDOG_SECS: float = 0.0
+    # Install SIGTERM/SIGINT handlers for the duration of train(): the
+    # fit loop then exits at the next step boundary after one final
+    # snapshot save, so spot-VM preemption loses at most the current
+    # step. No-op when fit runs outside the main thread.
+    HANDLE_PREEMPTION_SIGNALS: bool = True
+    # Deterministic fault injection spec (resilience/faults.py):
+    # comma-separated <point>@<trigger>=<n>, e.g.
+    # 'nan_loss@step=120,sigterm@step=50'. None = UNSET, so the
+    # FAULT_INJECT environment variable fills in (runs launched by
+    # scripts you can't edit, like the TELEMETRY_TRACE_AT_STEP
+    # convention); '' = explicitly disabled, overriding the env var
+    # (the clean control arm of a fault drill).
+    FAULT_INJECT: Optional[str] = None
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -410,6 +441,27 @@ class Config:
                                  'when global step N is reached (implies '
                                  '--telemetry; live runs can instead touch '
                                  '<telemetry_dir>/TRACE_NOW)')
+        parser.add_argument('--fault-inject', dest='fault_inject',
+                            default=None, metavar='SPEC',
+                            help='deterministic fault injection: '
+                                 'comma-separated <point>@<trigger>=<n> '
+                                 '(e.g. nan_loss@step=120); the '
+                                 'FAULT_INJECT env var fills in when '
+                                 'unset (ROBUSTNESS.md)')
+        parser.add_argument('--watchdog-secs', dest='watchdog_secs',
+                            type=float, default=None, metavar='S',
+                            help='hang-watchdog deadline for the hot '
+                                 "loop's blocking waits; past it the run "
+                                 'dumps thread stacks and aborts '
+                                 '(0 disables; ROBUSTNESS.md)')
+        parser.add_argument('--max-divergence-rewinds',
+                            dest='max_divergence_rewinds', type=int,
+                            default=None, metavar='N',
+                            help='rewind budget of the divergence guard '
+                                 'before the run aborts with diagnostics')
+        parser.add_argument('--no-divergence-guard',
+                            dest='no_divergence_guard', action='store_true',
+                            help='disable the NaN/Inf loss-window guard')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -497,6 +549,20 @@ class Config:
             if env_step >= 0:
                 self.TELEMETRY_TRACE_AT_STEP = env_step
                 self.TELEMETRY = True
+        if parsed.fault_inject is not None:
+            # an explicit --fault-inject '' DISABLES injection even when
+            # the env var is set (the control arm of a drill)
+            self.FAULT_INJECT = parsed.fault_inject
+        elif self.FAULT_INJECT is None:
+            # env-var fallback, same rationale as TELEMETRY_TRACE_AT_STEP:
+            # fault drills on runs whose launch scripts you can't edit
+            self.FAULT_INJECT = os.environ.get('FAULT_INJECT')
+        if parsed.watchdog_secs is not None:
+            self.HANG_WATCHDOG_SECS = parsed.watchdog_secs
+        if parsed.max_divergence_rewinds is not None:
+            self.MAX_DIVERGENCE_REWINDS = parsed.max_divergence_rewinds
+        if parsed.no_divergence_guard:
+            self.DIVERGENCE_GUARD = False
         return self
 
     # ------------------------------------------------------- derived props
@@ -666,6 +732,17 @@ class Config:
                 "config.OPTIMIZER_STATE_SHARDING='zero' shards the dense "
                 'optax Adam moment tree; LAZY_EMBEDDING_ADAM keeps its own '
                 'state layout.')
+        if self.MAX_DIVERGENCE_REWINDS < 0:
+            raise ValueError('config.MAX_DIVERGENCE_REWINDS must be >= 0.')
+        if self.HANG_WATCHDOG_SECS < 0:
+            raise ValueError('config.HANG_WATCHDOG_SECS must be >= 0 '
+                             '(0 disables the watchdog).')
+        if self.FAULT_INJECT:
+            # a typo'd injection spec must fail at startup, not silently
+            # inject nothing (parse_spec raises ValueError with the
+            # offending entry and the known fault points)
+            from code2vec_tpu.resilience.faults import parse_spec
+            parse_spec(self.FAULT_INJECT)
 
     def __iter__(self) -> Iterator[Tuple[str, Any]]:
         for field in dataclasses.fields(self):

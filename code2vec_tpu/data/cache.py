@@ -95,20 +95,40 @@ class TokenCache:
         max_contexts = self.meta['max_contexts']
         if self.version >= 2:
             self.num_contexts = self.meta['num_contexts']
+            # size validation BEFORE mapping (ISSUE 3 satellite): a
+            # truncated shard (disk-full or killed build) would otherwise
+            # surface as an opaque mmap error — or worse, feed mis-aligned
+            # epochs if the meta undercounts
+            self._check_shard_size('ctx.bin', self.num_contexts * 3 * 4)
+            self._check_shard_size('count.bin', self.num_rows * 4)
             self.ctx = np.memmap(os.path.join(cache_dir, 'ctx.bin'),
                                  dtype=np.int32, mode='r',
                                  shape=(self.num_contexts, 3))
             self.count = np.memmap(os.path.join(cache_dir, 'count.bin'),
                                    dtype=np.int32, mode='r',
                                    shape=(self.num_rows,))
+            # and the counts must RECONCILE with the context shard: the
+            # per-example lengths are the offsets every epoch iteration
+            # slices ctx.bin by — a mismatch mis-aligns every batch
+            total = int(np.asarray(self.count).sum(dtype=np.int64))
+            if total != self.num_contexts:
+                raise ValueError(
+                    'Token cache at `%s` is corrupt: count.bin totals %d '
+                    'contexts but meta.json/ctx.bin hold %d — delete the '
+                    'cache directory to rebuild it.'
+                    % (cache_dir, total, self.num_contexts))
         else:
             shape2 = (self.num_rows, max_contexts)
+            plane_bytes = self.num_rows * max_contexts * 4
+            for name in ('source.bin', 'path.bin', 'target.bin'):
+                self._check_shard_size(name, plane_bytes)
             self.source = np.memmap(os.path.join(cache_dir, 'source.bin'),
                                     dtype=np.int32, mode='r', shape=shape2)
             self.path = np.memmap(os.path.join(cache_dir, 'path.bin'),
                                   dtype=np.int32, mode='r', shape=shape2)
             self.target = np.memmap(os.path.join(cache_dir, 'target.bin'),
                                     dtype=np.int32, mode='r', shape=shape2)
+        self._check_shard_size('label.bin', self.num_rows * 4)
         self.label = np.memmap(os.path.join(cache_dir, 'label.bin'),
                                dtype=np.int32, mode='r',
                                shape=(self.num_rows,))
@@ -116,6 +136,19 @@ class TokenCache:
         # monotonically across batches AND epochs so the jitted packed
         # step specializes a handful of times per run, not per batch
         self._packer = None
+
+    def _check_shard_size(self, name: str, expected_bytes: int) -> None:
+        """A shard whose on-disk size disagrees with meta.json means a
+        truncated or torn cache build: fail with instructions, never
+        serve mis-aligned epochs."""
+        path = os.path.join(self.cache_dir, name)
+        actual = os.path.getsize(path) if os.path.isfile(path) else -1
+        if actual != expected_bytes:
+            raise ValueError(
+                'Token cache at `%s` is truncated or corrupt: %s is %d '
+                'bytes but meta.json implies %d (disk-full or killed '
+                'build?) — delete the cache directory to rebuild it.'
+                % (self.cache_dir, name, actual, expected_bytes))
 
     def _packer_for(self, data_shards: int) -> packed_lib.StickyPacker:
         if self._packer is None or self._packer.data_shards != data_shards:

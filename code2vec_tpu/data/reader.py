@@ -74,9 +74,18 @@ def context_valid_mask(source: np.ndarray, path: np.ndarray,
 
 def _counted_batches(batches):
     """Pass-through that counts emitted batches into the telemetry
-    pipeline counter (one bool read per batch when telemetry is off)."""
+    pipeline counter (one bool read per batch when telemetry is off).
+    Also hosts the ``hang_input`` fault point (resilience/faults.py):
+    firing blocks this stream — from whichever thread drives it, usually
+    the prefetch producer — exactly like a wedged filesystem would, so
+    the hang watchdog's input-wait arm is exercised end to end."""
+    import time as _time
+
+    from code2vec_tpu.resilience import faults
     from code2vec_tpu.telemetry import core
     for batch in batches:
+        if faults.maybe_fire('hang_input'):
+            _time.sleep(faults.HANG_SECONDS)
         if core.enabled():
             core.registry().counter('input/batches_total').inc()
         yield batch

@@ -17,10 +17,15 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import threading
 import time
 from typing import List, Optional
+
+# package logger: 'code2vec_tpu.metrics_writer' — propagates to the
+# 'code2vec_tpu' root logger Config.get_logger configures
+logger = logging.getLogger(__name__)
 
 # One disk append per this many scalars. fit() emits 2 scalars per log
 # window (train/loss + examples_per_sec), so 8 keeps a plotting tail -f
@@ -38,6 +43,11 @@ class MetricsWriter:
         self._buffer_records = max(1, buffer_records)
         self._lock = threading.Lock()
         self._closed = False
+        # dropped-write accounting (ISSUE 3 satellite): a read-only or
+        # full disk must neither crash training nor masquerade as a
+        # healthy run — the FIRST failure is logged, later ones counted
+        self._write_failures = 0
+        self._dropped_records = 0
         # a crashed or non-closing run still gets its buffered tail
         atexit.register(self._atexit_flush)
         self._tb = None
@@ -55,7 +65,14 @@ class MetricsWriter:
             if len(self._buffer) >= self._buffer_records:
                 self._flush_locked()
         if self._tb is not None:
-            self._tb.add_scalar(tag, value, step)
+            try:
+                self._tb.add_scalar(tag, value, step)
+            except Exception as exc:
+                # the event-file mirror is best-effort, but its death
+                # must be visible once, not swallowed forever
+                logger.warning('metrics writer: tensorboard mirror failed '
+                               '(%s); disabling it for this writer', exc)
+                self._tb = None
 
     def flush(self) -> None:
         with self._lock:
@@ -66,10 +83,23 @@ class MetricsWriter:
     def _flush_locked(self) -> None:
         if not self._buffer:
             return
-        # open-per-flush append: no long-lived handle to leak between
-        # flushes, and append mode keeps resumed runs' streams intact
-        with open(self._path, 'a') as f:
-            f.write('\n'.join(self._buffer) + '\n')
+        try:
+            # open-per-flush append: no long-lived handle to leak between
+            # flushes, and append mode keeps resumed runs' streams intact
+            with open(self._path, 'a') as f:
+                f.write('\n'.join(self._buffer) + '\n')
+        except OSError as exc:
+            # metric persistence must never take down the training run —
+            # but it must not fail SILENTLY either: log the first failure
+            # (rate-limited to once per writer; close() reports the total)
+            self._write_failures += 1
+            self._dropped_records += len(self._buffer)
+            if self._write_failures == 1:
+                logger.warning(
+                    'metrics writer: appending to `%s` failed (%s) — '
+                    'metric records will be DROPPED until writes recover; '
+                    'further failures are logged once at close', self._path,
+                    exc)
         self._buffer = []
 
     def _atexit_flush(self) -> None:
@@ -83,6 +113,11 @@ class MetricsWriter:
         if self._closed:
             return
         self.flush()
+        if self._dropped_records:
+            logger.warning(
+                'metrics writer: %d record(s) dropped across %d failed '
+                'append(s) to `%s` (read-only or full disk?)',
+                self._dropped_records, self._write_failures, self._path)
         self._closed = True
         atexit.unregister(self._atexit_flush)
         if self._tb is not None:

@@ -12,6 +12,8 @@ load-or-create params.
 """
 from __future__ import annotations
 
+import contextlib
+import json
 import os
 import time
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
@@ -209,6 +211,19 @@ class Code2VecModel:
                 self.log('Resumed from `%s` at epoch %d (step %d)' % (
                     self.config.MODEL_LOAD_PATH, restored.epoch,
                     restored.step))
+                # preemption marker (resilience/preempt.py): advisory
+                # breadcrumb from a run that exited on SIGTERM/SIGINT —
+                # consumed here so a later unclean crash isn't misread
+                # as a preemption
+                marker = os.path.join(store.snapshot_dir, 'PREEMPTED.json')
+                if os.path.isfile(marker):
+                    self.log('Previous run exited on a preemption signal '
+                             '(marker `%s`); continuing from its final '
+                             'snapshot.' % marker)
+                    try:
+                        os.remove(marker)
+                    except OSError:
+                        pass
             else:
                 params = store.restore_params(abstract_params)
                 if params is None:
@@ -380,16 +395,115 @@ class Code2VecModel:
             _evaluate_and_log('batch %d' % batch_num, batch_num,
                               state.params)
 
+        # ---- resilience wiring (ROBUSTNESS.md) ----
+        from code2vec_tpu.resilience.preempt import PreemptionHandler
+        from code2vec_tpu.telemetry import core as tele_core
+        preemption = (PreemptionHandler(log=self.log)
+                      if config.HANDLE_PREEMPTION_SIGNALS else None)
+
+        def on_preempt(epoch: int, batch_num: int,
+                       state: TrainerState) -> None:
+            if save_store is None:
+                # no --save path: there is nowhere to snapshot — still
+                # exit cleanly (flushed metrics, no traceback)
+                if writer is not None:
+                    writer.flush()
+                self.log('Preemption: no MODEL_SAVE_PATH, exiting without '
+                         'a snapshot.')
+                return
+            # one final snapshot (deduped against an interval save that
+            # just fired on this step), made DURABLE before the fit loop
+            # returns — the preemption grace window may be short, so the
+            # wait happens here, not in train()'s finally
+            t0 = time.time()
+            _save_at(state, epoch - 1, snapshot=True)
+            save_store.wait_until_finished()
+            save_s = time.time() - t0
+            if tele_core.enabled():
+                tele_core.registry().gauge(
+                    'resilience/preempt_save_s').set(save_s)
+            # claim success only when a checkpoint for THIS step is
+            # actually on disk: _save_at dedupes against the run's
+            # starting step, so a fresh run preempted before its first
+            # completed step saved nothing — telling the operator to
+            # '--load' would then fail
+            step = int(state.step)
+            if not save_store.has_step(step):
+                if writer is not None:
+                    writer.flush()
+                self.log('Preemption at step %d: no completed step to '
+                         'snapshot (nothing newer than the run\'s start); '
+                         'exiting without a resume marker.' % step)
+                return
+            # advisory resume marker — the snapshot itself is the resume
+            # state; the marker only tells the next run (and the
+            # operator) this was a clean preemption exit
+            marker = os.path.join(save_store.snapshot_dir,
+                                  'PREEMPTED.json')
+            try:
+                os.makedirs(save_store.snapshot_dir, exist_ok=True)
+                with open(marker, 'w') as f:
+                    json.dump({'step': int(state.step),
+                               'last_complete_epoch': epoch - 1,
+                               'time': time.time()}, f)
+            except OSError:
+                pass
+            if writer is not None:
+                writer.flush()
+            self.log('Preemption save complete at step %d (%.2fs); '
+                     'resume with --load %s'
+                     % (int(state.step), save_s, config.MODEL_SAVE_PATH))
+
+        def on_divergence(last_good_step: int) -> Optional[TrainerState]:
+            """Divergence-guard rewind target: the newest restorable
+            checkpoint across the epoch + step-snapshot stores, capped
+            at the guard's last known-finite step (a snapshot saved
+            inside the unchecked window may hold poisoned params)."""
+            if save_store is None:
+                return None
+            # drain any in-flight async save first, so the newest
+            # snapshot is durable and readable
+            save_store.wait_until_finished()
+            abstract_params, abstract_opt = self.trainer.abstract_state()
+            try:
+                restored = save_store.restore_training(
+                    abstract_params, abstract_opt,
+                    max_step=last_good_step)
+            except Exception as exc:
+                self.log('Divergence rewind: no checkpoint restorable '
+                         '(%s).' % exc)
+                return None
+            if restored is None:
+                return None
+            # rewind hygiene: retained steps NEWER than the restore
+            # target were saved inside the poisoned window — purge them
+            # so (a) a crash-resume cannot restore them as 'newest' and
+            # (b) their keys don't make orbax silently skip re-saves
+            save_store.purge_steps_newer_than(restored.step)
+            # re-arm the save dedupe at the restored step: the pre-rewind
+            # 'last saved' value may name a just-purged key, and the
+            # re-trained states at those steps must be saved again
+            last_saved_step[0] = restored.step
+            return TrainerState(
+                params=self.backend.from_canonical(restored.params),
+                opt_state=restored.opt_state,
+                step=jnp.asarray(restored.step, jnp.int32),
+                rng=jax.random.PRNGKey(42))
+
         start = getattr(self, '_start_epoch', 0)
         try:
-            self.state = self.trainer.fit(
-                self.state, epoch_batches, start_epoch=start,
-                on_epoch_end=on_epoch_end, on_log=on_log,
-                on_eval_interval=(on_eval_interval
-                                  if run_evals else None),
-                on_save_interval=(on_save_interval
-                                  if save_store is not None else None),
-                on_epoch_time=on_epoch_time)
+            with (preemption if preemption is not None
+                  else contextlib.nullcontext()):
+                self.state = self.trainer.fit(
+                    self.state, epoch_batches, start_epoch=start,
+                    on_epoch_end=on_epoch_end, on_log=on_log,
+                    on_eval_interval=(on_eval_interval
+                                      if run_evals else None),
+                    on_save_interval=(on_save_interval
+                                      if save_store is not None else None),
+                    on_epoch_time=on_epoch_time,
+                    preemption=preemption, on_preempt=on_preempt,
+                    on_divergence=on_divergence)
         finally:
             # drain in-flight async checkpoint saves even when training
             # raises: a commenced save must end up durable
@@ -397,6 +511,10 @@ class Code2VecModel:
             if writer is not None:
                 writer.close()
         self.params = self.state.params
+        if preemption is not None and preemption.requested:
+            self.log('Training stopped early by %s after a '
+                     'preemption-safe snapshot; remaining epochs were '
+                     'skipped.' % preemption.signal_name)
 
     # ---------------------------------------------------------------- save
     def save(self, model_save_path: Optional[str] = None,

@@ -1,0 +1,31 @@
+"""Training resilience layer (ROBUSTNESS.md).
+
+Four pillars, each wired through the trainer/model lifecycle and each
+testable on CPU via deterministic fault injection:
+
+- ``guard``    — divergence guard: non-finite loss window -> rewind to
+                 the last snapshot, retry with a bounded budget, abort
+                 with diagnostics when the budget burns out.
+- ``preempt``  — SIGTERM/SIGINT -> step-boundary flag -> one final
+                 snapshot + clean exit (spot-VM preemption loses at most
+                 the current step).
+- ``watchdog`` — hang monitor armed around the two blocking waits in
+                 the hot loop; dumps all thread stacks and hard-aborts
+                 past the deadline so a wedged collective fails loud.
+- ``faults``   — the deterministic fault-injection harness
+                 (``FAULT_INJECT=<point>@<trigger>=<n>,...``) that makes
+                 the other three testable; fault points are cataloged in
+                 ``faults.FAULT_POINTS`` and linted by
+                 ``scripts/check_fault_points.py``.
+
+Everything is stdlib-only at import time (same policy as
+``telemetry/``); jax is only touched by the trainer integration.
+"""
+from __future__ import annotations
+
+from code2vec_tpu.resilience.guard import DivergenceError, DivergenceGuard
+from code2vec_tpu.resilience.preempt import PreemptionHandler
+from code2vec_tpu.resilience.watchdog import HangWatchdog
+
+__all__ = ['DivergenceError', 'DivergenceGuard', 'PreemptionHandler',
+           'HangWatchdog']

@@ -1,0 +1,182 @@
+"""Deterministic fault injection: the testing backbone of the resilience
+layer (ROBUSTNESS.md).
+
+A run is armed with a spec — ``Config.FAULT_INJECT`` or the
+``FAULT_INJECT`` environment variable — of comma-separated
+``<point>@<trigger>=<n>`` entries:
+
+    nan_loss@step=120,sigterm@step=50
+    hang_input@step=30
+    corrupt_snapshot@save=2
+
+Each *fault point* is a named site in production code that calls
+``maybe_fire(<point>)``; the spec decides WHEN that site fires (at most
+once per configured plan).  The trigger count is either the explicit
+``step=`` value the site passes (the trainer passes its global step
+counter) or, for sites with no natural step, the number of times the
+site has been reached (``hang_input`` counts batches, ``corrupt_snapshot``
+counts snapshot saves).  The trigger key name (``step`` / ``save`` / …)
+is documentation for humans — the plan only keeps the integer.
+
+What happens on fire is implemented AT the site (poison the loss, kill
+the process, sleep, truncate the artifact): the harness only decides
+when, so the injected failure exercises the exact code path a real one
+would.
+
+``FAULT_POINTS`` is the catalog every site name must come from —
+``scripts/check_fault_points.py`` lints call sites against it (the same
+pattern as the metric-schema lint), so a typo'd point name fails tier-1
+instead of silently never firing.
+
+Dependency-free (stdlib only) and thread-safe: sites fire from the
+training thread, the reader prefetch thread, and the checkpoint path.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+#: every fault point a ``maybe_fire`` site may name, with where it lives
+#: and what firing does there.  Keep ROBUSTNESS.md's table in sync — the
+#: lint checks the doc mentions every name.
+FAULT_POINTS: Dict[str, str] = {
+    'nan_loss': 'training/trainer.py hot loop: poison the triggering '
+                "step's loss with NaN (exercises the divergence guard).",
+    'sigterm': 'training/trainer.py hot loop: deliver SIGTERM to this '
+               'process once the step counter reaches the trigger '
+               '(exercises preemption-safe shutdown).',
+    'hang_input': 'data/reader.py batch stream: block the input pipeline '
+                  'indefinitely at the triggering batch (exercises the '
+                  'hang watchdog).',
+    'corrupt_snapshot': 'checkpoints.py: truncate the files of the '
+                        'just-written step snapshot (exercises the '
+                        'restore fallback).',
+}
+
+#: how long a fired ``hang_input`` blocks.  Long enough that only a
+#: watchdog abort ends the run, short enough that a leaked daemon thread
+#: in a test process eventually unwinds.
+HANG_SECONDS = 600.0
+
+
+def parse_spec(spec: str) -> Dict[str, int]:
+    """``'nan_loss@step=120,sigterm@step=50'`` -> {point: trigger_count}.
+
+    Raises ``ValueError`` on an unknown fault point or malformed entry —
+    a typo'd injection spec must fail the run at startup, not silently
+    inject nothing.
+    """
+    plan: Dict[str, int] = {}
+    for entry in (spec or '').split(','):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            point, trigger = entry.split('@', 1)
+            _key, value = trigger.split('=', 1)
+            at = int(value)
+        except ValueError:
+            raise ValueError(
+                'FAULT_INJECT entry %r is not <point>@<trigger>=<int> '
+                '(e.g. nan_loss@step=120)' % entry)
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                'FAULT_INJECT names unknown fault point %r; known points: '
+                '%s (resilience/faults.py)' % (point,
+                                               ', '.join(sorted(FAULT_POINTS))))
+        if at < 0:
+            raise ValueError(
+                'FAULT_INJECT entry %r: trigger count must be >= 0' % entry)
+        plan[point] = at
+    return plan
+
+
+class FaultPlan:
+    """The armed plan: which points fire, and at which trigger count.
+
+    Each point fires AT MOST ONCE per plan (deterministic single-shot
+    faults); ``>=`` matching makes a fault whose exact count was skipped
+    (a resumed run starting past it) still fire at the next opportunity.
+    """
+
+    def __init__(self, plan: Dict[str, int]):
+        self._at = dict(plan)
+        self._site_counts: Dict[str, int] = {}
+        self._fired: set = set()
+        self._lock = threading.Lock()
+
+    def maybe_fire(self, point: str, step: Optional[int] = None) -> bool:
+        with self._lock:
+            at = self._at.get(point)
+            if at is None or point in self._fired:
+                return False
+            if step is None:
+                step = self._site_counts.get(point, 0)
+                self._site_counts[point] = step + 1
+            if step < at:
+                return False
+            self._fired.add(point)
+        logger.warning('FAULT_INJECT: firing %r at trigger count %d',
+                       point, step)
+        from code2vec_tpu.telemetry import core
+        if core.enabled():
+            core.registry().counter('resilience/faults_fired_total').inc()
+        return True
+
+    def fired(self, point: str) -> bool:
+        with self._lock:
+            return point in self._fired
+
+
+# Process-global plan, like the telemetry registry: fault points live in
+# layers (reader, checkpoints) that have no config handle.  None (the
+# default) keeps every site at a single attribute read.
+_PLAN: Optional[FaultPlan] = None
+
+
+def configure(spec: str) -> Optional[FaultPlan]:
+    """Arm (or clear, for an empty spec) the process-global plan.  Called
+    once per run from ``Trainer.__init__`` with the resolved
+    config/env spec; re-configuring resets fired state, so each run's
+    injections are deterministic regardless of process reuse (tests)."""
+    global _PLAN
+    plan = parse_spec(spec)
+    _PLAN = FaultPlan(plan) if plan else None
+    if _PLAN is not None:
+        logger.warning('FAULT_INJECT armed: %s',
+                       ', '.join('%s@%d' % (p, n)
+                                 for p, n in sorted(plan.items())))
+    return _PLAN
+
+
+def maybe_fire(point: str, step: Optional[int] = None) -> bool:
+    """True when the armed plan says fault ``point`` fires now.  The
+    caller implements the fault.  Assert-level cheap when no plan is
+    armed (the production default)."""
+    if _PLAN is None:
+        return False
+    assert point in FAULT_POINTS, point  # lint catches this statically too
+    return _PLAN.maybe_fire(point, step)
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def corrupt_directory(path: str) -> None:
+    """Truncate every regular file under ``path`` to one NUL byte — the
+    on-disk shape a disk-full or mid-write kill leaves behind.  Used by
+    the ``corrupt_snapshot`` fault site (checkpoints.py); destructive by
+    design, so it lives here with the drills, not in production code."""
+    for dirpath, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                with open(os.path.join(dirpath, name), 'wb') as f:
+                    f.write(b'\0')
+            except OSError:
+                pass
+    logger.warning('FAULT_INJECT: corrupted artifact directory `%s`', path)

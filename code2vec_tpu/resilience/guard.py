@@ -1,0 +1,152 @@
+"""Divergence guard: detect a non-finite loss window, rewind to the last
+good snapshot, retry with a bounded budget (ROBUSTNESS.md pillar 1).
+
+Detection piggybacks on the hot loop's existing per-log-window
+``jax.device_get`` sync (trainer._fit_loop): the windowed losses come to
+host there anyway, so the finiteness check costs zero extra host syncs —
+``sum(losses)`` is non-finite iff any loss in the window is (NaN
+dominates; +inf/-inf sum to NaN or propagate).
+
+On detection the guard:
+
+1. dumps diagnostics — the window's losses, the last batch's label/
+   context stats, and a full telemetry registry snapshot — to
+   ``<dump_dir>/divergence_step<k>.json`` (the triage artifact the
+   runbook starts from);
+2. if the rewind budget (``MAX_DIVERGENCE_REWINDS``) is not exhausted,
+   restores the newest checkpoint NOT NEWER than the window's FIRST
+   non-finite step via the caller-provided ``restore(last_good_step)``
+   callback (model_api wires it to ``CheckpointStore.restore_training``
+   with that ceiling) — a snapshot saved between the first NaN and its
+   detection at the window sync can already hold poisoned params, while
+   everything before the first bad loss is clean.  The trainer keeps
+   consuming the SAME epoch iterator, so the offending data window is
+   skipped, not replayed;
+3. otherwise raises ``DivergenceError`` so the run fails loud with the
+   dump path in the message.
+
+The guard never rewinds the data: a loss spike caused by one poisonous
+window then self-heals (new data, restored params), while a
+systematically diverging run burns its budget and aborts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class DivergenceError(RuntimeError):
+    """Non-finite loss that the guard could not (or may no longer) rewind
+    past."""
+
+
+def batch_stats(host_batch: Any) -> dict:
+    """min/max/shape per array field of a Batch/PackedBatch NamedTuple —
+    the 'offending batch' half of the diagnostic dump.  Tolerant of any
+    tuple-of-arrays batch type; non-array fields are skipped."""
+    stats = {}
+    fields = getattr(host_batch, '_asdict', None)
+    items = fields().items() if fields else enumerate(host_batch or ())
+    for name, value in items:
+        if isinstance(value, np.ndarray) and value.size \
+                and value.dtype != object:
+            stats[str(name)] = {
+                'shape': list(value.shape),
+                'dtype': str(value.dtype),
+                'min': float(value.min()),
+                'max': float(value.max()),
+            }
+    return stats
+
+
+class DivergenceGuard:
+    def __init__(self, max_rewinds: int,
+                 restore: Optional[Callable[[int], Optional[Any]]],
+                 dump_dir: str, log=None, telemetry=None):
+        self.max_rewinds = max_rewinds
+        self.restore = restore
+        self.dump_dir = dump_dir
+        self.log = log or (lambda msg: None)
+        self.telemetry = telemetry
+        self.rewinds = 0
+
+    def handle(self, batch_num: int, losses: List[float],
+               host_batch: Any, step_now: Optional[int] = None) -> Any:
+        """Called by the trainer when a log window's losses are
+        non-finite.  ``step_now`` is the CURRENT state.step — after an
+        earlier rewind it lags the loop's batch counter, and checkpoint
+        keys live in step units.  Returns the rewound TrainerState, or
+        raises ``DivergenceError``."""
+        dump_path = self._dump(batch_num, losses, host_batch)
+        self.rewinds += 1
+        if self.rewinds > self.max_rewinds:
+            raise DivergenceError(
+                'Non-finite training loss at batch %d and the rewind '
+                'budget (MAX_DIVERGENCE_REWINDS=%d) is exhausted — this '
+                'run diverges systematically, not from one bad window. '
+                'Diagnostics: %s'
+                % (batch_num, self.max_rewinds, dump_path))
+        # the window's loss list pinpoints where the divergence began:
+        # every step before the FIRST non-finite loss updated params off
+        # finite gradients of a finite loss, so snapshots up to there are
+        # clean — while a snapshot from the poisoned tail would just
+        # diverge again. The ceiling is that first-bad step, in
+        # state.step units (checkpoints are keyed by state.step).
+        first_bad = next((i for i, x in enumerate(losses)
+                          if not np.isfinite(x)), len(losses))
+        base = step_now if step_now is not None else batch_num
+        last_good_step = max(0, base - len(losses) + first_bad)
+        state = (self.restore(last_good_step)
+                 if self.restore is not None else None)
+        if state is None:
+            raise DivergenceError(
+                'Non-finite training loss at batch %d and no checkpoint '
+                'at or before the last known-finite step %d to rewind to '
+                '— enable step-interval snapshots (SAVE_EVERY_N_STEPS) '
+                'so the guard has a rewind target. Diagnostics: %s'
+                % (batch_num, last_good_step, dump_path))
+        from code2vec_tpu.telemetry import core
+        if core.enabled():
+            # counted only on an ACTUAL restore: aborts above must not
+            # read as successful rewinds on a dashboard
+            core.registry().counter('resilience/rewinds_total').inc()
+        self.log(
+            'Divergence guard: non-finite loss window at batch %d; '
+            'rewound to checkpoint step %d and skipping the offending '
+            'window (rewind %d of %d). Diagnostics: %s'
+            % (batch_num, int(state.step), self.rewinds, self.max_rewinds,
+               dump_path))
+        return state
+
+    def _dump(self, batch_num: int, losses: List[float],
+              host_batch: Any) -> str:
+        """Best-effort diagnostic JSON; failures to write must never mask
+        the divergence handling itself."""
+        from code2vec_tpu.telemetry import core
+        record = {
+            'batch_num': batch_num,
+            'time': time.time(),
+            'window_losses': [float(x) for x in losses],
+            'last_batch': batch_stats(host_batch),
+            'telemetry': core.registry().snapshot(),
+            'rewinds_so_far': self.rewinds,
+        }
+        path = os.path.join(self.dump_dir,
+                            'divergence_step%d.json' % batch_num)
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(path, 'w') as f:
+                json.dump(record, f, indent=1, default=str)
+        except OSError as exc:
+            self.log('Divergence guard: could not write diagnostics to '
+                     '`%s`: %s' % (path, exc))
+            return '<unwritable: %s>' % path
+        if self.telemetry is not None:
+            # a JSONL snapshot of the registry next to the dump: the
+            # exporters' view of the run right up to the divergence
+            self.telemetry.flush_now(batch_num)
+        return path

@@ -1,0 +1,85 @@
+"""Preemption-safe shutdown: turn SIGTERM/SIGINT into a step-boundary
+flag the fit loop polls (ROBUSTNESS.md pillar 2).
+
+Spot-VM preemption delivers SIGTERM with a short grace window; Ctrl-C is
+SIGINT.  Killing a run mid-step corrupts nothing (jax state is
+immutable), but exiting without a save loses everything since the last
+``SAVE_EVERY_N_STEPS`` snapshot.  The handler makes the loss at most the
+current step: the fit loop checks ``requested`` at each step boundary,
+saves one final snapshot (model_api's ``on_preempt``), flushes
+telemetry, and returns cleanly.
+
+A SECOND SIGINT raises ``KeyboardInterrupt`` immediately — an operator
+hammering Ctrl-C means "now", not "after the snapshot".
+
+Installation is a context manager and is a no-op outside the main thread
+(``signal.signal`` raises there — e.g. fits driven from a worker
+thread); the previous handlers are restored on exit so nested/serial
+trainers never leak a stale flag into the process.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Dict, Optional
+
+
+class PreemptionHandler:
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, log=None):
+        self.log = log or (lambda msg: None)
+        self._requested = False
+        self._signum: Optional[int] = None
+        self._sigint_count = 0
+        self._previous: Dict[int, object] = {}
+        self._installed = False
+
+    # ------------------------------------------------------------- handler
+    def _handle(self, signum, frame) -> None:
+        if signum == signal.SIGINT:
+            self._sigint_count += 1
+            if self._sigint_count > 1:
+                raise KeyboardInterrupt
+        self._requested = True
+        self._signum = signum
+        self.log('Received %s: finishing the current step, then saving a '
+                 'snapshot and exiting cleanly (press Ctrl-C again to '
+                 'abort immediately).'
+                 % signal.Signals(signum).name)
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    @property
+    def signal_name(self) -> str:
+        return (signal.Signals(self._signum).name
+                if self._signum is not None else '')
+
+    # ------------------------------------------------------------ lifecycle
+    def install(self) -> 'PreemptionHandler':
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal is main-thread-only: poll-only mode
+        for signum in self.SIGNALS:
+            try:
+                self._previous[signum] = signal.signal(signum, self._handle)
+            except (ValueError, OSError):  # exotic embedders
+                self._previous.pop(signum, None)
+        self._installed = bool(self._previous)
+        return self
+
+    def uninstall(self) -> None:
+        for signum, previous in self._previous.items():
+            try:
+                signal.signal(signum, previous)
+            except (ValueError, OSError):
+                pass
+        self._previous.clear()
+        self._installed = False
+
+    def __enter__(self) -> 'PreemptionHandler':
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

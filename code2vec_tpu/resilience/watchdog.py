@@ -1,0 +1,154 @@
+"""Hang watchdog: a deadlocked run must fail loud, not burn a
+reservation (ROBUSTNESS.md pillar 3).
+
+The two places a healthy trainer can block indefinitely are the input
+pipeline (``next`` on the staged batch generator — a wedged prefetch
+thread, a hung filesystem) and the per-log-window device sync (a
+deadlocked multi-host collective: one process missed a step and the
+mesh rendezvous never completes).  The trainer arms the watchdog around
+exactly those two waits (``with watchdog.watch('...'):``).
+
+Past the deadline, a daemon monitor thread:
+
+1. dumps ALL Python thread stacks to ``<dump_dir>/watchdog_stacks.txt``
+   (``faulthandler`` — safe even when the main thread is wedged inside a
+   C call);
+2. runs the ``on_expire`` hook (the trainer wires a final telemetry
+   flush, so metrics.jsonl records the run's last healthy state);
+3. hard-aborts the process — SIGABRT by default, because a wedged
+   collective cannot be unwound from Python (no exception reaches a
+   thread blocked in C).  Cluster schedulers then see a crashed task
+   (restart/reschedule) instead of a silently stalled one.
+
+``abort`` is injectable for in-process tests; the subprocess e2e test
+(tests/test_resilience.py) exercises the real SIGABRT path.
+"""
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+
+def _default_abort() -> None:
+    # SIGABRT, not sys.exit: the hung wait lives in another (often C)
+    # frame — only a signal ends the process from the monitor thread.
+    os.kill(os.getpid(), signal.SIGABRT)
+
+
+STACKS_FILE_NAME = 'watchdog_stacks.txt'
+
+
+class HangWatchdog:
+    def __init__(self, deadline_s: float, dump_dir: str, log=None,
+                 on_expire: Optional[Callable[[], None]] = None,
+                 abort: Optional[Callable[[], None]] = None,
+                 poll_s: Optional[float] = None):
+        self.deadline_s = float(deadline_s)
+        self.dump_dir = dump_dir
+        self.log = log or (lambda msg: None)
+        self.on_expire = on_expire
+        self.abort = abort or _default_abort
+        # poll granularity: fine enough to fire within ~10% of the
+        # deadline, bounded below for sub-second test deadlines
+        self.poll_s = poll_s if poll_s is not None else max(
+            0.05, self.deadline_s / 10.0)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._armed_at: Optional[float] = None
+        self._label = ''
+        self._stop = False
+        self._expired = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- arming
+    def arm(self, label: str) -> None:
+        with self._cond:
+            self._armed_at = time.monotonic()
+            self._label = label
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._monitor, name='hang-watchdog', daemon=True)
+                self._thread.start()
+            self._cond.notify()
+        from code2vec_tpu.telemetry import core
+        if core.enabled():
+            core.registry().gauge('watchdog/armed').set(1)
+
+    def disarm(self) -> None:
+        with self._cond:
+            self._armed_at = None
+            self._label = ''
+        from code2vec_tpu.telemetry import core
+        if core.enabled():
+            core.registry().gauge('watchdog/armed').set(0)
+
+    @contextlib.contextmanager
+    def watch(self, label: str):
+        """Arm around one blocking wait; disarms even when the wait
+        raises (an input-pipeline error must not later abort an
+        otherwise-healthy teardown)."""
+        self.arm(label)
+        try:
+            yield
+        finally:
+            self.disarm()
+
+    # ------------------------------------------------------------- monitor
+    def _monitor(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                armed_at, label = self._armed_at, self._label
+                if armed_at is None:
+                    self._cond.wait(timeout=self.poll_s)
+                    continue
+            overdue = time.monotonic() - armed_at - self.deadline_s
+            if overdue >= 0:
+                self._expire(label)
+                return
+            time.sleep(min(self.poll_s, -overdue))
+
+    def _expire(self, label: str) -> None:
+        self._expired = True
+        stacks_path = os.path.join(self.dump_dir, STACKS_FILE_NAME)
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(stacks_path, 'w') as f:
+                f.write('hang watchdog expired after %.1fs waiting on: '
+                        '%s\n\n' % (self.deadline_s, label))
+                f.flush()
+                faulthandler.dump_traceback(file=f, all_threads=True)
+        except OSError:
+            stacks_path = '<unwritable: %s>' % stacks_path
+        from code2vec_tpu.telemetry import core
+        if core.enabled():
+            core.registry().counter('watchdog/expired_total').inc()
+        self.log('HANG WATCHDOG: `%s` exceeded the %.1fs deadline — '
+                 'thread stacks dumped to `%s`; aborting.'
+                 % (label, self.deadline_s, stacks_path))
+        if self.on_expire is not None:
+            try:
+                self.on_expire()
+            except Exception:
+                pass  # the abort below is the priority, not the flush
+        self.abort()
+
+    @property
+    def expired(self) -> bool:
+        return self._expired
+
+    def shutdown(self) -> None:
+        """Stop the monitor thread (fit teardown)."""
+        with self._cond:
+            self._stop = True
+            self._armed_at = None
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -86,6 +86,21 @@ CATALOG: Dict[str, Dict[str, str]] = {
     # ---- profiler capture ----
     'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
                                'trace captures completed.'),
+    # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
+    'resilience/rewinds_total': _m(COUNTER, 'rewinds', 'Divergence-guard '
+                                   'rewinds: non-finite loss windows that '
+                                   'triggered a checkpoint restore.'),
+    'resilience/faults_fired_total': _m(COUNTER, 'faults', 'Injected faults '
+                                        'fired by the FAULT_INJECT plan '
+                                        '(nonzero only in fault drills).'),
+    'resilience/preempt_save_s': _m(GAUGE, 's', 'Duration of the final '
+                                    'snapshot save after a preemption '
+                                    'signal (SIGTERM/SIGINT).'),
+    'watchdog/armed': _m(GAUGE, 'bool', 'Hang watchdog state: 1 while the '
+                         'hot loop is inside a watched blocking wait.'),
+    'watchdog/expired_total': _m(COUNTER, 'expiries', 'Watchdog deadline '
+                                 'expiries (stack dump + hard abort; >0 '
+                                 'at most once per process).'),
     # ---- MetricsWriter scalar tags (per-step JSONL series) ----
     'train/loss': _m(SCALAR, 'nats', 'Windowed average training loss.'),
     'eval/top1_acc': _m(SCALAR, 'fraction', 'Top-1 exact-match accuracy.'),

@@ -17,7 +17,10 @@ sharded-table gathers, sharded softmax) with no collective written by hand.
 from __future__ import annotations
 
 import collections
+import contextlib
 import logging
+import os
+import signal as signal_lib
 import time
 from typing import Any, Callable, Iterable, NamedTuple, Optional, Tuple
 
@@ -32,6 +35,7 @@ from code2vec_tpu.data.reader import Batch
 from code2vec_tpu.models import functional
 from code2vec_tpu.ops.topk import sharded_top_k
 from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.resilience import faults
 
 # package logger: 'code2vec_tpu.training.trainer' — propagates to the
 # 'code2vec_tpu' root logger Config.get_logger configures
@@ -138,6 +142,15 @@ class Trainer:
             self._telemetry = StepTelemetry(
                 config, log=config.log,
                 process_index=jax.process_index())
+        # Resilience (ROBUSTNESS.md): arm the process-global fault plan
+        # from config. None = unset -> the env var fills in (launches
+        # whose scripts you can't edit); '' = explicitly disabled, so an
+        # exported FAULT_INJECT cannot leak into a declared control run.
+        # Re-arming per Trainer resets fired state, so each run's
+        # injections are deterministic even under process reuse (tests).
+        faults.configure(config.FAULT_INJECT
+                         if config.FAULT_INJECT is not None
+                         else os.environ.get('FAULT_INJECT', ''))
         self._build_steps()
 
     # ----------------------------------------------------------- jit steps
@@ -467,7 +480,12 @@ class Trainer:
             on_save_interval: Optional[Callable[[int, int, TrainerState],
                                                 None]] = None,
             on_epoch_time: Optional[Callable[[int, int, float],
-                                             None]] = None
+                                             None]] = None,
+            preemption=None,
+            on_preempt: Optional[Callable[[int, int, TrainerState],
+                                          None]] = None,
+            on_divergence: Optional[Callable[[int],
+                                             Optional[TrainerState]]] = None
             ) -> TrainerState:
         """Epoch-driven loop with the reference's windowed throughput trace
         (tensorflow_model.py:74-101, 424-430).
@@ -475,7 +493,15 @@ class Trainer:
         ``on_epoch_time(epoch, batch_num, seconds)`` receives each epoch's
         training wall time (the loop over its batches, including interval
         evals; excluding ``on_epoch_end``'s eval/save) — model_api routes
-        it into the metrics writer."""
+        it into the metrics writer.
+
+        Resilience hooks (ROBUSTNESS.md): ``preemption`` is a
+        ``PreemptionHandler`` polled at step boundaries — when it has a
+        pending signal the loop runs ``on_preempt(epoch, batch_num,
+        state)`` (the final snapshot save) and returns cleanly.
+        ``on_divergence(last_good_step)`` restores the newest checkpoint
+        at or before that step for the divergence guard, returning a
+        ``TrainerState`` or None."""
         config = self.config
         log_every = config.NUM_BATCHES_TO_LOG_PROGRESS
         # resumed runs continue the step axis instead of restarting at 0
@@ -484,12 +510,37 @@ class Trainer:
         window_losses = []  # device arrays: no per-step host sync, the
         window_examples = 0  # host only blocks once per log window
         window_start = time.time()
+        guard = None
+        watchdog = None
+        if config.DIVERGENCE_GUARD:
+            from code2vec_tpu.resilience.guard import DivergenceGuard
+            from code2vec_tpu.telemetry.stepwatch import telemetry_dir
+            guard = DivergenceGuard(
+                config.MAX_DIVERGENCE_REWINDS, restore=on_divergence,
+                dump_dir=telemetry_dir(config), log=config.log,
+                telemetry=self._telemetry)
+        if config.HANG_WATCHDOG_SECS > 0:
+            from code2vec_tpu.resilience.watchdog import HangWatchdog
+            from code2vec_tpu.telemetry.stepwatch import telemetry_dir
+            tele = self._telemetry
+            watchdog = HangWatchdog(
+                config.HANG_WATCHDOG_SECS,
+                dump_dir=telemetry_dir(config), log=config.log,
+                # metrics.jsonl must record the run's last healthy state
+                # before the abort
+                on_expire=((lambda: tele.flush_now(
+                    getattr(self, '_last_batch_num', 0)))
+                    if tele is not None else None))
         try:
             state = self._fit_loop(
                 state, epoch_batches, start_epoch, on_epoch_end, on_log,
                 on_eval_interval, on_save_interval, batch_num, window_losses,
-                window_examples, window_start, log_every, on_epoch_time)
+                window_examples, window_start, log_every, on_epoch_time,
+                guard=guard, watchdog=watchdog, preemption=preemption,
+                on_preempt=on_preempt)
         finally:
+            if watchdog is not None:
+                watchdog.shutdown()
             if getattr(self, '_profiling', False):
                 jax.profiler.stop_trace()
                 self._profiling = False
@@ -513,9 +564,32 @@ class Trainer:
     def _fit_loop(self, state, epoch_batches, start_epoch, on_epoch_end,
                   on_log, on_eval_interval, on_save_interval, batch_num,
                   window_losses, window_examples, window_start, log_every,
-                  on_epoch_time=None):
+                  on_epoch_time=None, guard=None, watchdog=None,
+                  preemption=None, on_preempt=None):
         config = self.config
         tele = self._telemetry
+        if watchdog is None:
+            # the shared nullcontext is stateless and reusable; taking
+            # (and discarding) the label args keeps the disabled path
+            # free of any per-batch string formatting
+            null_ctx = contextlib.nullcontext()
+
+            def watched(label_fmt, step):
+                return null_ctx
+        else:
+            def watched(label_fmt, step):
+                return watchdog.watch(label_fmt % step)
+        host_batch = None
+
+        def rewind(losses_host):
+            """Divergence-guard rewind over the current window — reads
+            the loop's batch_num/host_batch/state at call time; raises
+            DivergenceError when the guard is out of options.  step_now
+            keys the rewind ceiling in state.step units (after an
+            earlier rewind they lag batch_num, and checkpoints are
+            keyed by state.step)."""
+            return guard.handle(batch_num, [float(x) for x in losses_host],
+                                host_batch, step_now=int(state.step))
         if tele is not None:
             tele.resume()  # shutdown() in fit's finally disables globally
         self._profiling = False
@@ -537,15 +611,30 @@ class Trainer:
                 if tele is not None:
                     h2d_before = tele.h2d.total
                     iter_t0 = time.perf_counter()
-                    with jax.profiler.TraceAnnotation('host/batch_wait'):
+                    with jax.profiler.TraceAnnotation('host/batch_wait'), \
+                            watched('next staged batch (batch %d)',
+                                    batch_num):
                         item = next(staged, None)
                     tele.batch_wait.record(max(
                         0.0, (time.perf_counter() - iter_t0)
                         - (tele.h2d.total - h2d_before)))
                 else:
-                    item = next(staged, None)
+                    with watched('next staged batch (batch %d)', batch_num):
+                        item = next(staged, None)
                 if item is None:
                     break
+                # preemption (ROBUSTNESS.md pillar 2): the signal handler
+                # only sets a flag; the exit happens HERE, at a step
+                # boundary, so the saved state is a completed step and
+                # resume loses at most the batch just pulled
+                if preemption is not None and preemption.requested:
+                    config.log(
+                        'Preemption (%s): leaving the fit loop at step '
+                        'boundary %d for a final snapshot save.'
+                        % (preemption.signal_name, batch_num))
+                    if on_preempt is not None:
+                        on_preempt(epoch, batch_num, state)
+                    return state
                 arrays, host_batch = item
                 # step-interval checkpointing fires at the TOP of the next
                 # iteration (state reflects batch_num completed steps): an
@@ -592,7 +681,14 @@ class Trainer:
                         state, loss = self.train_step_placed(state, arrays)
                 else:
                     state, loss = self.train_step_placed(state, arrays)
+                if faults.maybe_fire('nan_loss', step=batch_num):
+                    # poison on device: keeps the real loss's dtype and
+                    # sharding, so the window sync path is exercised
+                    # exactly as a genuine divergence would
+                    loss = loss + float('nan')
                 batch_num += 1
+                if faults.maybe_fire('sigterm', step=batch_num):
+                    os.kill(os.getpid(), signal_lib.SIGTERM)
                 window_losses.append(loss)
                 n_valid = host_batch.num_valid_examples
                 window_examples += n_valid
@@ -604,13 +700,26 @@ class Trainer:
                     # scalars eagerly aborts in jaxlib on CPU meshes
                     if tele is not None:
                         sync_t0 = time.perf_counter()
-                        with jax.profiler.TraceAnnotation('host/sync'):
+                        with jax.profiler.TraceAnnotation('host/sync'), \
+                                watched('log-window device sync (batch %d)',
+                                        batch_num):
                             losses = jax.device_get(window_losses)
                         tele.sync.record(time.perf_counter() - sync_t0)
-                        sum_loss = float(np.sum(losses))
                     else:
-                        sum_loss = float(np.sum(
-                            jax.device_get(window_losses)))
+                        with watched('log-window device sync (batch %d)',
+                                     batch_num):
+                            losses = jax.device_get(window_losses)
+                    sum_loss = float(np.sum(losses))
+                    # divergence guard (ROBUSTNESS.md pillar 1): the sum
+                    # is non-finite iff any loss in the window is, so the
+                    # check piggybacks on this sync at zero extra host
+                    # round-trips
+                    if guard is not None and not np.isfinite(sum_loss):
+                        state = rewind(losses)
+                        window_losses = []
+                        window_examples = 0
+                        window_start = time.time()
+                        continue
                     elapsed = time.time() - window_start
                     throughput = window_examples / max(elapsed, 1e-9)
                     config.log(
@@ -630,6 +739,20 @@ class Trainer:
                 if on_eval_interval is not None and \
                         config.NUM_TRAIN_BATCHES_TO_EVALUATE > 0 and \
                         batch_num % config.NUM_TRAIN_BATCHES_TO_EVALUATE == 0:
+                    # the reset below DISCARDS the partial window, so the
+                    # guard must check it first or a NaN between log
+                    # boundaries slips through unexamined (and the eval
+                    # would run — and log — on a possibly-diverged state)
+                    if guard is not None and window_losses:
+                        with watched('eval-interval window sync (batch %d)',
+                                     batch_num):
+                            losses = jax.device_get(window_losses)
+                        if not np.isfinite(float(np.sum(losses))):
+                            state = rewind(losses)
+                            window_losses = []
+                            window_examples = 0
+                            window_start = time.time()
+                            continue
                     on_eval_interval(batch_num, state)
                     # restart the throughput window completely: a partial
                     # window timed from post-eval would overstate samples/sec
@@ -640,14 +763,25 @@ class Trainer:
                     tele.step_total.record(time.perf_counter() - iter_t0)
                     tele.after_step(batch_num)
                     self._last_batch_num = batch_num
-            if tele is not None and window_losses:
-                # short runs may never hit a log window: sync the partial
-                # window here so step/sync_ms is recorded at least once
-                # per epoch (the losses stay in the window — this only
-                # drains the dispatched work, it does not consume them)
+            if (tele is not None or guard is not None) and window_losses:
+                # short epochs (steps/epoch < log_every) may never hit a
+                # log window: sync the partial window here so step/sync_ms
+                # is recorded at least once per epoch AND the divergence
+                # guard examines every epoch's losses — without this a
+                # NaN in a short run is never detected (the losses stay
+                # in the window; this sync does not consume them). With
+                # telemetry off the guard pays this one extra device_get
+                # per EPOCH, not per step.
                 sync_t0 = time.perf_counter()
-                jax.device_get(window_losses)
-                tele.sync.record(time.perf_counter() - sync_t0)
+                with watched('epoch-end window sync (batch %d)', batch_num):
+                    losses = jax.device_get(window_losses)
+                if tele is not None:
+                    tele.sync.record(time.perf_counter() - sync_t0)
+                if guard is not None and \
+                        not np.isfinite(float(np.sum(losses))):
+                    state = rewind(losses)
+                    window_losses = []
+                    window_examples = 0
             epoch_wall = time.time() - epoch_start
             if tele is not None:
                 tele.registry.gauge('train/epoch_wall_time_s').set(

@@ -242,3 +242,37 @@ def test_cache_partial_final_batch_padded(small_setup):  # noqa: F811
     assert batches[-1].source.shape == (2, config.MAX_CONTEXTS)
     np.testing.assert_array_equal(batches[-1].weight, [1.0, 0.0])
     np.testing.assert_array_equal(batches[-1].mask[1], 0.0)
+
+
+def test_truncated_cache_shard_raises_rebuild_error(small_setup):  # noqa: F811
+    """ISSUE 3 satellite: a truncated ctx.bin (disk-full or killed
+    build) must fail at load with a clear rebuild message, not serve
+    mis-aligned epochs."""
+    import os
+
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1 s2,p2,t1'] * 6)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    ctx_path = os.path.join(cache.cache_dir, 'ctx.bin')
+    with open(ctx_path, 'r+b') as f:
+        f.truncate(os.path.getsize(ctx_path) - 4)
+    with pytest.raises(ValueError, match='rebuild'):
+        TokenCache(cache.cache_dir, config, vocabs)
+
+
+def test_count_total_mismatch_raises_rebuild_error(small_setup):  # noqa: F811
+    """Same-size but inconsistent count.bin (torn write) must be caught
+    by the count/ctx reconciliation, not mis-slice every batch."""
+    import os
+
+    config, vocabs, prefix = small_setup
+    _write_train(prefix, ['lbl1 s1,p1,t1 s2,p2,t1'] * 6)
+    reader = PathContextReader(vocabs, config, EstimatorAction.Train)
+    cache = TokenCache.build_or_load(config, vocabs, reader)
+    count_path = os.path.join(cache.cache_dir, 'count.bin')
+    counts = np.fromfile(count_path, dtype=np.int32).copy()
+    counts[0] += 1  # same byte size, broken offsets
+    counts.tofile(count_path)
+    with pytest.raises(ValueError, match='rebuild'):
+        TokenCache(cache.cache_dir, config, vocabs)

@@ -1,0 +1,42 @@
+"""tier-1 guard: fault-point names cannot drift from the catalog/doc
+(scripts/check_fault_points.py; ISSUE 3 satellite — same pattern as
+tests/test_metrics_schema.py)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, 'scripts'))
+
+import check_fault_points  # noqa: E402
+
+
+def test_fire_regex_matches_wrapped_calls():
+    content = ("faults.maybe_fire(\n    'corrupt_snapshot')\n"
+               "if faults.maybe_fire('hang_input'):\n"
+               "faults.maybe_fire('nan_loss', step=batch_num)\n"
+               "plan.maybe_fire(point, step)  # no literal: ignored\n")
+    names = [m.group(1)
+             for m in check_fault_points.FIRE_RE.finditer(content)]
+    assert names == ['corrupt_snapshot', 'hang_input', 'nan_loss']
+
+
+def test_every_fault_site_is_cataloged_and_documented():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts',
+                                      'check_fault_points.py')],
+        capture_output=True, text=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_every_cataloged_point_has_a_site_and_vice_versa():
+    from code2vec_tpu.resilience.faults import FAULT_POINTS
+    sites = check_fault_points.find_sites()
+    assert sites, 'lint found no fault sites — regex broke'
+    emitted = {name for _rel, _line, name in sites}
+    assert emitted <= set(FAULT_POINTS)
+    # every cataloged point is wired somewhere (a spec naming an unwired
+    # point would silently inject nothing)
+    assert set(FAULT_POINTS) <= emitted
+    assert 'definitely_not_a_point' not in FAULT_POINTS

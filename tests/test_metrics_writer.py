@@ -79,3 +79,38 @@ def test_atexit_flush_covers_unclosed_writers(tmp_path):
     writer._atexit_flush()            # what interpreter exit would run
     assert len(path.read_text().splitlines()) == 1
     writer.close()
+
+
+def test_write_failure_is_logged_once_not_fatal(tmp_path):
+    """ISSUE 3 satellite: a failing metrics append (read-only/full disk)
+    must neither crash the training run nor be swallowed silently — the
+    first failure warns, close() reports the dropped total.
+
+    Records are captured with a handler on the module logger directly:
+    Config.get_logger pins ``code2vec_tpu``.propagate=False, so once any
+    earlier test built a Config, caplog's root handler never sees these
+    records (ordering-dependent flake otherwise)."""
+    import logging
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    module_logger = logging.getLogger('code2vec_tpu.metrics_writer')
+    module_logger.addHandler(handler)
+    old_level = module_logger.level
+    module_logger.setLevel(logging.WARNING)
+    try:
+        writer = MetricsWriter(str(tmp_path / 'logs'), buffer_records=1)
+        # point the stream at a DIRECTORY: every append raises OSError
+        writer._path = str(tmp_path / 'logs')
+        writer.scalar('a', 1.0, 1)   # must not raise
+        writer.scalar('a', 2.0, 2)   # second failure: silent
+        warnings = [r for r in records if 'DROPPED' in r.getMessage()]
+        assert len(warnings) == 1
+        records.clear()
+        writer.close()
+        assert any('2 record(s) dropped' in r.getMessage()
+                   for r in records)
+    finally:
+        module_logger.removeHandler(handler)
+        module_logger.setLevel(old_level)

@@ -1,0 +1,431 @@
+"""Resilience layer tests (ISSUE 3): fault-spec grammar and plan
+semantics, watchdog/preemption unit behavior, and the e2e pillars on a
+tiny CPU corpus — NaN rewind + recovery, SIGTERM snapshot + mid-epoch
+resume with a monotonic metric step axis, corrupt-snapshot restore
+fallback with quarantine, and the subprocess hang-abort drill."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.resilience.guard import (DivergenceError, DivergenceGuard,
+                                           batch_stats)
+from code2vec_tpu.resilience.preempt import PreemptionHandler
+from code2vec_tpu.resilience.watchdog import STACKS_FILE_NAME, HangWatchdog
+from tests.test_train_overfit import make_dataset
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plan():
+    """The plan is process-global by design (like the telemetry
+    registry): every test starts and ends disarmed."""
+    faults.configure('')
+    yield
+    faults.configure('')
+
+
+def _train_config(tmp_path, prefix, **overrides):
+    defaults = dict(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_EPOCHS=1000,
+        SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        MODEL_SAVE_PATH=str(tmp_path / 'models' / 'saved_model'),
+        TELEMETRY_DIR=str(tmp_path / 'tele'))
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+# ------------------------------------------------------------- fault plan
+def test_parse_spec_grammar():
+    assert faults.parse_spec('') == {}
+    assert faults.parse_spec('nan_loss@step=120') == {'nan_loss': 120}
+    assert faults.parse_spec('nan_loss@step=120, sigterm@step=50') == \
+        {'nan_loss': 120, 'sigterm': 50}
+    assert faults.parse_spec('corrupt_snapshot@save=2') == \
+        {'corrupt_snapshot': 2}
+    with pytest.raises(ValueError, match='unknown fault point'):
+        faults.parse_spec('definitely_not_a_point@step=1')
+    with pytest.raises(ValueError, match='not <point>@<trigger>'):
+        faults.parse_spec('nan_loss=3')
+    with pytest.raises(ValueError, match='not <point>@<trigger>'):
+        faults.parse_spec('nan_loss@step=abc')
+
+
+def test_config_verify_rejects_bad_fault_spec():
+    config = Config(TRAIN_DATA_PATH_PREFIX='x',
+                    FAULT_INJECT='bogus@step=1')
+    with pytest.raises(ValueError, match='unknown fault point'):
+        config.verify()
+
+
+def test_cli_flags_fill_resilience_knobs(monkeypatch):
+    config = Config().load_from_args(
+        ['--data', 'x', '--fault-inject', 'nan_loss@step=3',
+         '--watchdog-secs', '5.5', '--max-divergence-rewinds', '7',
+         '--no-divergence-guard'])
+    assert config.FAULT_INJECT == 'nan_loss@step=3'
+    assert config.HANG_WATCHDOG_SECS == 5.5
+    assert config.MAX_DIVERGENCE_REWINDS == 7
+    assert not config.DIVERGENCE_GUARD
+    # env fallback, like TELEMETRY_TRACE_AT_STEP
+    monkeypatch.setenv('FAULT_INJECT', 'sigterm@step=9')
+    config2 = Config().load_from_args(['--data', 'x'])
+    assert config2.FAULT_INJECT == 'sigterm@step=9'
+    # the explicit flag wins over the env var
+    config3 = Config().load_from_args(
+        ['--data', 'x', '--fault-inject', 'sigterm@step=2'])
+    assert config3.FAULT_INJECT == 'sigterm@step=2'
+    # and an explicit '' DISABLES injection despite the env var (the
+    # control arm of a drill)
+    config4 = Config().load_from_args(['--data', 'x', '--fault-inject', ''])
+    assert config4.FAULT_INJECT == ''
+
+
+def test_fault_plan_fires_once_at_step():
+    faults.configure('nan_loss@step=3')
+    assert not faults.maybe_fire('nan_loss', step=2)
+    assert faults.maybe_fire('nan_loss', step=3)
+    assert not faults.maybe_fire('nan_loss', step=4)  # single-shot
+    assert not faults.maybe_fire('sigterm', step=3)   # not in the plan
+
+
+def test_fault_plan_fires_late_when_exact_step_was_skipped():
+    """Resumed runs can start past the configured trigger: >= matching
+    still fires the fault at the first opportunity."""
+    faults.configure('nan_loss@step=3')
+    assert faults.maybe_fire('nan_loss', step=10)
+
+
+def test_fault_plan_site_counter_mode():
+    """Sites with no natural step counter (hang_input counts batches)
+    trigger on their own invocation count."""
+    faults.configure('hang_input@step=2')
+    assert not faults.maybe_fire('hang_input')   # call 0
+    assert not faults.maybe_fire('hang_input')   # call 1
+    assert faults.maybe_fire('hang_input')       # call 2
+    assert not faults.maybe_fire('hang_input')   # single-shot
+
+
+def test_disarmed_plan_is_inert():
+    faults.configure('')
+    assert not faults.active()
+    assert not faults.maybe_fire('nan_loss', step=0)
+
+
+# --------------------------------------------------------------- watchdog
+def test_watchdog_expires_dumps_stacks_and_aborts(tmp_path):
+    aborted = threading.Event()
+    wd = HangWatchdog(0.2, str(tmp_path), abort=aborted.set, poll_s=0.02)
+    wd.arm('unit-test wait')
+    assert aborted.wait(timeout=5.0), 'watchdog never fired'
+    wd.shutdown()
+    stacks = (tmp_path / STACKS_FILE_NAME).read_text()
+    assert 'unit-test wait' in stacks
+    # faulthandler dumped THIS (test) thread's frames too
+    assert 'test_resilience' in stacks
+
+
+def test_watchdog_disarm_prevents_expiry(tmp_path):
+    fired = threading.Event()
+    wd = HangWatchdog(0.1, str(tmp_path), abort=fired.set, poll_s=0.02)
+    with wd.watch('quick wait'):
+        pass
+    time.sleep(0.3)
+    wd.shutdown()
+    assert not fired.is_set()
+    assert not (tmp_path / STACKS_FILE_NAME).exists()
+
+
+def test_watchdog_rearm_resets_deadline(tmp_path):
+    fired = threading.Event()
+    wd = HangWatchdog(0.25, str(tmp_path), abort=fired.set, poll_s=0.02)
+    for _ in range(4):  # 0.4s of short watched waits: never overdue
+        with wd.watch('short wait'):
+            time.sleep(0.1)
+    assert not fired.is_set()
+    wd.shutdown()
+
+
+# -------------------------------------------------------------- preempt
+def test_preemption_handler_flag_and_restore():
+    previous = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as handler:
+        assert not handler.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython delivers at the next bytecode boundary
+        for _ in range(100):
+            if handler.requested:
+                break
+            time.sleep(0.01)
+        assert handler.requested
+        assert handler.signal_name == 'SIGTERM'
+    assert signal.getsignal(signal.SIGTERM) is previous
+
+
+# ----------------------------------------------------------------- guard
+class _FakeState:
+    step = 7
+
+
+def test_guard_aborts_without_restore_target(tmp_path):
+    guard = DivergenceGuard(3, restore=None, dump_dir=str(tmp_path))
+    with pytest.raises(DivergenceError, match='no checkpoint'):
+        guard.handle(4, [float('nan')], None)
+    dump = json.loads((tmp_path / 'divergence_step4.json').read_text())
+    assert dump['batch_num'] == 4
+
+
+def test_guard_budget_exhaustion(tmp_path):
+    guard = DivergenceGuard(1, restore=lambda b: _FakeState(),
+                            dump_dir=str(tmp_path))
+    state = guard.handle(2, [float('inf')], None)
+    assert state.step == 7
+    with pytest.raises(DivergenceError, match='budget'):
+        guard.handle(4, [float('nan')], None)
+
+
+def test_batch_stats_tolerates_batch_types():
+    from code2vec_tpu.data.reader import Batch
+    batch = Batch(source=np.ones((2, 3), np.int32),
+                  path=np.zeros((2, 3), np.int32),
+                  target=np.ones((2, 3), np.int32),
+                  mask=np.ones((2, 3), np.float32),
+                  label=np.arange(2, dtype=np.int32),
+                  weight=np.ones((2,), np.float32))
+    stats = batch_stats(batch)
+    assert stats['label'] == {'shape': [2], 'dtype': 'int32',
+                              'min': 0.0, 'max': 1.0}
+    assert batch_stats(None) == {}
+
+
+def test_quarantine_picks_unique_destination(tmp_path):
+    """A repeat rewind can quarantine the same step number twice (the
+    key was re-saved after the first purge); the rename must not fail
+    against the existing `.rewound` dir and leave the artifact behind."""
+    import types
+
+    from code2vec_tpu.checkpoints import CheckpointStore
+    store = CheckpointStore(str(tmp_path / 'm'))
+    manager = types.SimpleNamespace(directory=str(tmp_path))
+    for _ in range(2):
+        (tmp_path / '6').mkdir()
+        (tmp_path / '6' / 'x').write_text('data')
+        store._quarantine(manager, 6, suffix='.rewound')
+    assert (tmp_path / '6.rewound').is_dir()
+    assert (tmp_path / '6.rewound.2').is_dir()
+    assert not (tmp_path / '6').exists()
+
+
+# ---------------------------------------------------------- e2e: pillars
+def test_nan_loss_rewinds_and_recovers(tmp_path):
+    """Acceptance: a CPU fit with FAULT_INJECT=nan_loss@step=k rewinds to
+    the prior snapshot, skips the poisoned window, and finishes healthy
+    (finite eval loss, step axis reflecting exactly one rewound
+    window)."""
+    prefix = make_dataset(tmp_path)
+    kwargs = dict(NUM_TRAIN_EPOCHS=8, LEARNING_RATE=0.01,
+                  TEST_DATA_PATH=str(tmp_path / 'tiny.val.c2v'),
+                  SAVE_EVERY_N_STEPS=2, NUM_BATCHES_TO_LOG_PROGRESS=2)
+    config = _train_config(tmp_path, prefix,
+                           FAULT_INJECT='nan_loss@step=5', **kwargs)
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+    model.train()
+    # 8 epochs x 4 steps = 32 batches consumed; the poisoned window
+    # ([4, 5], synced at batch 6) rewound to the step-4 snapshot, so the
+    # final step counter is 32 - 2
+    assert int(model.state.step) == 30
+    results = model.evaluate()
+    assert results.loss is not None and np.isfinite(results.loss)
+
+    # uninjected twin (same seeds -> same batch order, 2 more effective
+    # steps): the recovered run must land in the same final-loss ballpark
+    twin_config = _train_config(
+        tmp_path, prefix,
+        MODEL_SAVE_PATH=str(tmp_path / 'models_twin' / 'saved_model'),
+        **kwargs)
+    twin = Code2VecModel(twin_config)
+    twin.train()
+    twin_results = twin.evaluate()
+    assert results.loss < twin_results.loss * 1.5 + 0.1, \
+        (results.loss, twin_results.loss)
+    # the diagnostic dump landed next to the telemetry artifacts
+    dump_path = tmp_path / 'tele' / 'divergence_step6.json'
+    assert dump_path.exists()
+    dump = json.loads(dump_path.read_text())
+    assert dump['batch_num'] == 6
+    assert any(not np.isfinite(x) for x in dump['window_losses'])
+    assert 'label' in dump['last_batch']  # offending-batch stats
+
+
+def test_rewind_purges_poisoned_window_snapshots(tmp_path):
+    """A snapshot saved BETWEEN the first NaN and its detection holds
+    suspect params: the rewind must purge it (rename `<step>.rewound`)
+    so it neither shadows the rewound state as 'newest' for a later
+    resume nor blocks orbax from re-saving its step key (orbax silently
+    skips saves at `step <= latest_step`)."""
+    prefix = make_dataset(tmp_path)
+    config = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=3, SAVE_EVERY_N_STEPS=2,
+        NUM_BATCHES_TO_LOG_PROGRESS=4, FAULT_INJECT='nan_loss@step=5')
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+    model.train()
+    # NaN at step 5 -> snapshot at step 6 lands inside the poisoned
+    # window -> detection at the batch-8 sync rewinds to step 4 (first
+    # bad step = 5) and purges step 6; 12 batches minus the 4 rewound
+    # steps end the run at state.step 8
+    assert int(model.state.step) == 8
+    snapshot_dir = tmp_path / 'models' / 'saved_model__step-snapshots'
+    assert (snapshot_dir / '6.rewound').is_dir()
+    # the RE-TRAINED step 6 was saved again after the purge (orbax did
+    # not skip its key), so resume restores the healthy step-6 state
+    assert (snapshot_dir / '6').is_dir()
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=3,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert int(model2.state.step) == 6
+
+
+def test_nan_loss_without_snapshot_aborts_with_diagnostics(tmp_path):
+    """No checkpoint to rewind to -> the guard fails loud with the dump
+    path instead of training on NaN."""
+    prefix = make_dataset(tmp_path)
+    config = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=1, MODEL_SAVE_PATH=None,
+        NUM_BATCHES_TO_LOG_PROGRESS=2, FAULT_INJECT='nan_loss@step=1')
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+    with pytest.raises(DivergenceError, match='no checkpoint'):
+        model.train()
+    assert (tmp_path / 'tele' / 'divergence_step2.json').exists()
+
+
+def test_sigterm_preempts_saves_and_resumes_monotonically(tmp_path):
+    """Acceptance + satellite: sigterm@step=k exits cleanly with a
+    durable snapshot at exactly step k; --load resume restarts the
+    interrupted epoch from it and the metric step axis stays monotonic
+    across the kill/resume boundary."""
+    prefix = make_dataset(tmp_path)
+    kwargs = dict(NUM_TRAIN_EPOCHS=4, SAVE_EVERY_EPOCHS=1,
+                  TEST_DATA_PATH=str(tmp_path / 'tiny.val.c2v'),
+                  NUM_BATCHES_TO_LOG_PROGRESS=2, USE_TENSORBOARD=True)
+    config = _train_config(tmp_path, prefix,
+                           FAULT_INJECT='sigterm@step=5', **kwargs)
+    from code2vec_tpu.model_api import Code2VecModel
+    model = Code2VecModel(config)
+    model.train()  # returns early, cleanly, after the preemption save
+    assert int(model.state.step) == 5
+    snapshot_dir = tmp_path / 'models' / 'saved_model__step-snapshots'
+    assert (snapshot_dir / '5').is_dir()
+    marker = json.loads((snapshot_dir / 'PREEMPTED.json').read_text())
+    assert marker['step'] == 5
+    # step 5 is inside epoch 1 (4 steps/epoch): last complete epoch is 0
+    assert marker['last_complete_epoch'] == 0
+
+    config2 = _train_config(
+        tmp_path, prefix,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'),
+        **kwargs)
+    model2 = Code2VecModel(config2)
+    assert int(model2.state.step) == 5
+    assert model2._start_epoch == 1  # restart the interrupted epoch
+    assert not (snapshot_dir / 'PREEMPTED.json').exists()  # consumed
+    model2.train()  # completes epochs 1..3
+    assert int(model2.state.step) > 5
+    # eval history resumed on the global batch axis
+    assert model2.eval_history, 'resumed run ran no evals'
+
+    # satellite: the writer's metric streams (same summaries dir, append
+    # mode) must carry a monotone non-decreasing step axis across the
+    # preemption/resume boundary, per tag
+    metrics_path = tmp_path / 'models' / 'summaries' / 'metrics.jsonl'
+    by_tag = {}
+    for line in metrics_path.read_text().splitlines():
+        record = json.loads(line)
+        by_tag.setdefault(record['tag'], []).append(record['step'])
+    assert 'train/loss' in by_tag and 'eval/top1_acc' in by_tag
+    for tag, steps in by_tag.items():
+        assert steps == sorted(steps), (tag, steps)
+
+
+def test_corrupt_snapshot_restore_falls_back_and_quarantines(tmp_path):
+    """Satellite + corrupt_snapshot drill: the newest snapshot is
+    truncated on disk (disk-full shape); restore must log, quarantine
+    that step, and fall back to the next-older retained snapshot instead
+    of failing the run."""
+    prefix = make_dataset(tmp_path)
+    config = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2, SAVE_EVERY_N_STEPS=2,
+        FAULT_INJECT='corrupt_snapshot@save=2')
+    from code2vec_tpu.model_api import Code2VecModel
+    Code2VecModel(config).train()
+    snapshot_dir = tmp_path / 'models' / 'saved_model__step-snapshots'
+    # snapshots landed at steps 2, 4, 6 (retention keeps the last two);
+    # the third save (index 2 -> step 6) was corrupted after finalize
+    assert (snapshot_dir / '6').is_dir()
+
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=2,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    model2 = Code2VecModel(config2)
+    assert int(model2.state.step) == 4  # fell back past the corrupt 6
+    assert (snapshot_dir / '6.corrupt').is_dir()  # quarantined, kept
+    assert not (snapshot_dir / '6').exists()
+    model2.train()  # the fallback state trains on without error
+
+
+def test_all_snapshots_corrupt_raises_clearly(tmp_path):
+    prefix = make_dataset(tmp_path)
+    config = _train_config(tmp_path, prefix, NUM_TRAIN_EPOCHS=1,
+                           SAVE_EVERY_N_STEPS=2)
+    from code2vec_tpu.model_api import Code2VecModel
+    Code2VecModel(config).train()
+    snapshot_dir = tmp_path / 'models' / 'saved_model__step-snapshots'
+    for step_dir in snapshot_dir.iterdir():
+        if step_dir.is_dir():
+            faults.corrupt_directory(str(step_dir))
+    config2 = _train_config(
+        tmp_path, prefix, NUM_TRAIN_EPOCHS=1,
+        MODEL_LOAD_PATH=str(tmp_path / 'models' / 'saved_model'))
+    with pytest.raises(ValueError, match='could be restored'):
+        Code2VecModel(config2)
+
+
+def test_hang_input_watchdog_aborts_subprocess(tmp_path):
+    """Acceptance: hang_input@step=k wedges the input pipeline; the
+    watchdog must dump thread stacks to disk and hard-abort the process
+    within the deadline — asserted against a REAL training process,
+    since SIGABRT cannot be faked in-process."""
+    prefix = make_dataset(tmp_path)
+    tele_dir = tmp_path / 'tele'
+    cmd = [sys.executable, '-m', 'code2vec_tpu.cli',
+           '--data', str(prefix), '--epochs', '1', '--batch-size', '16',
+           '--dtype', 'float32', '--no-data-cache',
+           '--fault-inject', 'hang_input@step=1',
+           '--watchdog-secs', '5', '--telemetry-dir', str(tele_dir),
+           '-v', '0']
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
+           'PYTHONPATH': repo + os.pathsep + os.environ.get('PYTHONPATH',
+                                                            '')}
+    t0 = time.time()
+    result = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                            timeout=240, cwd=str(tmp_path))
+    wall = time.time() - t0
+    assert result.returncode != 0, (result.stdout, result.stderr)
+    stacks_path = tele_dir / STACKS_FILE_NAME
+    assert stacks_path.exists(), (result.stdout, result.stderr, wall)
+    stacks = stacks_path.read_text()
+    assert 'next staged batch' in stacks  # the wait that expired
+    assert 'Thread' in stacks             # all-threads faulthandler dump
