@@ -60,5 +60,9 @@ run_stage pallas_ab 900 python benchmarks/bench_pallas_encode.py
 probe >/dev/null || { echo "wedged after pallas_ab" >&2; exit 3; }
 BENCH_CONTEXTS=1024 run_stage pallas_ab_c1024 900 \
   python benchmarks/bench_pallas_encode.py
+probe >/dev/null || { echo "wedged after pallas_ab_c1024" >&2; exit 3; }
+# serving engine A/B (ISSUE 4): naive per-request predict vs the
+# micro-batching engine — on-chip latency p50/p99 + throughput
+run_stage serving 900 python benchmarks/bench_serving.py
 
 echo "capture complete: ${OUT}" >&2

@@ -5,6 +5,7 @@
     python -m code2vec_tpu.cli --load models/m/s --predict
     python -m code2vec_tpu.cli --load models/m/s --release
     python -m code2vec_tpu.cli --load models/m/s --save_word2v tokens.txt
+    python -m code2vec_tpu.cli --load models/m/s --bulk-vectors corpus.c2v
 
 The backend ('flax' | 'jax') is selected at runtime with ``--framework``
 (the reference selected 'tensorflow' | 'keras' the same way,
@@ -52,6 +53,11 @@ def main(args=None) -> None:
         model.save_word2vec_format(config.SAVE_T2V, VocabType.Target)
         config.log('Target word vectors saved in word2vec text format in: %s'
                    % config.SAVE_T2V)
+    # offline corpus embedding: the vectors-only predict program streamed
+    # over eval-sized sharded batches (serving/bulk.py, SERVING.md)
+    if config.BULK_VECTORS_PATH:
+        from code2vec_tpu.serving.bulk import export_code_vectors
+        export_code_vectors(model, config.BULK_VECTORS_PATH)
     # evaluate standalone only: training already evaluates per epoch
     # (reference code2vec.py:28-33)
     if config.is_testing and not config.is_training:

@@ -288,6 +288,26 @@ class Config:
     # convention); '' = explicitly disabled, overriding the env var
     # (the clean control arm of a fault drill).
     FAULT_INJECT: Optional[str] = None
+    # ---- serving (code2vec_tpu/serving/engine.py, SERVING.md) ----
+    # Batch buckets of the serving engine's warm program ladder,
+    # comma-separated ascending. Every bucket is rounded up to a multiple
+    # of the mesh data axis; a request stream is coalesced into the
+    # smallest covering bucket. More buckets = less padding waste per
+    # dispatch but more programs to pre-compile at load.
+    SERVING_BATCH_BUCKETS: str = '8,64,512,1024'
+    # Micro-batcher deadline: how long the dispatcher may hold the OLDEST
+    # queued request while coalescing followers into one bucket. The
+    # direct latency/throughput trade — 0 dispatches every request
+    # immediately (still bucketed + warm, just unbatched).
+    SERVING_MAX_DELAY_MS: float = 5.0
+    # Worker threads for host-side decode (device fetch, top-k word
+    # lookup, attention parsing), so device dispatch never waits on
+    # Python.
+    SERVING_DECODE_WORKERS: int = 2
+    # Output tiers warmed at engine load, comma-separated subset of
+    # {topk, attention, full, vectors} (training/trainer.py
+    # PREDICT_TIERS). Fewer tiers = proportionally fewer eager compiles.
+    SERVING_WARM_TIERS: str = 'topk,attention,full'
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -307,6 +327,10 @@ class Config:
     TEST_DATA_PATH: str = ''
     RELEASE: bool = False
     EXPORT_CODE_VECTORS: bool = False
+    # Offline corpus embedding (serving/bulk.py): stream this .c2v file
+    # through the 'vectors'-tier predict program and write one code
+    # vector per kept example to <file>.vectors.
+    BULK_VECTORS_PATH: Optional[str] = None
     SAVE_W2V: Optional[str] = None
     SAVE_T2V: Optional[str] = None
     VERBOSE_MODE: int = 1
@@ -462,6 +486,23 @@ class Config:
         parser.add_argument('--no-divergence-guard',
                             dest='no_divergence_guard', action='store_true',
                             help='disable the NaN/Inf loss-window guard')
+        parser.add_argument('--serving-buckets', dest='serving_buckets',
+                            default=None, metavar='B1,B2,...',
+                            help='batch buckets of the serving engine\'s '
+                                 'warm program ladder '
+                                 '(SERVING_BATCH_BUCKETS; SERVING.md)')
+        parser.add_argument('--serving-max-delay-ms',
+                            dest='serving_max_delay_ms', type=float,
+                            default=None, metavar='MS',
+                            help='micro-batcher coalescing deadline: max '
+                                 'added latency while batching concurrent '
+                                 'requests (0 = dispatch immediately)')
+        parser.add_argument('--bulk-vectors', dest='bulk_vectors',
+                            default=None, metavar='FILE.c2v',
+                            help='stream a whole .c2v corpus through the '
+                                 'vectors-only predict program and write '
+                                 'FILE.c2v.vectors (offline embedding '
+                                 'export; serving/bulk.py)')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -563,6 +604,12 @@ class Config:
             self.MAX_DIVERGENCE_REWINDS = parsed.max_divergence_rewinds
         if parsed.no_divergence_guard:
             self.DIVERGENCE_GUARD = False
+        if parsed.serving_buckets:
+            self.SERVING_BATCH_BUCKETS = parsed.serving_buckets
+        if parsed.serving_max_delay_ms is not None:
+            self.SERVING_MAX_DELAY_MS = parsed.serving_max_delay_ms
+        if parsed.bulk_vectors:
+            self.BULK_VECTORS_PATH = parsed.bulk_vectors
         return self
 
     # ------------------------------------------------------- derived props
@@ -603,6 +650,32 @@ class Config:
 
     def batch_size(self, is_evaluating: bool = False) -> int:
         return self.TEST_BATCH_SIZE if is_evaluating else self.TRAIN_BATCH_SIZE
+
+    @property
+    def serving_batch_buckets(self) -> Tuple[int, ...]:
+        """Parsed, sorted SERVING_BATCH_BUCKETS (serving/engine.py rounds
+        them up to the mesh data axis at engine construction)."""
+        try:
+            buckets = tuple(sorted(
+                int(part) for part in
+                str(self.SERVING_BATCH_BUCKETS).split(',') if part.strip()))
+        except ValueError:
+            raise ValueError(
+                'SERVING_BATCH_BUCKETS must be comma-separated ints, got '
+                '%r' % self.SERVING_BATCH_BUCKETS)
+        if not buckets or any(bucket < 1 for bucket in buckets):
+            raise ValueError(
+                'SERVING_BATCH_BUCKETS needs at least one bucket >= 1, '
+                'got %r' % self.SERVING_BATCH_BUCKETS)
+        return buckets
+
+    @property
+    def serving_warm_tiers(self) -> Tuple[str, ...]:
+        """Parsed SERVING_WARM_TIERS (validated against PREDICT_TIERS in
+        verify() and at engine construction)."""
+        return tuple(part.strip()
+                     for part in str(self.SERVING_WARM_TIERS).split(',')
+                     if part.strip())
 
     def wire_format_for(self, process_count: int) -> str:
         """The EFFECTIVE batch wire format for a run of ``process_count``
@@ -737,6 +810,18 @@ class Config:
         if self.HANG_WATCHDOG_SECS < 0:
             raise ValueError('config.HANG_WATCHDOG_SECS must be >= 0 '
                              '(0 disables the watchdog).')
+        self.serving_batch_buckets  # raises on malformed bucket specs
+        if self.SERVING_MAX_DELAY_MS < 0:
+            raise ValueError('config.SERVING_MAX_DELAY_MS must be >= 0.')
+        if self.SERVING_DECODE_WORKERS < 1:
+            raise ValueError('config.SERVING_DECODE_WORKERS must be >= 1.')
+        valid_tiers = {'topk', 'attention', 'full', 'vectors'}
+        tiers = self.serving_warm_tiers
+        if not tiers or not set(tiers) <= valid_tiers:
+            raise ValueError(
+                'config.SERVING_WARM_TIERS must be a non-empty '
+                'comma-separated subset of %s, got %r'
+                % (sorted(valid_tiers), self.SERVING_WARM_TIERS))
         if self.FAULT_INJECT:
             # a typo'd injection spec must fail at startup, not silently
             # inject nothing (parse_spec raises ValueError with the

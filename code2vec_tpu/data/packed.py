@@ -93,6 +93,48 @@ def bucketed_capacity(total: int, minimum: int = MIN_CAPACITY) -> int:
     return -(-cap // bucket) * bucket
 
 
+def capacity_ladder(max_total: int, minimum: int = MIN_CAPACITY,
+                    growth: int = 4) -> Tuple[int, ...]:
+    """Fixed geometric ladder of packed capacities covering ``max_total``.
+
+    The serving engine (serving/engine.py) pre-compiles one step program
+    per rung at load, so steady-state packing always lands on a warm
+    capacity — the eager-compile counterpart of ``StickyPacker``'s
+    grow-on-demand bucketing (which trades a few mid-run recompiles for
+    tighter fill during training). ``growth=4`` bounds the rung count to
+    ~log4(max_total/minimum)+1 programs per batch bucket while keeping
+    worst-case padding waste under the previous rung's 4x.
+
+    Every rung is exact under ``pack_ragged(..., capacity_minimum=rung)``
+    for totals <= rung (``bucketed_capacity`` returns its minimum
+    unchanged), so picking the first rung >= the shard total yields a
+    wire shape that is always one of the pre-compiled ladder shapes."""
+    if max_total < 1:
+        raise ValueError('max_total must be >= 1, got %d' % max_total)
+    if growth < 2:
+        raise ValueError('growth must be >= 2, got %d' % growth)
+    rungs = []
+    cap = minimum
+    while cap < max_total:
+        rungs.append(cap)
+        cap *= growth
+    rungs.append(max(max_total, minimum))
+    return tuple(rungs)
+
+
+def shard_totals(count: np.ndarray, data_shards: int) -> np.ndarray:
+    """(data_shards,) int64 of retained-context totals per data-parallel
+    shard — the quantity the packed capacity must cover (pack_ragged's
+    internal reshape, exposed for callers that pick a capacity BEFORE
+    packing, e.g. the serving engine's ladder lookup)."""
+    n = count.shape[0]
+    if n % data_shards:
+        raise ValueError('batch size %d not divisible by data_shards %d'
+                         % (n, data_shards))
+    return count.reshape(data_shards, n // data_shards).sum(
+        axis=1, dtype=np.int64)
+
+
 def effective_lengths(mask: np.ndarray) -> np.ndarray:
     """(B,) int32 of per-example effective lengths: index of the last
     mask-valid slot + 1, or 0 for all-padding rows."""
@@ -117,21 +159,15 @@ def pack_ragged(ctx_rows: np.ndarray, count: np.ndarray, token_pad: int,
                 capacity_minimum: int = MIN_CAPACITY) -> np.ndarray:
     """(total, 3) ragged triple stream + per-example counts -> the
     rectangular (data_shards, capacity, 3) wire array."""
-    n = count.shape[0]
-    if n % data_shards:
-        raise ValueError('batch size %d not divisible by data_shards %d'
-                         % (n, data_shards))
-    count2 = count.reshape(data_shards, n // data_shards)
-    shard_totals = count2.sum(axis=1, dtype=np.int64)
-    cap = bucketed_capacity(int(shard_totals.max(initial=0)),
-                            capacity_minimum)
+    totals = shard_totals(count, data_shards)
+    cap = bucketed_capacity(int(totals.max(initial=0)), capacity_minimum)
     ctx = np.empty((data_shards, cap, 3), np.int32)
     ctx[..., 0] = token_pad
     ctx[..., 1] = path_pad
     ctx[..., 2] = token_pad
-    bounds = np.concatenate([[0], np.cumsum(shard_totals)])
+    bounds = np.concatenate([[0], np.cumsum(totals)])
     for d in range(data_shards):
-        ctx[d, :shard_totals[d]] = ctx_rows[bounds[d]:bounds[d + 1]]
+        ctx[d, :totals[d]] = ctx_rows[bounds[d]:bounds[d + 1]]
     return ctx
 
 

@@ -31,7 +31,7 @@ from code2vec_tpu.metrics import (SubtokensEvaluationMetric,
                                   decode_topk_batch)
 from code2vec_tpu.models.backends import create_backend
 from code2vec_tpu.parallel import mesh as mesh_lib
-from code2vec_tpu.training.trainer import Trainer, TrainerState, as_numpy
+from code2vec_tpu.training.trainer import Trainer, TrainerState
 from code2vec_tpu.vocab import Code2VecVocabs, VocabType
 
 
@@ -705,51 +705,64 @@ class Code2VecModel:
                                   + original_name + '\n')
 
     # -------------------------------------------------------------- predict
+    def _get_predict_reader(self) -> PathContextReader:
+        """One reader for the model's lifetime — a fresh reader per
+        ``predict`` call was pure construction overhead on the serving
+        path (it holds no per-call state)."""
+        reader = getattr(self, '_predict_reader', None)
+        if reader is None:
+            reader = PathContextReader(self.vocabs, self.config,
+                                       EstimatorAction.Predict)
+            self._predict_reader = reader
+        return reader
+
     def predict(self, predict_data_lines: Iterable[str]
                 ) -> List[ModelPredictionResults]:
         """(reference tensorflow_model.py:311-368; per-line in the
-        reference, batched here — the REPL passes a handful of lines)"""
+        reference, batched here — the REPL passes a handful of lines).
+
+        Pads to the serving bucket ladder (SERVING_BATCH_BUCKETS), so
+        repeated calls of varying size reuse a handful of compiled
+        programs instead of compiling one per distinct size, and fetches
+        only the output keys the caller needs: the tiered predict
+        program already omits code vectors unless EXPORT_CODE_VECTORS.
+        For sustained concurrent traffic use ``serving_engine()``; for
+        whole corpora use ``serving/bulk.py``."""
         lines = list(predict_data_lines)
         if not lines:
             return []
-        reader = PathContextReader(self.vocabs, self.config,
-                                   EstimatorAction.Predict)
+        from code2vec_tpu.serving import engine as engine_lib
+        reader = self._get_predict_reader()
         batch = reader.process_input_rows(lines)
-        # pad to a multiple of the mesh data axis so the batch shards evenly
         data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
-        padded_size = -(-len(lines) // data_axis) * data_axis
+        ladder = engine_lib.batch_ladder(
+            self.config.serving_batch_buckets, data_axis)
+        padded_size = engine_lib.pick_bucket(len(lines), ladder)
+        if padded_size is None:
+            # beyond the ladder: the old ad-hoc padding (shards evenly,
+            # compiles per size — bulk_predict is the right tool there)
+            padded_size = -(-len(lines) // data_axis) * data_axis
         batch = reader.pad_batch_to(batch, padded_size)
-        out = as_numpy(self.trainer.predict_step(self.params, batch))
-        results: List[ModelPredictionResults] = []
-        for r in range(len(lines)):
-            top_words = list(
-                self._target_index_to_word[out['topk_indices'][r]])
-            attention_per_context = self._get_attention_weight_per_context(
-                batch.source_strings[r], batch.path_strings[r],
-                batch.target_strings[r], out['attention'][r])
-            results.append(ModelPredictionResults(
-                original_name=str(batch.label_strings[r]),
-                topk_predicted_words=top_words,
-                topk_predicted_words_scores=out['topk_scores'][r],
-                attention_per_context=attention_per_context,
-                code_vector=(out['code_vectors'][r]
-                             if self.config.EXPORT_CODE_VECTORS else None)))
-        return results
+        tier = 'full' if self.config.EXPORT_CODE_VECTORS else 'attention'
+        out = self.trainer.predict_step(self.params, batch, tier=tier)
+        fetched = {key: np.asarray(value) for key, value in out.items()}
+        return engine_lib.decode_results(fetched, batch, len(lines),
+                                         self._target_index_to_word)
 
-    @staticmethod
-    def _get_attention_weight_per_context(
-            source_strings, path_strings, target_strings, attention_weights
-    ) -> Dict[Tuple[str, str, str], float]:
-        """(reference model_base.py:115-129)"""
-        attention_per_context: Dict[Tuple[str, str, str], float] = {}
-        for source, path, target, weight in zip(
-                source_strings, path_strings, target_strings,
-                attention_weights):
-            if not source and not path and not target:
-                continue  # padding context
-            attention_per_context[(str(source), str(path), str(target))] = \
-                float(weight)
-        return attention_per_context
+    def serving_engine(self, tiers=None, warmup: bool = True, **overrides):
+        """Build a ``ServingEngine`` over this model's warm params:
+        dynamic micro-batching + a pre-compiled bucket ladder for
+        concurrent request traffic (serving/engine.py, SERVING.md).
+        ``warmup=False`` defers the eager ladder compile to the first
+        ``submit``."""
+        from code2vec_tpu.serving.engine import ServingEngine
+        engine = ServingEngine(
+            self.config, self.trainer, self.params, self.vocabs,
+            decode_table=self._target_index_to_word, tiers=tiers,
+            log=self.log, **overrides)
+        if warmup:
+            engine.warmup()
+        return engine
 
     # ----------------------------------------------------- embedding export
     def get_vocab_embedding_as_np_array(self, vocab_type: VocabType

@@ -1,0 +1,177 @@
+"""Corpus-scale offline serving: stream whole ``.c2v`` files through
+eval-sized sharded batches.
+
+The naive way to embed a corpus is thousands of tiny ``model.predict``
+calls — one program dispatch, one h2d upload, and one d2h fetch per
+handful of methods. This module instead drives the same double-buffered
+device staging ring the trainer uses (``Trainer.stage_batches``: batch
+k+1 uploads while batch k computes, decode of batch k-1 overlaps both)
+at ``TEST_BATCH_SIZE`` granularity, through the TIERED predict programs
+(training/trainer.py::PREDICT_TIERS):
+
+- ``export_code_vectors`` runs the 'vectors' tier — the (B, V) logits
+  matmul and top-k are dead-code-eliminated from the program, so
+  embedding export pays for the encoder only — and writes one
+  space-separated vector per kept example (the same format
+  ``evaluate``'s ``--export_code_vectors`` path emits).
+- ``bulk_predict`` streams prediction results (any tier) for an
+  iterable of raw context lines, preserving input order and the
+  predict-path contract that rows are never filtered.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.serving.engine import decode_results
+from code2vec_tpu.telemetry import core as tele_core
+
+
+def _require_single_host(what: str) -> None:
+    """The bulk paths are single-host offline tools: without per-process
+    line striding and per-shard output files (the evaluate path's
+    machinery) a multi-host run would feed every example to EVERY
+    process and race them on one output file — fail loud instead."""
+    import jax
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            '%s is single-host only (runs on %d processes); use a '
+            'one-process run of the loaded model, or evaluate() with '
+            '--export_code_vectors for multi-host vector export.'
+            % (what, jax.process_count()))
+
+
+def _record_throughput(examples: int, seconds: float) -> float:
+    rate = examples / max(seconds, 1e-9)
+    if tele_core.enabled():
+        tele_core.registry().gauge(
+            'serving/bulk_examples_per_sec').set(rate)
+    return rate
+
+
+def export_code_vectors(model, corpus_path: str,
+                        output_path: Optional[str] = None
+                        ) -> Tuple[int, str]:
+    """Embed every (valid) example of a ``.c2v`` corpus into
+    ``output_path`` (default ``<corpus>.vectors``), one space-separated
+    code vector per line, in corpus order.
+
+    Rows with no valid context are dropped (they cannot produce a
+    vector; same filter the evaluate path applies), and the short final
+    batch's zero-weight padding rows are excluded from the output.
+    Returns ``(n_vectors, output_path)``."""
+    _require_single_host('export_code_vectors')
+    config = model.config
+    trainer = model.trainer
+    # evaluate-action reader, strings OFF: no decode happens here, so
+    # the native tokenizer can cover the whole parse and nothing but
+    # index arrays crosses threads
+    reader = PathContextReader(model.vocabs, config,
+                               EstimatorAction.Evaluate,
+                               data_path=corpus_path, keep_strings=False,
+                               data_shards=trainer.mesh.shape[
+                                   mesh_lib.DATA_AXIS])
+    wire_format = reader.wire_format()
+    out_path = output_path if output_path is not None \
+        else corpus_path + '.vectors'
+    total = 0
+    t0 = time.perf_counter()
+    with open(out_path, 'w') as out_file:
+        def consume(out, batch) -> None:
+            nonlocal total
+            vectors = mesh_lib.local_rows(out['code_vectors'])
+            valid = batch.weight > 0
+            for vec in vectors[valid]:
+                out_file.write(' '.join(map(str, vec)) + '\n')
+            total += int(valid.sum())
+
+        # one-step pipeline (like evaluate): dispatch batch k+1 before
+        # fetching batch k, so host-side writing overlaps device compute
+        pending = None
+        for arrays, batch in trainer.stage_batches(
+                reader.iter_epoch_prefetched(shuffle=False,
+                                             wire_format=wire_format)):
+            out = trainer.predict_step_placed(model.params, arrays,
+                                              tier='vectors')
+            if pending is not None:
+                consume(*pending)
+            pending = (out, batch)
+        if pending is not None:
+            consume(*pending)
+    rate = _record_throughput(total, time.perf_counter() - t0)
+    model.log('Exported %d code vectors to `%s` (%d examples/sec).'
+              % (total, out_path, int(rate)))
+    return total, out_path
+
+
+def bulk_predict(model, context_lines: Iterable[str], tier: str = 'topk',
+                 batch_size: Optional[int] = None) -> Iterator[list]:
+    """Stream predictions for raw context lines (predict semantics —
+    never filtered) through eval-sized warm batches, yielding one
+    ``ModelPredictionResults`` per input line, in order.
+
+    ``tier`` selects the output tier ('topk' | 'attention' | 'full' |
+    'vectors'); ``batch_size`` defaults to ``TEST_BATCH_SIZE``."""
+    _require_single_host('bulk_predict')
+    import jax
+
+    from code2vec_tpu.data import packed as packed_lib
+    config = model.config
+    trainer = model.trainer
+    reader = PathContextReader(model.vocabs, config,
+                               EstimatorAction.Predict)
+    size = batch_size if batch_size is not None else config.TEST_BATCH_SIZE
+    data_axis = trainer.mesh.shape[mesh_lib.DATA_AXIS]
+    size = -(-size // data_axis) * data_axis
+    wire_format = config.wire_format_for(jax.process_count())
+
+    def batches():
+        chunk = []
+        for line in context_lines:
+            chunk.append(line)
+            if len(chunk) == size:
+                yield reader.process_input_rows(chunk)
+                chunk = []
+        if chunk:
+            yield reader.pad_batch_to(
+                reader.process_input_rows(chunk), size)
+
+    def wire_batches():
+        stream = batches()
+        if wire_format != 'packed':
+            yield from stream
+            return
+        # sticky capacity across the run, exactly like training's reader
+        # path — one (or a few) packed step specializations per corpus
+        packer = packed_lib.StickyPacker(trainer._token_pad,
+                                         trainer._path_pad,
+                                         data_shards=data_axis)
+        for batch in stream:
+            yield packer.pack_batch(batch)
+
+    t0 = time.perf_counter()
+    total = 0
+    pending = None
+
+    def decode(out, batch) -> list:
+        fetched = {key: np.asarray(value) for key, value in out.items()}
+        n_rows = int((batch.weight > 0).sum())
+        return decode_results(fetched, batch, n_rows,
+                              model._target_index_to_word)
+
+    for arrays, batch in trainer.stage_batches(wire_batches()):
+        out = trainer.predict_step_placed(model.params, arrays, tier=tier)
+        if pending is not None:
+            results = decode(*pending)
+            total += len(results)
+            yield from results
+        pending = (out, batch)
+    if pending is not None:
+        results = decode(*pending)
+        total += len(results)
+        yield from results
+    _record_throughput(total, time.perf_counter() - t0)

@@ -1,0 +1,556 @@
+"""High-throughput serving engine: dynamic micro-batching over a fixed
+ladder of warm, pre-compiled programs.
+
+The naive serving shape — one ``model.predict`` per request — compiles a
+fresh XLA program for every distinct request size, batches nothing
+across requests, and computes + transfers attention weights and code
+vectors even when the caller wants neither. TPU serving systems instead
+coalesce ragged concurrent requests into a small set of pre-compiled
+bucketed shapes and keep the device queue full (Ragged Paged Attention,
+arxiv 2604.15464; Google's ads-serving infrastructure, arxiv 2501.10546
+— PAPERS.md). This module is that shape for code2vec:
+
+- **Bucket ladder.** Batch buckets (``Config.SERVING_BATCH_BUCKETS``,
+  each rounded up to a multiple of the mesh data axis) × packed-capacity
+  rungs (``data/packed.py::capacity_ladder`` — the eager-compile
+  counterpart of training's StickyPacker bucketing) × output tiers
+  (``training/trainer.py::PREDICT_TIERS``). ``warmup()`` compiles every
+  program in the ladder at load, so steady-state serving never compiles
+  (compile-counter-asserted in tests/test_serving_bench.py).
+- **Dynamic micro-batcher.** ``submit()`` tokenizes on the caller thread
+  and enqueues; a dispatcher thread coalesces concurrent requests under
+  a max-latency deadline (``SERVING_MAX_DELAY_MS``) into the smallest
+  covering batch bucket, packs them over the compact wire format
+  (data/packed.py — the 0.24x bytes win applies directly to the h2d
+  serving path), and dispatches asynchronously, so the device queue
+  stays full while the NEXT batch coalesces.
+- **Decode offload.** Host-side decode (device fetch, top-k word lookup,
+  attention parsing) runs on a worker pool (``SERVING_DECODE_WORKERS``),
+  so device dispatch never waits on Python.
+
+Instrumented with standalone telemetry instruments (``stats()``) that
+mirror into the process-global registry when telemetry is enabled
+(``serving/*`` in telemetry/catalog.py; OBSERVABILITY.md).
+
+Typical use::
+
+    engine = model.serving_engine()          # warm-compiles the ladder
+    future = engine.submit(context_lines)    # -> Future[list[results]]
+    results = engine.predict(context_lines)  # sync convenience
+    engine.close()                           # or `with model.serving_engine() as engine:`
+
+SERVING.md has the architecture, the latency/throughput model, and the
+runbook.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.data import packed as packed_lib
+from code2vec_tpu.data.reader import (Batch, EstimatorAction,
+                                      PathContextReader)
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry.core import Counter, Gauge, Timer
+from code2vec_tpu.training.trainer import PREDICT_TIERS
+
+
+# --------------------------------------------------------------- ladder
+def batch_ladder(buckets: Sequence[int], data_axis: int) -> Tuple[int, ...]:
+    """Sorted, deduplicated batch buckets, each rounded UP to a multiple
+    of the mesh data axis so every bucket shards evenly."""
+    if data_axis < 1:
+        raise ValueError('data_axis must be >= 1, got %d' % data_axis)
+    out = set()
+    for bucket in buckets:
+        bucket = int(bucket)
+        if bucket < 1:
+            raise ValueError('batch buckets must be >= 1, got %d' % bucket)
+        out.add(-(-bucket // data_axis) * data_axis)
+    return tuple(sorted(out))
+
+
+def pick_bucket(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest bucket covering ``n`` rows, or None when ``n`` exceeds
+    the ladder (callers split, or fall back to ad-hoc padding)."""
+    for bucket in ladder:
+        if bucket >= n:
+            return bucket
+    return None
+
+
+def attention_per_context(source_strings, path_strings, target_strings,
+                          attention_weights) -> Dict[Tuple[str, str, str],
+                                                     float]:
+    """Per-context attention dict, skipping padding contexts (reference
+    model_base.py:115-129). Single definition — model_api and the engine
+    decode both use it."""
+    out: Dict[Tuple[str, str, str], float] = {}
+    for source, path, target, weight in zip(
+            source_strings, path_strings, target_strings,
+            attention_weights):
+        if not source and not path and not target:
+            continue  # padding context
+        out[(str(source), str(path), str(target))] = float(weight)
+    return out
+
+
+def decode_results(fetched: Dict[str, np.ndarray], batch: Batch,
+                   n_rows: int, decode_table: np.ndarray) -> list:
+    """Host numpy outputs + the (string-bearing) plane batch -> one
+    ``ModelPredictionResults`` per row. Only the keys the tier produced
+    are present in ``fetched``; absent tiers decode to empty/None."""
+    # lazy: model_api imports this module (circularity-free direction)
+    from code2vec_tpu.model_api import ModelPredictionResults
+    topk_indices = fetched.get('topk_indices')
+    topk_scores = fetched.get('topk_scores')
+    attention = fetched.get('attention')
+    code_vectors = fetched.get('code_vectors')
+    results = []
+    for r in range(n_rows):
+        attn = {}
+        if attention is not None and batch.source_strings is not None:
+            attn = attention_per_context(
+                batch.source_strings[r], batch.path_strings[r],
+                batch.target_strings[r], attention[r])
+        results.append(ModelPredictionResults(
+            original_name=(str(batch.label_strings[r])
+                           if batch.label_strings is not None else ''),
+            topk_predicted_words=(list(decode_table[topk_indices[r]])
+                                  if topk_indices is not None else []),
+            topk_predicted_words_scores=(topk_scores[r]
+                                         if topk_scores is not None
+                                         else None),
+            attention_per_context=attn,
+            code_vector=(code_vectors[r]
+                         if code_vectors is not None else None)))
+    return results
+
+
+# ------------------------------------------------------------- requests
+def _resolve(future: Future, results: list) -> None:
+    """set_result tolerating an already-done future: a caller may
+    cancel() (these futures are never marked running, so cancel always
+    succeeds) — its own result is then dropped, but delivery to the
+    OTHER requests coalesced into the same micro-batch must proceed."""
+    if not future.done():
+        try:
+            future.set_result(results)
+        except Exception:
+            pass  # lost the race to a concurrent cancel
+
+
+class _Aggregate:
+    """Joins the chunk results of one oversize request back into its
+    caller-visible future, preserving row order."""
+
+    def __init__(self, future: Future, n_chunks: int):
+        self.future = future
+        self.parts: List[Optional[list]] = [None] * n_chunks
+        self.left = n_chunks
+        self.lock = threading.Lock()
+
+    def deliver(self, idx: int, results: list) -> None:
+        with self.lock:
+            self.parts[idx] = results
+            self.left -= 1
+            done = self.left == 0
+        if done:
+            merged: list = []
+            for part in self.parts:
+                merged.extend(part)
+            _resolve(self.future, merged)
+
+    def fail(self, exc: BaseException) -> None:
+        # first failure wins; set_exception on a done future raises
+        if not self.future.done():
+            try:
+                self.future.set_exception(exc)
+            except Exception:
+                pass
+
+
+class _Request:
+    """One queue entry: a tokenized chunk of <= max-bucket rows."""
+
+    __slots__ = ('batch', 'rows', 'tier', 'future', 'aggregate',
+                 'chunk_idx', 't_enqueue')
+
+    def __init__(self, batch: Batch, tier: str,
+                 future: Optional[Future] = None,
+                 aggregate: Optional[_Aggregate] = None,
+                 chunk_idx: int = 0):
+        self.batch = batch
+        self.rows = int(batch.label.shape[0])
+        self.tier = tier
+        self.future = future
+        self.aggregate = aggregate
+        self.chunk_idx = chunk_idx
+        self.t_enqueue = time.perf_counter()
+
+    def deliver(self, results: list) -> None:
+        if self.aggregate is not None:
+            self.aggregate.deliver(self.chunk_idx, results)
+        else:
+            _resolve(self.future, results)
+
+    def fail(self, exc: BaseException) -> None:
+        if self.aggregate is not None:
+            self.aggregate.fail(exc)
+        elif not self.future.done():
+            self.future.set_exception(exc)
+
+
+# --------------------------------------------------------------- engine
+class ServingEngine:
+    """Warm-compiled, micro-batching inference engine over a model's
+    trainer + params. Build via ``Code2VecModel.serving_engine()``.
+
+    Thread-safe: ``submit`` may be called from any number of threads;
+    one dispatcher thread coalesces, ``decode_workers`` threads decode.
+    """
+
+    def __init__(self, config, trainer, params, vocabs,
+                 decode_table: np.ndarray,
+                 tiers: Optional[Sequence[str]] = None,
+                 max_delay_ms: Optional[float] = None,
+                 decode_workers: Optional[int] = None,
+                 log=None):
+        self.config = config
+        self.trainer = trainer
+        self.params = params
+        self.decode_table = decode_table
+        self.log = log if log is not None else (lambda msg: None)
+        self.mesh = trainer.mesh
+        self.data_axis = self.mesh.shape[mesh_lib.DATA_AXIS]
+        # predict semantics: rows are never filtered; strings ride along
+        # for the attention tiers' decode
+        self.reader = PathContextReader(vocabs, config,
+                                        EstimatorAction.Predict)
+        import jax
+        if jax.process_count() > 1:
+            # per-host request queues cannot agree on batch contents
+            # without a coordination layer; multi-host serving runs one
+            # engine per host replica over that host's own mesh instead
+            raise NotImplementedError(
+                'ServingEngine is single-host only (runs on %d '
+                'processes); serve one engine replica per host.'
+                % jax.process_count())
+        self.wire = config.wire_format_for(jax.process_count())
+        self.buckets = batch_ladder(config.serving_batch_buckets,
+                                    self.data_axis)
+        # capacity rungs per bucket: a bucket's per-shard stream can hold
+        # at most (bucket / data_axis) * MAX_CONTEXTS retained slots
+        self.capacities: Dict[int, Tuple[int, ...]] = {
+            bucket: packed_lib.capacity_ladder(
+                (bucket // self.data_axis) * config.MAX_CONTEXTS)
+            for bucket in self.buckets}
+        tiers = tuple(tiers if tiers is not None
+                      else config.serving_warm_tiers)
+        for tier in tiers:
+            if tier not in PREDICT_TIERS:
+                raise ValueError('unknown tier %r; expected a subset of %s'
+                                 % (tier, PREDICT_TIERS))
+        self.tiers = tiers
+        self.max_delay_s = (max_delay_ms if max_delay_ms is not None
+                            else config.SERVING_MAX_DELAY_MS) / 1e3
+        workers = (decode_workers if decode_workers is not None
+                   else config.SERVING_DECODE_WORKERS)
+        # standalone instruments: stats()/benchmarks read them without
+        # enabling the process-global telemetry layer; emission sites
+        # below mirror into the registry when telemetry is on
+        self.latency = Timer('serving/latency_ms')
+        self.dispatch_timer = Timer('serving/dispatch_ms')
+        self.decode_timer = Timer('serving/decode_ms')
+        self.requests_total = Counter('serving/requests_total')
+        self.batches_total = Counter('serving/batches_total')
+        self.queue_depth = Gauge('serving/queue_depth')
+        self.fill_rate = Gauge('serving/batch_fill_rate')
+        self.last_dispatch: Optional[Dict[str, int]] = None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: Dict[str, collections.deque] = {
+            tier: collections.deque() for tier in PREDICT_TIERS}
+        self._pending_rows: Dict[str, int] = {t: 0 for t in PREDICT_TIERS}
+        self._closed = False
+        self._warm = False
+        self._warm_lock = threading.Lock()
+        self._decode_pool = ThreadPoolExecutor(
+            max_workers=max(1, workers),
+            thread_name_prefix='serving-decode')
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name='serving-dispatch')
+        self._dispatcher.start()
+
+    # ---------------------------------------------------------- warmup
+    def _warm_batches(self, bucket: int):
+        """Device-shaped zero batches for one bucket — every wire shape
+        the dispatcher can produce for it (programs key on shapes, not
+        values; all-PAD rows are valid model input)."""
+        contexts = self.config.MAX_CONTEXTS
+        if self.wire == 'packed':
+            token_pad = self.trainer._token_pad
+            path_pad = self.trainer._path_pad
+            for cap in self.capacities[bucket]:
+                ctx = np.empty((self.data_axis, cap, 3), np.int32)
+                ctx[..., 0] = token_pad
+                ctx[..., 1] = path_pad
+                ctx[..., 2] = token_pad
+                yield (ctx, np.zeros((bucket,), np.int32),
+                       np.zeros((bucket,), np.int32),
+                       np.zeros((bucket,), np.float32))
+        else:
+            yield (np.zeros((bucket, contexts), np.int32),
+                   np.zeros((bucket, contexts), np.int32),
+                   np.zeros((bucket, contexts), np.int32),
+                   np.zeros((bucket, contexts), np.float32),
+                   np.zeros((bucket,), np.int32),
+                   np.zeros((bucket,), np.float32))
+
+    def warmup(self) -> 'ServingEngine':
+        """Eagerly compile every (bucket x capacity x tier) program in
+        the ladder, so steady-state ``submit`` traffic never compiles.
+        Idempotent; auto-invoked by the first ``submit`` if skipped."""
+        import jax
+        with self._warm_lock:
+            if self._warm:
+                return self
+            t0 = time.perf_counter()
+            programs = 0
+            for bucket in self.buckets:
+                for host_arrays in self._warm_batches(bucket):
+                    arrays = mesh_lib.shard_batch(
+                        host_arrays, self.mesh, self.config.SHARD_CONTEXTS,
+                        direct=True)
+                    for tier in self.tiers:
+                        out = self.trainer.predict_step_placed(
+                            self.params, arrays, tier=tier)
+                        jax.block_until_ready(out)
+                        programs += 1
+            warm_s = time.perf_counter() - t0
+            if tele_core.enabled():
+                reg = tele_core.registry()
+                reg.gauge('serving/warmup_s').set(warm_s)
+                reg.gauge('serving/programs_warm').set(programs)
+            self.log('serving: warmed %d programs (buckets %s x tiers %s, '
+                     '%s wire) in %.1fs'
+                     % (programs, list(self.buckets), list(self.tiers),
+                        self.wire, warm_s))
+            self._warm = True
+        return self
+
+    # ---------------------------------------------------------- submit
+    def submit(self, context_lines: Sequence[str],
+               tier: str = 'topk') -> Future:
+        """Enqueue one prediction request (raw extractor/``.c2v`` context
+        lines, like ``model.predict``). Returns a Future resolving to
+        one ``ModelPredictionResults`` per line, in order. Requests
+        larger than the top batch bucket are split transparently."""
+        if tier not in self.tiers:
+            raise ValueError('tier %r is not warmed on this engine '
+                             '(tiers=%s)' % (tier, list(self.tiers)))
+        if self._closed:
+            raise RuntimeError('ServingEngine is closed')
+        lines = list(context_lines)
+        future: Future = Future()
+        if not lines:
+            future.set_result([])
+            return future
+        if not self._warm:
+            self.warmup()
+        batch = self.reader.process_input_rows(lines)
+        max_bucket = self.buckets[-1]
+        n = len(lines)
+        if n <= max_bucket:
+            requests = [_Request(batch, tier, future=future)]
+        else:
+            n_chunks = -(-n // max_bucket)
+            aggregate = _Aggregate(future, n_chunks)
+            requests = [
+                _Request(PathContextReader._take_rows(
+                    batch, slice(i * max_bucket, (i + 1) * max_bucket)),
+                    tier, aggregate=aggregate, chunk_idx=i)
+                for i in range(n_chunks)]
+        self.requests_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter('serving/requests_total').inc()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError('ServingEngine is closed')
+            for request in requests:
+                self._queues[tier].append(request)
+                self._pending_rows[tier] += request.rows
+            self._set_queue_depth_locked()
+            self._cond.notify_all()
+        return future
+
+    def predict(self, context_lines: Sequence[str], tier: str = 'topk',
+                timeout: Optional[float] = None) -> list:
+        """Synchronous ``submit().result()`` convenience."""
+        return self.submit(context_lines, tier).result(timeout)
+
+    def _set_queue_depth_locked(self) -> None:
+        depth = sum(len(q) for q in self._queues.values())
+        self.queue_depth.set(depth)
+        if tele_core.enabled():
+            tele_core.registry().gauge('serving/queue_depth').set(depth)
+
+    # ------------------------------------------------------ dispatcher
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and \
+                        not any(self._queues[t] for t in PREDICT_TIERS):
+                    self._cond.wait()
+                if self._closed and \
+                        not any(self._queues[t] for t in PREDICT_TIERS):
+                    return
+                # serve the tier whose head request has waited longest
+                tier = min(
+                    (t for t in PREDICT_TIERS if self._queues[t]),
+                    key=lambda t: self._queues[t][0].t_enqueue)
+                deadline = (self._queues[tier][0].t_enqueue
+                            + self.max_delay_s)
+                max_bucket = self.buckets[-1]
+                while not self._closed:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or \
+                            self._pending_rows[tier] >= max_bucket:
+                        break
+                    self._cond.wait(remaining)
+                taken: List[_Request] = []
+                rows = 0
+                queue = self._queues[tier]
+                while queue and rows + queue[0].rows <= max_bucket:
+                    request = queue.popleft()
+                    taken.append(request)
+                    rows += request.rows
+                self._pending_rows[tier] -= rows
+                self._set_queue_depth_locked()
+            if taken:
+                try:
+                    self._dispatch_batch(tier, taken, rows)
+                except BaseException as exc:  # keep the dispatcher alive
+                    for request in taken:
+                        request.fail(exc)
+
+    def _pack_padded(self, padded: Batch, bucket: int) -> Tuple[tuple, int]:
+        """Pad-complete plane batch -> packed wire arrays on a capacity
+        rung from the warm ladder. Returns (arrays, capacity)."""
+        ctx_rows, lengths = packed_lib.ragged_from_planes(
+            padded.source, padded.path, padded.target, padded.mask)
+        per_shard = int(packed_lib.shard_totals(
+            lengths, self.data_axis).max(initial=0))
+        capacity = pick_bucket(per_shard, self.capacities[bucket])
+        ctx = packed_lib.pack_ragged(
+            ctx_rows, lengths, self.trainer._token_pad,
+            self.trainer._path_pad, data_shards=self.data_axis,
+            capacity_minimum=capacity)
+        return (ctx, lengths, np.ascontiguousarray(padded.label),
+                np.ascontiguousarray(padded.weight)), capacity
+
+    def _dispatch_batch(self, tier: str, taken: List[_Request],
+                        rows: int) -> None:
+        t0 = time.perf_counter()
+        merged = (taken[0].batch if len(taken) == 1 else
+                  PathContextReader._concat([r.batch for r in taken]))
+        bucket = pick_bucket(rows, self.buckets)
+        padded = self.reader.pad_batch_to(merged, bucket)
+        if self.wire == 'packed':
+            host_arrays, capacity = self._pack_padded(padded, bucket)
+        else:
+            host_arrays, capacity = padded.device_arrays(), 0
+        arrays = mesh_lib.shard_batch(host_arrays, self.mesh,
+                                      self.config.SHARD_CONTEXTS,
+                                      direct=True)
+        # async dispatch: returns with device futures; the decode pool
+        # blocks on them, the dispatcher goes back to coalescing
+        out = self.trainer.predict_step_placed(self.params, arrays,
+                                               tier=tier)
+        dispatch_s = time.perf_counter() - t0
+        self.dispatch_timer.record(dispatch_s)
+        self.batches_total.inc()
+        self.fill_rate.set(rows / bucket)
+        self.last_dispatch = {'bucket': bucket, 'rows': rows,
+                              'capacity': capacity,
+                              'requests': len(taken)}
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.timer('serving/dispatch_ms').record(dispatch_s)
+            reg.counter('serving/batches_total').inc()
+            reg.gauge('serving/batch_fill_rate').set(rows / bucket)
+        self._decode_pool.submit(self._decode, out, padded, taken)
+
+    # ----------------------------------------------------------- decode
+    def _decode(self, out: dict, padded: Batch,
+                taken: List[_Request]) -> None:
+        try:
+            t0 = time.perf_counter()
+            # fetch ONLY the keys the tier produced (np.asarray blocks on
+            # the device value — this is the worker pool's job, never the
+            # dispatcher's)
+            fetched = {key: np.asarray(value)
+                       for key, value in out.items()}
+            n_rows = sum(request.rows for request in taken)
+            results = decode_results(fetched, padded, n_rows,
+                                     self.decode_table)
+            decode_s = time.perf_counter() - t0
+            self.decode_timer.record(decode_s)
+            if tele_core.enabled():
+                tele_core.registry().timer(
+                    'serving/decode_ms').record(decode_s)
+            row = 0
+            now = time.perf_counter()
+            for request in taken:
+                request.deliver(results[row:row + request.rows])
+                row += request.rows
+                latency = now - request.t_enqueue
+                self.latency.record(latency)
+                if tele_core.enabled():
+                    tele_core.registry().timer(
+                        'serving/latency_ms').record(latency)
+        except BaseException as exc:
+            for request in taken:
+                request.fail(exc)
+
+    # -------------------------------------------------------- lifecycle
+    def stats(self) -> Dict[str, object]:
+        """Snapshot of the engine's standalone instruments (latency
+        percentiles come from the windowed Timer snapshots)."""
+        return {
+            'requests_total': self.requests_total.snapshot(),
+            'batches_total': self.batches_total.snapshot(),
+            'queue_depth': self.queue_depth.snapshot(),
+            'batch_fill_rate': self.fill_rate.snapshot(),
+            'latency_ms': self.latency.snapshot(),
+            'dispatch_ms': self.dispatch_timer.snapshot(),
+            'decode_ms': self.decode_timer.snapshot(),
+            'last_dispatch': self.last_dispatch,
+        }
+
+    def close(self) -> None:
+        """Drain pending requests, stop the dispatcher and decode pool.
+        Idempotent."""
+        with self._cond:
+            if self._closed:
+                already = True
+            else:
+                already = False
+                self._closed = True
+            self._cond.notify_all()
+        if not already:
+            self._dispatcher.join()
+            self._decode_pool.shutdown(wait=True)
+
+    def __enter__(self) -> 'ServingEngine':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

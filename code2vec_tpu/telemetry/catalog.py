@@ -83,6 +83,32 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'input/packed_fill_rate': _m(GAUGE, 'fraction', 'Retained context slots '
                                  '/ packed wire capacity of the last packed '
                                  'batch (padding waste = 1 - this).'),
+    # ---- serving engine (code2vec_tpu/serving/, SERVING.md) ----
+    'serving/requests_total': _m(COUNTER, 'requests', 'Prediction requests '
+                                 'submitted to the serving engine.'),
+    'serving/batches_total': _m(COUNTER, 'batches', 'Coalesced '
+                                'micro-batches dispatched to the device.'),
+    'serving/queue_depth': _m(GAUGE, 'requests', 'Requests waiting in the '
+                              'micro-batcher queue.'),
+    'serving/batch_fill_rate': _m(GAUGE, 'fraction', 'Valid rows / bucket '
+                                  'size of the last dispatched '
+                                  'micro-batch.'),
+    'serving/latency_ms': _m(TIMER, 'ms', 'Request latency: submit -> '
+                             'decoded results (windowed percentiles).'),
+    'serving/dispatch_ms': _m(TIMER, 'ms', 'Coalesce + pack + place + '
+                              'async device dispatch of one '
+                              'micro-batch.'),
+    'serving/decode_ms': _m(TIMER, 'ms', 'Host-side device fetch + '
+                            'top-k/attention decode of one micro-batch '
+                            '(worker pool).'),
+    'serving/warmup_s': _m(GAUGE, 's', 'Wall time of the eager '
+                           'bucket-ladder compile at engine load.'),
+    'serving/programs_warm': _m(GAUGE, 'programs', 'Pre-compiled (bucket '
+                                'x capacity x tier) programs resident '
+                                'after warmup.'),
+    'serving/bulk_examples_per_sec': _m(GAUGE, 'examples/s', 'Streaming '
+                                        'bulk predict / embedding-export '
+                                        'throughput.'),
     # ---- profiler capture ----
     'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
                                'trace captures completed.'),

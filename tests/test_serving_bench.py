@@ -1,0 +1,95 @@
+"""CPU guard on the serving engine's throughput win (ISSUE 4 acceptance):
+on a synthetic concurrent request stream the engine must sustain >= 5x
+the naive per-request ``model.predict`` loop, with ZERO XLA compiles
+after warmup (asserted via the telemetry jit-compile counter). The real
+numbers are captured by ``benchmarks/bench_serving.py`` at full size."""
+import time
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+from tests.test_train_overfit import make_dataset
+
+LINE_POOL = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+    'run|c tokc0,pC,tokc1 tokc2,pA,tokc0',
+    'close|d tokd0,pB,tokd1 tokd1,pC,tokd2 tokd0,pA,tokd2',
+]
+
+
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('serving_bench'))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,64')
+    return Code2VecModel(config)
+
+
+def make_requests(n=64, seed=0):
+    """Ragged 1-4 line requests, the shape of concurrent REPL traffic."""
+    rng = np.random.default_rng(seed)
+    return [[LINE_POOL[int(i)] for i in
+             rng.integers(0, len(LINE_POOL), int(rng.integers(1, 5)))]
+            for _ in range(n)]
+
+
+def test_engine_beats_naive_loop_5x_with_zero_postwarm_compiles(model):
+    requests = make_requests()
+    n_lines = sum(len(r) for r in requests)
+
+    core.reset()
+    core.enable()
+    try:
+        assert install_compile_listener()
+        compiles = core.registry().counter('jit/compiles_total')
+
+        # ---- naive loop, warmed: every request size pads to bucket 8,
+        # so one warm call covers the whole measured loop
+        model.predict(requests[0])
+        naive_t0 = time.perf_counter()
+        naive_results = [model.predict(lines) for lines in requests]
+        naive_s = time.perf_counter() - naive_t0
+
+        # ---- engine, warmed ladder; snapshot the compile counter AFTER
+        # warmup — the measured load must add nothing to it
+        with model.serving_engine(tiers=('topk',),
+                                  max_delay_ms=2.0) as engine:
+            warm_compiles = compiles.value
+            engine_t0 = time.perf_counter()
+            futures = [engine.submit(lines, tier='topk')
+                       for lines in requests]
+            engine_results = [f.result(timeout=120) for f in futures]
+            engine_s = time.perf_counter() - engine_t0
+            postwarm_compiles = compiles.value - warm_compiles
+            stats = engine.stats()
+    finally:
+        core.disable()
+        core.reset()
+
+    assert postwarm_compiles == 0, (
+        '%d XLA compiles during the post-warmup serving load (stats=%r)'
+        % (postwarm_compiles, stats))
+    # every request answered, in shape
+    assert [len(r) for r in engine_results] == \
+        [len(r) for r in naive_results] == [len(r) for r in requests]
+    for served, direct in zip(engine_results, naive_results):
+        for s, d in zip(served, direct):
+            assert s.topk_predicted_words == d.topk_predicted_words
+    # the engine coalesced: far fewer device dispatches than requests
+    assert stats['batches_total'] < len(requests) / 2
+    naive_rps = len(requests) / naive_s
+    engine_rps = len(requests) / engine_s
+    assert engine_rps >= 5.0 * naive_rps, (
+        'engine %.1f req/s (%d lines in %.3fs, %d batches) vs naive '
+        '%.1f req/s (%.3fs): below the 5x floor'
+        % (engine_rps, n_lines, engine_s, stats['batches_total'],
+           naive_rps, naive_s))

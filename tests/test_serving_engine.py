@@ -1,0 +1,228 @@
+"""Serving engine (serving/engine.py + serving/bulk.py): bucket
+selection, deadline coalescing, exact parity with ``model.predict``,
+output tiers, oversize splitting, and the corpus-scale bulk paths."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data import packed as packed_lib
+from code2vec_tpu.serving import engine as engine_lib
+from tests.test_train_overfit import make_dataset
+
+# the four labels/token families of make_dataset's corpus
+PREDICT_LINES = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+    'run|c tokc0,pC,tokc1 tokc2,pA,tokc0 tokc1,pB,tokc2',
+]
+
+
+# ------------------------------------------------------------ pure units
+def test_batch_ladder_rounds_to_data_axis():
+    assert engine_lib.batch_ladder([8, 64], 8) == (8, 64)
+    # rounded up to the axis, deduplicated, sorted
+    assert engine_lib.batch_ladder([1, 8, 10, 60], 8) == (8, 16, 64)
+    with pytest.raises(ValueError):
+        engine_lib.batch_ladder([0], 8)
+
+
+def test_pick_bucket_smallest_cover():
+    ladder = (8, 16, 64)
+    assert engine_lib.pick_bucket(1, ladder) == 8
+    assert engine_lib.pick_bucket(8, ladder) == 8
+    assert engine_lib.pick_bucket(9, ladder) == 16
+    assert engine_lib.pick_bucket(64, ladder) == 64
+    assert engine_lib.pick_bucket(65, ladder) is None
+
+
+def test_capacity_ladder_covers_and_grows_geometrically():
+    assert packed_lib.capacity_ladder(6) == (64,)
+    assert packed_lib.capacity_ladder(64) == (64,)
+    assert packed_lib.capacity_ladder(65) == (64, 65)
+    assert packed_lib.capacity_ladder(1600) == (64, 256, 1024, 1600)
+    ladder = packed_lib.capacity_ladder(25600)
+    assert ladder[-1] == 25600
+    assert all(a < b for a, b in zip(ladder, ladder[1:]))
+    with pytest.raises(ValueError):
+        packed_lib.capacity_ladder(0)
+
+
+def test_capacity_rungs_are_exact_pack_targets():
+    """pack_ragged with capacity_minimum=<rung> must land EXACTLY on the
+    rung for any total <= rung — that is what makes every dispatched
+    wire shape one of the pre-compiled ladder shapes."""
+    rng = np.random.default_rng(0)
+    for rung in packed_lib.capacity_ladder(1600):
+        count = np.array([3, 0, 5, 1], np.int32)
+        ctx_rows = rng.integers(
+            1, 100, (int(count.sum()), 3)).astype(np.int32)
+        ctx = packed_lib.pack_ragged(ctx_rows, count, 0, 0,
+                                     capacity_minimum=rung)
+        assert ctx.shape == (1, rung, 3)
+
+
+def test_shard_totals():
+    count = np.array([1, 2, 3, 4], np.int32)
+    np.testing.assert_array_equal(
+        packed_lib.shard_totals(count, 2), [3, 7])
+    with pytest.raises(ValueError):
+        packed_lib.shard_totals(count, 3)
+
+
+# -------------------------------------------------------------- fixtures
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('serving'))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,16')
+    return Code2VecModel(config)
+
+
+# --------------------------------------------------------------- engine
+def test_engine_matches_model_predict_exactly(model):
+    direct = model.predict(PREDICT_LINES)
+    with model.serving_engine(tiers=('attention',),
+                              max_delay_ms=0.0) as engine:
+        served = engine.predict(PREDICT_LINES, tier='attention',
+                                timeout=60)
+    assert len(served) == len(direct) == len(PREDICT_LINES)
+    for s, d in zip(served, direct):
+        assert s.original_name == d.original_name
+        assert s.topk_predicted_words == d.topk_predicted_words
+        np.testing.assert_array_equal(s.topk_predicted_words_scores,
+                                      d.topk_predicted_words_scores)
+        assert s.attention_per_context == d.attention_per_context
+        assert s.code_vector is None and d.code_vector is None
+
+
+def test_deadline_coalescing_batches_concurrent_requests(model):
+    """Requests submitted inside one deadline window ride ONE dispatched
+    micro-batch, and each future gets exactly its own rows back."""
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=500.0) as engine:
+        futures = [engine.submit([line], tier='topk')
+                   for line in PREDICT_LINES]
+        results = [f.result(timeout=60) for f in futures]
+        stats = engine.stats()
+    assert stats['batches_total'] == 1
+    assert stats['requests_total'] == len(PREDICT_LINES)
+    assert stats['last_dispatch']['requests'] == len(PREDICT_LINES)
+    assert stats['last_dispatch']['rows'] == len(PREDICT_LINES)
+    direct = model.predict(PREDICT_LINES)
+    for (res,), d in zip(results, direct):
+        assert res.original_name == d.original_name
+        assert res.topk_predicted_words == d.topk_predicted_words
+
+
+def test_bucket_selection_smallest_cover(model):
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=0.0) as engine:
+        engine.predict([PREDICT_LINES[0]], tier='topk', timeout=60)
+        first = dict(engine.stats()['last_dispatch'])
+        nine = [PREDICT_LINES[i % 3] for i in range(9)]
+        engine.predict(nine, tier='topk', timeout=60)
+        second = dict(engine.stats()['last_dispatch'])
+    assert first == {'bucket': 8, 'rows': 1, 'capacity': 64,
+                     'requests': 1}
+    assert second['bucket'] == 16 and second['rows'] == 9
+    assert engine.stats()['batch_fill_rate'] == pytest.approx(9 / 16)
+
+
+def test_topk_tier_is_attention_and_vector_free(model):
+    direct = model.predict(PREDICT_LINES)
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=0.0) as engine:
+        served = engine.predict(PREDICT_LINES, tier='topk', timeout=60)
+    for s, d in zip(served, direct):
+        assert s.topk_predicted_words == d.topk_predicted_words
+        np.testing.assert_array_equal(s.topk_predicted_words_scores,
+                                      d.topk_predicted_words_scores)
+        assert s.attention_per_context == {}
+        assert s.code_vector is None
+
+
+def test_oversize_request_splits_across_buckets(model):
+    lines = [PREDICT_LINES[i % 3] for i in range(20)]
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=0.0) as engine:
+        served = engine.predict(lines, tier='topk', timeout=60)
+        stats = engine.stats()
+    assert len(served) == 20
+    assert stats['batches_total'] == 2  # 16-row chunk + 4-row chunk
+    # row results are independent of batch membership (per-row softmax)
+    direct = model.predict(lines)
+    for s, d in zip(served, direct):
+        assert s.original_name == d.original_name
+        assert s.topk_predicted_words == d.topk_predicted_words
+        np.testing.assert_allclose(s.topk_predicted_words_scores,
+                                   d.topk_predicted_words_scores,
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_cancelled_request_does_not_poison_batchmates(model):
+    """A caller cancelling its future (these futures are never marked
+    running, so cancel() always succeeds) must not break delivery to
+    the other requests coalesced into the same micro-batch."""
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=300.0) as engine:
+        doomed = engine.submit([PREDICT_LINES[0]], tier='topk')
+        survivor = engine.submit([PREDICT_LINES[1]], tier='topk')
+        assert doomed.cancel()
+        results = survivor.result(timeout=60)
+        stats = engine.stats()
+    assert stats['batches_total'] == 1  # same micro-batch
+    assert results[0].topk_predicted_words == \
+        model.predict([PREDICT_LINES[1]])[0].topk_predicted_words
+
+
+def test_engine_empty_submit_and_close_semantics(model):
+    engine = model.serving_engine(tiers=('topk',), warmup=False,
+                                  max_delay_ms=0.0)
+    assert engine.submit([], tier='topk').result(timeout=5) == []
+    with pytest.raises(ValueError):
+        engine.submit(PREDICT_LINES, tier='vectors')  # not warmed
+    engine.close()
+    engine.close()  # idempotent
+    with pytest.raises(RuntimeError):
+        engine.submit(PREDICT_LINES, tier='topk')
+
+
+# ----------------------------------------------------------------- bulk
+def test_bulk_export_code_vectors(model, tmp_path):
+    corpus = tmp_path / 'corpus.c2v'
+    lines = [PREDICT_LINES[i % 3] for i in range(10)]
+    corpus.write_text('\n'.join(lines) + '\n')
+    from code2vec_tpu.serving import bulk
+    total, out_path = bulk.export_code_vectors(model, str(corpus))
+    assert total == 10
+    rows = [np.array(line.split(), dtype=float)
+            for line in open(out_path).read().splitlines()]
+    assert len(rows) == 10
+    dim = model.config.CODE_VECTOR_SIZE
+    assert all(r.shape == (dim,) for r in rows)
+    # parity with the engine's vectors tier (batch shapes differ, so
+    # allclose, not bit equality)
+    with model.serving_engine(tiers=('vectors',),
+                              max_delay_ms=0.0) as engine:
+        served = engine.predict(lines, tier='vectors', timeout=60)
+    for file_vec, res in zip(rows, served):
+        np.testing.assert_allclose(file_vec, res.code_vector,
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_bulk_predict_streams_in_order(model):
+    lines = [PREDICT_LINES[i % 3] for i in range(11)]
+    from code2vec_tpu.serving import bulk
+    results = list(bulk.bulk_predict(model, iter(lines), tier='topk',
+                                     batch_size=8))
+    assert len(results) == 11
+    direct = model.predict(lines)
+    for r, d in zip(results, direct):
+        assert r.original_name == d.original_name
+        assert r.topk_predicted_words == d.topk_predicted_words
+        assert r.attention_per_context == {}
