@@ -64,5 +64,9 @@ probe >/dev/null || { echo "wedged after pallas_ab_c1024" >&2; exit 3; }
 # serving engine A/B (ISSUE 4): naive per-request predict vs the
 # micro-batching engine — on-chip latency p50/p99 + throughput
 run_stage serving 900 python benchmarks/bench_serving.py
+probe >/dev/null || { echo "wedged after serving" >&2; exit 3; }
+# embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
+# the naive numpy host-loop baseline
+run_stage index 900 python benchmarks/bench_index.py
 
 echo "capture complete: ${OUT}" >&2

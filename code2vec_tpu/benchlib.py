@@ -62,6 +62,17 @@ def bench_steps(smoke: bool):
     return (2, 5) if smoke else (10, 60)
 
 
+def bench_timer_wall(fn) -> float:
+    """Wall-clock one call of ``fn`` through the shared Timer (the same
+    clock discipline as ``bench_timer``; returns seconds). For variants
+    whose result is host numpy — already synchronized — so no extra
+    device fence is needed."""
+    sw = bench_timer()
+    with sw.time():
+        fn()
+    return sw.last
+
+
 def honor_env_platforms() -> None:
     """Honor the caller's JAX_PLATFORMS even though the sitecustomize
     preimport pins a platform list before this process's env is read (same

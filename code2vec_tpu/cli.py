@@ -6,6 +6,9 @@
     python -m code2vec_tpu.cli --load models/m/s --release
     python -m code2vec_tpu.cli --load models/m/s --save_word2v tokens.txt
     python -m code2vec_tpu.cli --load models/m/s --bulk-vectors corpus.c2v
+    python -m code2vec_tpu.cli --load models/m/s --build-index corpus.c2v
+    python -m code2vec_tpu.cli --load models/m/s \
+        --index-path corpus.c2v.vecindex --query-neighbors queries.c2v
 
 The backend ('flax' | 'jax') is selected at runtime with ``--framework``
 (the reference selected 'tensorflow' | 'keras' the same way,
@@ -53,11 +56,29 @@ def main(args=None) -> None:
         model.save_word2vec_format(config.SAVE_T2V, VocabType.Target)
         config.log('Target word vectors saved in word2vec text format in: %s'
                    % config.SAVE_T2V)
+    # one-flag parity export of BOTH vocab tables (reference
+    # --save_w2v/--save_t2v): the word2vec text files double as index
+    # build sources for nearest-method-NAME queries (INDEX.md)
+    if config.EXPORT_VOCAB_VECTORS:
+        prefix = config.EXPORT_VOCAB_VECTORS
+        model.save_word2vec_format(prefix + '.tokens.txt', VocabType.Token)
+        model.save_word2vec_format(prefix + '.targets.txt',
+                                   VocabType.Target)
+        config.log('Vocab embedding tables saved in word2vec text format '
+                   'in: %s.{tokens,targets}.txt' % prefix)
     # offline corpus embedding: the vectors-only predict program streamed
     # over eval-sized sharded batches (serving/bulk.py, SERVING.md)
     if config.BULK_VECTORS_PATH:
         from code2vec_tpu.serving.bulk import export_code_vectors
         export_code_vectors(model, config.BULK_VECTORS_PATH)
+    # embedding index: build + batch neighbor queries (index/, INDEX.md)
+    index = None
+    if config.BUILD_INDEX_FROM:
+        from code2vec_tpu.index.service import build_index
+        index = build_index(model, config)
+    if config.QUERY_NEIGHBORS_PATH:
+        from code2vec_tpu.index.service import query_neighbors_file
+        query_neighbors_file(model, config, index=index)
     # evaluate standalone only: training already evaluates per epoch
     # (reference code2vec.py:28-33)
     if config.is_testing and not config.is_training:

@@ -308,6 +308,27 @@ class Config:
     # {topk, attention, full, vectors} (training/trainer.py
     # PREDICT_TIERS). Fewer tiers = proportionally fewer eager compiles.
     SERVING_WARM_TIERS: str = 'topk,attention,full'
+    # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
+    # Storage dtype for exported code vectors AND the index store:
+    # 'float16' halves disk + device-resident (HBM) footprint; scores
+    # always accumulate in float32 on device, and recall@10 is
+    # parity-tested across the two (tests/test_index.py).
+    VECTORS_DTYPE: str = 'float32'
+    # Index tier: 'exact' is the brute-force matmul + sharded top-k
+    # (bit-for-rank exact); 'ivf' adds the k-means coarse quantizer +
+    # inverted lists for corpora that outgrow exact search.
+    INDEX_KIND: str = 'exact'
+    # Similarity metric: 'cosine' (store rows normalized at build) or
+    # raw 'dot'.
+    INDEX_METRIC: str = 'cosine'
+    # IVF: inverted lists probed per query. The recall/latency dial —
+    # nprobe/C of the corpus is scanned. 0 picks the default (ivf.py).
+    INDEX_NPROBE: int = 8
+    # IVF: k-means cluster count; 0 = sqrt(N) heuristic.
+    INDEX_CLUSTERS: int = 0
+    # Neighbors returned per query by the serving/CLI paths, and the k
+    # the index warm-compiles at load.
+    INDEX_NEIGHBORS_K: int = 10
     # Model backend: 'flax' (nn.Module) or 'jax' (pure-pytree functional).
     # Mirrors the reference's two swappable backends (keras/tensorflow),
     # selected at runtime (reference code2vec.py:7-13).
@@ -331,8 +352,23 @@ class Config:
     # through the 'vectors'-tier predict program and write one code
     # vector per kept example to <file>.vectors.
     BULK_VECTORS_PATH: Optional[str] = None
+    # Index build source (index/service.py): a .c2v corpus (streamed
+    # through the vectors tier, no text round-trip), a .vectors text
+    # export, or a word2vec text file (--export_vocab_vectors output —
+    # the index then serves nearest-method-NAME queries).
+    BUILD_INDEX_FROM: Optional[str] = None
+    # Where the index directory lives; None derives <source>.vecindex
+    # on build and is required for --query-neighbors.
+    INDEX_PATH: Optional[str] = None
+    # Batch neighbor queries: stream this .c2v file through the vectors
+    # tier + index lookup and write <file>.neighbors.jsonl.
+    QUERY_NEIGHBORS_PATH: Optional[str] = None
     SAVE_W2V: Optional[str] = None
     SAVE_T2V: Optional[str] = None
+    # One-flag parity export of BOTH vocab embedding tables in word2vec
+    # text format: <prefix>.tokens.txt + <prefix>.targets.txt
+    # (reference --save_w2v/--save_t2v, model_base.py:176-182).
+    EXPORT_VOCAB_VECTORS: Optional[str] = None
     VERBOSE_MODE: int = 1
     LOGS_PATH: Optional[str] = None
     USE_TENSORBOARD: bool = False
@@ -503,6 +539,53 @@ class Config:
                                  'vectors-only predict program and write '
                                  'FILE.c2v.vectors (offline embedding '
                                  'export; serving/bulk.py)')
+        parser.add_argument('--vectors-dtype', dest='vectors_dtype',
+                            choices=['float32', 'float16'], default=None,
+                            help='storage dtype for exported code vectors '
+                                 'and the index store (float16 halves '
+                                 'disk + HBM; INDEX.md)')
+        parser.add_argument('--export_vocab_vectors',
+                            dest='export_vocab_vectors', default=None,
+                            metavar='PREFIX',
+                            help='write BOTH vocab embedding tables in '
+                                 'word2vec text format: PREFIX.tokens.txt '
+                                 '+ PREFIX.targets.txt (one-flag parity '
+                                 'with --save_w2v/--save_t2v)')
+        parser.add_argument('--build-index', dest='build_index',
+                            default=None, metavar='SOURCE',
+                            help='build a k-NN index from SOURCE: a .c2v '
+                                 'corpus (streamed through the vectors '
+                                 'tier), a .vectors export, or a word2vec '
+                                 'text file (code2vec_tpu/index/, '
+                                 'INDEX.md)')
+        parser.add_argument('--index-path', dest='index_path',
+                            default=None, metavar='DIR',
+                            help='index directory (default on build: '
+                                 '<source>.vecindex; required for '
+                                 '--query-neighbors)')
+        parser.add_argument('--query-neighbors', dest='query_neighbors',
+                            default=None, metavar='FILE.c2v',
+                            help='stream a .c2v file through the vectors '
+                                 'tier + index lookup and write '
+                                 'FILE.neighbors.jsonl (one query per '
+                                 'kept example)')
+        parser.add_argument('--index-kind', dest='index_kind',
+                            choices=['exact', 'ivf'], default=None,
+                            help='index tier: exact brute-force or IVF '
+                                 'approximate (INDEX.md)')
+        parser.add_argument('--index-metric', dest='index_metric',
+                            choices=['cosine', 'dot'], default=None,
+                            help='similarity metric of the index store')
+        parser.add_argument('--nprobe', dest='index_nprobe', type=int,
+                            default=None, metavar='N',
+                            help='IVF inverted lists probed per query '
+                                 '(the recall/latency dial)')
+        parser.add_argument('--index-clusters', dest='index_clusters',
+                            type=int, default=None, metavar='C',
+                            help='IVF k-means cluster count (0 = sqrt(N))')
+        parser.add_argument('--neighbors-k', dest='index_neighbors_k',
+                            type=int, default=None, metavar='K',
+                            help='neighbors returned per query')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -610,6 +693,26 @@ class Config:
             self.SERVING_MAX_DELAY_MS = parsed.serving_max_delay_ms
         if parsed.bulk_vectors:
             self.BULK_VECTORS_PATH = parsed.bulk_vectors
+        if parsed.vectors_dtype:
+            self.VECTORS_DTYPE = parsed.vectors_dtype
+        if parsed.export_vocab_vectors:
+            self.EXPORT_VOCAB_VECTORS = parsed.export_vocab_vectors
+        if parsed.build_index:
+            self.BUILD_INDEX_FROM = parsed.build_index
+        if parsed.index_path:
+            self.INDEX_PATH = parsed.index_path
+        if parsed.query_neighbors:
+            self.QUERY_NEIGHBORS_PATH = parsed.query_neighbors
+        if parsed.index_kind:
+            self.INDEX_KIND = parsed.index_kind
+        if parsed.index_metric:
+            self.INDEX_METRIC = parsed.index_metric
+        if parsed.index_nprobe is not None:
+            self.INDEX_NPROBE = parsed.index_nprobe
+        if parsed.index_clusters is not None:
+            self.INDEX_CLUSTERS = parsed.index_clusters
+        if parsed.index_neighbors_k is not None:
+            self.INDEX_NEIGHBORS_K = parsed.index_neighbors_k
         return self
 
     # ------------------------------------------------------- derived props
@@ -822,6 +925,29 @@ class Config:
                 'config.SERVING_WARM_TIERS must be a non-empty '
                 'comma-separated subset of %s, got %r'
                 % (sorted(valid_tiers), self.SERVING_WARM_TIERS))
+        if self.VECTORS_DTYPE not in {'float32', 'float16'}:
+            raise ValueError("config.VECTORS_DTYPE must be in "
+                             "{'float32', 'float16'}.")
+        if self.INDEX_KIND not in {'exact', 'ivf'}:
+            raise ValueError("config.INDEX_KIND must be in "
+                             "{'exact', 'ivf'}.")
+        if self.INDEX_METRIC not in {'cosine', 'dot'}:
+            raise ValueError("config.INDEX_METRIC must be in "
+                             "{'cosine', 'dot'}.")
+        if self.INDEX_NPROBE < 0:
+            raise ValueError('config.INDEX_NPROBE must be >= 0 '
+                             '(0 = default).')
+        if self.INDEX_CLUSTERS < 0:
+            raise ValueError('config.INDEX_CLUSTERS must be >= 0 '
+                             '(0 = sqrt(N)).')
+        if self.INDEX_NEIGHBORS_K < 1:
+            raise ValueError('config.INDEX_NEIGHBORS_K must be >= 1.')
+        if self.QUERY_NEIGHBORS_PATH and not (self.INDEX_PATH
+                                              or self.BUILD_INDEX_FROM):
+            raise ValueError(
+                '--query-neighbors needs an index: pass --index-path '
+                'DIR (an existing index) or --build-index SOURCE '
+                '(build one first).')
         if self.FAULT_INJECT:
             # a typo'd injection spec must fail at startup, not silently
             # inject nothing (parse_spec raises ValueError with the

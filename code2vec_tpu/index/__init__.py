@@ -1,0 +1,24 @@
+"""TPU-native embedding index: sharded k-NN search over code vectors.
+
+The code2vec paper's headline downstream use is semantic retrieval —
+"similar methods have nearby vectors" — but until this package the
+framework could only WRITE vectors (serving/bulk.py, evaluate's
+``--export_code_vectors``), never query them. The index closes the loop
+extract → train → export → **search** (INDEX.md):
+
+- ``store``   — on-disk memory-mapped vector store (+ labels), built
+  from ``.vectors`` files, word2vec text exports, or streamed straight
+  from ``bulk.iter_code_vector_batches`` without a text round-trip;
+- ``exact``   — brute-force k-NN: one warm jitted matmul + the
+  axis-general ``ops/topk.py::sharded_top_k`` merge, store rows sharded
+  over the mesh data axis; plus a host-merge streamed tier for stores
+  larger than device memory;
+- ``ivf``     — approximate tier: on-device k-means coarse quantizer,
+  inverted lists, ``nprobe``-bounded probing;
+- ``service`` — build/load/query orchestration and the ServingEngine
+  ``submit_neighbors`` composition (one warm round-trip from raw
+  context lines to the K most similar corpus methods).
+"""
+from code2vec_tpu.index.store import VectorStore
+
+__all__ = ['VectorStore']

@@ -1,0 +1,274 @@
+"""Brute-force exact k-NN over a device-resident, mesh-sharded store.
+
+The search is one warm jitted program per (query bucket, k): a
+``(Q, D) @ (D, N_shard)`` matmul per data shard — float32 accumulation
+whatever the store dtype — a validity mask over the row-padding, and the
+axis-general two-stage top-k merge from ``ops/topk.py`` (the same kernel
+that merges the column-sharded softmax, here over the DATA axis: store
+rows shard over ``data`` like eval batches, queries are replicated, and
+only k candidates per shard cross the ICI).
+
+Query batches ride a bucket ladder (``DEFAULT_QUERY_BUCKETS``, or the
+``ExactIndex(query_buckets=...)`` parameter; bucket pick reuses the
+serving engine's ``pick_bucket``), so steady-state search never
+compiles — ``warmup()`` eagerly compiles the ladder and the
+compile counter is asserted flat in tests/test_index_bench.py, the same
+trick as tests/test_serving_bench.py.
+
+Two tiers:
+
+- ``ExactIndex`` — the whole store resident on device. The right tier
+  whenever the store fits HBM (a java14m-scale corpus at 384 dims /
+  float16 is ~10 GB — fits a v5e-8 data axis with room).
+- ``search_streamed`` — stores larger than device memory: stream the
+  mmap shards through a fixed-shape device chunk, per-shard
+  ``padded_local_topk`` (a shard may hold FEWER than k rows — padded
+  with −inf/−1 sentinels), and an exact host-side ``merge_topk_host``
+  across shards with deterministic index tie-breaking.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.index.store import VectorStore, normalize_rows
+from code2vec_tpu.telemetry import core as tele_core
+
+DEFAULT_QUERY_BUCKETS = (1, 8, 64, 512)
+
+
+def _pick_bucket(n: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder bucket covering ``n`` queries; oversize query
+    batches fall back to the next multiple of the top bucket (compiles
+    once per such size — callers chunk instead when they care)."""
+    from code2vec_tpu.serving.engine import pick_bucket
+    bucket = pick_bucket(n, ladder)
+    if bucket is None:
+        top = ladder[-1]
+        bucket = -(-n // top) * top
+    return bucket
+
+
+class ExactIndex:
+    """Device-resident exact-nearest-neighbor index over a store (or a
+    raw ``(N, D)`` array for tests/benchmarks).
+
+    ``mesh=None`` keeps everything on the default device (single-chip /
+    CPU); a mesh shards store rows over its data axis."""
+
+    def __init__(self, store, mesh=None,
+                 metric: Optional[str] = None,
+                 query_buckets: Sequence[int] = DEFAULT_QUERY_BUCKETS,
+                 labels: Optional[np.ndarray] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from code2vec_tpu.parallel.mesh import DATA_AXIS
+
+        if isinstance(store, VectorStore):
+            vectors = store.all_rows()
+            self.metric = store.metric if metric is None else metric
+            normalized = store.normalized
+            self.labels = store.labels if labels is None else labels
+        else:
+            vectors = np.asarray(store)
+            self.metric = 'cosine' if metric is None else metric
+            normalized = False
+            self.labels = labels
+        if vectors.ndim != 2:
+            raise ValueError('store must be (N, D), got %r'
+                             % (vectors.shape,))
+        if self.metric == 'cosine' and not normalized:
+            vectors = normalize_rows(vectors).astype(vectors.dtype)
+        self.count = int(vectors.shape[0])
+        self.dim = int(vectors.shape[1])
+        self.query_buckets = tuple(sorted(set(int(b)
+                                              for b in query_buckets)))
+        self.mesh = mesh
+        self._data_axis = (mesh.shape[DATA_AXIS]
+                           if mesh is not None else 1)
+        # rows padded so every data shard holds an equal slice; padded
+        # rows are masked to -inf and can never rank
+        n_pad = -(-self.count // self._data_axis) * self._data_axis
+        if n_pad != self.count:
+            vectors = np.concatenate(
+                [vectors, np.zeros((n_pad - self.count, self.dim),
+                                   vectors.dtype)])
+        self.padded_rows = n_pad
+        neg_mask = np.zeros((n_pad,), np.float32)
+        neg_mask[self.count:] = -np.inf
+        if mesh is not None and mesh.size > 1:
+            self._matrix = jax.device_put(
+                vectors, NamedSharding(mesh, P(DATA_AXIS, None)))
+            self._neg_mask = jax.device_put(
+                neg_mask, NamedSharding(mesh, P(DATA_AXIS)))
+        else:
+            self._matrix = jax.device_put(vectors)
+            self._neg_mask = jax.device_put(neg_mask)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.gauge('index/vectors_total').set(self.count)
+            reg.gauge('index/shard_rows').set(n_pad // self._data_axis)
+        self._programs: Dict[Tuple[int, int], object] = {}
+        self._jnp = jnp
+
+    # ---------------------------------------------------------- programs
+    def _program(self, q_bucket: int, k: int):
+        key = (q_bucket, k)
+        program = self._programs.get(key)
+        if program is not None:
+            return program
+        import jax
+        import jax.numpy as jnp
+
+        from code2vec_tpu.ops.topk import sharded_top_k
+        from code2vec_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = self.mesh
+        cosine = self.metric == 'cosine'
+        sharded = mesh is not None and mesh.shape[DATA_AXIS] > 1
+
+        def run(queries, matrix, neg_mask):
+            q = queries.astype(jnp.float32)
+            if cosine:
+                norms = jnp.linalg.norm(q, axis=-1, keepdims=True)
+                q = q / jnp.where(norms > 0, norms, 1.0)
+            # float32 accumulation whatever the store dtype (float16
+            # stores halve HBM; the MXU/VPU accumulates in f32 anyway)
+            scores = jax.lax.dot_general(
+                q.astype(matrix.dtype), matrix,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = scores + neg_mask[None, :]
+            if sharded:
+                return sharded_top_k(scores, k, mesh,
+                                     shard_axis=DATA_AXIS,
+                                     batch_axis=None)
+            return jax.lax.top_k(scores, k)
+
+        program = jax.jit(run)
+        self._programs[key] = program
+        return program
+
+    def warmup(self, k: int) -> 'ExactIndex':
+        """Eagerly compile every query-bucket program for ``k``, so
+        steady-state search never compiles."""
+        import jax
+        k = min(k, self.count)
+        t0 = time.perf_counter()
+        for bucket in self.query_buckets:
+            queries = np.zeros((bucket, self.dim), np.float32)
+            jax.block_until_ready(
+                self._program(bucket, k)(queries, self._matrix,
+                                         self._neg_mask))
+        if tele_core.enabled():
+            tele_core.registry().gauge('index/warmup_s').set(
+                time.perf_counter() - t0)
+        return self
+
+    # ------------------------------------------------------------ search
+    def search(self, queries: np.ndarray, k: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """(Q, D) queries -> ((Q, k) scores, (Q, k) row indices), exact,
+        ranked by score then lowest index. ``k`` is capped at the store
+        size. A single (D,) query is treated as Q=1."""
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        if queries.shape[1] != self.dim:
+            raise ValueError('queries have dim %d, store has %d'
+                             % (queries.shape[1], self.dim))
+        k = min(k, self.count)
+        n = queries.shape[0]
+        bucket = _pick_bucket(n, self.query_buckets)
+        if bucket != n:
+            queries = np.concatenate(
+                [queries, np.zeros((bucket - n, self.dim), np.float32)])
+        t0 = time.perf_counter()
+        values, indices = self._program(bucket, k)(
+            queries, self._matrix, self._neg_mask)
+        values = np.asarray(values)[:n]
+        indices = np.asarray(indices)[:n]
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.counter('index/queries_total').inc(n)
+            reg.timer('index/query_latency_ms').record(
+                time.perf_counter() - t0)
+        return values, indices
+
+
+# one jitted kernel shared by every search_streamed call: jit's cache is
+# keyed on function identity + static args, so a per-call closure would
+# retrace and recompile every invocation — exactly the warm-shape
+# discipline the compile-counter guards enforce elsewhere
+_streamed_program = None
+
+
+def _streamed_shard_topk(queries, chunk, neg_mask, k: int):
+    global _streamed_program
+    if _streamed_program is None:
+        import jax
+        import jax.numpy as jnp
+
+        from code2vec_tpu.ops.topk import padded_local_topk
+
+        def shard_topk(q, rows, mask, kk):
+            scores = jax.lax.dot_general(
+                q.astype(rows.dtype), rows, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return padded_local_topk(scores + mask[None, :], kk)
+
+        _streamed_program = jax.jit(shard_topk, static_argnums=3)
+    return _streamed_program(queries, chunk, neg_mask, k)
+
+
+def search_streamed(store: VectorStore, queries: np.ndarray, k: int,
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact k-NN WITHOUT loading the store on device: stream the mmap
+    shards through one fixed-shape device chunk each, take a per-shard
+    ``padded_local_topk`` (−inf/−1 sentinels where a shard holds fewer
+    than k rows), and merge the per-shard candidates exactly on the host
+    (``merge_topk_host`` — deterministic index tie-breaking).
+
+    Bit-for-rank identical to ``ExactIndex.search``
+    (tests/test_index.py); the tier for stores larger than device
+    memory. One compiled program serves every shard AND every call
+    (module-level jitted kernel): all chunks pad to ``store.shard_rows``
+    rows."""
+    queries = np.atleast_2d(np.asarray(queries, np.float32))
+    if store.metric == 'cosine':
+        queries = normalize_rows(queries)
+    k = min(k, store.count)
+    n = queries.shape[0]
+    q_bucket = _pick_bucket(n, DEFAULT_QUERY_BUCKETS)
+    if q_bucket != n:
+        queries = np.concatenate(
+            [queries, np.zeros((q_bucket - n, store.dim), np.float32)])
+    chunk_rows = min(store.shard_rows, max(k, max(store.shards)))
+
+    cand_values = []
+    cand_indices = []
+    for offset, rows in store.iter_shards():
+        rows = np.asarray(rows)
+        pad = chunk_rows - rows.shape[0]
+        neg_mask = np.zeros((chunk_rows,), np.float32)
+        if pad:
+            rows = np.concatenate(
+                [rows, np.zeros((pad, store.dim), rows.dtype)])
+            neg_mask[-pad:] = -np.inf
+        values, indices = _streamed_shard_topk(queries, rows, neg_mask, k)
+        values = np.asarray(values)
+        indices = np.asarray(indices)
+        # globalize real candidates; anything −inf (k-padding sentinels
+        # AND selected chunk-padding rows) becomes the −1 sentinel so a
+        # padding row's local index can never alias a later shard's real
+        # global index
+        indices = np.where(np.isfinite(values), indices + offset, -1)
+        cand_values.append(values)
+        cand_indices.append(indices)
+    from code2vec_tpu.ops.topk import merge_topk_host
+    values, indices = merge_topk_host(
+        np.concatenate(cand_values, axis=-1),
+        np.concatenate(cand_indices, axis=-1), k)
+    return values[:n], indices[:n]

@@ -1,0 +1,285 @@
+"""IVF approximate tier: k-means coarse quantizer + inverted lists.
+
+For corpora that outgrow exact search, the classic two-level scheme:
+
+1. **Build** — an on-device k-means over the store (jitted Lloyd
+   iterations: assignment is one ``(N, D) @ (D, C)`` matmul + argmax,
+   the update a ``segment_sum``), then the store rows are REORDERED into
+   cluster-sorted order so each inverted list is a contiguous slice
+   (CSR offsets) — probing is a segment-gather, not a scatter chase.
+2. **Query** — score the C centroids (tiny), take the top ``nprobe``
+   lists, gather their rows from the cluster-sorted matrix with one
+   padded ``take`` (the candidate capacity rides
+   ``data/packed.py::bucketed_capacity``, so the probe program
+   specializes on a handful of capacities, not one per query batch),
+   mask the padding to −inf, and top-k.
+
+Probing ``nprobe`` of C lists scans ~``nprobe/C`` of the corpus;
+recall depends on how clustered the vectors are (code vectors cluster by
+construction — that is the paper's premise). The builder measures
+recall@10 against the exact tier on a held-out query sample and reports
+it (``index/recall_at10``); ``benchmarks/bench_index.py`` sweeps the
+nprobe/recall/throughput curve.
+
+Persistence: ``ivf.npz`` (centroids, cluster-sorted row ids, CSR
+offsets) inside the store directory — the store shards stay the single
+source of vector truth; loading re-sorts rows from the mmap.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from code2vec_tpu.data.packed import bucketed_capacity
+from code2vec_tpu.index.store import VectorStore
+from code2vec_tpu.telemetry import core as tele_core
+
+IVF_NAME = 'ivf.npz'
+
+DEFAULT_ITERS = 10
+DEFAULT_NPROBE = 8
+# probe-gather capacity floor (bucketed_capacity minimum): small enough
+# that tiny test corpora stay cheap
+MIN_PROBE_CAPACITY = 64
+
+
+def default_clusters(count: int) -> int:
+    """The classic sqrt(N) heuristic, floored at 1."""
+    return max(1, int(np.sqrt(count)))
+
+
+def kmeans(vectors: np.ndarray, n_clusters: int,
+           iters: int = DEFAULT_ITERS, seed: int = 0
+           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Jitted Lloyd iterations; returns (centroids (C, D) float32,
+    assignment (N,) int32). Assignment maximizes the dot product —
+    equivalent to min-L2 for the normalized rows of a cosine store.
+    Empty clusters keep their previous centroid."""
+    import jax
+    import jax.numpy as jnp
+
+    vectors = np.asarray(vectors, np.float32)
+    n, dim = vectors.shape
+    n_clusters = min(n_clusters, n)
+    rng = np.random.default_rng(seed)
+    init = vectors[rng.choice(n, size=n_clusters, replace=False)]
+
+    @jax.jit
+    def step(centroids, data):
+        scores = data @ centroids.T                     # (N, C)
+        assign = jnp.argmax(scores, axis=-1)
+        sums = jax.ops.segment_sum(data, assign, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), assign,
+                                     num_segments=n_clusters)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        # empty cluster: keep the old centroid instead of collapsing to 0
+        new = jnp.where((counts > 0)[:, None], means, centroids)
+        return new, assign
+
+    centroids = jnp.asarray(init)
+    data = jnp.asarray(vectors)
+    assign = None
+    for _ in range(max(1, iters)):
+        centroids, assign = step(centroids, data)
+    return (np.asarray(centroids, np.float32),
+            np.asarray(assign, np.int32))
+
+
+class IVFIndex:
+    """nprobe-bounded approximate k-NN over a built store.
+
+    Build with ``IVFIndex.build(store, ...)`` (persists ``ivf.npz``) or
+    reopen with ``IVFIndex(store)`` when the sidecar exists."""
+
+    def __init__(self, store: VectorStore, centroids: np.ndarray = None,
+                 list_ids: np.ndarray = None, offsets: np.ndarray = None,
+                 nprobe: int = DEFAULT_NPROBE,
+                 vectors: Optional[np.ndarray] = None):
+        import jax
+
+        self.store = store
+        self.metric = store.metric
+        self.labels = store.labels
+        self.count = store.count
+        self.dim = store.dim
+        self.nprobe = nprobe
+        if centroids is None:
+            sidecar = os.path.join(store.path, IVF_NAME)
+            if not os.path.isfile(sidecar):
+                raise FileNotFoundError(
+                    'no IVF sidecar at `%s` — build one with '
+                    'IVFIndex.build(store) or --build-index '
+                    '--index-kind ivf' % sidecar)
+            data = np.load(sidecar)
+            centroids = data['centroids']
+            list_ids = data['list_ids']
+            offsets = data['offsets']
+        self.centroids = np.asarray(centroids, np.float32)
+        self.n_clusters = self.centroids.shape[0]
+        self.list_ids = np.asarray(list_ids, np.int64)
+        self.offsets = np.asarray(offsets, np.int64)
+        self.list_lengths = np.diff(self.offsets)
+        # cluster-sorted rows, device-resident (replicated: the IVF
+        # tier's win is scanning nprobe/C of the rows, and the padded
+        # gather wants local rows; the sharded story is the exact
+        # tier's). `vectors` lets build() hand over its already-loaded
+        # array instead of a second all_rows() read; device residency
+        # keeps the STORE dtype either way (f16 stores stay halved).
+        rows = (np.asarray(vectors, store.dtype) if vectors is not None
+                else store.all_rows())[self.list_ids]
+        self._sorted_rows = jax.device_put(rows)
+        self._centroids_dev = jax.device_put(self.centroids)
+        self._programs: Dict[Tuple[int, int, int], object] = {}
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def build(cls, store: VectorStore, n_clusters: Optional[int] = None,
+              iters: int = DEFAULT_ITERS, seed: int = 0,
+              nprobe: int = DEFAULT_NPROBE, persist: bool = True,
+              log=None) -> 'IVFIndex':
+        t0 = time.perf_counter()
+        n_clusters = (n_clusters if n_clusters
+                      else default_clusters(store.count))
+        vectors = np.asarray(store.all_rows(), np.float32)
+        centroids, assign = kmeans(vectors, n_clusters, iters=iters,
+                                   seed=seed)
+        n_clusters = centroids.shape[0]
+        # CSR inverted lists: stable sort keeps ascending row ids inside
+        # each list (deterministic probe order)
+        list_ids = np.argsort(assign, kind='stable').astype(np.int64)
+        counts = np.bincount(assign, minlength=n_clusters)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        if persist:
+            np.savez(os.path.join(store.path, IVF_NAME),
+                     centroids=centroids, list_ids=list_ids,
+                     offsets=offsets)
+        build_s = time.perf_counter() - t0
+        if tele_core.enabled():
+            tele_core.registry().gauge('index/build_s').set(build_s)
+        if log is not None:
+            occupied = int((counts > 0).sum())
+            log('index: IVF built — %d clusters (%d occupied, p50 list '
+                '%d rows) over %d vectors in %.1fs'
+                % (n_clusters, occupied,
+                   int(np.median(counts[counts > 0])) if occupied else 0,
+                   store.count, build_s))
+        return cls(store, centroids=centroids, list_ids=list_ids,
+                   offsets=offsets, nprobe=nprobe, vectors=vectors)
+
+    # ------------------------------------------------------------ search
+    def _program(self, q_bucket: int, capacity: int, k: int):
+        # nprobe is deliberately NOT in the key: it shapes only the
+        # host-side candidate fill, so an nprobe sweep (recall tuning,
+        # bench_index.py) reuses one compiled program per shape
+        key = (q_bucket, capacity, k)
+        program = self._programs.get(key)
+        if program is not None:
+            return program
+        import jax
+        import jax.numpy as jnp
+
+        from code2vec_tpu.ops.topk import padded_local_topk
+
+        cosine = self.metric == 'cosine'
+
+        def run(queries, sorted_rows, cand_ids):
+            q = queries.astype(jnp.float32)
+            if cosine:
+                norms = jnp.linalg.norm(q, axis=-1, keepdims=True)
+                q = q / jnp.where(norms > 0, norms, 1.0)
+            # segment-gather of the probed lists: one padded take over
+            # the cluster-sorted matrix
+            rows = jnp.take(sorted_rows, jnp.maximum(cand_ids, 0),
+                            axis=0)                     # (Q, cap, D)
+            scores = jnp.einsum('qd,qcd->qc', q,
+                                rows.astype(jnp.float32))
+            scores = jnp.where(cand_ids >= 0, scores, -jnp.inf)
+            return padded_local_topk(scores, k)
+
+        program = jax.jit(run)
+        self._programs[key] = program
+        return program
+
+    def _coarse(self, queries: np.ndarray, nprobe: int) -> np.ndarray:
+        """Top-``nprobe`` cluster ids per query (host numpy — C is tiny
+        next to N; the heavy gather+score runs jitted)."""
+        q = queries
+        if self.metric == 'cosine':
+            norms = np.linalg.norm(q, axis=-1, keepdims=True)
+            q = q / np.where(norms > 0, norms, 1.0)
+        scores = q @ self.centroids.T
+        return np.argsort(-scores, axis=-1, kind='stable')[:, :nprobe]
+
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """(Q, D) queries -> ((Q, k) scores, (Q, k) ORIGINAL row ids).
+        Approximate: only the ``nprobe`` best inverted lists per query
+        are scored. Queries with fewer than ``k`` candidates in their
+        probed lists pad the tail with −inf/−1 sentinels."""
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        n = queries.shape[0]
+        nprobe = min(self.n_clusters,
+                     nprobe if nprobe is not None else self.nprobe)
+        t0 = time.perf_counter()
+        probe = self._coarse(queries, nprobe)            # (Q, nprobe)
+        # candidate positions in the cluster-sorted matrix: contiguous
+        # [offset, offset+len) runs per probed list, padded to a
+        # bucketed capacity (warm shapes, like the packed wire)
+        starts = self.offsets[probe]                     # (Q, nprobe)
+        lengths = self.list_lengths[probe]
+        totals = lengths.sum(axis=1)
+        capacity = bucketed_capacity(int(totals.max(initial=1)),
+                                     MIN_PROBE_CAPACITY)
+        cand = np.full((n, capacity), -1, np.int64)
+        for r in range(n):
+            pos = 0
+            for start, length in zip(starts[r], lengths[r]):
+                cand[r, pos:pos + length] = np.arange(start,
+                                                      start + length)
+                pos += length
+        from code2vec_tpu.index.exact import _pick_bucket
+        from code2vec_tpu.index.exact import DEFAULT_QUERY_BUCKETS
+        q_bucket = _pick_bucket(n, DEFAULT_QUERY_BUCKETS)
+        if q_bucket != n:
+            queries = np.concatenate(
+                [queries, np.zeros((q_bucket - n, self.dim), np.float32)])
+            cand = np.concatenate(
+                [cand, np.full((q_bucket - n, capacity), -1, np.int64)])
+        program = self._program(q_bucket, capacity, k)
+        values, positions = program(queries, self._sorted_rows,
+                                    cand.astype(np.int32))
+        values = np.asarray(values)[:n]
+        positions = np.asarray(positions)[:n]
+        # positions index the (Q, capacity) candidate axis -> map back to
+        # cluster-sorted positions, then through list_ids to row ids
+        sorted_pos = np.take_along_axis(
+            cand[:n], np.maximum(positions, 0).astype(np.int64), axis=-1)
+        indices = np.where((positions >= 0) & (sorted_pos >= 0),
+                           self.list_ids[np.maximum(sorted_pos, 0)], -1)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.counter('index/queries_total').inc(n)
+            reg.timer('index/query_latency_ms').record(
+                time.perf_counter() - t0)
+            reg.gauge('index/probe_fanout').set(float(totals.mean()))
+        return values, indices
+
+
+def measure_recall(approx_index, exact_index, queries: np.ndarray,
+                   k: int = 10, nprobe: Optional[int] = None) -> float:
+    """recall@k of the approximate tier against the exact tier on a
+    query sample: |approx ∩ exact| / k, averaged over queries."""
+    _val_a, idx_a = approx_index.search(queries, k, nprobe=nprobe)
+    _val_e, idx_e = exact_index.search(queries, k)
+    hits = 0
+    for row_a, row_e in zip(idx_a, idx_e):
+        hits += len(set(int(i) for i in row_a if i >= 0)
+                    & set(int(i) for i in row_e))
+    recall = hits / float(idx_e.shape[0] * idx_e.shape[1])
+    if tele_core.enabled():
+        tele_core.registry().gauge('index/recall_at10').set(recall)
+    return recall

@@ -10,9 +10,20 @@ kernel does the standard two-stage merge instead:
   2. only the k candidates per shard (values + globalized indices) are
      all-gathered — k·m ≪ V/m traffic (k=10, m=8, V=261K: ~80 floats vs
      ~32K per example);
-  3. a final top-k over the m·k candidates yields the exact global result
-     (ties broken by shard order rather than pure index order — the only
-     deviation from the single-device semantics).
+  3. a final top-k over the m·k candidates yields the exact global result.
+     Tie-breaking is by LOWEST GLOBAL INDEX, matching single-device
+     ``lax.top_k``: shards own ascending index ranges, each shard's
+     candidates are emitted in (value desc, index asc) order, and the
+     merge's ``lax.top_k`` picks the leftmost of equal values — which is
+     always the lowest global index (tested in tests/test_topk_merge.py).
+
+The same merge shape serves the embedding index (code2vec_tpu/index/):
+``sharded_top_k`` is axis-general (the index's store shards over the
+*data* axis where the softmax shards over *model*), and the
+``padded_local_topk`` / ``merge_topk_host`` pair implements the
+host-side streamed merge across store shards, where a shard may hold
+FEWER than k rows (k > n_shard pads with −inf/−1 sentinels that the
+merge drops).
 """
 from __future__ import annotations
 
@@ -20,10 +31,16 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from code2vec_tpu.ops._shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# Index sentinel for padded top-k slots (k > n): value is -inf, index is
+# -1 — never a valid row, and np.take-safe (wraps to the last row, whose
+# score the merge has already discarded).
+PAD_INDEX = -1
 
 
 def grouped_top_k(x: jax.Array, k: int, group_size: int = 2048
@@ -49,6 +66,8 @@ def grouped_top_k(x: jax.Array, k: int, group_size: int = 2048
     documented negative result.
     """
     v = x.shape[-1]
+    # cap like sharded_top_k: lax.top_k rejects k > axis length
+    k = min(k, v)
     if v <= group_size or k >= group_size:
         return jax.lax.top_k(x, k)
     lead = x.shape[:-1]
@@ -68,30 +87,38 @@ def grouped_top_k(x: jax.Array, k: int, group_size: int = 2048
     return final_values, final_indices
 
 
-def sharded_top_k(logits: jax.Array, k: int, mesh: Mesh
+def sharded_top_k(logits: jax.Array, k: int, mesh: Mesh,
+                  shard_axis: str = MODEL_AXIS,
+                  batch_axis: str = DATA_AXIS
                   ) -> Tuple[jax.Array, jax.Array]:
-    """Top-k over the last (vocab) axis of ``logits`` laid out
-    ``P(data, model)`` on ``mesh``. Returns (values, indices), both
-    ``P(data, None)``.
+    """Top-k over the last axis of ``logits`` laid out
+    ``P(batch_axis, shard_axis)`` on ``mesh``. Returns (values, indices),
+    both ``P(batch_axis, None)``.
 
-    Falls back to ``lax.top_k`` when the model axis is trivial.
+    The default axes are the softmax layout (batch over ``data``, vocab
+    columns over ``model``); the embedding index calls it with
+    ``shard_axis=DATA_AXIS, batch_axis=None`` — queries replicated, store
+    rows (the score columns) sharded over the data axis
+    (code2vec_tpu/index/exact.py).
+
+    Falls back to ``lax.top_k`` when the shard axis is trivial.
     ``k`` may exceed the per-shard width V/m (as long as k <= V): each
     shard then contributes all of its columns as candidates.
     """
-    model_size = mesh.shape[MODEL_AXIS]
+    shard_size = mesh.shape[shard_axis]
     k = min(k, logits.shape[-1])
-    if model_size == 1:
+    if shard_size == 1:
         return jax.lax.top_k(logits, k)
 
     def local_merge(local_logits):
-        # local_logits: (B/d, V/m) on each (data, model) shard
+        # local_logits: (B/d, V/m) on each (batch, shard) shard
         local_k = min(k, local_logits.shape[-1])
         local_values, local_indices = jax.lax.top_k(local_logits, local_k)
-        shard = jax.lax.axis_index(MODEL_AXIS)
+        shard = jax.lax.axis_index(shard_axis)
         global_indices = local_indices + shard * local_logits.shape[-1]
-        # gather local_k candidates per shard along the model axis
-        all_values = jax.lax.all_gather(local_values, MODEL_AXIS)
-        all_indices = jax.lax.all_gather(global_indices, MODEL_AXIS)
+        # gather local_k candidates per shard along the shard axis
+        all_values = jax.lax.all_gather(local_values, shard_axis)
+        all_indices = jax.lax.all_gather(global_indices, shard_axis)
         # (m, B/d, local_k) -> (B/d, m*local_k); m*local_k >= k always
         all_values = jnp.moveaxis(all_values, 0, 1).reshape(
             local_values.shape[0], -1)
@@ -101,10 +128,56 @@ def sharded_top_k(logits: jax.Array, k: int, mesh: Mesh
         merged_indices = jnp.take_along_axis(all_indices, positions, axis=1)
         return merged_values, merged_indices
 
-    # check_vma=False: outputs ARE replicated along 'model' (post
+    # check_vma=False: outputs ARE replicated along the shard axis (post
     # all_gather + identical merge on every shard) but the static checker
     # can't prove it
     return shard_map(local_merge, mesh=mesh,
-                     in_specs=(P(DATA_AXIS, MODEL_AXIS),),
-                     out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                     in_specs=(P(batch_axis, shard_axis),),
+                     out_specs=(P(batch_axis), P(batch_axis)),
                      check_vma=False)(logits)
+
+
+def padded_local_topk(x: jax.Array, k: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """``lax.top_k`` over the last axis where ``k`` MAY exceed the axis
+    length: the result is padded to exactly ``k`` slots with ``-inf``
+    values and ``PAD_INDEX`` indices, so per-shard candidate lists from
+    unevenly-sized shards stack rectangularly and ``merge_topk_host``
+    can drop the sentinels. Traceable (static shapes only)."""
+    n = x.shape[-1]
+    local_k = min(k, n)
+    values, indices = jax.lax.top_k(x, local_k)
+    if local_k < k:
+        pad_widths = [(0, 0)] * (x.ndim - 1) + [(0, k - local_k)]
+        values = jnp.pad(values, pad_widths, constant_values=-jnp.inf)
+        indices = jnp.pad(indices, pad_widths,
+                          constant_values=PAD_INDEX)
+    return values, indices
+
+
+def merge_topk_host(values: np.ndarray, indices: np.ndarray, k: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side EXACT merge of per-shard top-k candidates.
+
+    ``values``/``indices`` are ``(..., m)`` numpy arrays of candidate
+    scores and GLOBAL row indices — typically the concatenation of each
+    shard's ``padded_local_topk`` output with per-shard offsets already
+    applied. Sentinel slots (``-inf`` value / ``PAD_INDEX``) sort past
+    every real candidate and are returned only when fewer than ``k``
+    real candidates exist in total.
+
+    Deterministic: ties break by LOWEST index (``np.lexsort`` with the
+    index as the secondary key), matching ``lax.top_k`` single-device
+    semantics — property-tested against ``np.argsort`` in
+    tests/test_topk_merge.py."""
+    values = np.asarray(values)
+    indices = np.asarray(indices)
+    if values.shape != indices.shape:
+        raise ValueError('values %r and indices %r must agree in shape'
+                         % (values.shape, indices.shape))
+    k = min(k, values.shape[-1])
+    # primary key: value DESC; secondary: index ASC (lexsort's last key
+    # is primary). -(-inf) = +inf sorts sentinels last.
+    order = np.lexsort((indices, -values), axis=-1)[..., :k]
+    return (np.take_along_axis(values, order, axis=-1),
+            np.take_along_axis(indices, order, axis=-1))

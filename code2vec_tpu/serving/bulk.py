@@ -53,58 +53,95 @@ def _record_throughput(examples: int, seconds: float) -> float:
     return rate
 
 
-def export_code_vectors(model, corpus_path: str,
-                        output_path: Optional[str] = None
-                        ) -> Tuple[int, str]:
-    """Embed every (valid) example of a ``.c2v`` corpus into
-    ``output_path`` (default ``<corpus>.vectors``), one space-separated
-    code vector per line, in corpus order.
+def iter_code_vector_batches(model, corpus_path: str,
+                             with_labels: bool = False):
+    """Stream a ``.c2v`` corpus through the 'vectors'-tier predict
+    program and yield ``(vectors, labels)`` per batch — ``vectors`` a
+    ``(n_i, D)`` float32 array of the batch's VALID rows, ``labels`` a
+    matching object array of method names (or None unless
+    ``with_labels``).
 
-    Rows with no valid context are dropped (they cannot produce a
-    vector; same filter the evaluate path applies), and the short final
-    batch's zero-weight padding rows are excluded from the output.
-    Returns ``(n_vectors, output_path)``."""
-    _require_single_host('export_code_vectors')
+    ORDER GUARANTEE: concatenated across batches, row i is the i-th
+    KEPT example of the corpus, in file order — rows with no valid
+    context are dropped (the evaluate-path filter) and the short final
+    batch's zero-weight padding rows are excluded. The index builder
+    (code2vec_tpu/index/) and the ``.vectors`` text export both depend
+    on this (tested in tests/test_bulk_order.py).
+
+    Runs the same one-step pipeline as evaluate: batch k+1 is
+    dispatched before batch k's outputs are fetched, so host-side
+    consumption overlaps device compute."""
+    _require_single_host('iter_code_vector_batches')
     config = model.config
     trainer = model.trainer
-    # evaluate-action reader, strings OFF: no decode happens here, so
-    # the native tokenizer can cover the whole parse and nothing but
-    # index arrays crosses threads
+    # evaluate-action reader. Strings OFF unless labels are wanted: no
+    # decode happens here, so the native tokenizer can cover the whole
+    # parse; with labels, only the label string is retained (a single
+    # split per line — the native path still covers the contexts)
     reader = PathContextReader(model.vocabs, config,
                                EstimatorAction.Evaluate,
-                               data_path=corpus_path, keep_strings=False,
+                               data_path=corpus_path,
+                               keep_strings=None if with_labels else False,
                                data_shards=trainer.mesh.shape[
                                    mesh_lib.DATA_AXIS])
     wire_format = reader.wire_format()
+    total = 0
+    t0 = time.perf_counter()
+
+    def decode(out, batch):
+        vectors = mesh_lib.local_rows(out['code_vectors'])
+        valid = batch.weight > 0
+        labels = (batch.label_strings[valid]
+                  if with_labels and batch.label_strings is not None
+                  else None)
+        return np.asarray(vectors[valid], np.float32), labels
+
+    pending = None
+    for arrays, batch in trainer.stage_batches(
+            reader.iter_epoch_prefetched(shuffle=False,
+                                         wire_format=wire_format)):
+        out = trainer.predict_step_placed(model.params, arrays,
+                                          tier='vectors')
+        if pending is not None:
+            vectors, labels = decode(*pending)
+            total += vectors.shape[0]
+            yield vectors, labels
+        pending = (out, batch)
+    if pending is not None:
+        vectors, labels = decode(*pending)
+        total += vectors.shape[0]
+        yield vectors, labels
+    _record_throughput(total, time.perf_counter() - t0)
+
+
+def export_code_vectors(model, corpus_path: str,
+                        output_path: Optional[str] = None,
+                        dtype: Optional[str] = None) -> Tuple[int, str]:
+    """Embed every (valid) example of a ``.c2v`` corpus into
+    ``output_path`` (default ``<corpus>.vectors``), one space-separated
+    code vector per line, in corpus order (the
+    ``iter_code_vector_batches`` order guarantee).
+
+    ``dtype`` (default ``Config.VECTORS_DTYPE``) narrows the exported
+    values: 'float16' halves the text footprint (fewer significant
+    digits) and matches the storage dtype an index built from this file
+    would use. Returns ``(n_vectors, output_path)``."""
+    config = model.config
     out_path = output_path if output_path is not None \
         else corpus_path + '.vectors'
+    out_dtype = np.dtype(dtype if dtype is not None
+                         else getattr(config, 'VECTORS_DTYPE', 'float32'))
     total = 0
     t0 = time.perf_counter()
     with open(out_path, 'w') as out_file:
-        def consume(out, batch) -> None:
-            nonlocal total
-            vectors = mesh_lib.local_rows(out['code_vectors'])
-            valid = batch.weight > 0
-            for vec in vectors[valid]:
+        for vectors, _labels in iter_code_vector_batches(model,
+                                                         corpus_path):
+            for vec in vectors.astype(out_dtype):
                 out_file.write(' '.join(map(str, vec)) + '\n')
-            total += int(valid.sum())
-
-        # one-step pipeline (like evaluate): dispatch batch k+1 before
-        # fetching batch k, so host-side writing overlaps device compute
-        pending = None
-        for arrays, batch in trainer.stage_batches(
-                reader.iter_epoch_prefetched(shuffle=False,
-                                             wire_format=wire_format)):
-            out = trainer.predict_step_placed(model.params, arrays,
-                                              tier='vectors')
-            if pending is not None:
-                consume(*pending)
-            pending = (out, batch)
-        if pending is not None:
-            consume(*pending)
+            total += vectors.shape[0]
     rate = _record_throughput(total, time.perf_counter() - t0)
-    model.log('Exported %d code vectors to `%s` (%d examples/sec).'
-              % (total, out_path, int(rate)))
+    model.log('Exported %d code vectors (%s) to `%s` (%d examples/sec).'
+              % (total, out_dtype.name, out_path, int(rate)))
     return total, out_path
 
 

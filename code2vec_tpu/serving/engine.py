@@ -280,6 +280,7 @@ class ServingEngine:
         self._pending_rows: Dict[str, int] = {t: 0 for t in PREDICT_TIERS}
         self._closed = False
         self._warm = False
+        self._index = None  # attach_index() arms submit_neighbors
         self._warm_lock = threading.Lock()
         self._decode_pool = ThreadPoolExecutor(
             max_workers=max(1, workers),
@@ -395,6 +396,75 @@ class ServingEngine:
                 timeout: Optional[float] = None) -> list:
         """Synchronous ``submit().result()`` convenience."""
         return self.submit(context_lines, tier).result(timeout)
+
+    # -------------------------------------------------------- neighbors
+    def attach_index(self, index) -> 'ServingEngine':
+        """Arm ``submit_neighbors`` with a k-NN index over the corpus
+        (code2vec_tpu/index/, INDEX.md). The engine must have the
+        'vectors' tier warmed — neighbor queries ride it through the
+        same micro-batching dispatcher as every other tier."""
+        if 'vectors' not in self.tiers:
+            raise ValueError(
+                "submit_neighbors needs the 'vectors' tier warmed on "
+                'this engine (tiers=%s); build it with '
+                "tiers=('vectors', ...) or SERVING_WARM_TIERS."
+                % list(self.tiers))
+        self._index = index
+        return self
+
+    def submit_neighbors(self, context_or_vectors, k: Optional[int] = None
+                         ) -> Future:
+        """One warm round-trip from code to its nearest corpus methods:
+        raw context lines (like ``submit``) ride the micro-batched
+        'vectors' tier, and the resulting code vectors feed the attached
+        index; an ``(n, D)`` vector array skips the predict leg. Returns
+        a Future of one ``NeighborResult`` per input row, in order."""
+        index = self._index
+        if index is None:
+            raise RuntimeError('no index attached — call '
+                               'attach_index(load_index(...)) first '
+                               '(code2vec_tpu/index/service.py)')
+        k = k if k is not None else self.config.INDEX_NEIGHBORS_K
+        from code2vec_tpu.index.service import neighbors_from_search
+        outer: Future = Future()
+        if isinstance(context_or_vectors, np.ndarray):
+            vectors = np.atleast_2d(context_or_vectors)
+
+            def lookup():
+                try:
+                    values, indices = index.search(vectors, k)
+                    _resolve(outer, neighbors_from_search(
+                        values, indices, index.labels))
+                except BaseException as exc:
+                    if not outer.done():
+                        outer.set_exception(exc)
+            self._decode_pool.submit(lookup)
+            return outer
+        inner = self.submit(context_or_vectors, tier='vectors')
+
+        def chain(done: Future) -> None:
+            # runs on the decode worker that resolved `inner` — the
+            # index search stays off the dispatcher thread
+            try:
+                results = done.result()
+                if not results:
+                    _resolve(outer, [])
+                    return
+                vectors = np.stack([r.code_vector for r in results])
+                values, indices = index.search(vectors, k)
+                _resolve(outer, neighbors_from_search(
+                    values, indices, index.labels))
+            except BaseException as exc:
+                if not outer.done():
+                    outer.set_exception(exc)
+        inner.add_done_callback(chain)
+        return outer
+
+    def predict_neighbors(self, context_or_vectors,
+                          k: Optional[int] = None,
+                          timeout: Optional[float] = None) -> list:
+        """Synchronous ``submit_neighbors().result()`` convenience."""
+        return self.submit_neighbors(context_or_vectors, k).result(timeout)
 
     def _set_queue_depth_locked(self) -> None:
         depth = sum(len(q) for q in self._queues.values())

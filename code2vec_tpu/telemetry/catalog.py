@@ -109,6 +109,30 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'serving/bulk_examples_per_sec': _m(GAUGE, 'examples/s', 'Streaming '
                                         'bulk predict / embedding-export '
                                         'throughput.'),
+    # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
+    'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
+                        'build.'),
+    'index/vectors_total': _m(GAUGE, 'vectors', 'Vectors resident in the '
+                              'loaded index store.'),
+    'index/shard_rows': _m(GAUGE, 'rows', 'Store rows per mesh data '
+                           'shard after padding (device-resident exact '
+                           'tier).'),
+    'index/warmup_s': _m(GAUGE, 's', 'Wall time of the eager '
+                         'query-bucket ladder compile at index load.'),
+    'index/queries_total': _m(COUNTER, 'queries', 'Neighbor queries '
+                              'answered by the index.'),
+    'index/query_latency_ms': _m(TIMER, 'ms', 'Index search latency per '
+                                 'query batch (dispatch + fetch + '
+                                 'merge).'),
+    'index/queries_per_sec': _m(GAUGE, 'queries/s', 'Streaming batch '
+                                'neighbor-query throughput '
+                                '(--query-neighbors).'),
+    'index/probe_fanout': _m(GAUGE, 'candidates', 'Mean candidate rows '
+                             'scanned per query by the IVF probe '
+                             '(nprobe lists, pre-padding).'),
+    'index/recall_at10': _m(GAUGE, 'fraction', 'Measured IVF recall@10 '
+                            'vs the exact tier on a held-out query '
+                            'sample.'),
     # ---- profiler capture ----
     'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
                                'trace captures completed.'),

@@ -26,8 +26,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-# Directories scanned for emission sites. tests/ is deliberately out:
-# tests mint throwaway names to exercise the instruments themselves.
+# Directories scanned for emission sites (the whole code2vec_tpu tree —
+# including subsystem packages like serving/, resilience/ and index/; a
+# coverage regression on index/ is guarded by tests/test_index.py).
+# tests/ is deliberately out: tests mint throwaway names to exercise the
+# instruments themselves.
 SCAN_DIRS = ('code2vec_tpu', 'benchmarks', 'scripts')
 SCAN_FILES = ('bench.py',)
 

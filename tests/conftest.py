@@ -26,3 +26,11 @@ jax.config.update('jax_platforms', 'cpu')
 # parity tests (fused-CE padded table vs plain; mesh vs single-device)
 # rely on to get identical initial params from differently-padded shapes.
 jax.config.update('jax_threefry_partitionable', True)
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP.md); register the marker
+    # so the opt-in heavy tests (e.g. the 50k-vector IVF recall
+    # acceptance) don't warn as typos
+    config.addinivalue_line(
+        'markers', 'slow: heavy acceptance tests, excluded from tier-1')

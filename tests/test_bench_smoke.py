@@ -87,6 +87,31 @@ def test_bench_fused_ce_smoke_runs_all_arms():
             'step_ms_ce_fused_rbg_bf16mu_SMOKE_ONLY'} <= measures
 
 
+def test_bench_index_smoke_meets_acceptance():
+    """ISSUE 5 acceptance on the CPU smoke shapes: >= 10x the naive
+    NumPy host loop, zero post-warmup compiles on the query path, and
+    IVF recall@10 >= 0.95 at the default nprobe."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'bench_index.py'), '--reps', '2'],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = {r['metric']: r for r in
+               (json.loads(line) for line in proc.stdout.splitlines()
+                if line.strip())}
+    assert all(r.get('smoke') for r in records.values())
+    speedup = records['index_exact_speedup_vs_numpy']
+    assert speedup['value'] >= 10.0, speedup
+    assert speedup['postwarm_compiles'] == 0, speedup
+    recall = records['index_ivf_recall_at10']
+    assert recall['value'] >= 0.95, recall
+    curve = records['index_ivf_curve']['points']
+    assert curve and all(
+        {'nprobe', 'recall', 'queries_per_sec'} <= set(p) for p in curve)
+
+
 def test_bench_sigterm_flushes_fallback_line(tmp_path):
     """VERDICT r3 #1: the driver kills bench.py with SIGTERM at its own
     timeout; the supervisor must flush a parseable fallback line and die

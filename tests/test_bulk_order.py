@@ -1,0 +1,103 @@
+"""Output-order guarantees the index builder depends on (ISSUE 5
+satellite): ``serving/bulk`` vector export must map row i ↔ kept
+example i in corpus order across batch boundaries and the short final
+batch, and the serving engine's oversize split + re-join must deliver
+results in request order."""
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from tests.test_train_overfit import make_dataset
+
+LABELS = ['get|a', 'set|b', 'run|c', 'close|d']
+
+
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('bulk_order'),
+                          n_train=60)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,64', EXPORT_CODE_VECTORS=True)
+    return Code2VecModel(config)
+
+
+def predict_vector(model, line: str) -> np.ndarray:
+    (result,) = model.predict([line])
+    assert result.code_vector is not None
+    return np.asarray(result.code_vector, np.float32)
+
+
+def cosine(a, b) -> float:
+    return float(np.dot(a, b)
+                 / max(np.linalg.norm(a) * np.linalg.norm(b), 1e-12))
+
+
+def test_bulk_vector_rows_align_with_kept_examples(model, tmp_path):
+    """Row i of the streamed export must be the vector of the i-th KEPT
+    corpus example — across multiple batches, a short final batch, and
+    a filtered (contextless) row in the middle of the file."""
+    from code2vec_tpu.serving.bulk import iter_code_vector_batches
+    corpus_lines = open(
+        model.config.train_data_path).read().splitlines()[:35]
+    # a row with NO valid context: dropped by the evaluate-path filter,
+    # so everything after it shifts — exactly what an off-by-one in the
+    # split/re-join would scramble
+    corpus_lines.insert(10, 'orphan|label ' + ' ' * 5)
+    corpus = tmp_path / 'order.c2v'
+    corpus.write_text('\n'.join(corpus_lines) + '\n')
+
+    kept = [line for i, line in enumerate(corpus_lines) if i != 10]
+    chunks = list(iter_code_vector_batches(model, str(corpus),
+                                           with_labels=True))
+    vectors = np.concatenate([v for v, _labels in chunks])
+    labels = np.concatenate([lab for _v, lab in chunks])
+    assert vectors.shape[0] == len(kept) == 35
+    assert [str(l) for l in labels] == [line.split()[0] for line in kept]
+    # 36 rows at TEST_BATCH_SIZE=16 -> 3 batches incl. short final
+    assert len(chunks) == 3
+    for i in (0, 9, 10, 17, 33, 34):   # spans every batch boundary
+        direct = predict_vector(model, kept[i])
+        assert cosine(vectors[i], direct) > 0.999, i
+
+
+def test_export_code_vectors_text_matches_stream(model, tmp_path):
+    """The .vectors text export is the same stream, formatted — and
+    --vectors-dtype float16 changes precision, not order."""
+    from code2vec_tpu.serving.bulk import (export_code_vectors,
+                                           iter_code_vector_batches)
+    corpus = model.config.train_data_path
+    streamed = np.concatenate(
+        [v for v, _l in iter_code_vector_batches(model, corpus)])
+    n, out_path = export_code_vectors(model, corpus,
+                                      output_path=str(tmp_path / 'v32'))
+    text32 = np.loadtxt(out_path, dtype=np.float32, ndmin=2)
+    assert n == streamed.shape[0]
+    np.testing.assert_allclose(text32, streamed, atol=1e-6)
+    n16, out16 = export_code_vectors(model, corpus, dtype='float16',
+                                     output_path=str(tmp_path / 'v16'))
+    text16 = np.loadtxt(out16, dtype=np.float32, ndmin=2)
+    assert n16 == n
+    np.testing.assert_allclose(text16, streamed, atol=2e-2, rtol=1e-2)
+
+
+def test_engine_oversize_split_rejoins_in_order(model):
+    """A request larger than the top batch bucket splits into chunks and
+    re-joins: result i must be line i's vector (vectors tier — the
+    composition submit_neighbors rides)."""
+    reader_lines = open(
+        model.config.train_data_path).read().splitlines()[:20]
+    with model.serving_engine(tiers=('vectors',)) as engine:
+        # top bucket is 64 — rebuild a tiny ladder so 20 lines oversize
+        engine.buckets = (8,)
+        results = engine.submit(reader_lines,
+                                tier='vectors').result(timeout=300)
+    assert len(results) == len(reader_lines)
+    for i in (0, 7, 8, 9, 15, 19):     # spans the 8-row chunk seams
+        direct = predict_vector(model, reader_lines[i])
+        assert cosine(np.asarray(results[i].code_vector, np.float32),
+                      direct) > 0.999, i

@@ -77,6 +77,30 @@ def test_cli_export_code_vectors(trained_model):
     assert len(lines[0].split()) == 384  # code vector size
 
 
+def test_cli_build_index_and_query_neighbors(trained_model):
+    """--build-index + --query-neighbors + --export_vocab_vectors: the
+    index dispatch chain through cli.main (ISSUE 5)."""
+    import json
+    tmp_path, save = trained_model
+    corpus = tmp_path / 'tiny.val.c2v'
+    main(['--load', str(save), '--framework', 'jax', '--dtype', 'float32',
+          '--batch-size', '16', '-v', '0',
+          '--build-index', str(corpus), '--vectors-dtype', 'float16',
+          '--query-neighbors', str(corpus), '--neighbors-k', '3',
+          '--export_vocab_vectors', str(tmp_path / 'vocab')])
+    assert (corpus.with_name('tiny.val.c2v.vecindex') / 'meta.json'
+            ).exists()
+    assert (tmp_path / 'vocab.tokens.txt').exists()
+    assert (tmp_path / 'vocab.targets.txt').exists()
+    out = corpus.with_name('tiny.val.c2v.neighbors.jsonl')
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(records) == 16
+    top = records[0]['neighbors'][0]
+    # a corpus row queried against its own index is its own neighbor
+    assert top['row'] == 0 and abs(top['score'] - 1.0) < 1e-2
+    assert top['label'] == records[0]['name']
+
+
 def test_cli_requires_train_or_load():
     with pytest.raises(ValueError):
         main(['-v', '0'])

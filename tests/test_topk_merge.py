@@ -1,0 +1,122 @@
+"""Property tests for the top-k merge primitives (ISSUE 5 satellite):
+``padded_local_topk`` / ``merge_topk_host`` against an ``np.argsort``
+reference — k > n_shard sentinel handling and deterministic
+tie-breaking by lowest index — plus the axis-general ``sharded_top_k``
+(the index's data-axis layout) and the ``grouped_top_k`` k > v cap."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.ops.topk import (grouped_top_k, merge_topk_host,
+                                   padded_local_topk, sharded_top_k)
+from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def reference_topk(x: np.ndarray, k: int):
+    """Ground truth: value desc, ties by LOWEST index (stable argsort
+    of -x), exactly lax.top_k's documented semantics."""
+    idx = np.argsort(-x, axis=-1, kind='stable')[..., :k]
+    return np.take_along_axis(x, idx, axis=-1), idx
+
+
+def shard_merge(x: np.ndarray, k: int, bounds):
+    """Per-shard padded_local_topk + host merge over arbitrary (possibly
+    k-smaller) column shards of x."""
+    values, indices = [], []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        v, i = padded_local_topk(jnp.asarray(x[..., lo:hi]), k)
+        v, i = np.asarray(v), np.asarray(i)
+        indices.append(np.where(i >= 0, i + lo, i))
+        values.append(v)
+    return merge_topk_host(np.concatenate(values, axis=-1),
+                           np.concatenate(indices, axis=-1), k)
+
+
+@pytest.mark.parametrize('k', [1, 3, 7, 16])
+def test_shard_merge_matches_argsort_reference(k):
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        n = int(rng.integers(k, 60))
+        # integer-valued floats: ties are EXACT, so tie-breaking order
+        # is actually exercised (continuous draws almost never tie)
+        x = rng.integers(0, 6, (4, n)).astype(np.float32)
+        n_shards = int(rng.integers(1, 6))
+        cuts = np.sort(rng.integers(0, n + 1, n_shards - 1))
+        bounds = np.concatenate([[0], cuts, [n]])
+        got_v, got_i = shard_merge(x, k, bounds)
+        want_v, want_i = reference_topk(x, k)
+        assert np.array_equal(got_v, want_v), (trial, bounds)
+        assert np.array_equal(got_i, want_i), (trial, bounds)
+
+
+def test_padded_local_topk_pads_with_sentinels():
+    values, indices = padded_local_topk(jnp.asarray([3.0, 1.0, 2.0]), 5)
+    assert np.array_equal(np.asarray(values)[:3], [3.0, 2.0, 1.0])
+    assert np.all(np.isneginf(np.asarray(values)[3:]))
+    assert np.array_equal(np.asarray(indices), [0, 2, 1, -1, -1])
+
+
+def test_merge_surfaces_sentinels_only_when_candidates_run_out():
+    # 2 real candidates, k=4: the tail must be the sentinel pair, and
+    # the real ones must lead in value order
+    values = np.asarray([[1.0, -np.inf, 2.0, -np.inf]])
+    indices = np.asarray([[5, -1, 9, -1]])
+    got_v, got_i = merge_topk_host(values, indices, 4)
+    assert np.array_equal(got_i, [[9, 5, -1, -1]])
+    assert np.array_equal(got_v[0, :2], [2.0, 1.0])
+    assert np.all(np.isneginf(got_v[0, 2:]))
+
+
+def test_merge_breaks_value_ties_by_lowest_index():
+    values = np.asarray([[7.0, 7.0, 7.0, 5.0]])
+    indices = np.asarray([[40, 3, 17, 1]])
+    _v, got_i = merge_topk_host(values, indices, 3)
+    assert np.array_equal(got_i, [[3, 17, 40]])
+
+
+def _mesh(data, model):
+    devices = np.asarray(jax.devices()[:data * model]).reshape(data, model)
+    return jax.sharding.Mesh(devices, (DATA_AXIS, MODEL_AXIS))
+
+
+def test_sharded_top_k_breaks_ties_by_index_across_shards():
+    """The cross-shard merge must match single-device lax.top_k on a
+    tie-heavy input — including ties that straddle shard boundaries."""
+    mesh = _mesh(2, 4)
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 3, (8, 64)).astype(np.float32)
+    placed = jax.device_put(
+        jnp.asarray(x), jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(DATA_AXIS, MODEL_AXIS)))
+    got_v, got_i = jax.jit(lambda a: sharded_top_k(a, 10, mesh))(placed)
+    want_v, want_i = jax.lax.top_k(jnp.asarray(x), 10)
+    assert np.array_equal(np.asarray(got_v), np.asarray(want_v))
+    assert np.array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_sharded_top_k_over_data_axis():
+    """The index layout: batch replicated, columns sharded over DATA —
+    must agree with lax.top_k including integer ties."""
+    mesh = _mesh(8, 1)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 4, (5, 96)).astype(np.float32)
+    placed = jax.device_put(
+        jnp.asarray(x), jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, DATA_AXIS)))
+    got_v, got_i = jax.jit(
+        lambda a: sharded_top_k(a, 7, mesh, shard_axis=DATA_AXIS,
+                                batch_axis=None))(placed)
+    want_v, want_i = jax.lax.top_k(jnp.asarray(x), 7)
+    assert np.array_equal(np.asarray(got_v), np.asarray(want_v))
+    assert np.array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_grouped_top_k_caps_k_at_vocab():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 50)),
+                    jnp.float32)
+    values, indices = grouped_top_k(x, 200)
+    assert values.shape == (2, 50)
+    ref_v, ref_i = jax.lax.top_k(x, 50)
+    assert np.array_equal(np.asarray(values), np.asarray(ref_v))
+    assert np.array_equal(np.asarray(indices), np.asarray(ref_i))
