@@ -1,0 +1,17 @@
+"""graftlint: AST-based static enforcement of the repo's JAX invariants.
+
+The performance and robustness wins in this tree rest on invariants
+nothing used to enforce: warm-ladder shapes (zero steady-state
+compiles), cataloged host syncs, donated staging buffers never read
+back, pure jitted bodies, and lock-guarded cross-thread state.  This
+package is the rule engine that makes those invariants fail tier-1
+instead of regressing silently — ANALYSIS.md has the rule catalog, the
+suppression/baseline workflow, and the guide to adding a rule.
+
+Entry points: ``scripts/graftlint.py`` / ``scripts/lint_all.py`` (CLI),
+``analysis.engine.run`` (in-process), ``tests/test_graftlint.py``
+(tier-1 guard).  Dependency-free: the lint pass never imports jax.
+"""
+from code2vec_tpu.analysis.core import (Finding, Rule, all_rules,  # noqa: F401
+                                        get_rules, register)
+from code2vec_tpu.analysis.engine import Report, run  # noqa: F401

@@ -1,0 +1,112 @@
+"""graftlint catalogs: the reviewed invariants the rules check against.
+
+Like ``telemetry/catalog.py`` and ``resilience/faults.py::FAULT_POINTS``,
+these are the single source of truth their rules lint the tree against —
+adding a host sync or a donation edge means adding a catalog entry (with
+its justification) in the same diff, where reviewers see it.
+
+ANALYSIS.md documents every catalog and the workflow around it.
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------- host syncs
+# Sanctioned host-synchronization sites (rule ``host-sync``).  Keyed by
+# (file, enclosing function qualname, kind); ``count`` pins the number
+# of sites inside that function, so a NEW sync slipped into an already-
+# sanctioned function still fails.  Kinds:
+#   device_get        — jax.device_get(...)
+#   block_until_ready — jax.block_until_ready(...) / x.block_until_ready()
+#   item              — x.item()
+#   fetch             — np.asarray/float/int over a value traced to a
+#                       jitted program's output in the same function
+#
+# Every entry carries the WHY — the performance contract that makes the
+# sync acceptable at that site.
+SANCTIONED_SYNCS = (
+    {'file': 'code2vec_tpu/training/trainer.py',
+     'func': 'Trainer._fit_loop', 'kind': 'device_get', 'count': 4,
+     'reason': 'the per-log-window sync (telemetry + plain paths), the '
+               'eval-interval partial-window check, and the epoch-end '
+               'drain — the divergence guard piggybacks on all of them '
+               'at zero extra round-trips (ROBUSTNESS.md pillar 1)'},
+    {'file': 'code2vec_tpu/training/trainer.py',
+     'func': 'Trainer._fit_loop', 'kind': 'block_until_ready', 'count': 1,
+     'reason': 'profiler window close: the trace must contain completed '
+               'device work before stop_trace'},
+    {'file': 'code2vec_tpu/telemetry/trace.py',
+     'func': 'TraceController.maybe_update', 'kind': 'block_until_ready',
+     'count': 1,
+     'reason': 'on-demand capture close: same contract as the fixed '
+               'profiler window'},
+    {'file': 'code2vec_tpu/serving/engine.py',
+     'func': 'ServingEngine.warmup', 'kind': 'block_until_ready',
+     'count': 1,
+     'reason': 'eager ladder compile at load time — blocking here is '
+               'the point (steady-state submit never compiles)'},
+    {'file': 'code2vec_tpu/index/exact.py',
+     'func': 'ExactIndex.warmup', 'kind': 'block_until_ready', 'count': 1,
+     'reason': 'eager query-bucket compile at load time (same warm-'
+               'ladder contract as serving warmup)'},
+    {'file': 'code2vec_tpu/index/exact.py',
+     'func': 'ExactIndex.search', 'kind': 'fetch', 'count': 2,
+     'reason': 'search returns host numpy (scores, indices) by '
+               'contract; one round-trip per query batch'},
+    {'file': 'code2vec_tpu/index/exact.py',
+     'func': 'search_streamed', 'kind': 'fetch', 'count': 2,
+     'reason': 'per-shard candidate fetch feeding the exact host-side '
+               'merge (merge_topk_host) — the streamed tier is host-'
+               'merge by design'},
+    {'file': 'code2vec_tpu/index/ivf.py',
+     'func': 'kmeans', 'kind': 'fetch', 'count': 2,
+     'reason': 'build-path result fetch after the Lloyd iterations '
+               '(once per index build, not per query)'},
+    {'file': 'code2vec_tpu/index/ivf.py',
+     'func': 'IVFIndex.search', 'kind': 'fetch', 'count': 2,
+     'reason': 'search returns host numpy (scores, ids) by contract — '
+               'the probe-map back through list_ids is host-side'},
+    {'file': 'code2vec_tpu/model_api.py',
+     'func': 'Code2VecModel.predict', 'kind': 'fetch', 'count': 1,
+     'reason': 'REPL path: one blocking fetch per interactive request; '
+               'throughput traffic goes through the serving engine '
+               'whose decode pool owns the blocking np.asarray'},
+)
+
+# ----------------------------------------------------- jitted callables
+# Names whose call RESULT is a device value (taint sources for the
+# host-sync 'fetch' kind) and whose call SITES the recompile-hazard rule
+# audits.  The per-file prepass additionally discovers `x = jax.jit(...)`
+# bindings and @jax.jit-decorated defs; this catalog adds the dispatcher
+# entry points whose jit lives behind a method boundary.
+JIT_ENTRY_POINTS = frozenset((
+    'train_step', 'train_step_placed', 'eval_step', 'eval_step_placed',
+    'predict_step', 'predict_step_placed',
+    '_train_step', '_train_step_packed', '_eval_step', '_eval_step_packed',
+    '_streamed_shard_topk',
+))
+
+# Methods returning a jitted program (calling the returned value
+# dispatches a compiled step): `p = self._program(...); p(args)`.
+JIT_RETURNING = frozenset(('_program',))
+
+# ----------------------------------------------------- warm shape sources
+# Calls that launder a raw size into a warm-ladder shape (recompile-
+# hazard rule): values returned here are sanctioned shape sources.
+WARM_SHAPE_SOURCES = frozenset((
+    'pick_bucket', '_pick_bucket', 'capacity_ladder', 'batch_ladder',
+    'bucketed_capacity', 'pad_batch_to',
+))
+
+# ------------------------------------------------------------- donation
+# Callables that donate caller buffers (rule ``donation-safety``):
+# {terminal call name: positions in the CALL argument list donated when
+# DONATE_STAGED_BATCHES is on}.  Positions are of the call site (bound
+# methods: 'self' not counted).  Reading a variable after passing it at
+# a donated position is a use-after-free on the donating backends.
+DONATING_CALLS = {
+    '_train_step': (0, 1),          # (state, arrays)
+    '_train_step_packed': (0, 1),
+    'train_step_placed': (0, 1),
+    '_eval_step': (1,),             # (params, arrays) — params re-fed
+    '_eval_step_packed': (1,),
+    'eval_step_placed': (1,),
+}

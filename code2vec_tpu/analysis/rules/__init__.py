@@ -1,0 +1,17 @@
+"""graftlint rules: importing this package registers every rule.
+
+One module per rule; each registers itself via ``@core.register`` at
+import time.  Adding a rule = adding a module here + importing it below
++ a seeded-violation unit test in tests/test_graftlint.py + a catalog
+row in ANALYSIS.md (the test file asserts the doc row exists).
+"""
+from code2vec_tpu.analysis.rules import (  # noqa: F401
+    config_knobs,
+    donation,
+    fault_points,
+    host_sync,
+    jit_purity,
+    locks,
+    metrics_schema,
+    recompile_hazard,
+)

@@ -1,0 +1,121 @@
+"""Rule ``donation-safety``: never read a buffer after donating it.
+
+The staging ring donates consumed batch buffers into the train/eval
+steps (``DONATE_STAGED_BATCHES``, data/packed.py + trainer) so the
+ring's device footprint stays ~depth batches.  XLA is then free to
+alias the donated input's memory for outputs — reading the Python
+reference afterwards observes whatever the program scribbled there (or
+raises on deleted-buffer backends).  The failure is silent corruption
+on exactly the configs the donation optimizes.
+
+The rule knows which call positions donate (``catalog.DONATING_CALLS``)
+and flags a load of a donated plain-Name argument after the dispatch
+and before any rebinding, using the ordered event stream of the shared
+taint pass.  Lexical, single-pass: loop-carried reads are covered by
+the surrounding iteration's rebinding discipline (the ring yields a
+fresh placement each iteration), and attribute/subscript donations are
+out of scope — keep donated buffers in locals.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from code2vec_tpu.analysis import catalog, taint
+from code2vec_tpu.analysis.core import Finding, Rule, register
+from code2vec_tpu.analysis.walker import SourceTree, terminal_name
+
+
+@register
+class DonationSafetyRule(Rule):
+    name = 'donation-safety'
+    doc = ('no reads of a local after it is passed through a donated '
+           'argnum (DONATE_STAGED_BATCHES aliasing)')
+    scope = 'package'
+
+    def run(self, tree: SourceTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for source in tree.files(self.scope):
+            if source.tree is None:
+                continue
+            for info, analysis in taint.analyze_file(source):
+                branches = _BranchMap(info.node)
+                for dispatch in analysis.dispatches:
+                    term = terminal_name(dispatch.node.func)
+                    donated = catalog.DONATING_CALLS.get(term)
+                    if donated is None:
+                        continue
+                    if branches.inside_return(dispatch.node):
+                        continue  # the donating call exits the function
+                    for pos in donated:
+                        if pos >= len(dispatch.node.args):
+                            continue
+                        arg = dispatch.node.args[pos]
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        read = self._read_after(analysis, arg.id,
+                                                dispatch.seq,
+                                                dispatch.node, branches)
+                        if read is not None:
+                            findings.append(self.finding(
+                                source.rel, read,
+                                'read of `%s` in `%s` after it was '
+                                'donated to `%s` (arg %d) — the step '
+                                'may alias/overwrite its buffer; '
+                                'rebind or copy before the dispatch'
+                                % (arg.id, info.qualname, term, pos)))
+        return findings
+
+    @staticmethod
+    def _read_after(analysis: taint.FunctionTaint, name: str,
+                    donate_seq: int, dispatch_node: ast.AST,
+                    branches: '_BranchMap'):
+        """Line of the first load of ``name`` after ``donate_seq``,
+        before its next rebind, on a path reachable from the dispatch
+        (sibling if/else arms and except-handlers are not), else None."""
+        for seq, kind, lineno, node in analysis.events.get(name, ()):
+            if seq <= donate_seq:
+                continue
+            if node is not None and \
+                    branches.siblings(dispatch_node, node):
+                continue  # the lexical walk crossed into the other arm
+            if kind == 'bind':
+                return None
+            return lineno
+        return None
+
+
+class _BranchMap:
+    """Which if/else arm (or try/except handler) each node sits in, so
+    the lexical event stream can skip pairs that never execute on the
+    same path."""
+
+    def __init__(self, func: ast.AST):
+        self._arm_sets = []  # [(set(ids of arm A), set(ids of arm B))]
+        self._return_ids = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.If):
+                self._add_arms(node.body, node.orelse)
+            elif isinstance(node, ast.Try):
+                for handler in node.handlers:
+                    self._add_arms(node.body, handler.body)
+            elif isinstance(node, ast.Return):
+                self._return_ids.update(
+                    id(sub) for sub in ast.walk(node))
+
+    def _add_arms(self, body_a, body_b) -> None:
+        if not body_a or not body_b:
+            return
+        ids_a = {id(sub) for stmt in body_a for sub in ast.walk(stmt)}
+        ids_b = {id(sub) for stmt in body_b for sub in ast.walk(stmt)}
+        self._arm_sets.append((ids_a, ids_b))
+
+    def siblings(self, a: ast.AST, b: ast.AST) -> bool:
+        for ids_a, ids_b in self._arm_sets:
+            if (id(a) in ids_a and id(b) in ids_b) or \
+                    (id(a) in ids_b and id(b) in ids_a):
+                return True
+        return False
+
+    def inside_return(self, node: ast.AST) -> bool:
+        return id(node) in self._return_ids

@@ -1,0 +1,103 @@
+"""Rule ``host-sync``: device synchronization only at cataloged sites.
+
+A host sync (``jax.device_get``, ``block_until_ready``, ``.item()``, or
+a ``np.asarray``/``float``/``int`` over a jitted program's output)
+stalls the dispatch pipeline: the host blocks until the device drains.
+The trainer budgets exactly ONE sync per log window (the divergence
+guard deliberately piggybacks on it — ROBUSTNESS.md pillar 1), serving
+confines blocking fetches to the decode pool, and the index tiers sync
+once per query batch.  Every such site is cataloged with its
+justification in ``analysis/catalog.py::SANCTIONED_SYNCS``; this rule
+fails on:
+
+- a sync at an uncataloged site (new stall slipped into a hot path);
+- MORE syncs than the entry's pinned ``count`` inside a sanctioned
+  function (a second sync hiding behind a sanctioned first);
+- a stale catalog entry matching nothing (the catalog must not rot).
+
+The 'fetch' kind rides the function-local taint pass (analysis/
+taint.py): only values traced to a jit dispatch in the SAME function
+are flagged, so host-numpy ``np.asarray`` staging code stays silent.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Tuple
+
+from code2vec_tpu.analysis import catalog, taint
+from code2vec_tpu.analysis.core import Finding, Rule, register
+from code2vec_tpu.analysis.walker import SourceTree
+
+_KIND_HINTS = {
+    'device_get': 'jax.device_get blocks on the device queue',
+    'block_until_ready': 'block_until_ready drains the device queue',
+    'item': '.item() forces a device round-trip per scalar',
+    'fetch': 'np.asarray/float/int over a jitted output blocks on it',
+}
+
+
+@register
+class HostSyncRule(Rule):
+    name = 'host-sync'
+    doc = ('host synchronization (device_get/block_until_ready/.item()/'
+           'jit-output fetch) only at cataloged sanctioned sites')
+    scope = 'package'
+
+    def run(self, tree: SourceTree) -> List[Finding]:
+        findings: List[Finding] = []
+        # (file, func, kind) -> observed count
+        observed: Dict[Tuple[str, str, str], int] = \
+            collections.Counter()
+        sanctioned = {(e['file'], e['func'], e['kind']): e
+                      for e in catalog.SANCTIONED_SYNCS}
+        for source in tree.files(self.scope):
+            if source.tree is None:
+                continue
+            for info, analysis in taint.analyze_file(source):
+                for sync in analysis.syncs:
+                    key = (source.rel, info.qualname, sync.kind)
+                    # nested defs: credit the innermost enclosing
+                    # function actually containing the node
+                    inner = source.enclosing_function(sync.node.lineno)
+                    if inner != info.qualname:
+                        continue  # counted when walking `inner` itself
+                    observed[key] += 1
+                    entry = sanctioned.get(key)
+                    if entry is None:
+                        findings.append(self.finding(
+                            source.rel, sync.node.lineno,
+                            'uncataloged host sync (%s) in `%s` — %s; '
+                            'move it off the hot path or add a '
+                            'SANCTIONED_SYNCS entry with its '
+                            'justification (analysis/catalog.py)'
+                            % (sync.kind, info.qualname,
+                               _KIND_HINTS[sync.kind])))
+        # count pins + stale entries (skipped when the entry's file is
+        # outside the scanned tree, e.g. the synthetic unit-test trees)
+        for key, entry in sanctioned.items():
+            if tree.get(entry['file']) is None:
+                continue
+            seen = observed.get(key, 0)
+            if seen == 0:
+                findings.append(self.finding(
+                    entry['file'], 0,
+                    'stale SANCTIONED_SYNCS entry: no %s sync found in '
+                    '`%s` — the sanctioned site moved or was removed; '
+                    'update the catalog' % (entry['kind'], entry['func'])))
+            elif seen > entry['count']:
+                findings.append(self.finding(
+                    entry['file'], 0,
+                    '`%s` has %d %s sync(s) but the catalog sanctions '
+                    '%d — a new sync is hiding behind a sanctioned '
+                    'site; justify it by raising the count'
+                    % (entry['func'], seen, entry['kind'],
+                       entry['count'])))
+            elif seen < entry['count']:
+                findings.append(self.finding(
+                    entry['file'], 0,
+                    '`%s` has %d %s sync(s) but the catalog sanctions '
+                    '%d — a site was removed; lower the count so the '
+                    'headroom cannot mask a future addition'
+                    % (entry['func'], seen, entry['kind'],
+                       entry['count'])))
+        return findings

@@ -1,0 +1,138 @@
+"""Rule ``jit-purity``: no host side effects inside jitted bodies.
+
+A jitted function's body runs ONCE, at trace time.  ``time.*`` reads,
+``np.random`` draws, logging, prints, ``os.environ`` reads, telemetry
+registry writes, and fault-point probes inside a jitted body are all
+bugs of the same shape: they execute during tracing, bake one stale
+value into the compiled program, and never run again — a timer that
+measures the first call forever, a "random" draw that repeats every
+step, a fault point that can never fire after warmup.  (In-program
+randomness is ``jax.random`` with explicit keys; measurement belongs
+outside the dispatch, on the host.)
+
+Jitted bodies are found three ways: ``@jax.jit``-style decorations
+(including ``functools.partial(jax.jit, ...)``), defs passed by name to
+``jax.jit(...)`` anywhere in the same file, and defs NESTED inside
+either (a ``loss_fn`` inside a jitted ``train_step`` traces with it).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from code2vec_tpu.analysis import taint
+from code2vec_tpu.analysis.core import Finding, Rule, register
+from code2vec_tpu.analysis.walker import SourceTree, dotted_name
+
+# dotted-prefix ban list; matched against the full resolved chain so
+# `jax.random.*` (fine) never collides with `np.random.*` (not fine)
+_BANNED_PREFIXES = (
+    ('time.', 'host clock read traces once and freezes'),
+    ('np.random.', 'host RNG draws once at trace time — use jax.random '
+                   'with an explicit key'),
+    ('numpy.random.', 'host RNG draws once at trace time — use '
+                      'jax.random with an explicit key'),
+    ('random.', 'host RNG draws once at trace time — use jax.random '
+                'with an explicit key'),
+    ('os.environ', 'environment read bakes one value in at trace time'),
+    ('logging.', 'logging executes at trace time only'),
+    ('logger.', 'logging executes at trace time only'),
+    ('tele_core.', 'telemetry registry access traces once — instrument '
+                   'the dispatch site, not the program body'),
+    ('telemetry.', 'telemetry registry access traces once — instrument '
+                   'the dispatch site, not the program body'),
+    ('faults.maybe_fire', 'fault probes trace once and never fire '
+                          'again — probe at the dispatch site'),
+)
+_BANNED_BARE_CALLS = {
+    'print': 'print executes at trace time only (use jax.debug.print)',
+    'open': 'file I/O inside a traced body runs once, at trace time',
+}
+
+
+@register
+class JitPurityRule(Rule):
+    name = 'jit-purity'
+    doc = ('no time/np.random/logging/os.environ/telemetry/fault-probe '
+           'side effects inside jitted function bodies')
+    scope = 'package'
+
+    def run(self, tree: SourceTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for source in tree.files(self.scope):
+            if source.tree is None:
+                continue
+            jitted_roots = self._jitted_defs(source)
+            for qual, node in jitted_roots:
+                for finding in self._check_body(source, qual, node):
+                    findings.append(finding)
+        return findings
+
+    # ------------------------------------------------------- discovery
+    def _jitted_defs(self, source):
+        """(qualname, def node) for every jitted root def in the file:
+        decorated, or referenced by name in a jax.jit(...) call."""
+        by_name = {}
+        for info in source.functions:
+            by_name.setdefault(info.node.name, []).append(info)
+        roots = {}
+        for info in source.functions:
+            if any(taint._is_jit_decorator(d)
+                   for d in info.node.decorator_list):
+                roots[info.qualname] = info.node
+        if source.tree is not None:
+            for node in ast.walk(source.tree):
+                # taint._is_jit_call covers every jit spelling the taint
+                # pass knows (jax.jit / pjit / jax.experimental.pjit.pjit
+                # / functools.partial(jax.jit, ...)(f)) — the two modules
+                # must not disagree on what counts as jitted
+                if isinstance(node, ast.Call) and \
+                        taint._is_jit_call(node) and node.args:
+                    ref = node.args[0]
+                    if isinstance(ref, ast.Name):
+                        for info in by_name.get(ref.id, ()):
+                            roots[info.qualname] = info.node
+        return sorted(roots.items())
+
+    # ------------------------------------------------------------ check
+    def _check_body(self, source, qual: str, func: ast.AST):
+        # walk the WHOLE body including nested defs (they trace with
+        # the root); decorators/defaults are evaluated eagerly at def
+        # time, so they are exempt
+        out: List[Finding] = []
+        call_lines: Set[int] = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            hit = self._banned(name)
+            if hit is None and isinstance(node.func, ast.Name):
+                why = _BANNED_BARE_CALLS.get(node.func.id)
+                if why is not None:
+                    hit = (node.func.id, why)
+            if hit is not None:
+                call_lines.add(node.lineno)
+                out.append(self.finding(
+                    source.rel, node.lineno,
+                    'impure call `%s(...)` inside jitted `%s`: %s'
+                    % (hit[0], qual, hit[1])))
+        for node in ast.walk(func):
+            # bare os.environ[...] reads with no call around them
+            if isinstance(node, ast.Attribute) and \
+                    dotted_name(node) == 'os.environ' and \
+                    node.lineno not in call_lines:
+                call_lines.add(node.lineno)
+                out.append(self.finding(
+                    source.rel, node.lineno,
+                    'os.environ access inside jitted `%s`: environment '
+                    'read bakes one value in at trace time' % qual))
+        return out
+
+    @staticmethod
+    def _banned(name):
+        if name is None:
+            return None
+        for prefix, why in _BANNED_PREFIXES:
+            if name == prefix.rstrip('.') or name.startswith(prefix):
+                return (name, why)
+        return None

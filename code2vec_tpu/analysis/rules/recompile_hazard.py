@@ -1,0 +1,81 @@
+"""Rule ``recompile-hazard``: jitted programs may only see warm shapes.
+
+The serving/index/trainer hot paths guarantee ZERO steady-state
+compiles (compile-counter-guarded in tests/test_serving_bench.py and
+tests/test_index_bench.py) because every dispatch lands on a shape from
+a warm ladder — ``capacity_ladder``, the serving batch buckets, the
+index query buckets.  A call site whose shape derives from a raw
+Python size (``len(...)``, ``.shape``) silently re-specializes the
+whole program on every new size: correct output, 100-1000x the latency,
+invisible until a p99 graph melts.  Three checks:
+
+1. **unbucketed dispatch** — a call into a jitted callable where an
+   argument's shape taints back to ``len``/``.shape`` without passing a
+   warm-ladder source (``catalog.WARM_SHAPE_SOURCES``);
+2. **inline jit** — ``jax.jit(...)(args)`` built and invoked in one
+   expression inside a function: the fresh function identity defeats
+   jit's cache, so every call recompiles;
+3. **nested-def jit** — ``@jax.jit`` on a def nested inside another
+   function: a fresh program identity per outer call (fine on a
+   build/restore path — baseline it with the why — fatal on a hot one).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from code2vec_tpu.analysis import taint
+from code2vec_tpu.analysis.core import Finding, Rule, register
+from code2vec_tpu.analysis.walker import SourceTree
+
+
+@register
+class RecompileHazardRule(Rule):
+    name = 'recompile-hazard'
+    doc = ('jit dispatches must use warm-ladder shapes; no inline or '
+           'per-call jax.jit program identities')
+    scope = 'package'
+
+    def run(self, tree: SourceTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for source in tree.files(self.scope):
+            if source.tree is None:
+                continue
+            nested_jitted = self._nested_jit_defs(source)
+            for qual, node in nested_jitted:
+                findings.append(self.finding(
+                    source.rel, node.lineno,
+                    'jax.jit on nested def `%s`: a fresh program '
+                    'identity per enclosing call — every call of the '
+                    'outer function recompiles' % qual))
+            for info, analysis in taint.analyze_file(source):
+                for dispatch in analysis.dispatches:
+                    if dispatch.inline_jit:
+                        findings.append(self.finding(
+                            source.rel, dispatch.node.lineno,
+                            'inline jax.jit(...)(...) in `%s`: the '
+                            'fresh function identity defeats the jit '
+                            'cache — every call compiles'
+                            % info.qualname))
+                    for arg in dispatch.tainted_args:
+                        findings.append(self.finding(
+                            source.rel, dispatch.node.lineno,
+                            'jit dispatch `%s(...)` in `%s`: argument '
+                            '`%s` has a shape derived from a raw '
+                            'len()/.shape size — route it through a '
+                            'warm-ladder source (%s)'
+                            % (dispatch.callee, info.qualname, arg,
+                               'pick_bucket/capacity_ladder/'
+                               'bucketed_capacity')))
+        return findings
+
+    def _nested_jit_defs(self, source):
+        """(qualname, node) of jit-decorated defs nested in functions."""
+        out = []
+        for info in source.functions:
+            if '.<locals>.' not in info.qualname:
+                continue
+            for deco in info.node.decorator_list:
+                if taint._is_jit_decorator(deco):
+                    out.append((info.qualname, info.node))
+        return out

@@ -1,0 +1,407 @@
+"""Function-local taint analysis shared by the jit-invariant rules.
+
+One ordered walk over a function body computes, per local name:
+
+- **shape state** — UNTAINTED / TAINTED / WARM.  ``len(...)`` and
+  ``.shape`` reads taint; passing through a warm-ladder source
+  (``catalog.WARM_SHAPE_SOURCES``) launders to WARM.  Arithmetic
+  combining a WARM value stays WARM (the ``bucket - n`` pad-to-bucket
+  idiom); concatenating a WARM pad launders the result (the
+  ``np.concatenate([x, zeros((bucket - n, d))])`` idiom).
+- **device taint** — True when the value traces to a jitted program's
+  output (``catalog.JIT_ENTRY_POINTS`` + per-file ``jax.jit`` bindings).
+  ``np.asarray``/``float``/``int`` over a device value is a host sync.
+- **program binding** — names holding a jitted program (assigned from a
+  ``catalog.JIT_RETURNING`` method or a ``jax.jit(...)`` expression);
+  calling one is a jit dispatch.
+
+The walk is LEXICAL: statements are visited once, in source order, with
+no branch joins or loop fixpoints.  That misses loop-carried flows and
+cross-function flows by design — the rules trade soundness for zero
+false-positive noise on idiomatic code, and ANALYSIS.md states the
+blind spots.  Events (binds/loads/jit dispatches/syncs) are recorded
+with a monotone sequence number so rules can reason about order
+(read-after-donate).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from code2vec_tpu.analysis import catalog
+from code2vec_tpu.analysis.walker import (assigned_names, dotted_name,
+                                          terminal_name)
+
+UNTAINTED, TAINTED, WARM = 0, 1, 2
+
+# numpy/jnp constructors whose result SHAPE is their first argument
+_ARRAY_CTORS = ('zeros', 'empty', 'ones', 'full', 'arange')
+# combinators whose result shape merges the parts'
+_ARRAY_JOINS = ('concatenate', 'stack', 'vstack', 'hstack')
+# value-preserving methods: x.astype(...) etc. keep x's taint
+_PASSTHROUGH_METHODS = ('astype', 'reshape', 'copy', 'items', 'values',
+                        'keys', 'sum', 'max', 'min', 'mean')
+
+
+class Value:
+    __slots__ = ('shape', 'device', 'program')
+
+    def __init__(self, shape: int = UNTAINTED, device: bool = False,
+                 program: bool = False):
+        self.shape = shape
+        self.device = device
+        self.program = program
+
+
+def _merge(values) -> Value:
+    out = Value()
+    for v in values:
+        out.shape = max(out.shape, v.shape)
+        out.device = out.device or v.device
+    return out
+
+
+def _join_shapes(values) -> int:
+    states = [v.shape for v in values]
+    if WARM in states:
+        return WARM  # a warm pad pins the joined result to the ladder
+    if TAINTED in states:
+        return TAINTED
+    return UNTAINTED
+
+
+class JitDispatch:
+    """One call into a jitted program."""
+
+    __slots__ = ('node', 'seq', 'callee', 'tainted_args', 'inline_jit')
+
+    def __init__(self, node: ast.Call, seq: int, callee: str,
+                 tainted_args: List[str], inline_jit: bool):
+        self.node = node
+        self.seq = seq
+        self.callee = callee
+        self.tainted_args = tainted_args  # descriptions of TAINTED args
+        self.inline_jit = inline_jit      # jax.jit(...)(...) at call time
+
+
+class SyncSite:
+    """One host synchronization (host-sync rule)."""
+
+    __slots__ = ('node', 'kind')
+
+    def __init__(self, node: ast.Call, kind: str):
+        self.node = node
+        self.kind = kind
+
+
+class FunctionTaint(ast.NodeVisitor):
+    """Ordered walk of ONE function body (nested defs are skipped —
+    they get their own analysis)."""
+
+    def __init__(self, func: ast.AST, extra_jitted: Set[str]):
+        self.env: Dict[str, Value] = {}
+        self.seq = 0
+        self.jitted_names = (set(catalog.JIT_ENTRY_POINTS)
+                             | set(extra_jitted))
+        self.dispatches: List[JitDispatch] = []
+        self.syncs: List[SyncSite] = []
+        # name -> ordered [(seq, 'bind'|'load', lineno, node)]
+        self.events: Dict[str, List[Tuple[int, str, int, ast.AST]]] = {}
+        self._root = func
+        for stmt in func.body:
+            self._stmt(stmt)
+
+    # ------------------------------------------------------------ events
+    def _tick(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def _event(self, name: str, kind: str, lineno: int,
+               node: Optional[ast.AST] = None) -> None:
+        self.events.setdefault(name, []).append(
+            (self._tick(), kind, lineno, node))
+
+    def _bind(self, target: ast.AST, value: Value) -> None:
+        for name, node in assigned_names(target):
+            if isinstance(node, ast.Name):
+                self.env[name] = Value(value.shape, value.device,
+                                       value.program)
+                self._event(name, 'bind', node.lineno, node)
+
+    # -------------------------------------------------------- statements
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes analyzed separately
+        if isinstance(stmt, ast.Assign):
+            value = self._expr(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, value)
+        elif isinstance(stmt, ast.AnnAssign):
+            value = (self._expr(stmt.value) if stmt.value is not None
+                     else Value())
+            self._bind(stmt.target, value)
+        elif isinstance(stmt, ast.AugAssign):
+            value = self._expr(stmt.value)
+            prior = (self.env.get(stmt.target.id, Value())
+                     if isinstance(stmt.target, ast.Name) else Value())
+            self._bind(stmt.target, _merge((value, prior)))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            value = self._expr(stmt.iter)
+            self._bind(stmt.target, value)  # element ~ iterable taint
+            for child in stmt.body + stmt.orelse:
+                self._stmt(child)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, Value())
+            for child in stmt.body:
+                self._stmt(child)
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            for child in stmt.body + stmt.orelse:
+                self._stmt(child)
+        elif isinstance(stmt, (ast.While,)):
+            self._expr(stmt.test)
+            for child in stmt.body + stmt.orelse:
+                self._stmt(child)
+        elif isinstance(stmt, ast.Try):
+            for child in (stmt.body + stmt.orelse + stmt.finalbody):
+                self._stmt(child)
+            for handler in stmt.handlers:
+                for child in handler.body:
+                    self._stmt(child)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._expr(stmt.value)
+        elif isinstance(stmt, (ast.Assert, ast.Raise, ast.Delete)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+        # pass/break/continue/import/global/nonlocal: nothing to track
+
+    # ------------------------------------------------------- expressions
+    def _expr(self, node: ast.expr) -> Value:
+        if isinstance(node, ast.Name):
+            value = self.env.get(node.id, Value())
+            if isinstance(node.ctx, ast.Load):
+                self._event(node.id, 'load', node.lineno, node)
+            return value
+        if isinstance(node, ast.Attribute):
+            base = self._expr(node.value)
+            if node.attr == 'shape':
+                return Value(TAINTED, False)
+            return Value(base.shape, base.device)
+        if isinstance(node, ast.Subscript):
+            base = self._expr(node.value)
+            self._expr(node.slice)
+            return Value(base.shape, base.device)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            left, right = self._expr(node.left), self._expr(node.right)
+            return Value(_join_shapes((left, right)))
+        if isinstance(node, ast.UnaryOp):
+            return Value(self._expr(node.operand).shape)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return _merge([self._expr(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            return _merge([self._expr(v) for v in node.values
+                           if v is not None])
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test)
+            return _merge([self._expr(node.body), self._expr(node.orelse)])
+        if isinstance(node, ast.BoolOp):
+            return _merge([self._expr(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            self._expr(node.left)
+            for comp in node.comparators:
+                self._expr(comp)
+            return Value()
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._comprehension(node)
+        if isinstance(node, ast.Lambda):
+            return Value()
+        if isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    self._expr(part.value)
+            return Value()
+        if isinstance(node, ast.FormattedValue):
+            self._expr(node.value)
+            return Value()
+        return Value()  # constants and the rest
+
+    def _comprehension(self, node) -> Value:
+        for gen in node.generators:
+            self._bind(gen.target, self._expr(gen.iter))
+            for cond in gen.ifs:
+                self._expr(cond)
+        if isinstance(node, ast.DictComp):
+            self._expr(node.key)
+            return self._expr(node.value)
+        return self._expr(node.elt)
+
+    # -------------------------------------------------------------- calls
+    def _describe_arg(self, arg: ast.expr) -> str:
+        name = dotted_name(arg)
+        if name is not None:
+            return name
+        return '<%s at line %d>' % (type(arg).__name__, arg.lineno)
+
+    def _call(self, node: ast.Call) -> Value:
+        func = node.func
+        dotted = dotted_name(func)
+        term = terminal_name(func)
+
+        # --- host syncs by name -------------------------------------
+        if dotted in ('jax.device_get', 'device_get'):
+            for arg in node.args:
+                self._expr(arg)
+            self.syncs.append(SyncSite(node, 'device_get'))
+            return Value()  # host value
+        if dotted in ('jax.block_until_ready',) or \
+                term == 'block_until_ready':
+            base = _merge([self._expr(arg) for arg in node.args])
+            if isinstance(func, ast.Attribute) and \
+                    term == 'block_until_ready':
+                base = _merge((base, self._expr(func.value)))
+            self.syncs.append(SyncSite(node, 'block_until_ready'))
+            return base  # returns its (still-device) argument
+        if term == 'item' and isinstance(func, ast.Attribute) and \
+                not node.args:
+            self._expr(func.value)
+            self.syncs.append(SyncSite(node, 'item'))
+            return Value()
+
+        # keep the value-expression nodes parallel to their states so
+        # keyword arguments participate in the dispatch taint check —
+        # `program(x=pad)` is the same hazard as `program(pad)`
+        arg_nodes = list(node.args) + [kw.value for kw in node.keywords]
+        args = [self._expr(n) for n in arg_nodes]
+
+        # --- device fetches (sync iff the value is a jit output) ----
+        if dotted in ('np.asarray', 'numpy.asarray', 'np.array',
+                      'numpy.array') or \
+                (func_is_builtin(func, 'float') or
+                 func_is_builtin(func, 'int')):
+            if args and args[0].device:
+                self.syncs.append(SyncSite(node, 'fetch'))
+            return Value(args[0].shape if args else UNTAINTED, False)
+
+        # --- shape sources ------------------------------------------
+        if func_is_builtin(func, 'len'):
+            return Value(TAINTED)
+        if term in catalog.WARM_SHAPE_SOURCES:
+            return Value(WARM)
+        if term in _ARRAY_CTORS:
+            return Value(args[0].shape if args else UNTAINTED)
+        if term in _ARRAY_JOINS:
+            return Value(_join_shapes(args) if args else UNTAINTED)
+
+        # --- jit program construction / dispatch --------------------
+        if dotted in ('jax.jit', 'pjit', 'jax.experimental.pjit.pjit'):
+            return Value(program=True)
+        if term in catalog.JIT_RETURNING:
+            return Value(program=True)
+        inline_jit = False
+        is_dispatch = False
+        if isinstance(func, ast.Call):
+            inner = self._expr(func)  # evaluates the program-maker call
+            if inner.program:
+                is_dispatch = True
+                inner_dotted = dotted_name(func.func)
+                inline_jit = inner_dotted in (
+                    'jax.jit', 'pjit', 'jax.experimental.pjit.pjit')
+        elif isinstance(func, ast.Name) and \
+                self.env.get(func.id, Value()).program:
+            is_dispatch = True
+            self._event(func.id, 'load', func.lineno, func)
+        elif term in self.jitted_names:
+            is_dispatch = True
+        if is_dispatch:
+            tainted = [self._describe_arg(arg)
+                       for arg, value in zip(arg_nodes, args)
+                       if value.shape == TAINTED]
+            self.dispatches.append(JitDispatch(
+                node, self._tick(),
+                dotted or term or '<call>', tainted, inline_jit))
+            return Value(device=True)
+
+        # --- passthrough methods ------------------------------------
+        if isinstance(func, ast.Attribute) and \
+                term in _PASSTHROUGH_METHODS:
+            base = self._expr(func.value)
+            return Value(base.shape, base.device)
+        if isinstance(func, ast.Attribute):
+            self._expr(func.value)
+        return Value()
+
+
+def analyze_file(source):
+    """[(FunctionInfo, FunctionTaint)] for every function in a file,
+    computed once and cached on the SourceFile — three rules consume
+    the taint pass, and the walker's one-parse contract extends to it."""
+    cache = getattr(source, '_taint_analysis', None)
+    if cache is None:
+        extra = (file_jitted_bindings(source.tree)
+                 if source.tree is not None else set())
+        cache = [(info, FunctionTaint(info.node, extra))
+                 for info in source.functions]
+        source._taint_analysis = cache
+    return cache
+
+
+def func_is_builtin(func: ast.expr, name: str) -> bool:
+    return isinstance(func, ast.Name) and func.id == name
+
+
+def file_jitted_bindings(tree: ast.Module) -> Set[str]:
+    """Terminal names bound to ``jax.jit(...)`` / ``pjit(...)`` results
+    anywhere in a file (``self._train_step = jax.jit(...)``,
+    ``program = jax.jit(run)``, ``_streamed_program = jax.jit(...)``),
+    plus defs decorated with jit."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if _is_jit_call(node.value):
+                for target in node.targets:
+                    for name, _t in assigned_names(target):
+                        out.add(name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_decorator(deco):
+                    out.add(node.name)
+    return out
+
+
+def _is_jit_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = dotted_name(node.func)
+    if dotted in ('jax.jit', 'pjit', 'jax.experimental.pjit.pjit'):
+        return True
+    # functools.partial(jax.jit, ...)(f) shape
+    if isinstance(node.func, ast.Call):
+        return _is_jit_decorator(node.func)
+    return False
+
+
+def _is_jit_decorator(deco: ast.expr) -> bool:
+    dotted = dotted_name(deco)
+    if dotted in ('jax.jit', 'pjit', 'jax.experimental.pjit.pjit'):
+        return True
+    if isinstance(deco, ast.Call):
+        deco_name = dotted_name(deco.func)
+        if deco_name in ('jax.jit', 'pjit', 'jax.experimental.pjit.pjit'):
+            return True
+        if deco_name in ('functools.partial', 'partial') and deco.args:
+            return dotted_name(deco.args[0]) in (
+                'jax.jit', 'pjit', 'jax.experimental.pjit.pjit')
+    return False

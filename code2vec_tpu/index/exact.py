@@ -257,6 +257,7 @@ def search_streamed(store: VectorStore, queries: np.ndarray, k: int,
             rows = np.concatenate(
                 [rows, np.zeros((pad, store.dim), rows.dtype)])
             neg_mask[-pad:] = -np.inf
+        # graftlint: disable=recompile-hazard -- every chunk pads to the store-constant chunk_rows: one compile per STORE, not per call (the shared module-level program above)
         values, indices = _streamed_shard_topk(queries, rows, neg_mask, k)
         values = np.asarray(values)
         indices = np.asarray(indices)

@@ -39,6 +39,9 @@ class MetricsWriter:
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
         self._path = os.path.join(logdir, 'metrics.jsonl')
+        # the trainer thread and the atexit/close path both flush
+        # (lock-discipline rule, ANALYSIS.md):
+        # graftlint: guard MetricsWriter._buffer by _lock
         self._buffer: List[str] = []
         self._buffer_records = max(1, buffer_records)
         self._lock = threading.Lock()

@@ -103,6 +103,9 @@ class FaultPlan:
     (a resumed run starting past it) still fire at the next opportunity.
     """
 
+    # fault sites probe from the trainer thread, the input pipeline, and
+    # tests' drill threads (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard FaultPlan._at,_site_counts,_fired by _lock
     def __init__(self, plan: Dict[str, int]):
         self._at = dict(plan)
         self._site_counts: Dict[str, int] = {}

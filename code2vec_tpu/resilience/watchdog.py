@@ -57,6 +57,9 @@ class HangWatchdog:
         # deadline, bounded below for sub-second test deadlines
         self.poll_s = poll_s if poll_s is not None else max(
             0.05, self.deadline_s / 10.0)
+        # the training thread arms/disarms while the monitor thread
+        # polls; _cond wraps _lock (lock-discipline rule, ANALYSIS.md):
+        # graftlint: guard HangWatchdog._armed_at,_label,_stop by _lock|_cond
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._armed_at: Optional[float] = None

@@ -150,6 +150,9 @@ class _Aggregate:
     """Joins the chunk results of one oversize request back into its
     caller-visible future, preserving row order."""
 
+    # decode workers race on the chunk slots (lock-discipline rule,
+    # ANALYSIS.md):
+    # graftlint: guard _Aggregate.parts,left by lock
     def __init__(self, future: Future, n_chunks: int):
         self.future = future
         self.parts: List[Optional[list]] = [None] * n_chunks
@@ -160,10 +163,12 @@ class _Aggregate:
         with self.lock:
             self.parts[idx] = results
             self.left -= 1
-            done = self.left == 0
-        if done:
+            # snapshot under the lock: the last-chunk decider must not
+            # re-read `parts` barehanded after releasing it
+            done = list(self.parts) if self.left == 0 else None
+        if done is not None:
             merged: list = []
-            for part in self.parts:
+            for part in done:
                 merged.extend(part)
             _resolve(self.future, merged)
 
@@ -273,6 +278,11 @@ class ServingEngine:
         self.queue_depth = Gauge('serving/queue_depth')
         self.fill_rate = Gauge('serving/batch_fill_rate')
         self.last_dispatch: Optional[Dict[str, int]] = None
+        # submitters, the dispatcher, and close() share the queue state;
+        # _cond wraps _lock, so holding either alias guards the fields
+        # (lock-discipline rule, ANALYSIS.md):
+        # graftlint: guard ServingEngine._queues,_pending_rows,_closed by _lock|_cond
+        # graftlint: guard ServingEngine._warm by _warm_lock
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[str, collections.deque] = {
@@ -357,6 +367,7 @@ class ServingEngine:
         if tier not in self.tiers:
             raise ValueError('tier %r is not warmed on this engine '
                              '(tiers=%s)' % (tier, list(self.tiers)))
+        # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked under _cond before enqueue below
         if self._closed:
             raise RuntimeError('ServingEngine is closed')
         lines = list(context_lines)
@@ -364,6 +375,7 @@ class ServingEngine:
         if not lines:
             future.set_result([])
             return future
+        # graftlint: disable=lock-discipline -- benign racy read: warmup() is idempotent and re-checks _warm under _warm_lock
         if not self._warm:
             self.warmup()
         batch = self.reader.process_input_rows(lines)

@@ -118,6 +118,10 @@ class Timer:
     otherwise poison the tail — and the max — forever).
     """
 
+    # the window deque mutates under concurrent record()/snapshot()
+    # (lock-discipline rule, ANALYSIS.md; the scalar _count/_total/_last
+    # reads in the properties are deliberately lock-free — GIL-atomic):
+    # graftlint: guard Timer._samples by _lock
     __slots__ = ('name', 'window', '_samples', '_count', '_total',
                  '_last', '_lock')
 
@@ -186,6 +190,9 @@ class Registry:
     threading a handle through every layer.
     """
 
+    # get-or-create races between the input pipeline, trainer, and
+    # exporter threads (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard Registry._instruments by _lock
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[str, object] = {}

@@ -365,6 +365,7 @@ class Trainer:
         # moment layout — jit alone does not propagate input shardings to
         # the opt-state outputs. Single source of truth with the train
         # step's donated-output layout (_build_steps).
+        # graftlint: disable=recompile-hazard -- cold path: runs once per init/restore, never per step; the throwaway program is the point
         return jax.jit(self.optimizer.init,
                        out_shardings=self._state_shardings.opt_state)(
                            params)

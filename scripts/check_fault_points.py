@@ -2,8 +2,13 @@
 the fault catalog (code2vec_tpu/resilience/faults.py::FAULT_POINTS), and
 every cataloged point must be documented in ROBUSTNESS.md — so a typo'd
 point name fails tier-1 instead of silently never firing (ISSUE 3
-satellite; same pattern as scripts/check_metrics_schema.py, runs in
-tier-1 via tests/test_fault_points_lint.py).
+satellite; runs in tier-1 via tests/test_fault_points_lint.py).
+
+Since ISSUE 6 this is a thin CLI over the graftlint rule
+``fault-points`` (code2vec_tpu/analysis/rules/fault_points.py —
+ANALYSIS.md): same regex, same scan scope, same exit codes; the rule
+additionally runs under ``scripts/lint_all.py`` with the shared
+suppression/baseline machinery.
 
 Grep-based by design: fault sites are ``maybe_fire`` calls with a string
 literal —
@@ -11,103 +16,55 @@ literal —
     faults.maybe_fire('nan_loss', step=batch_num)
     if faults.maybe_fire('hang_input'):
 
-Exit status: 0 clean, 1 on unknown sites or undocumented catalog
-entries.  ``--list`` prints every discovered site.
+(this file and the rule module never scan themselves: the examples
+above would count as sites and mask a deleted real site).
+
+Exit status: 0 clean, 1 on unknown sites, undocumented catalog entries,
+or cataloged points with no wired site.  ``--list`` prints every
+discovered site.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-# Directories scanned for fault sites. tests/ is deliberately out: tests
-# mint throwaway names to exercise the plan machinery itself.
-SCAN_DIRS = ('code2vec_tpu', 'benchmarks', 'scripts')
-SCAN_FILES = ('bench.py',)
-
-# \s* spans newlines: calls wrap across lines under the 79-column style
-FIRE_RE = re.compile(r"""maybe_fire\(\s*['"]([A-Za-z0-9_]+)['"]""")
-
-
-def iter_python_files():
-    self_path = os.path.abspath(__file__)
-    for rel in SCAN_DIRS:
-        for dirpath, _dirnames, filenames in os.walk(os.path.join(REPO, rel)):
-            if '__pycache__' in dirpath:
-                continue
-            for name in sorted(filenames):
-                path = os.path.join(dirpath, name)
-                # never scan this script itself: its docstring examples
-                # would count as sites and mask a deleted real site
-                if name.endswith('.py') and \
-                        os.path.abspath(path) != self_path:
-                    yield path
-    for rel in SCAN_FILES:
-        path = os.path.join(REPO, rel)
-        if os.path.isfile(path):
-            yield path
+# the rule owns the regex + scan; re-exported here because
+# tests/test_fault_points_lint.py imports them from this module
+from code2vec_tpu.analysis.rules.fault_points import (  # noqa: E402
+    FIRE_RE)
+from code2vec_tpu.analysis.rules import fault_points as _rule  # noqa: E402
+from code2vec_tpu.analysis.walker import SourceTree  # noqa: E402
 
 
 def find_sites():
     """[(relpath, lineno, point_name)] across the scanned tree."""
-    out = []
-    for path in iter_python_files():
-        rel = os.path.relpath(path, REPO)
-        with open(path, 'r') as f:
-            content = f.read()
-        for match in FIRE_RE.finditer(content):
-            lineno = content.count('\n', 0, match.start()) + 1
-            out.append((rel, lineno, match.group(1)))
-    return out
+    return _rule.find_sites(SourceTree(REPO))
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    from code2vec_tpu.analysis import engine
     from code2vec_tpu.resilience.faults import FAULT_POINTS
 
-    sites = find_sites()
+    tree = SourceTree(REPO)
+    sites = _rule.find_sites(tree)
     if '--list' in argv:
         for rel, lineno, name in sites:
             print('%s:%d: %s' % (rel, lineno, name))
 
-    failures = []
-    for rel, lineno, name in sites:
-        if name not in FAULT_POINTS:
-            failures.append(
-                '%s:%d: fault point %r is not in the catalog '
-                '(code2vec_tpu/resilience/faults.py) — add it there and to '
-                'ROBUSTNESS.md, or fix the name' % (rel, lineno, name))
-
-    doc_path = os.path.join(REPO, 'ROBUSTNESS.md')
-    if os.path.isfile(doc_path):
-        with open(doc_path, 'r') as f:
-            doc = f.read()
-        for name in sorted(FAULT_POINTS):
-            if name not in doc:
-                failures.append(
-                    'ROBUSTNESS.md: cataloged fault point %r is '
-                    'undocumented' % name)
-    else:
-        failures.append('ROBUSTNESS.md is missing (the fault-point catalog '
-                        'must be documented)')
-
-    fired = {name for _rel, _lineno, name in sites}
-    for name in sorted(set(FAULT_POINTS) - fired):
-        # a cataloged point with NO site is a real failure here (unlike
-        # the metrics lint's note): a fault spec naming it would parse
-        # fine and then never fire — the silent-injection trap this lint
-        # exists to close
-        failures.append(
-            'fault point %r is cataloged but has no maybe_fire site — '
-            'every point must be wired, or specs naming it silently '
-            'inject nothing' % name)
-
+    # standalone semantics: no baseline — catalog drift is never OK —
+    # and ONLY this rule's findings: unrelated graftlint meta-findings
+    # (malformed suppressions elsewhere in the tree) belong to lint_all
+    report = engine.run(root=REPO, rule_names=['fault-points'],
+                        baseline_path='', tree=tree)
+    failures = [f for f in report.findings if f.rule == 'fault-points']
     if failures:
-        print('\n'.join(failures), file=sys.stderr)
+        for finding in failures:
+            print(finding.format(), file=sys.stderr)
         print('%d fault-point violation(s).' % len(failures),
               file=sys.stderr)
         return 1

@@ -4,6 +4,12 @@ cataloged name must be documented in OBSERVABILITY.md — so metric names
 cannot silently drift from the catalog/doc (ISSUE 2 satellite; runs in
 tier-1 via tests/test_metrics_schema.py).
 
+Since ISSUE 6 this is a thin CLI over the graftlint rule
+``metrics-schema`` (code2vec_tpu/analysis/rules/metrics_schema.py —
+ANALYSIS.md): same regex, same scan scope, same exit codes; the rule
+additionally runs under ``scripts/lint_all.py`` with the shared
+suppression/baseline machinery.
+
 Grep-based by design: emission sites are method calls with a string
 literal —
 
@@ -19,83 +25,43 @@ entries.  ``--list`` prints every discovered emission with its site.
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-# Directories scanned for emission sites (the whole code2vec_tpu tree —
-# including subsystem packages like serving/, resilience/ and index/; a
-# coverage regression on index/ is guarded by tests/test_index.py).
-# tests/ is deliberately out: tests mint throwaway names to exercise the
-# instruments themselves.
-SCAN_DIRS = ('code2vec_tpu', 'benchmarks', 'scripts')
-SCAN_FILES = ('bench.py',)
-
-# \s* spans newlines: emission calls wrap across lines under the
-# 79-column style, so matching is against whole-file content
-EMIT_RE = re.compile(
-    r"""\.(?:counter|gauge|timer|scalar|get)\(\s*['"]([^'"]*/[^'"]*)['"]""")
-
-
-def iter_python_files():
-    for rel in SCAN_DIRS:
-        for dirpath, _dirnames, filenames in os.walk(os.path.join(REPO, rel)):
-            if '__pycache__' in dirpath:
-                continue
-            for name in sorted(filenames):
-                if name.endswith('.py'):
-                    yield os.path.join(dirpath, name)
-    for rel in SCAN_FILES:
-        path = os.path.join(REPO, rel)
-        if os.path.isfile(path):
-            yield path
+# the rule owns the regex + scan; re-exported here because
+# tests/test_metrics_schema.py (and muscle memory) import them from
+# this module
+from code2vec_tpu.analysis.rules.metrics_schema import (  # noqa: E402
+    EMIT_RE)
+from code2vec_tpu.analysis.rules import metrics_schema as _rule  # noqa: E402
+from code2vec_tpu.analysis.walker import SourceTree  # noqa: E402
 
 
 def find_emissions():
     """[(relpath, lineno, metric_name)] across the scanned tree."""
-    out = []
-    for path in iter_python_files():
-        rel = os.path.relpath(path, REPO)
-        with open(path, 'r') as f:
-            content = f.read()
-        for match in EMIT_RE.finditer(content):
-            lineno = content.count('\n', 0, match.start()) + 1
-            out.append((rel, lineno, match.group(1)))
-    return out
+    return _rule.find_emissions(SourceTree(REPO))
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    from code2vec_tpu.analysis import engine
     from code2vec_tpu.telemetry.catalog import CATALOG
 
-    emissions = find_emissions()
+    tree = SourceTree(REPO)
+    emissions = _rule.find_emissions(tree)
     if '--list' in argv:
         for rel, lineno, name in emissions:
             print('%s:%d: %s' % (rel, lineno, name))
 
-    failures = []
-    for rel, lineno, name in emissions:
-        if name not in CATALOG:
-            failures.append(
-                '%s:%d: metric %r is not in the catalog '
-                '(code2vec_tpu/telemetry/catalog.py) — add it there and to '
-                'OBSERVABILITY.md, or fix the name' % (rel, lineno, name))
-
-    doc_path = os.path.join(REPO, 'OBSERVABILITY.md')
-    if os.path.isfile(doc_path):
-        with open(doc_path, 'r') as f:
-            doc = f.read()
-        for name in sorted(CATALOG):
-            if name not in doc:
-                failures.append(
-                    'OBSERVABILITY.md: cataloged metric %r is undocumented'
-                    % name)
-    else:
-        failures.append('OBSERVABILITY.md is missing (the metric catalog '
-                        'must be documented)')
+    # standalone semantics: no baseline — schema drift is never OK —
+    # and ONLY this rule's findings: unrelated graftlint meta-findings
+    # (malformed suppressions elsewhere in the tree) belong to lint_all
+    report = engine.run(root=REPO, rule_names=['metrics-schema'],
+                        baseline_path='', tree=tree)
+    failures = [f for f in report.findings if f.rule == 'metrics-schema']
 
     emitted = {name for _rel, _lineno, name in emissions}
     for name in sorted(set(CATALOG) - emitted):
@@ -105,7 +71,8 @@ def main(argv=None) -> int:
               % name)
 
     if failures:
-        print('\n'.join(failures), file=sys.stderr)
+        for finding in failures:
+            print(finding.format(), file=sys.stderr)
         print('%d metric-schema violation(s).' % len(failures),
               file=sys.stderr)
         return 1

@@ -1,0 +1,103 @@
+"""graftlint CLI: run the JAX-invariant rule engine over the repo.
+
+    python scripts/graftlint.py                      # all rules
+    python scripts/graftlint.py --rules host-sync,jit-purity
+    python scripts/graftlint.py --list-rules
+    python scripts/graftlint.py --list               # show suppressed/
+                                                     # baselined too
+    python scripts/graftlint.py --write-baseline     # regenerate (new
+                                                     # entries get
+                                                     # reason TODO)
+
+Exit status: 0 clean, 1 on any unbaselined, unsuppressed finding.
+Tier-1 runs the same engine in-process (tests/test_graftlint.py);
+``scripts/lint_all.py`` is the one-command entry point.  ANALYSIS.md
+documents the rules, the suppression/baseline workflow, and how to add
+a rule.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    from code2vec_tpu.analysis import baseline as baseline_lib
+    from code2vec_tpu.analysis import engine
+    from code2vec_tpu.analysis import rules as _rules  # noqa: F401
+    from code2vec_tpu.analysis.core import all_rules
+
+    parser = argparse.ArgumentParser(
+        prog='graftlint', description=__doc__.splitlines()[0])
+    parser.add_argument('--rules', default=None, metavar='R1,R2',
+                        help='comma-separated rule names (default: all)')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the registered rules and exit')
+    parser.add_argument('--list', action='store_true',
+                        help='also print suppressed and baselined '
+                             'findings')
+    parser.add_argument('--root', default=REPO, metavar='DIR',
+                        help='repository root to lint (default: this '
+                             'repo)')
+    parser.add_argument('--baseline', default=None, metavar='FILE',
+                        help='baseline file (default: '
+                             '<root>/graftlint_baseline.json)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='ignore the baseline (show everything)')
+    parser.add_argument('--write-baseline', action='store_true',
+                        help='regenerate the baseline from current '
+                             'findings; NEW entries get reason TODO '
+                             'and still fail until a human fills them '
+                             'in')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print('%-18s %s' % (rule.name, rule.doc))
+        return 0
+
+    rule_names = (None if args.rules is None
+                  else [r.strip() for r in args.rules.split(',')
+                        if r.strip()])
+    baseline_path = args.baseline
+    if args.no_baseline or args.write_baseline:
+        baseline_path = ''  # raw findings (no stale-entry meta noise)
+    report = engine.run(root=args.root, rule_names=rule_names,
+                        baseline_path=baseline_path)
+
+    if args.write_baseline:
+        path = (args.baseline if args.baseline else
+                os.path.join(args.root, baseline_lib.BASELINE_NAME))
+        existing = baseline_lib.Baseline.load(path)
+        # keep reasons of entries that still match; new entries get
+        # reason TODO and keep failing until a human fills them in.
+        # Entries of rules this run did NOT execute are preserved
+        # verbatim — a --rules subset must not destroy the others.
+        ran = set(report.rules_run)
+        keep = [e for e in existing.entries if e.get('rule') not in ran]
+        baseline_lib.write(path, report.findings, existing=existing,
+                           preserve=keep)
+        print('baseline written to %s (%d finding(s), %d preserved '
+              'from un-run rules) — fill in any TODO reasons before '
+              'committing' % (path, len(report.findings), len(keep)))
+        return 0
+
+    if args.list:
+        for finding in report.suppressed:
+            print('suppressed: %s' % finding.format())
+        for finding in report.baselined:
+            print('baselined:  %s' % finding.format())
+    for finding in report.findings:
+        print(finding.format(), file=sys.stderr)
+    print(report.summary(), file=sys.stderr if report.findings
+          else sys.stdout)
+    return 0 if report.clean else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

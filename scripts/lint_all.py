@@ -1,0 +1,32 @@
+"""The one-command lint entry point: every graftlint rule over the repo.
+
+    python scripts/lint_all.py          # exit 0 iff the repo is clean
+    python scripts/lint_all.py --list   # show suppressed/baselined too
+
+Runs the full registered rule set — the five jit-invariant rules
+(recompile-hazard, host-sync, donation-safety, jit-purity,
+lock-discipline), config-knob-docs, and the migrated catalog-drift
+rules (metrics-schema, fault-points) — with the repo baseline and
+inline suppressions applied.  Tier-1 asserts exactly this via
+tests/test_graftlint.py; the full pass is AST-only (no jax import) and
+runs in ~1s, far under the <20s budget (ANALYSIS.md).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(SCRIPTS)
+for path in (REPO, SCRIPTS):
+    if path not in sys.path:
+        sys.path.insert(0, path)
+
+
+def main(argv=None) -> int:
+    import graftlint
+    return graftlint.main(list(sys.argv[1:] if argv is None else argv))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

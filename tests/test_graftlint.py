@@ -1,0 +1,630 @@
+"""graftlint engine + rules (ISSUE 6 tentpole; ANALYSIS.md).
+
+Three layers of guarantee:
+
+1. **per-rule**: each rule fires on a seeded-violation snippet and stays
+   quiet on the fixed version (the rule demonstrably detects what it
+   claims to);
+2. **mechanics**: inline suppressions need reasons, baselines need
+   reasons, stale baseline entries and stale catalogs are findings;
+3. **tier-1 guard**: the repo itself is CLEAN — zero unbaselined,
+   unsuppressed findings across every registered rule — and the full
+   pass stays far under its latency budget.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from code2vec_tpu.analysis import engine  # noqa: E402
+from code2vec_tpu.analysis import rules as _rules  # noqa: E402,F401
+from code2vec_tpu.analysis.core import all_rules  # noqa: E402
+from code2vec_tpu.analysis.walker import SourceTree  # noqa: E402
+
+
+def lint(tmp_path, code, rule_names, extra_files=None):
+    """Run rules over one synthetic module in a tmp tree."""
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir(exist_ok=True)
+    (pkg / 'mod.py').write_text(code)
+    for name, text in (extra_files or {}).items():
+        (tmp_path / name).write_text(text)
+    tree = SourceTree(str(tmp_path), scan_dirs=('pkg',), scan_files=(),
+                      package_dirs=('pkg',))
+    return engine.run(root=str(tmp_path), rule_names=rule_names,
+                      baseline_path='', tree=tree)
+
+
+def by_rule(report, rule):
+    return [f for f in report.findings if f.rule == rule]
+
+
+# ------------------------------------------------------ recompile-hazard
+SEEDED_UNBUCKETED = '''
+import jax
+import numpy as np
+
+program = jax.jit(lambda x: x)
+
+def hot(rows):
+    n = len(rows)
+    batch = np.zeros((n, 8), np.float32)
+    return program(batch)
+'''
+
+FIXED_BUCKETED = '''
+import jax
+import numpy as np
+
+program = jax.jit(lambda x: x)
+LADDER = (8, 64, 512)
+
+def hot(rows):
+    n = pick_bucket(len(rows), LADDER)
+    batch = np.zeros((n, 8), np.float32)
+    return program(batch)
+'''
+
+
+def test_recompile_hazard_fires_on_unbucketed_shape(tmp_path):
+    report = lint(tmp_path, SEEDED_UNBUCKETED, ['recompile-hazard'])
+    found = by_rule(report, 'recompile-hazard')
+    assert len(found) == 1, report.findings
+    assert 'batch' in found[0].message and 'len()' in found[0].message
+
+
+def test_recompile_hazard_quiet_on_warm_ladder(tmp_path):
+    report = lint(tmp_path, FIXED_BUCKETED, ['recompile-hazard'])
+    assert not by_rule(report, 'recompile-hazard'), report.findings
+
+
+def test_recompile_hazard_flags_keyword_args_too(tmp_path):
+    # `program(x=pad)` is the same hazard as `program(pad)`
+    code = ('import jax\n'
+            'import numpy as np\n'
+            'program = jax.jit(lambda x: x)\n'
+            'def hot(rows):\n'
+            '    pad = np.zeros((len(rows), 4))\n'
+            '    return program(x=pad)\n')
+    report = lint(tmp_path, code, ['recompile-hazard'])
+    found = by_rule(report, 'recompile-hazard')
+    assert len(found) == 1 and 'pad' in found[0].message
+
+
+def test_recompile_hazard_flags_inline_jit(tmp_path):
+    code = ('import jax\n'
+            'def resize(leaf):\n'
+            '    return jax.jit(lambda x: x * 2)(leaf)\n')
+    report = lint(tmp_path, code, ['recompile-hazard'])
+    found = by_rule(report, 'recompile-hazard')
+    assert len(found) == 1 and 'inline jax.jit' in found[0].message
+
+
+def test_recompile_hazard_flags_nested_def_jit(tmp_path):
+    code = ('import jax\n'
+            'def build(data):\n'
+            '    @jax.jit\n'
+            '    def step(x):\n'
+            '        return x\n'
+            '    return step(data)\n')
+    report = lint(tmp_path, code, ['recompile-hazard'])
+    assert any('nested def' in f.message
+               for f in by_rule(report, 'recompile-hazard'))
+
+
+def test_recompile_hazard_pad_to_bucket_idiom_is_warm(tmp_path):
+    # the np.concatenate([x, zeros((bucket - n, d))]) pad idiom: the
+    # WARM pad launders the join (exact.py/ivf.py query padding)
+    code = ('import jax\n'
+            'import numpy as np\n'
+            'program = jax.jit(lambda x: x)\n'
+            'def hot(queries, ladder):\n'
+            '    n = queries.shape[0]\n'
+            '    bucket = pick_bucket(n, ladder)\n'
+            '    if bucket != n:\n'
+            '        queries = np.concatenate(\n'
+            '            [queries, np.zeros((bucket - n, 4))])\n'
+            '    return program(queries)\n')
+    report = lint(tmp_path, code, ['recompile-hazard'])
+    assert not by_rule(report, 'recompile-hazard'), report.findings
+
+
+# ------------------------------------------------------------- host-sync
+SEEDED_SYNC = '''
+import jax
+import numpy as np
+
+def hot(trainer, state, arrays):
+    state, loss = trainer.train_step_placed(state, arrays)
+    return state, float(loss)
+
+def drain(xs):
+    return jax.device_get(xs)
+
+def wait(tree):
+    jax.block_until_ready(tree)
+
+def scalar(x):
+    return x.item()
+'''
+
+FIXED_SYNC = '''
+def hot(trainer, state, arrays):
+    state, loss = trainer.train_step_placed(state, arrays)
+    return state, loss  # stays on device; the log window syncs later
+'''
+
+
+def test_host_sync_fires_on_all_four_kinds(tmp_path):
+    report = lint(tmp_path, SEEDED_SYNC, ['host-sync'])
+    found = by_rule(report, 'host-sync')
+    kinds = sorted(f.message.split('(')[1].split(')')[0] for f in found)
+    assert kinds == ['block_until_ready', 'device_get', 'fetch', 'item']
+
+
+def test_host_sync_quiet_when_value_stays_on_device(tmp_path):
+    report = lint(tmp_path, FIXED_SYNC, ['host-sync'])
+    assert not by_rule(report, 'host-sync'), report.findings
+
+
+def test_host_sync_fetch_requires_device_taint(tmp_path):
+    # np.asarray over plain host data is NOT a sync — the staging
+    # pipeline np.asarray's constantly
+    code = ('import numpy as np\n'
+            'def stage(batch):\n'
+            '    return [np.asarray(a) for a in batch]\n')
+    report = lint(tmp_path, code, ['host-sync'])
+    assert not by_rule(report, 'host-sync'), report.findings
+
+
+def test_host_sync_catalog_counts_are_exact():
+    """The repo's sanctioned-sync catalog matches reality site-for-site
+    (counts pinned, nothing stale) — asserted via the full repo run in
+    test_repo_is_clean; here: the catalog is non-trivial."""
+    from code2vec_tpu.analysis.catalog import SANCTIONED_SYNCS
+    assert len(SANCTIONED_SYNCS) >= 10
+    for entry in SANCTIONED_SYNCS:
+        assert entry['reason'].strip(), entry
+        assert entry['count'] >= 1
+
+
+# ------------------------------------------------------- donation-safety
+SEEDED_DONATION = '''
+def fit(self, state, arrays):
+    state, loss = self._train_step(state, arrays)
+    total = arrays[0].sum()   # read-after-donate
+    return state, total
+'''
+
+FIXED_DONATION = '''
+def fit(self, state, arrays):
+    total = arrays[0].sum()   # read BEFORE the donating dispatch
+    state, loss = self._train_step(state, arrays)
+    return state, total
+'''
+
+
+def test_donation_fires_on_read_after_donate(tmp_path):
+    report = lint(tmp_path, SEEDED_DONATION, ['donation-safety'])
+    found = by_rule(report, 'donation-safety')
+    assert len(found) == 1 and '`arrays`' in found[0].message
+
+
+def test_donation_quiet_when_read_moves_before(tmp_path):
+    report = lint(tmp_path, FIXED_DONATION, ['donation-safety'])
+    assert not by_rule(report, 'donation-safety'), report.findings
+
+
+def test_donation_ignores_sibling_branches(tmp_path):
+    # the trainer's arity dispatch: packed and planes arms are exclusive
+    code = ('def step(self, state, arrays):\n'
+            '    if len(arrays) == 4:\n'
+            '        return self._train_step_packed(state, arrays)\n'
+            '    return self._train_step(state, arrays)\n')
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert not by_rule(report, 'donation-safety'), report.findings
+
+
+# ----------------------------------------------------------- jit-purity
+SEEDED_IMPURE = '''
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()
+    return x * t0
+'''
+
+FIXED_PURE = '''
+import jax
+
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)  # jax.random is the pure way
+    return x + noise
+'''
+
+
+def test_jit_purity_fires_on_time_in_jitted_body(tmp_path):
+    report = lint(tmp_path, SEEDED_IMPURE, ['jit-purity'])
+    found = by_rule(report, 'jit-purity')
+    assert len(found) == 1 and 'time.perf_counter' in found[0].message
+
+
+def test_jit_purity_quiet_on_jax_random(tmp_path):
+    report = lint(tmp_path, FIXED_PURE, ['jit-purity'])
+    assert not by_rule(report, 'jit-purity'), report.findings
+
+
+def test_jit_purity_covers_every_jit_spelling(tmp_path):
+    # by-name discovery must agree with the taint pass on what counts
+    # as jitted: pjit's full path and the partial(jax.jit, ...) form
+    code = ('import functools\n'
+            'import time\n'
+            'import jax\n'
+            'def body_a(x):\n'
+            '    return x * time.time()\n'
+            'def body_b(x):\n'
+            '    return x * time.time()\n'
+            'prog_a = jax.experimental.pjit.pjit(body_a)\n'
+            'prog_b = functools.partial(jax.jit, donate_argnums=0)('
+            'body_b)\n')
+    report = lint(tmp_path, code, ['jit-purity'])
+    found = by_rule(report, 'jit-purity')
+    assert len(found) == 2, report.findings
+
+
+def test_jit_purity_covers_jit_by_reference_and_nested_defs(tmp_path):
+    code = ('import jax\n'
+            'import numpy as np\n'
+            'def build():\n'
+            '    def train_step(state):\n'
+            '        def loss_fn(p):\n'
+            '            return p * np.random.rand()\n'
+            '        return loss_fn(state)\n'
+            '    return jax.jit(train_step)\n')
+    report = lint(tmp_path, code, ['jit-purity'])
+    found = by_rule(report, 'jit-purity')
+    assert len(found) == 1 and 'np.random.rand' in found[0].message
+
+
+# ------------------------------------------------------- lock-discipline
+SEEDED_UNGUARDED = '''
+import threading
+
+
+class Engine:
+    # graftlint: guard Engine._queue by _lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+
+    def put(self, item):
+        self._queue.append(item)
+
+    def depth_locked(self):
+        return len(self._queue)
+
+    def get(self):
+        with self._lock:
+            return self._queue.pop()
+'''
+
+FIXED_GUARDED = SEEDED_UNGUARDED.replace(
+    '''    def put(self, item):
+        self._queue.append(item)
+''',
+    '''    def put(self, item):
+        with self._lock:
+            self._queue.append(item)
+''')
+
+
+def test_lock_discipline_fires_on_unguarded_access(tmp_path):
+    report = lint(tmp_path, SEEDED_UNGUARDED, ['lock-discipline'])
+    found = by_rule(report, 'lock-discipline')
+    # exactly the `put` access: __init__ and *_locked are exempt, `get`
+    # holds the lock
+    assert len(found) == 1, report.findings
+    assert '`put`' in found[0].message
+
+
+def test_lock_discipline_quiet_when_guarded(tmp_path):
+    report = lint(tmp_path, FIXED_GUARDED, ['lock-discipline'])
+    assert not by_rule(report, 'lock-discipline'), report.findings
+
+
+def test_lock_discipline_flags_stale_annotation(tmp_path):
+    code = ('import threading\n'
+            'class Thing:\n'
+            '    # graftlint: guard Thing._ghost by _lock\n'
+            '    def __init__(self):\n'
+            '        self._lock = threading.Lock()\n')
+    report = lint(tmp_path, code, ['lock-discipline'])
+    assert any('stale guard annotation' in f.message
+               for f in by_rule(report, 'lock-discipline'))
+
+
+def test_lock_discipline_wrong_lock_does_not_count(tmp_path):
+    # two guard groups on one class stay separate: holding lock A does
+    # not guard a field declared under lock B
+    code = ('import threading\n'
+            'class E:\n'
+            '    # graftlint: guard E._queue by _lock\n'
+            '    # graftlint: guard E._warm by _warm_lock\n'
+            '    def __init__(self):\n'
+            '        self._lock = threading.Lock()\n'
+            '        self._warm_lock = threading.Lock()\n'
+            '        self._queue = []\n'
+            '        self._warm = False\n'
+            '    def bad(self):\n'
+            '        with self._lock:\n'
+            '            self._warm = True\n'
+            '    def good(self):\n'
+            '        with self._warm_lock:\n'
+            '            self._warm = True\n'
+            '        with self._lock:\n'
+            '            self._queue.append(1)\n')
+    report = lint(tmp_path, code, ['lock-discipline'])
+    found = by_rule(report, 'lock-discipline')
+    assert len(found) == 1, report.findings
+    assert '`bad`' in found[0].message and '_warm' in found[0].message
+
+
+def test_lock_discipline_condition_alias(tmp_path):
+    code = ('import threading\n'
+            'class W:\n'
+            '    # graftlint: guard W._stop by _lock|_cond\n'
+            '    def __init__(self):\n'
+            '        self._lock = threading.Lock()\n'
+            '        self._cond = threading.Condition(self._lock)\n'
+            '        self._stop = False\n'
+            '    def shutdown(self):\n'
+            '        with self._cond:\n'
+            '            self._stop = True\n')
+    report = lint(tmp_path, code, ['lock-discipline'])
+    assert not by_rule(report, 'lock-discipline'), report.findings
+
+
+# ------------------------------------------------------ config-knob-docs
+def test_config_knob_fires_on_undocumented_env_var(tmp_path):
+    code = ("import os\n"
+            "LIMIT = os.environ.get('PKG_SECRET_LIMIT', '8')\n")
+    report = lint(tmp_path, code, ['config-knob-docs'],
+                  extra_files={'README.md': '# docs\nnothing here\n'})
+    found = by_rule(report, 'config-knob-docs')
+    assert len(found) == 1 and 'PKG_SECRET_LIMIT' in found[0].message
+
+
+def test_config_knob_quiet_when_documented(tmp_path):
+    code = ("import os\n"
+            "LIMIT = os.environ.get('PKG_SECRET_LIMIT', '8')\n")
+    report = lint(tmp_path, code, ['config-knob-docs'],
+                  extra_files={'README.md': 'set `PKG_SECRET_LIMIT`\n'})
+    assert not by_rule(report, 'config-knob-docs'), report.findings
+
+
+def test_config_knob_changelog_mention_is_not_documentation(tmp_path):
+    # CHANGES.md names every flag a PR adds; counting it as docs would
+    # make the rule structurally vacuous
+    code = ("import os\n"
+            "LIMIT = os.environ.get('PKG_SECRET_LIMIT', '8')\n")
+    report = lint(tmp_path, code, ['config-knob-docs'],
+                  extra_files={'CHANGES.md': 'adds PKG_SECRET_LIMIT\n',
+                               'README.md': 'real docs, knob absent\n'})
+    found = by_rule(report, 'config-knob-docs')
+    assert len(found) == 1 and 'PKG_SECRET_LIMIT' in found[0].message
+
+
+def test_standalone_cli_reports_only_its_own_rule(tmp_path, monkeypatch):
+    # an unrelated graftlint meta-finding (reason-less suppression) must
+    # not fail the standalone metrics CLI as a 'metric-schema violation'
+    import check_metrics_schema as cms
+    from code2vec_tpu.telemetry.catalog import CATALOG
+    pkg = tmp_path / 'code2vec_tpu'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text(
+        '# graftlint: disable=host-sync\nX = 1\n')
+    (tmp_path / 'OBSERVABILITY.md').write_text('\n'.join(CATALOG))
+    monkeypatch.setattr(cms, 'REPO', str(tmp_path))
+    assert cms.main([]) == 0
+
+
+def test_taint_analysis_is_cached_per_file(tmp_path):
+    from code2vec_tpu.analysis import taint
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'mod.py').write_text('def f(x):\n    return x\n')
+    tree = SourceTree(str(tmp_path), scan_dirs=('pkg',), scan_files=(),
+                      package_dirs=('pkg',))
+    source = tree.files('all')[0]
+    assert taint.analyze_file(source) is taint.analyze_file(source)
+
+
+# ------------------------------------------------- suppression mechanics
+def test_suppression_with_reason_silences(tmp_path):
+    code = SEEDED_DONATION.replace(
+        '    total = arrays[0].sum()   # read-after-donate',
+        '    # graftlint: disable=donation-safety -- test: sanctioned\n'
+        '    total = arrays[0].sum()')
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert not report.findings, report.findings
+    assert len(report.suppressed) == 1
+
+
+def test_suppression_without_reason_is_a_finding_and_inert(tmp_path):
+    code = SEEDED_DONATION.replace(
+        '    total = arrays[0].sum()   # read-after-donate',
+        '    # graftlint: disable=donation-safety\n'
+        '    total = arrays[0].sum()')
+    report = lint(tmp_path, code, ['donation-safety'])
+    rules = {f.rule for f in report.findings}
+    # the original finding survives AND the bare suppression is flagged
+    assert rules == {'donation-safety', 'graftlint'}, report.findings
+
+
+def test_suppression_disable_all_is_rejected(tmp_path):
+    code = ('# graftlint: disable-file=all -- lazy\n'
+            + SEEDED_DONATION)
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert any('disable=all' in f.message or 'blanket' in f.message
+               for f in report.findings), report.findings
+    assert by_rule(report, 'donation-safety'), 'all must not suppress'
+
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    # suppression left behind after the code under it was fixed
+    code = FIXED_DONATION.replace(
+        '    total = arrays[0].sum()   # read BEFORE the donating dispatch',
+        '    # graftlint: disable=donation-safety -- obsolete: fixed below\n'
+        '    total = arrays[0].sum()')
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert any('stale suppression' in f.message
+               for f in report.findings), report.findings
+
+
+def test_stale_suppression_ignores_unrun_rules(tmp_path):
+    # a --rules subset must not flag other rules' suppressions as stale
+    code = ('# graftlint: disable=jit-purity -- owned by a rule not run\n'
+            'X = 1\n')
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert not report.findings, report.findings
+
+
+def test_docstring_examples_are_not_suppressions(tmp_path):
+    code = ('"""Doc: use `# graftlint: disable=donation-safety -- why`\n'
+            'on the offending line."""\n' + SEEDED_DONATION)
+    report = lint(tmp_path, code, ['donation-safety'])
+    assert by_rule(report, 'donation-safety'), \
+        'a docstring example must not suppress anything'
+
+
+# --------------------------------------------------- baseline mechanics
+def run_with_baseline(tmp_path, code, entries):
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir(exist_ok=True)
+    (pkg / 'mod.py').write_text(code)
+    baseline = tmp_path / 'graftlint_baseline.json'
+    baseline.write_text(json.dumps({'entries': entries}))
+    tree = SourceTree(str(tmp_path), scan_dirs=('pkg',), scan_files=(),
+                      package_dirs=('pkg',))
+    return engine.run(root=str(tmp_path), rule_names=['donation-safety'],
+                      baseline_path=str(baseline), tree=tree)
+
+
+DONATION_MSG = ('read of `arrays` in `fit` after it was donated to '
+                '`_train_step` (arg 1) — the step may alias/overwrite '
+                'its buffer; rebind or copy before the dispatch')
+
+
+def test_baseline_entry_absorbs_finding(tmp_path):
+    report = run_with_baseline(tmp_path, SEEDED_DONATION, [
+        {'rule': 'donation-safety', 'file': os.path.join('pkg', 'mod.py'),
+         'message': DONATION_MSG, 'reason': 'test: accepted debt'}])
+    assert not report.findings, report.findings
+    assert len(report.baselined) == 1
+
+
+def test_bare_baseline_entry_is_a_finding(tmp_path):
+    report = run_with_baseline(tmp_path, SEEDED_DONATION, [
+        {'rule': 'donation-safety', 'file': os.path.join('pkg', 'mod.py'),
+         'message': DONATION_MSG, 'reason': 'TODO'}])
+    assert any('bare baseline entry' in f.message
+               for f in report.findings), report.findings
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    report = run_with_baseline(tmp_path, FIXED_DONATION, [
+        {'rule': 'donation-safety', 'file': os.path.join('pkg', 'mod.py'),
+         'message': DONATION_MSG, 'reason': 'test: accepted debt'}])
+    assert any('stale baseline entry' in f.message
+               for f in report.findings), report.findings
+
+
+def test_rule_subset_run_ignores_other_rules_baseline_entries(tmp_path):
+    # a --rules subset run must not report another rule's baseline
+    # entries as stale (they had no chance to match)
+    report = run_with_baseline(tmp_path, FIXED_DONATION, [
+        {'rule': 'jit-purity', 'file': os.path.join('pkg', 'mod.py'),
+         'message': 'some other rule finding',
+         'reason': 'test: owned by a rule this run does not execute'}])
+    assert not report.findings, report.findings
+
+
+def test_rule_subset_run_against_repo_baseline_is_clean():
+    # the CLI-documented `--rules host-sync` usage: the repo baseline's
+    # recompile-hazard entries must not surface as stale
+    report = engine.run(rule_names=['host-sync'])
+    assert report.clean, [f.format() for f in report.findings]
+
+
+def test_write_baseline_preserves_unrun_rules_entries(tmp_path):
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'graftlint.py'),
+         '--rules', 'host-sync', '--write-baseline',
+         '--baseline', str(tmp_path / 'bl.json')],
+        capture_output=True, text=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert result.returncode == 0, result.stdout + result.stderr
+    # seed the target with another rule's reviewed entry, rewrite with a
+    # subset, and check the entry (and its reason) survived
+    entry = {'rule': 'recompile-hazard', 'file': 'code2vec_tpu/x.py',
+             'message': 'reviewed finding', 'reason': 'reviewed reason'}
+    (tmp_path / 'bl.json').write_text(json.dumps({'entries': [entry]}))
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'graftlint.py'),
+         '--rules', 'host-sync', '--write-baseline',
+         '--baseline', str(tmp_path / 'bl.json')],
+        capture_output=True, text=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert result.returncode == 0, result.stdout + result.stderr
+    data = json.loads((tmp_path / 'bl.json').read_text())
+    assert entry in data['entries'], data
+
+
+# ------------------------------------------------------- tier-1 guards
+def test_every_rule_is_registered_and_documented():
+    names = {rule.name for rule in all_rules()}
+    assert {'recompile-hazard', 'host-sync', 'donation-safety',
+            'jit-purity', 'lock-discipline', 'config-knob-docs',
+            'metrics-schema', 'fault-points'} <= names
+    with open(os.path.join(REPO, 'ANALYSIS.md')) as f:
+        doc = f.read()
+    for name in sorted(names):
+        assert name in doc, \
+            'rule %r is missing from the ANALYSIS.md catalog' % name
+
+
+def test_repo_is_clean():
+    """THE tier-1 guard: zero unbaselined, unsuppressed findings across
+    every rule, and every suppression/baseline carries a reason (the
+    engine turns reason-less ones into findings)."""
+    report = engine.run()
+    assert report.clean, 'graftlint findings:\n%s' % '\n'.join(
+        f.format() for f in report.findings)
+    # the invariants the rules exist for are actually being exercised
+    assert report.suppressed, 'expected at least one reasoned suppression'
+    assert report.baselined, 'expected at least one reasoned baseline hit'
+
+
+def test_full_pass_is_fast():
+    """The lint pass must stay far from the tier-1 cliff (<20s budget,
+    ANALYSIS.md; typically ~2s)."""
+    report = engine.run()
+    assert report.elapsed_s < 20, report.elapsed_s
+
+
+def test_lint_all_cli_exits_zero():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'lint_all.py')],
+        capture_output=True, text=True,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert '0 finding(s)' in result.stdout
