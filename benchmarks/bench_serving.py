@@ -101,8 +101,10 @@ def run_engine_open_loop(model, requests, tier: str, max_delay_ms: float):
     """Submit the whole stream up front; per-request latency is
     submit -> future-done (a done-callback stamps the clock)."""
     done_at = [0.0] * len(requests)
-    with model.serving_engine(tiers=(tier,),
-                              max_delay_ms=max_delay_ms) as engine:
+    # queue_bound=-1: the open-loop regime deliberately holds the WHOLE
+    # stream in flight; the default (auto) admission bound would shed it
+    with model.serving_engine(tiers=(tier,), max_delay_ms=max_delay_ms,
+                              queue_bound=-1) as engine:
         t0 = time.perf_counter()
         submit_at = []
         futures = []

@@ -501,6 +501,13 @@ class CheckpointStore:
         ``step`` (preemption save verification)."""
         return any(s == step for _m, s in self._restore_candidates())
 
+    def newest_step(self) -> Optional[int]:
+        """Newest retained step across both stores, or None when the
+        path holds no checkpoints (serving rollover polling —
+        ``ServingEngine.follow_checkpoints``, SERVING.md)."""
+        newest = self._newest()
+        return newest[1] if newest else None
+
     def _quarantine(self, manager, step: int,
                     suffix: str = '.corrupt') -> None:
         """Move a step directory ASIDE (rename to ``<step><suffix>``) so
@@ -696,11 +703,10 @@ class CheckpointStore:
             params=params, opt_state=opt_state,
             step=int(restored['step']), epoch=int(restored['epoch']))
 
-    def restore_params(self, abstract_params) -> Optional[Any]:
-        """Restore params only: prefer the released weights-only artifact,
-        fall back to the newest full checkpoint (reference load order:
-        whatever exists under the load path)."""
-        self.verify_metadata()
+    def _params_adapters(self, abstract_params):
+        """(with_rows, adapt) closures of the params-only restore paths:
+        target the SAVED target-table row count, then pad/slice back to
+        the current allocation (module-level row-adaptation note)."""
         current_params = abstract_params
 
         def with_rows(stored_rows):
@@ -715,6 +721,37 @@ class CheckpointStore:
                 return _resize_target_rows(params, current_params,
                                            current_rows)
             return params
+
+        return with_rows, adapt
+
+    def restore_params_step(self, abstract_params, step: int) -> Any:
+        """Params-only restore pinned to ONE retained step (canaried
+        serving rollover: ``ServingEngine.load_params(step)``). Unlike
+        ``restore_params`` there is no older-step fallback — the caller
+        asked for this step, so a missing or unrestorable artifact is an
+        error, not a silent downgrade."""
+        self.verify_metadata()
+        with_rows, adapt = self._params_adapters(abstract_params)
+        candidates = [(m, s) for m, s in self._restore_candidates()
+                      if s == step]
+        if not candidates:
+            raise ValueError(
+                'No retained checkpoint at step %d under `%s` (retained: '
+                '%s)' % (step, self.model_path,
+                         sorted({s for _m, s
+                                 in self._restore_candidates()})))
+        return self._restore_with_fallback(
+            candidates,
+            lambda manager, s: self._restore_params_at(manager, s,
+                                                       with_rows, adapt),
+            what='params restore at step %d' % step)
+
+    def restore_params(self, abstract_params) -> Optional[Any]:
+        """Restore params only: prefer the released weights-only artifact,
+        fall back to the newest full checkpoint (reference load order:
+        whatever exists under the load path)."""
+        self.verify_metadata()
+        with_rows, adapt = self._params_adapters(abstract_params)
 
         if os.path.isdir(self.weights_dir):
             checkpointer = ocp.StandardCheckpointer()

@@ -308,6 +308,56 @@ class Config:
     # {topk, attention, full, vectors} (training/trainer.py
     # PREDICT_TIERS). Fewer tiers = proportionally fewer eager compiles.
     SERVING_WARM_TIERS: str = 'topk,attention,full'
+    # ---- serving resilience (SERVING.md "Overload & rollover") ----
+    # Default per-request SLO deadline in milliseconds (submit's
+    # deadline_ms= overrides per request; 0 = no deadline). A deadlined
+    # request is shed at admission when the queue's drain estimate
+    # already exceeds it, and expired (typed DeadlineExceeded) if it is
+    # still queued when the deadline passes — dead work is never
+    # dispatched.
+    SERVING_DEADLINE_MS: float = 0.0
+    # Admission-controlled front-queue bound, in ROWS queued across all
+    # tiers. Submissions beyond it are shed with EngineOverloaded
+    # instead of queueing unboundedly. 0 = auto (8x the top batch
+    # bucket: a few in-flight bucket fills); -1 = unbounded (the
+    # pre-resilience behavior).
+    SERVING_QUEUE_BOUND: int = 0
+    # Canaried checkpoint rollover (ServingEngine.load_params): live
+    # micro-batches shadow-scored against BOTH param sets before the
+    # swap decision. 0 = swap immediately, no canary.
+    SERVING_CANARY_BATCHES: int = 8
+    # Minimum top-1 agreement (new vs serving params, over the canaried
+    # rows) for the swap; below it the rollover rolls back.
+    SERVING_CANARY_AGREEMENT: float = 0.9
+    # An armed canary that has not concluded after this many seconds of
+    # dispatches rolls back instead of wedging later rollovers — a
+    # mixed-tier engine serving only vectors traffic (submit_neighbors)
+    # produces no top-1 comparisons, so without a bound the rollover
+    # never decides. 0 disables the timeout.
+    SERVING_CANARY_TIMEOUT_SECS: float = 300.0
+    # Poll the checkpoint store every this-many seconds for a newer
+    # retained step and roll it over through the canary
+    # (--serve-follow-checkpoints; 0 disables).
+    SERVE_FOLLOW_CHECKPOINTS_SECS: float = 0.0
+    # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
+    # Per-invocation extractor timeout (--extractor-timeout): a wedged
+    # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
+    # instead of hanging the caller forever. 0 disables the bound.
+    EXTRACTOR_TIMEOUT_SECS: float = 30.0
+    # ExtractorPool retries per call after a crash-class failure
+    # (spawn/exit/timeout — clean "no paths" content errors are never
+    # retried), with exponential backoff from EXTRACTOR_BACKOFF_SECS.
+    EXTRACTOR_RETRIES: int = 2
+    EXTRACTOR_BACKOFF_SECS: float = 0.1
+    # Persistent extractor pool worker threads (bounded subprocess
+    # concurrency for raw-source serving traffic).
+    EXTRACTOR_POOL_WORKERS: int = 2
+    # Circuit breaker: consecutive crashed calls (each already retried)
+    # that trip it open; while open, calls fail fast with
+    # ExtractorUnavailable until the cooldown elapses and a half-open
+    # probe succeeds.
+    EXTRACTOR_BREAKER_THRESHOLD: int = 3
+    EXTRACTOR_BREAKER_COOLDOWN_SECS: float = 30.0
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     # Storage dtype for exported code vectors AND the index store:
     # 'float16' halves disk + device-resident (HBM) footprint; scores
@@ -533,6 +583,36 @@ class Config:
                             help='micro-batcher coalescing deadline: max '
                                  'added latency while batching concurrent '
                                  'requests (0 = dispatch immediately)')
+        parser.add_argument('--serving-deadline-ms',
+                            dest='serving_deadline_ms', type=float,
+                            default=None, metavar='MS',
+                            help='default per-request SLO deadline: '
+                                 'requests are shed at admission when '
+                                 'the queue cannot drain in time, and '
+                                 'expired instead of dispatched once '
+                                 'past it (0 = none; SERVING.md)')
+        parser.add_argument('--serving-queue-bound',
+                            dest='serving_queue_bound', type=int,
+                            default=None, metavar='ROWS',
+                            help='admission-controlled front-queue '
+                                 'bound in rows; excess submissions '
+                                 'are shed with a typed error (0 = '
+                                 'auto, -1 = unbounded; SERVING.md)')
+        parser.add_argument('--serve-follow-checkpoints',
+                            dest='serve_follow_checkpoints', type=float,
+                            default=None, metavar='SECS',
+                            help='poll the checkpoint store every SECS '
+                                 'for newer steps and roll them into '
+                                 'the live serving engine through the '
+                                 'canary (zero-downtime rollover; '
+                                 'SERVING.md)')
+        parser.add_argument('--extractor-timeout',
+                            dest='extractor_timeout_secs', type=float,
+                            default=None, metavar='SECS',
+                            help='per-invocation extractor timeout: a '
+                                 'wedged extractor fails the call with '
+                                 'its stderr instead of hanging the '
+                                 'caller (0 disables; SERVING.md)')
         parser.add_argument('--bulk-vectors', dest='bulk_vectors',
                             default=None, metavar='FILE.c2v',
                             help='stream a whole .c2v corpus through the '
@@ -691,6 +771,15 @@ class Config:
             self.SERVING_BATCH_BUCKETS = parsed.serving_buckets
         if parsed.serving_max_delay_ms is not None:
             self.SERVING_MAX_DELAY_MS = parsed.serving_max_delay_ms
+        if parsed.serving_deadline_ms is not None:
+            self.SERVING_DEADLINE_MS = parsed.serving_deadline_ms
+        if parsed.serving_queue_bound is not None:
+            self.SERVING_QUEUE_BOUND = parsed.serving_queue_bound
+        if parsed.serve_follow_checkpoints is not None:
+            self.SERVE_FOLLOW_CHECKPOINTS_SECS = \
+                parsed.serve_follow_checkpoints
+        if parsed.extractor_timeout_secs is not None:
+            self.EXTRACTOR_TIMEOUT_SECS = parsed.extractor_timeout_secs
         if parsed.bulk_vectors:
             self.BULK_VECTORS_PATH = parsed.bulk_vectors
         if parsed.vectors_dtype:
@@ -918,6 +1007,39 @@ class Config:
             raise ValueError('config.SERVING_MAX_DELAY_MS must be >= 0.')
         if self.SERVING_DECODE_WORKERS < 1:
             raise ValueError('config.SERVING_DECODE_WORKERS must be >= 1.')
+        if self.SERVING_DEADLINE_MS < 0:
+            raise ValueError('config.SERVING_DEADLINE_MS must be >= 0 '
+                             '(0 = no deadline).')
+        if self.SERVING_QUEUE_BOUND < -1:
+            raise ValueError('config.SERVING_QUEUE_BOUND must be >= -1 '
+                             '(0 = auto, -1 = unbounded).')
+        if self.SERVING_CANARY_BATCHES < 0:
+            raise ValueError('config.SERVING_CANARY_BATCHES must be >= 0 '
+                             '(0 = swap without canary).')
+        if not 0.0 <= self.SERVING_CANARY_AGREEMENT <= 1.0:
+            raise ValueError('config.SERVING_CANARY_AGREEMENT must be in '
+                             '[0, 1].')
+        if self.SERVING_CANARY_TIMEOUT_SECS < 0:
+            raise ValueError('config.SERVING_CANARY_TIMEOUT_SECS must be '
+                             '>= 0 (0 disables the canary timeout).')
+        if self.SERVE_FOLLOW_CHECKPOINTS_SECS < 0:
+            raise ValueError('config.SERVE_FOLLOW_CHECKPOINTS_SECS must '
+                             'be >= 0 (0 disables).')
+        if self.EXTRACTOR_TIMEOUT_SECS < 0:
+            raise ValueError('config.EXTRACTOR_TIMEOUT_SECS must be >= 0 '
+                             '(0 disables the bound).')
+        if self.EXTRACTOR_RETRIES < 0:
+            raise ValueError('config.EXTRACTOR_RETRIES must be >= 0.')
+        if self.EXTRACTOR_BACKOFF_SECS < 0:
+            raise ValueError('config.EXTRACTOR_BACKOFF_SECS must be >= 0.')
+        if self.EXTRACTOR_POOL_WORKERS < 1:
+            raise ValueError('config.EXTRACTOR_POOL_WORKERS must be >= 1.')
+        if self.EXTRACTOR_BREAKER_THRESHOLD < 1:
+            raise ValueError('config.EXTRACTOR_BREAKER_THRESHOLD must be '
+                             '>= 1.')
+        if self.EXTRACTOR_BREAKER_COOLDOWN_SECS < 0:
+            raise ValueError('config.EXTRACTOR_BREAKER_COOLDOWN_SECS must '
+                             'be >= 0.')
         valid_tiers = {'topk', 'attention', 'full', 'vectors'}
         tiers = self.serving_warm_tiers
         if not tiers or not set(tiers) <= valid_tiers:

@@ -749,19 +749,62 @@ class Code2VecModel:
         return engine_lib.decode_results(fetched, batch, len(lines),
                                          self._target_index_to_word)
 
+    def _serving_param_source(self) -> Optional['ServingParamSource']:
+        """Checkpoint-backed param source for the serving engine's
+        canaried rollover (``load_params`` / ``follow_checkpoints``,
+        SERVING.md): steps resolve against the model's own load path
+        (or the save path of a just-trained model); None when the model
+        was built from neither (fresh init)."""
+        path = (self.config.MODEL_LOAD_PATH if self.config.is_loading
+                else self.config.MODEL_SAVE_PATH
+                if self.config.is_saving else None)
+        if path is None:
+            return None
+        return ServingParamSource(self, self._store_for(path))
+
     def serving_engine(self, tiers=None, warmup: bool = True, **overrides):
         """Build a ``ServingEngine`` over this model's warm params:
         dynamic micro-batching + a pre-compiled bucket ladder for
         concurrent request traffic (serving/engine.py, SERVING.md).
         ``warmup=False`` defers the eager ladder compile to the first
-        ``submit``."""
+        ``submit``.
+
+        The engine is armed for canaried zero-downtime checkpoint
+        rollover against this model's checkpoint path; with
+        ``--serve-follow-checkpoints`` (SERVE_FOLLOW_CHECKPOINTS_SECS
+        > 0) it also polls that path and rolls newer steps in live."""
         from code2vec_tpu.serving.engine import ServingEngine
+        if 'param_source' in overrides:
+            param_source = overrides.pop('param_source')
+        else:
+            # only built when the caller didn't bring their own: the
+            # default opens a checkpoint store (filesystem access)
+            param_source = self._serving_param_source()
+        if 'params_step' not in overrides:
+            # baseline the follow-checkpoints poller at the step the
+            # params actually came from: without it the first poll
+            # re-rolls (full restore + canary) the already-serving step
+            if self.state is not None:
+                overrides['params_step'] = int(self.state.step)
+            elif param_source is not None:
+                # params-only load restores the newest retained step
+                overrides['params_step'] = param_source.newest_step()
         engine = ServingEngine(
             self.config, self.trainer, self.params, self.vocabs,
             decode_table=self._target_index_to_word, tiers=tiers,
+            param_source=param_source,
             log=self.log, **overrides)
-        if warmup:
-            engine.warmup()
+        try:
+            if warmup:
+                engine.warmup()
+            if self.config.SERVE_FOLLOW_CHECKPOINTS_SECS > 0:
+                engine.follow_checkpoints()
+        except BaseException:
+            # never leak a running dispatcher/decode pool: the caller
+            # gets the exception, not the engine, so nobody else can
+            # close it
+            engine.close()
+            raise
         return engine
 
     # ----------------------------------------------------- embedding export
@@ -791,3 +834,39 @@ class Code2VecModel:
             common.save_word2vec_file(words_file, index_to_word, matrix)
         self.log('Saved %s embeddings to `%s`.'
                  % (vocab_type.name, dest_save_path))
+
+
+class ServingParamSource:
+    """Resolves ``ServingEngine.load_params(step|path)`` refs and
+    ``newest_step()`` polls against a model's checkpoint store
+    (zero-downtime rollover, SERVING.md).
+
+    Restored params ride the SAME abstract targets (current-mesh
+    shardings) as the model's own load path, so a rolled-in candidate
+    matches the serving set's shapes and shardings exactly — which is
+    what lets every canary shadow dispatch reuse the warm compiled
+    ladder."""
+
+    def __init__(self, model: Code2VecModel, store: CheckpointStore):
+        self._model = model
+        self._store = store
+
+    def load(self, source):
+        """``source``: retained step (int) of the model's own store, or
+        a model path (str) — returns placed, backend-native params."""
+        abstract_params, _ = self._model.trainer.abstract_state()
+        if isinstance(source, int) and not isinstance(source, bool):
+            params = self._store.restore_params_step(abstract_params,
+                                                     source)
+        else:
+            store = self._model._store_for(str(source))
+            params = store.restore_params(abstract_params)
+            if params is None:
+                raise ValueError('No checkpoint found under `%s`.'
+                                 % source)
+        return self._model.backend.from_canonical(params)
+
+    def newest_step(self):
+        """Newest retained step of the model's store (None when the
+        path holds no checkpoints yet)."""
+        return self._store.newest_step()

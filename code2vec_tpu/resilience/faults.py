@@ -18,6 +18,17 @@ site has been reached (``hang_input`` counts batches, ``corrupt_snapshot``
 counts snapshot saves).  The trigger key name (``step`` / ``save`` / …)
 is documentation for humans — the plan only keeps the integer.
 
+A trigger may also be a **fire window** ``<lo>..<hi>`` (inclusive):
+
+    extractor_crash@call=0..2,slow_dispatch@req=0..3
+
+the point then fires at EVERY trigger count inside the window — the
+multi-shot shape breaker/overload drills need (a circuit breaker trips
+on K *consecutive* crashes; one crash proves nothing) — and is done once
+the count passes ``hi``.  A single ``<n>`` keeps the original semantics:
+single-shot, ``>=``-matched so a resumed run that skipped the exact
+count still fires once.
+
 What happens on fire is implemented AT the site (poison the loss, kill
 the process, sleep, truncate the artifact): the harness only decides
 when, so the injected failure exercises the exact code path a real one
@@ -55,6 +66,18 @@ FAULT_POINTS: Dict[str, str] = {
     'corrupt_snapshot': 'checkpoints.py: truncate the files of the '
                         'just-written step snapshot (exercises the '
                         'restore fallback).',
+    'slow_dispatch': 'serving/engine.py dispatcher: sleep '
+                     'SLOW_DISPATCH_SECONDS before dispatching the '
+                     'triggering micro-batch (exercises admission '
+                     'control: queue bound, shedding, deadline expiry).',
+    'extractor_crash': 'serving/extractor_bridge.py pool call: the '
+                       'triggering extractor invocation raises '
+                       'ExtractorCrash as if the subprocess died '
+                       '(exercises retry-with-backoff and the circuit '
+                       'breaker).',
+    'reject_all': 'serving/engine.py admission: the triggering submit '
+                  'calls are shed with EngineOverloaded regardless of '
+                  'queue state (exercises client fail-fast handling).',
 }
 
 #: how long a fired ``hang_input`` blocks.  Long enough that only a
@@ -62,15 +85,23 @@ FAULT_POINTS: Dict[str, str] = {
 #: in a test process eventually unwinds.
 HANG_SECONDS = 600.0
 
+#: how long a fired ``slow_dispatch`` stalls the serving dispatcher.
+#: Long enough that an open-loop burst deterministically outruns the
+#: queue bound, short enough that a windowed drill stays inside test
+#: budgets.
+SLOW_DISPATCH_SECONDS = 0.25
 
-def parse_spec(spec: str) -> Dict[str, int]:
-    """``'nan_loss@step=120,sigterm@step=50'`` -> {point: trigger_count}.
 
-    Raises ``ValueError`` on an unknown fault point or malformed entry —
-    a typo'd injection spec must fail the run at startup, not silently
-    inject nothing.
+def parse_spec(spec: str) -> Dict[str, object]:
+    """``'nan_loss@step=120,sigterm@step=50'`` -> {point: trigger}.
+
+    A trigger is an ``int`` (single-shot, ``>=``-matched) or a
+    ``(lo, hi)`` tuple for a ``lo..hi`` fire window (multi-shot,
+    inclusive).  Raises ``ValueError`` on an unknown fault point or
+    malformed entry — a typo'd injection spec must fail the run at
+    startup, not silently inject nothing.
     """
-    plan: Dict[str, int] = {}
+    plan: Dict[str, object] = {}
     for entry in (spec or '').split(','):
         entry = entry.strip()
         if not entry:
@@ -78,17 +109,27 @@ def parse_spec(spec: str) -> Dict[str, int]:
         try:
             point, trigger = entry.split('@', 1)
             _key, value = trigger.split('=', 1)
-            at = int(value)
+            if '..' in value:
+                lo_text, hi_text = value.split('..', 1)
+                at: object = (int(lo_text), int(hi_text))
+            else:
+                at = int(value)
         except ValueError:
             raise ValueError(
-                'FAULT_INJECT entry %r is not <point>@<trigger>=<int> '
-                '(e.g. nan_loss@step=120)' % entry)
+                'FAULT_INJECT entry %r is not <point>@<trigger>=<int> or '
+                '<point>@<trigger>=<lo>..<hi> (e.g. nan_loss@step=120, '
+                'extractor_crash@call=0..2)' % entry)
         if point not in FAULT_POINTS:
             raise ValueError(
                 'FAULT_INJECT names unknown fault point %r; known points: '
                 '%s (resilience/faults.py)' % (point,
                                                ', '.join(sorted(FAULT_POINTS))))
-        if at < 0:
+        if isinstance(at, tuple):
+            if at[0] < 0 or at[1] < at[0]:
+                raise ValueError(
+                    'FAULT_INJECT entry %r: fire window must be '
+                    '0 <= lo <= hi' % entry)
+        elif at < 0:
             raise ValueError(
                 'FAULT_INJECT entry %r: trigger count must be >= 0' % entry)
         plan[point] = at
@@ -98,15 +139,18 @@ def parse_spec(spec: str) -> Dict[str, int]:
 class FaultPlan:
     """The armed plan: which points fire, and at which trigger count.
 
-    Each point fires AT MOST ONCE per plan (deterministic single-shot
-    faults); ``>=`` matching makes a fault whose exact count was skipped
-    (a resumed run starting past it) still fire at the next opportunity.
+    A single-count point fires AT MOST ONCE per plan (deterministic
+    single-shot faults); ``>=`` matching makes a fault whose exact count
+    was skipped (a resumed run starting past it) still fire at the next
+    opportunity.  A ``(lo, hi)`` fire-window point fires at every
+    trigger count inside the window and is done once the count passes
+    ``hi``.
     """
 
     # fault sites probe from the trainer thread, the input pipeline, and
     # tests' drill threads (lock-discipline rule, ANALYSIS.md):
     # graftlint: guard FaultPlan._at,_site_counts,_fired by _lock
-    def __init__(self, plan: Dict[str, int]):
+    def __init__(self, plan: Dict[str, object]):
         self._at = dict(plan)
         self._site_counts: Dict[str, int] = {}
         self._fired: set = set()
@@ -120,9 +164,18 @@ class FaultPlan:
             if step is None:
                 step = self._site_counts.get(point, 0)
                 self._site_counts[point] = step + 1
-            if step < at:
-                return False
-            self._fired.add(point)
+            if isinstance(at, tuple):
+                lo, hi = at
+                if step > hi:
+                    self._fired.add(point)  # window passed: done
+                    return False
+                if step < lo:
+                    return False
+                # inside the window: fire, stay armed for the next count
+            else:
+                if step < at:
+                    return False
+                self._fired.add(point)
         logger.warning('FAULT_INJECT: firing %r at trigger count %d',
                        point, step)
         from code2vec_tpu.telemetry import core
@@ -151,7 +204,9 @@ def configure(spec: str) -> Optional[FaultPlan]:
     _PLAN = FaultPlan(plan) if plan else None
     if _PLAN is not None:
         logger.warning('FAULT_INJECT armed: %s',
-                       ', '.join('%s@%d' % (p, n)
+                       ', '.join('%s@%d..%d' % (p, n[0], n[1])
+                                 if isinstance(n, tuple) else
+                                 '%s@%d' % (p, n)
                                  for p, n in sorted(plan.items())))
     return _PLAN
 

@@ -1,8 +1,16 @@
-from code2vec_tpu.serving.extractor_bridge import Extractor
+from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+                                         EngineOverloaded, ExtractorCrash,
+                                         ExtractorError,
+                                         ExtractorUnavailable,
+                                         ServingError)
+from code2vec_tpu.serving.extractor_bridge import Extractor, ExtractorPool
 from code2vec_tpu.serving.predict import InteractivePredictor
 
 # ServingEngine / bulk_predict / export_code_vectors are imported from
 # their modules directly (code2vec_tpu.serving.engine / .bulk): they pull
 # in jax + the trainer, which the lightweight REPL pieces above must not.
 
-__all__ = ['Extractor', 'InteractivePredictor']
+__all__ = ['Extractor', 'ExtractorPool', 'InteractivePredictor',
+           'ServingError', 'EngineClosed', 'EngineOverloaded',
+           'DeadlineExceeded', 'ExtractorError', 'ExtractorCrash',
+           'ExtractorUnavailable']

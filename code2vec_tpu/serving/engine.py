@@ -28,6 +28,24 @@ arxiv 2604.15464; Google's ads-serving infrastructure, arxiv 2501.10546
   attention parsing) runs on a worker pool (``SERVING_DECODE_WORKERS``),
   so device dispatch never waits on Python.
 
+Resilient under overload and across model refreshes (ROBUSTNESS.md
+serving pillar; SERVING.md "Overload & rollover runbook"):
+
+- **Admission control.** The front queue is bounded
+  (``SERVING_QUEUE_BOUND`` rows); submissions past it — or whose SLO
+  deadline (``SERVING_DEADLINE_MS`` / per-``submit`` ``deadline_ms=``)
+  the queue's drain estimate already exceeds — are shed with a typed
+  ``EngineOverloaded`` at admission. Queued requests whose deadline
+  passes are expired with ``DeadlineExceeded`` instead of dispatching
+  dead work, and a degradation ladder downgrades output tier
+  (full → attention → topk) while the queue runs hot.
+- **Canaried zero-downtime rollover.** ``load_params(step|path|pytree)``
+  loads candidate params alongside the serving set, shadow-scores live
+  micro-batches against both (same shapes and shardings — the warm
+  ladder is reused, zero new compiles), and atomically swaps when top-1
+  agreement clears ``SERVING_CANARY_AGREEMENT``, else rolls back.
+  ``follow_checkpoints`` polls the store and rolls newer steps in.
+
 Instrumented with standalone telemetry instruments (``stats()``) that
 mirror into the process-global registry when telemetry is enabled
 (``serving/*`` in telemetry/catalog.py; OBSERVABILITY.md).
@@ -56,9 +74,35 @@ from code2vec_tpu.data import packed as packed_lib
 from code2vec_tpu.data.reader import (Batch, EstimatorAction,
                                       PathContextReader)
 from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+                                         EngineOverloaded)
 from code2vec_tpu.telemetry import core as tele_core
 from code2vec_tpu.telemetry.core import Counter, Gauge, Timer
 from code2vec_tpu.training.trainer import PREDICT_TIERS
+
+#: overload degradation ladder: tier served at each level (missing keys
+#: keep the requested tier). Level 1 sheds the attention decode of
+#: 'full'; level 2 serves bare top-k only. 'vectors' is never remapped —
+#: its callers need the vectors, not a cheaper answer.
+_DEGRADE_LADDER = {
+    1: {'full': 'attention'},
+    2: {'full': 'topk', 'attention': 'topk'},
+}
+#: queue-fill fractions (of the admission bound): enter level 2 / enter
+#: level 1 / drop back to 0. The wide exit gap is the hysteresis that
+#: makes the ladder respond to SUSTAINED overload instead of flapping
+#: on every burst.
+_OVERLOAD_ENTER_2 = 0.75
+_OVERLOAD_ENTER_1 = 0.50
+_OVERLOAD_EXIT = 0.25
+
+#: sliding window the drain-estimate throughput aggregates over, and the
+#: minimum span it must cover before it overrides the sojourn seed — a
+#: burst of near-simultaneous completions spans microseconds and carries
+#: no throughput signal
+_SERVICE_WINDOW_S = 2.0
+_SERVICE_MIN_SPAN_S = 0.05
 
 
 # --------------------------------------------------------------- ladder
@@ -185,12 +229,13 @@ class _Request:
     """One queue entry: a tokenized chunk of <= max-bucket rows."""
 
     __slots__ = ('batch', 'rows', 'tier', 'future', 'aggregate',
-                 'chunk_idx', 't_enqueue')
+                 'chunk_idx', 't_enqueue', 't_deadline')
 
     def __init__(self, batch: Batch, tier: str,
                  future: Optional[Future] = None,
                  aggregate: Optional[_Aggregate] = None,
-                 chunk_idx: int = 0):
+                 chunk_idx: int = 0,
+                 deadline_s: Optional[float] = None):
         self.batch = batch
         self.rows = int(batch.label.shape[0])
         self.tier = tier
@@ -198,6 +243,9 @@ class _Request:
         self.aggregate = aggregate
         self.chunk_idx = chunk_idx
         self.t_enqueue = time.perf_counter()
+        # absolute expiry instant on the t_enqueue clock; None = no SLO
+        self.t_deadline = (self.t_enqueue + deadline_s
+                           if deadline_s else None)
 
     def deliver(self, results: list) -> None:
         if self.aggregate is not None:
@@ -210,6 +258,46 @@ class _Request:
             self.aggregate.fail(exc)
         elif not self.future.done():
             self.future.set_exception(exc)
+
+
+class _Rollover:
+    """One in-flight canaried param rollover: the candidate params plus
+    the canary tallies. All fields are mutated under the engine's
+    ``_cond`` lock (the dispatcher reads it, decode workers tally into
+    it, ``load_params``/``close`` create and clear it)."""
+
+    __slots__ = ('params', 'step', 'handle', 'target_batches',
+                 'min_agreement', 't_armed', 'batches', 'rows',
+                 'agree_rows', 'primary_fetch_s', 'shadow_fetch_s')
+
+    def __init__(self, params, step: Optional[int], handle: Future,
+                 target_batches: int, min_agreement: float):
+        self.params = params
+        self.step = step
+        self.handle = handle
+        self.target_batches = target_batches
+        self.min_agreement = min_agreement
+        self.t_armed = time.perf_counter()
+        self.batches = 0
+        self.rows = 0
+        self.agree_rows = 0
+        self.primary_fetch_s = 0.0
+        self.shadow_fetch_s = 0.0
+
+    def report(self, swapped: bool, reason: str) -> Dict[str, object]:
+        rows = max(1, self.rows)
+        return {
+            'swapped': swapped,
+            'reason': reason,
+            'step': self.step,
+            'agreement': (self.agree_rows / rows if self.rows else None),
+            'batches': self.batches,
+            'rows': self.rows,
+            'primary_fetch_ms': 1e3 * self.primary_fetch_s
+            / max(1, self.batches),
+            'shadow_fetch_ms': 1e3 * self.shadow_fetch_s
+            / max(1, self.batches),
+        }
 
 
 # --------------------------------------------------------------- engine
@@ -226,6 +314,12 @@ class ServingEngine:
                  tiers: Optional[Sequence[str]] = None,
                  max_delay_ms: Optional[float] = None,
                  decode_workers: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 queue_bound: Optional[int] = None,
+                 canary_batches: Optional[int] = None,
+                 canary_agreement: Optional[float] = None,
+                 param_source=None,
+                 params_step: Optional[int] = None,
                  log=None):
         self.config = config
         self.trainer = trainer
@@ -265,6 +359,28 @@ class ServingEngine:
         self.tiers = tiers
         self.max_delay_s = (max_delay_ms if max_delay_ms is not None
                             else config.SERVING_MAX_DELAY_MS) / 1e3
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else config.SERVING_DEADLINE_MS)
+        # default SLO deadline in seconds; None = no deadline
+        self.deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
+        bound = (queue_bound if queue_bound is not None
+                 else config.SERVING_QUEUE_BOUND)
+        # admission bound in queued rows; None = unbounded (-1), auto (0)
+        # = a few in-flight fills of the top bucket
+        self.queue_bound: Optional[int] = (
+            None if bound < 0 else
+            8 * self.buckets[-1] if bound == 0 else bound)
+        self.canary_batches = (canary_batches
+                               if canary_batches is not None
+                               else config.SERVING_CANARY_BATCHES)
+        self.canary_agreement = (canary_agreement
+                                 if canary_agreement is not None
+                                 else config.SERVING_CANARY_AGREEMENT)
+        self.canary_timeout_s = config.SERVING_CANARY_TIMEOUT_SECS
+        # resolves load_params(step|path) refs and newest_step() polls;
+        # None on engines built from bare params (load_params then only
+        # accepts a params pytree)
+        self._param_source = param_source
         workers = (decode_workers if decode_workers is not None
                    else config.SERVING_DECODE_WORKERS)
         # standalone instruments: stats()/benchmarks read them without
@@ -277,21 +393,50 @@ class ServingEngine:
         self.batches_total = Counter('serving/batches_total')
         self.queue_depth = Gauge('serving/queue_depth')
         self.fill_rate = Gauge('serving/batch_fill_rate')
+        self.shed_total = Counter('serving/shed_total')
+        self.expired_total = Counter('serving/expired_total')
+        self.degraded_total = Counter('serving/degraded_total')
+        self.overload_level_gauge = Gauge('serving/overload_level')
+        self.rollover_total = Counter('serving/rollover_total')
+        self.rollover_rollbacks_total = Counter(
+            'serving/rollover_rollbacks_total')
+        self.rollover_agreement = Gauge('serving/rollover_agreement')
         self.last_dispatch: Optional[Dict[str, int]] = None
-        # submitters, the dispatcher, and close() share the queue state;
-        # _cond wraps _lock, so holding either alias guards the fields
-        # (lock-discipline rule, ANALYSIS.md):
-        # graftlint: guard ServingEngine._queues,_pending_rows,_closed by _lock|_cond
+        # submitters, the dispatcher, decode workers, and close() share
+        # the queue / rollover / overload state; _cond wraps _lock, so
+        # holding either alias guards the fields (lock-discipline rule,
+        # ANALYSIS.md):
+        # graftlint: guard ServingEngine._queues,_pending_rows,_reserved_rows,_closed,_drain,params,_rollover,_params_step,_overload_level,_peak_rows,_service_rows_per_s,_service_window,_service_window_rows by _lock|_cond
         # graftlint: guard ServingEngine._warm by _warm_lock
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[str, collections.deque] = {
             tier: collections.deque() for tier in PREDICT_TIERS}
         self._pending_rows: Dict[str, int] = {t: 0 for t in PREDICT_TIERS}
+        # rows admitted but not yet enqueued (tokenizing on the caller
+        # thread): counted against the bound so concurrent submitters
+        # cannot overshoot it between admission and enqueue
+        self._reserved_rows = 0
         self._closed = False
+        self._drain = False  # close(drain=True) serves the queue first
+        self._rollover: Optional[_Rollover] = None
+        # the retained step the serving params came from (wired by
+        # model.serving_engine() from the restored checkpoint): the
+        # follow-checkpoints baseline, so the first poll doesn't pay a
+        # full restore + canary to re-roll the already-serving step
+        self._params_step: Optional[int] = params_step
+        self._overload_level = 0
+        self._peak_rows = 0
+        # served rows/sec over a sliding window of decode completions —
+        # the drain estimate admission compares against deadlines
+        self._service_rows_per_s = 0.0
+        self._service_window: collections.deque = collections.deque()
+        self._service_window_rows = 0  # sum of rows in _service_window
         self._warm = False
         self._index = None  # attach_index() arms submit_neighbors
         self._warm_lock = threading.Lock()
+        self._follow_thread: Optional[threading.Thread] = None
+        self._follow_stop = threading.Event()
         self._decode_pool = ThreadPoolExecutor(
             max_workers=max(1, workers),
             thread_name_prefix='serving-decode')
@@ -333,6 +478,8 @@ class ServingEngine:
         with self._warm_lock:
             if self._warm:
                 return self
+            with self._lock:
+                params = self.params
             t0 = time.perf_counter()
             programs = 0
             for bucket in self.buckets:
@@ -342,7 +489,7 @@ class ServingEngine:
                         direct=True)
                     for tier in self.tiers:
                         out = self.trainer.predict_step_placed(
-                            self.params, arrays, tier=tier)
+                            params, arrays, tier=tier)
                         jax.block_until_ready(out)
                         programs += 1
             warm_s = time.perf_counter() - t0
@@ -357,19 +504,99 @@ class ServingEngine:
             self._warm = True
         return self
 
+    # ------------------------------------------------------- admission
+    def _shed_locked(self, rows: int, why: str) -> None:
+        """Reject one submission at admission (typed, nothing enqueued)."""
+        self.shed_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter('serving/shed_total').inc()
+        raise EngineOverloaded(
+            'request shed at admission (%s): %d rows, %d rows queued, '
+            'bound %s — retry against another replica or back off'
+            % (why, rows, self._admitted_rows_locked(),
+               self.queue_bound))
+
+    def _admitted_rows_locked(self) -> int:
+        return sum(self._pending_rows.values()) + self._reserved_rows
+
+    def _admit(self, rows: int, tier: str,
+               deadline_s: Optional[float]) -> str:
+        """Admission control for one submission: bound check, drain
+        estimate vs deadline, degradation ladder. Reserves ``rows``
+        against the bound (released on enqueue or failure) and returns
+        the EFFECTIVE tier to serve."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosed('ServingEngine is closed')
+            if faults.maybe_fire('reject_all'):
+                self._shed_locked(rows, 'reject_all drill')
+            admitted = self._admitted_rows_locked()
+            bound = self.queue_bound
+            if bound is not None and admitted + rows > bound:
+                # the bound rejects request PILE-UP, not request size: a
+                # single request larger than the whole bound (submit's
+                # oversize-splitting contract) is admitted alone on an
+                # idle queue — its own size then bounds the queue, and
+                # everything behind it is shed until it drains
+                if rows <= bound or admitted > 0:
+                    self._shed_locked(rows, 'queue bound')
+            if deadline_s is not None and self._service_rows_per_s > 0:
+                drain_s = (admitted + rows) / self._service_rows_per_s
+                if drain_s > deadline_s:
+                    self._shed_locked(
+                        rows, 'drain estimate %.0fms > deadline %.0fms'
+                        % (1e3 * drain_s, 1e3 * deadline_s))
+            if bound is not None:
+                fill = (admitted + rows) / bound
+                level = self._overload_level
+                if fill >= _OVERLOAD_ENTER_2:
+                    level = 2
+                elif fill >= _OVERLOAD_ENTER_1:
+                    level = max(level, 1)
+                elif fill < _OVERLOAD_EXIT:
+                    level = 0
+                if level != self._overload_level:
+                    self._overload_level = level
+                    self.overload_level_gauge.set(level)
+                    if tele_core.enabled():
+                        tele_core.registry().gauge(
+                            'serving/overload_level').set(level)
+            effective = _DEGRADE_LADDER.get(
+                self._overload_level, {}).get(tier, tier)
+            if effective != tier and effective not in self.tiers:
+                effective = tier  # never downgrade onto a cold program
+            if effective != tier:
+                self.degraded_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'serving/degraded_total').inc()
+            self._reserved_rows += rows
+            self._peak_rows = max(self._peak_rows,
+                                  self._admitted_rows_locked())
+            if tele_core.enabled():
+                tele_core.registry().gauge(
+                    'serving/queue_peak_rows').set(self._peak_rows)
+        return effective
+
     # ---------------------------------------------------------- submit
     def submit(self, context_lines: Sequence[str],
-               tier: str = 'topk') -> Future:
+               tier: str = 'topk',
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one prediction request (raw extractor/``.c2v`` context
         lines, like ``model.predict``). Returns a Future resolving to
         one ``ModelPredictionResults`` per line, in order. Requests
-        larger than the top batch bucket are split transparently."""
+        larger than the top batch bucket are split transparently.
+
+        ``deadline_ms`` overrides the engine's default SLO deadline for
+        this request (0 = none): past it the request is shed at
+        admission or expired in the queue with a typed error, never
+        dispatched."""
         if tier not in self.tiers:
             raise ValueError('tier %r is not warmed on this engine '
                              '(tiers=%s)' % (tier, list(self.tiers)))
         # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked under _cond before enqueue below
         if self._closed:
-            raise RuntimeError('ServingEngine is closed')
+            raise EngineClosed('ServingEngine is closed')
         lines = list(context_lines)
         future: Future = Future()
         if not lines:
@@ -378,25 +605,38 @@ class ServingEngine:
         # graftlint: disable=lock-discipline -- benign racy read: warmup() is idempotent and re-checks _warm under _warm_lock
         if not self._warm:
             self.warmup()
-        batch = self.reader.process_input_rows(lines)
-        max_bucket = self.buckets[-1]
         n = len(lines)
-        if n <= max_bucket:
-            requests = [_Request(batch, tier, future=future)]
+        if deadline_ms is None:
+            deadline_s = self.deadline_s
         else:
-            n_chunks = -(-n // max_bucket)
-            aggregate = _Aggregate(future, n_chunks)
-            requests = [
-                _Request(PathContextReader._take_rows(
-                    batch, slice(i * max_bucket, (i + 1) * max_bucket)),
-                    tier, aggregate=aggregate, chunk_idx=i)
-                for i in range(n_chunks)]
+            deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
         self.requests_total.inc()
         if tele_core.enabled():
             tele_core.registry().counter('serving/requests_total').inc()
+        tier = self._admit(n, tier, deadline_s)  # raises typed on shed
+        try:
+            batch = self.reader.process_input_rows(lines)
+            max_bucket = self.buckets[-1]
+            if n <= max_bucket:
+                requests = [_Request(batch, tier, future=future,
+                                     deadline_s=deadline_s)]
+            else:
+                n_chunks = -(-n // max_bucket)
+                aggregate = _Aggregate(future, n_chunks)
+                requests = [
+                    _Request(PathContextReader._take_rows(
+                        batch, slice(i * max_bucket, (i + 1) * max_bucket)),
+                        tier, aggregate=aggregate, chunk_idx=i,
+                        deadline_s=deadline_s)
+                    for i in range(n_chunks)]
+        except BaseException:
+            with self._cond:
+                self._reserved_rows -= n
+            raise
         with self._cond:
+            self._reserved_rows -= n
             if self._closed:
-                raise RuntimeError('ServingEngine is closed')
+                raise EngineClosed('ServingEngine is closed')
             for request in requests:
                 self._queues[tier].append(request)
                 self._pending_rows[tier] += request.rows
@@ -478,6 +718,217 @@ class ServingEngine:
         """Synchronous ``submit_neighbors().result()`` convenience."""
         return self.submit_neighbors(context_or_vectors, k).result(timeout)
 
+    # -------------------------------------------------------- rollover
+    def _check_rollover_clear_locked(self) -> None:
+        if self._closed:
+            raise EngineClosed('ServingEngine is closed')
+        if self._rollover is not None:
+            raise RuntimeError(
+                'a rollover is already in flight (step %s); await '
+                'its handle first' % self._rollover.step)
+
+    def load_params(self, source, canary_batches: Optional[int] = None,
+                    min_agreement: Optional[float] = None) -> Future:
+        """Canaried zero-downtime checkpoint rollover (SERVING.md).
+
+        ``source`` is a retained checkpoint step (int), a model path
+        (str) — both resolved through the engine's param source (wired
+        by ``model.serving_engine()``) — or a placed params pytree.
+        Candidate params must match the serving set's shapes and
+        shardings, so every shadow dispatch reuses the warm ladder:
+        a live rollover compiles NOTHING.
+
+        With ``canary_batches > 0`` (default ``SERVING_CANARY_BATCHES``)
+        the next live micro-batches are shadow-scored against both param
+        sets; the swap happens atomically once top-1 agreement over the
+        canaried rows clears ``min_agreement`` (default
+        ``SERVING_CANARY_AGREEMENT``), else the candidate is dropped.
+        ``canary_batches == 0`` swaps immediately.
+
+        Returns a Future resolving to the rollover report dict
+        (``{'swapped': bool, 'agreement': ..., ...}``); the canary needs
+        live traffic to conclude. Fails with ``EngineClosed`` if the
+        engine closes first."""
+        handle: Future = Future()
+        step: Optional[int] = None
+        with self._cond:
+            # advisory fast-fail before the checkpoint restore below —
+            # a full Orbax read + device placement is too expensive to
+            # spend on a call doomed by a closed engine or an in-flight
+            # rollover; the locked re-check after the load stays
+            # authoritative (the engine can close during the restore)
+            self._check_rollover_clear_locked()
+        if isinstance(source, (int, str)) and not isinstance(source, bool):
+            if self._param_source is None:
+                raise RuntimeError(
+                    'load_params(%r): this engine has no param source — '
+                    'build it via model.serving_engine(), or pass a '
+                    'params pytree' % (source,))
+            if isinstance(source, int):
+                step = source
+            params = self._param_source.load(source)
+        else:
+            params = source
+        n_canary = (canary_batches if canary_batches is not None
+                    else self.canary_batches)
+        floor = (min_agreement if min_agreement is not None
+                 else self.canary_agreement)
+        if n_canary > 0 and all(t == 'vectors' for t in self.tiers):
+            # the canary compares top-1 predictions, which the vectors
+            # tier does not produce: an armed canary would never
+            # conclude and wedge every later rollover
+            raise RuntimeError(
+                'canaried rollover needs a top-k-producing tier warmed '
+                '(tiers=%s are vectors-only); pass canary_batches=0 to '
+                'swap without a canary, or warm a topk tier'
+                % list(self.tiers))
+        report = None
+        with self._cond:
+            self._check_rollover_clear_locked()
+            rollover = _Rollover(params, step, handle, n_canary, floor)
+            if n_canary <= 0:
+                self.params = params
+                if step is not None:
+                    self._params_step = step
+                report = rollover.report(True, 'no canary configured')
+            else:
+                self._rollover = rollover
+        if report is not None:
+            self._count_rollover(True, None)
+            self.log('serving: params swapped without canary (step %s)'
+                     % step)
+            handle.set_result(report)
+        else:
+            self.log('serving: rollover armed (step %s): canarying %d '
+                     'live batches, agreement floor %.2f'
+                     % (step, n_canary, floor))
+        return handle
+
+    def _count_rollover(self, swapped: bool,
+                        agreement: Optional[float]) -> None:
+        if swapped:
+            self.rollover_total.inc()
+        else:
+            self.rollover_rollbacks_total.inc()
+        if agreement is not None:
+            self.rollover_agreement.set(agreement)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.counter('serving/rollover_total' if swapped
+                        else 'serving/rollover_rollbacks_total').inc()
+            if agreement is not None:
+                reg.gauge('serving/rollover_agreement').set(agreement)
+
+    def _observe_canary(self, rollover: _Rollover, agree_rows: int,
+                        rows: int, primary_s: float,
+                        shadow_s: float) -> None:
+        """Tally one shadow-scored batch; decide the rollover once the
+        canary target is reached (decode-worker thread)."""
+        decided = None
+        with self._cond:
+            if self._rollover is not rollover:
+                return  # already decided (or cleared by close)
+            rollover.batches += 1
+            rollover.rows += rows
+            rollover.agree_rows += agree_rows
+            rollover.primary_fetch_s += primary_s
+            rollover.shadow_fetch_s += shadow_s
+            if rollover.batches >= rollover.target_batches:
+                agreement = rollover.agree_rows / max(1, rollover.rows)
+                swapped = agreement >= rollover.min_agreement
+                if swapped:
+                    self.params = rollover.params
+                    if rollover.step is not None:
+                        self._params_step = rollover.step
+                self._rollover = None
+                decided = (swapped, agreement)
+        if decided is not None:
+            swapped, agreement = decided
+            self._count_rollover(swapped, agreement)
+            reason = ('canary passed' if swapped else
+                      'agreement %.3f below floor %.2f'
+                      % (agreement, rollover.min_agreement))
+            self.log('serving: rollover %s (step %s): top-1 agreement '
+                     '%.3f over %d rows in %d batches'
+                     % ('SWAPPED' if swapped else 'ROLLED BACK',
+                        rollover.step, agreement, rollover.rows,
+                        rollover.batches))
+            _resolve(rollover.handle, rollover.report(swapped, reason))
+
+    def _fail_rollover(self, rollover: Optional[_Rollover],
+                       exc: BaseException) -> None:
+        if rollover is None:
+            return
+        with self._cond:
+            if self._rollover is rollover:
+                self._rollover = None
+            elif rollover.handle.done():
+                return
+        if not rollover.handle.done():
+            try:
+                rollover.handle.set_exception(exc)
+            except Exception:
+                pass
+
+    def follow_checkpoints(self, poll_secs: Optional[float] = None
+                           ) -> 'ServingEngine':
+        """Poll the checkpoint store for a newer retained step and roll
+        it in through the canary (``--serve-follow-checkpoints``).
+        Requires the engine's param source; idempotent."""
+        if self._param_source is None:
+            raise RuntimeError('follow_checkpoints needs a param source '
+                               '(build the engine via '
+                               'model.serving_engine())')
+        poll = (poll_secs if poll_secs is not None
+                else self.config.SERVE_FOLLOW_CHECKPOINTS_SECS)
+        if poll <= 0:
+            raise ValueError('follow_checkpoints needs poll_secs > 0 '
+                             '(got %r)' % poll)
+        with self._lock:
+            # check-and-assign under the lock: concurrent calls must not
+            # each see None and start duplicate poller threads (close()
+            # only joins the one stored in _follow_thread)
+            if self._closed:
+                raise EngineClosed('ServingEngine is closed')
+            if self._follow_thread is not None:
+                return self
+            self._follow_thread = threading.Thread(
+                target=self._follow_loop, args=(poll,), daemon=True,
+                name='serving-follow')
+            self._follow_thread.start()
+        return self
+
+    def _follow_loop(self, poll_secs: float) -> None:
+        attempted: Optional[int] = None  # this thread's memory only
+        while not self._follow_stop.wait(poll_secs):
+            try:
+                newest = self._param_source.newest_step()
+                with self._cond:
+                    if self._closed:
+                        return
+                    busy = self._rollover is not None
+                    current = self._params_step
+                if newest is None or busy:
+                    continue
+                if attempted is not None and newest <= attempted:
+                    continue  # don't hot-loop a rolled-back step
+                if current is not None and newest <= current:
+                    continue
+                self.log('serving: follow-checkpoints found step %d; '
+                         'starting canaried rollover' % newest)
+                self.load_params(newest)
+                # marked only once the restore+arm succeeded: a transient
+                # load failure (poll racing an in-progress checkpoint
+                # write, a filesystem blip) leaves the step eligible for
+                # the next poll, while a canary rollback — which resolves
+                # the handle, not this call — still won't be hot-looped
+                attempted = newest
+            except EngineClosed:
+                return
+            except Exception as exc:  # poller must survive blips
+                self.log('serving: follow-checkpoints poll failed: %s'
+                         % exc)
+
     def _set_queue_depth_locked(self) -> None:
         depth = sum(len(q) for q in self._queues.values())
         self.queue_depth.set(depth)
@@ -487,13 +938,38 @@ class ServingEngine:
     # ------------------------------------------------------ dispatcher
     def _dispatch_loop(self) -> None:
         while True:
+            abandoned: List[_Request] = []
             with self._cond:
                 while not self._closed and \
                         not any(self._queues[t] for t in PREDICT_TIERS):
                     self._cond.wait()
+                if self._closed and not self._drain:
+                    # fail-fast close: queued work is going nowhere —
+                    # every undispatched future fails typed below (the
+                    # drain=True path instead falls through and keeps
+                    # serving until the queues are empty)
+                    for t in PREDICT_TIERS:
+                        abandoned.extend(self._queues[t])
+                        self._queues[t].clear()
+                        self._pending_rows[t] = 0
+                    self._set_queue_depth_locked()
                 if self._closed and \
                         not any(self._queues[t] for t in PREDICT_TIERS):
+                    done = True
+                else:
+                    done = False
+            if abandoned or done:
+                for request in abandoned:
+                    request.fail(EngineClosed(
+                        'ServingEngine closed with the request still '
+                        'queued (close(drain=True) serves the queue '
+                        'first)'))
+                if done:
                     return
+                continue
+            with self._cond:
+                if not any(self._queues[t] for t in PREDICT_TIERS):
+                    continue  # raced a drain-close or expiry
                 # serve the tier whose head request has waited longest
                 tier = min(
                     (t for t in PREDICT_TIERS if self._queues[t]),
@@ -507,15 +983,39 @@ class ServingEngine:
                             self._pending_rows[tier] >= max_bucket:
                         break
                     self._cond.wait(remaining)
+                if self._closed and not self._drain:
+                    # a fail-fast close() landed during coalescing:
+                    # the requests being gathered must fail typed at
+                    # the top of the loop, not ride a final dispatch
+                    continue
                 taken: List[_Request] = []
+                expired: List[_Request] = []
                 rows = 0
+                now = time.perf_counter()
                 queue = self._queues[tier]
                 while queue and rows + queue[0].rows <= max_bucket:
                     request = queue.popleft()
+                    if request.t_deadline is not None \
+                            and now >= request.t_deadline:
+                        # expire instead of dispatching dead work: the
+                        # client's SLO already passed while it queued
+                        expired.append(request)
+                        self._pending_rows[tier] -= request.rows
+                        continue
                     taken.append(request)
                     rows += request.rows
                 self._pending_rows[tier] -= rows
                 self._set_queue_depth_locked()
+            for request in expired:
+                self.expired_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'serving/expired_total').inc()
+                request.fail(DeadlineExceeded(
+                    'request expired after %.0fms in queue (SLO '
+                    'deadline %.0fms)'
+                    % (1e3 * (now - request.t_enqueue),
+                       1e3 * (request.t_deadline - request.t_enqueue))))
             if taken:
                 try:
                     self._dispatch_batch(tier, taken, rows)
@@ -541,6 +1041,10 @@ class ServingEngine:
     def _dispatch_batch(self, tier: str, taken: List[_Request],
                         rows: int) -> None:
         t0 = time.perf_counter()
+        if faults.maybe_fire('slow_dispatch'):
+            # deterministic overload: the queue keeps filling while the
+            # dispatcher stalls here, driving shed/expiry/degrade drills
+            time.sleep(faults.SLOW_DISPATCH_SECONDS)
         merged = (taken[0].batch if len(taken) == 1 else
                   PathContextReader._concat([r.batch for r in taken]))
         bucket = pick_bucket(rows, self.buckets)
@@ -552,10 +1056,40 @@ class ServingEngine:
         arrays = mesh_lib.shard_batch(host_arrays, self.mesh,
                                       self.config.SHARD_CONTEXTS,
                                       direct=True)
+        stale = None
+        with self._lock:
+            params = self.params
+            rollover = self._rollover
+            if rollover is not None and self.canary_timeout_s > 0 and \
+                    time.perf_counter() - rollover.t_armed \
+                    >= self.canary_timeout_s:
+                # checked on EVERY tier's dispatches: vectors-only
+                # traffic produces no top-1 comparisons, so a canary
+                # armed on a mixed-tier engine could otherwise wedge
+                # all later rollovers forever
+                self._rollover = None
+                stale, rollover = rollover, None
+        if stale is not None:
+            self._count_rollover(False, None)
+            self.log('serving: rollover ROLLED BACK (step %s): canary '
+                     'timed out after %.0fs with %d/%d batches scored '
+                     '(no top-1-producing traffic?)'
+                     % (stale.step, self.canary_timeout_s,
+                        stale.batches, stale.target_batches))
+            _resolve(stale.handle, stale.report(
+                False, 'canary timed out after %.0fs'
+                % self.canary_timeout_s))
         # async dispatch: returns with device futures; the decode pool
         # blocks on them, the dispatcher goes back to coalescing
-        out = self.trainer.predict_step_placed(self.params, arrays,
-                                               tier=tier)
+        out = self.trainer.predict_step_placed(params, arrays, tier=tier)
+        shadow_out = None
+        if rollover is not None and tier != 'vectors':
+            # canary shadow: same arrays, same shapes/shardings — the
+            # warm program is reused, so a live rollover never compiles
+            # (predict programs are never donated: re-feeding `arrays`
+            # is safe)
+            shadow_out = self.trainer.predict_step_placed(
+                rollover.params, arrays, tier=tier)
         dispatch_s = time.perf_counter() - t0
         self.dispatch_timer.record(dispatch_s)
         self.batches_total.inc()
@@ -568,10 +1102,12 @@ class ServingEngine:
             reg.timer('serving/dispatch_ms').record(dispatch_s)
             reg.counter('serving/batches_total').inc()
             reg.gauge('serving/batch_fill_rate').set(rows / bucket)
-        self._decode_pool.submit(self._decode, out, padded, taken)
+        self._decode_pool.submit(self._decode, out, shadow_out, rollover,
+                                 padded, taken)
 
     # ----------------------------------------------------------- decode
-    def _decode(self, out: dict, padded: Batch,
+    def _decode(self, out: dict, shadow_out: Optional[dict],
+                rollover: Optional[_Rollover], padded: Batch,
                 taken: List[_Request]) -> None:
         try:
             t0 = time.perf_counter()
@@ -580,6 +1116,7 @@ class ServingEngine:
             # dispatcher's)
             fetched = {key: np.asarray(value)
                        for key, value in out.items()}
+            fetch_s = time.perf_counter() - t0
             n_rows = sum(request.rows for request in taken)
             results = decode_results(fetched, padded, n_rows,
                                      self.decode_table)
@@ -598,14 +1135,67 @@ class ServingEngine:
                 if tele_core.enabled():
                     tele_core.registry().timer(
                         'serving/latency_ms').record(latency)
+            self._note_service(n_rows, taken)
         except BaseException as exc:
             for request in taken:
                 request.fail(exc)
+            return
+        if shadow_out is not None:
+            # canary tally AFTER the callers got their answers: the
+            # shadow fetch never adds to request latency
+            try:
+                t1 = time.perf_counter()
+                shadow_top = np.asarray(shadow_out['topk_indices'])
+                shadow_s = time.perf_counter() - t1
+                primary_top = fetched['topk_indices']
+                agree = int(np.sum(primary_top[:n_rows, 0]
+                                   == shadow_top[:n_rows, 0]))
+                self._observe_canary(rollover, agree, n_rows,
+                                     fetch_s, shadow_s)
+            except BaseException as exc:
+                self._fail_rollover(rollover, exc)
+
+    def _note_service(self, rows: int, taken: List[_Request]) -> None:
+        """Feed the drain estimate with observed THROUGHPUT: rows
+        delivered over a sliding window of recent batch completions.
+        Unlike rows/sojourn this excludes queue wait (which scales with
+        queue depth and would under-report a deep-but-draining queue by
+        that factor, shedding deadlines the engine could in fact meet)
+        and credits dispatch/decode pipelining; unlike a per-completion
+        inter-arrival rate it aggregates across parallel decode
+        workers, whose near-simultaneous completions would otherwise
+        inflate the estimate by orders of magnitude and admit deadlines
+        the queue cannot meet. Until the window spans a measurable
+        interval (first batch, or right after an idle gap evicted it)
+        the estimate seeds from batch sojourn — biased low, so a shed
+        too many, never a deadline promised and missed."""
+        now = time.perf_counter()
+        with self._lock:
+            window = self._service_window
+            window.append((now, rows))
+            self._service_window_rows += rows
+            horizon = now - _SERVICE_WINDOW_S
+            while len(window) > 1 and window[0][0] < horizon:
+                _t, evicted = window.popleft()
+                self._service_window_rows -= evicted
+            anchor_t, anchor_rows = window[0]
+            span = now - anchor_t
+            if span >= _SERVICE_MIN_SPAN_S:
+                # the anchor's own rows completed AT the span's start —
+                # they represent work done before it and are excluded
+                self._service_rows_per_s = (
+                    (self._service_window_rows - anchor_rows) / span)
+            elif self._service_rows_per_s <= 0:
+                oldest = min(request.t_enqueue for request in taken)
+                self._service_rows_per_s = rows / max(1e-6, now - oldest)
 
     # -------------------------------------------------------- lifecycle
     def stats(self) -> Dict[str, object]:
         """Snapshot of the engine's standalone instruments (latency
         percentiles come from the windowed Timer snapshots)."""
+        with self._lock:
+            peak_rows = self._peak_rows
+            params_step = self._params_step
         return {
             'requests_total': self.requests_total.snapshot(),
             'batches_total': self.batches_total.snapshot(),
@@ -615,21 +1205,52 @@ class ServingEngine:
             'dispatch_ms': self.dispatch_timer.snapshot(),
             'decode_ms': self.decode_timer.snapshot(),
             'last_dispatch': self.last_dispatch,
+            'shed_total': self.shed_total.snapshot(),
+            'expired_total': self.expired_total.snapshot(),
+            'degraded_total': self.degraded_total.snapshot(),
+            'overload_level': self.overload_level_gauge.snapshot(),
+            'queue_peak_rows': peak_rows,
+            'rollover_total': self.rollover_total.snapshot(),
+            'rollover_rollbacks_total':
+                self.rollover_rollbacks_total.snapshot(),
+            'params_step': params_step,
         }
 
-    def close(self) -> None:
-        """Drain pending requests, stop the dispatcher and decode pool.
-        Idempotent."""
+    def close(self, drain: bool = False) -> None:
+        """Stop the engine: new ``submit`` calls raise ``EngineClosed``.
+
+        Default (fail-fast) close fails every still-queued request's
+        future with a typed ``EngineClosed`` — nothing is left
+        unresolved, and this replica stops serving immediately (the
+        micro-batches already dispatched still deliver their results).
+        ``close(drain=True)`` instead serves everything already admitted
+        before stopping. An armed rollover's handle fails with
+        ``EngineClosed`` either way. Idempotent; a second call (any
+        mode) just waits for the first shutdown to finish."""
         with self._cond:
-            if self._closed:
-                already = True
-            else:
-                already = False
+            already = self._closed
+            if not already:
                 self._closed = True
+                self._drain = drain
+            rollover, self._rollover = self._rollover, None
             self._cond.notify_all()
-        if not already:
-            self._dispatcher.join()
-            self._decode_pool.shutdown(wait=True)
+        self._follow_stop.set()
+        if rollover is not None and not rollover.handle.done():
+            try:
+                rollover.handle.set_exception(EngineClosed(
+                    'ServingEngine closed mid-canary (step %s)'
+                    % rollover.step))
+            except Exception:
+                pass
+        # every closer (not just the first) joins: a concurrent second
+        # close() must not return while the dispatcher/decode workers
+        # are still draining (join and shutdown(wait=True) are both
+        # safe to call from multiple threads)
+        follow = self._follow_thread
+        if follow is not None:
+            follow.join()
+        self._dispatcher.join()
+        self._decode_pool.shutdown(wait=True)
 
     def __enter__(self) -> 'ServingEngine':
         return self

@@ -1,0 +1,61 @@
+"""Typed errors of the serving resilience layer (SERVING.md "Overload &
+rollover runbook"; ROBUSTNESS.md serving pillar).
+
+Every way a serving request can fail WITHOUT a model answer has a named
+type here, so callers can route on it (shed -> retry elsewhere with
+backoff; expired -> drop, the client already timed out; closed -> this
+replica is going away) instead of string-matching RuntimeError text.
+
+Hierarchy notes:
+
+- the engine-side errors subclass ``RuntimeError``: pre-resilience
+  callers that caught ``RuntimeError`` around ``submit`` keep working;
+- the extractor-side errors subclass ``ValueError``: the REPL loop's
+  "extraction errors are user-recoverable" contract
+  (serving/predict.py) catches ``ValueError``, and these must ride that
+  path — an unavailable extractor re-prompts instead of killing the
+  shell.
+"""
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base of the serving engine's typed request failures."""
+
+
+class EngineClosed(ServingError):
+    """The engine is shut down (or closing): the request was rejected at
+    submit, or its future was failed by a non-draining ``close()``.
+    Clients should fail over to another replica."""
+
+
+class EngineOverloaded(ServingError):
+    """Admission control shed this request: the bounded queue is full,
+    the drain estimate exceeds the request's deadline, or a
+    ``reject_all`` fault drill is armed. Nothing was enqueued — retry
+    against another replica or with client-side backoff."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request was admitted but its SLO deadline passed while it
+    waited in the queue; it was expired instead of dispatching work the
+    client has already given up on."""
+
+
+class ExtractorError(ValueError):
+    """Base of the extractor bridge's typed failures (a ``ValueError``
+    so the REPL's recoverable-error contract holds)."""
+
+
+class ExtractorCrash(ExtractorError):
+    """One extractor invocation failed for an infrastructure reason —
+    spawn failure, nonzero/signal exit, or per-call timeout — as opposed
+    to a clean "no paths in this input" outcome. Retried by
+    ``ExtractorPool``; counted against its circuit breaker."""
+
+
+class ExtractorUnavailable(ExtractorError):
+    """The extractor circuit breaker is OPEN: recent calls crashed
+    consecutively past the threshold, so the pool fails fast (no
+    subprocess spawn, no timeout wait) until the cooldown elapses and a
+    half-open probe succeeds."""

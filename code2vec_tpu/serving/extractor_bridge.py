@@ -10,16 +10,37 @@ hash→string dict used to display attention paths (:40-49).
 The extractor command is pluggable: the native C++ extractor shipped with
 this framework (``extractor/build/c2v-extract``), a reference-compatible JAR,
 or anything flag-compatible with them.
+
+Hardened for serving traffic (SERVING.md "Overload & rollover runbook"):
+
+- every invocation carries a **timeout** (``EXTRACTOR_TIMEOUT_SECS``,
+  ``--extractor-timeout``) — a wedged JVM/parser kills the call, not the
+  caller — and failures surface the child's stderr;
+- infrastructure failures (spawn, nonzero/signal exit, timeout) raise the
+  typed ``ExtractorCrash``, distinct from the clean "no paths in this
+  input" ``ValueError`` — only the former is worth retrying;
+- ``ExtractorPool`` runs calls on persistent worker threads with bounded
+  concurrency, retry-with-backoff on crash, and a circuit breaker that
+  fails fast (``ExtractorUnavailable``) while the extractor is known-bad,
+  instead of stacking doomed subprocess spawns under load.
 """
 from __future__ import annotations
 
 import os
 import shutil
 import subprocess
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 from code2vec_tpu import common
 from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.serving.errors import (ExtractorCrash,
+                                         ExtractorUnavailable)
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry.core import Counter, Gauge
 
 _NATIVE_EXTRACTOR_CANDIDATES = (
     os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -42,13 +63,27 @@ def find_default_extractor() -> Optional[List[str]]:
     return None
 
 
+def _stderr_of(proc_or_exc) -> str:
+    """Best-effort stderr text from a CompletedProcess or a
+    TimeoutExpired (whose captured output may be bytes or None)."""
+    stderr = getattr(proc_or_exc, 'stderr', None)
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode('utf-8', 'replace')
+    return (stderr or '').strip()
+
+
 class Extractor:
     def __init__(self, config: Config,
                  extractor_command: Optional[List[str]] = None,
-                 max_path_length: int = 8, max_path_width: int = 2):
+                 max_path_length: int = 8, max_path_width: int = 2,
+                 timeout_secs: Optional[float] = None):
         self.config = config
         self.max_path_length = max_path_length
         self.max_path_width = max_path_width
+        # 0 disables (debugger-friendly); the config default bounds every
+        # serving-path call so a wedged extractor cannot hang the caller
+        self.timeout_secs = (timeout_secs if timeout_secs is not None
+                             else config.EXTRACTOR_TIMEOUT_SECS)
         self.command = extractor_command or find_default_extractor()
         if self.command is None:
             raise RuntimeError(
@@ -61,27 +96,40 @@ class Extractor:
 
         Returns (prediction-ready context lines with hashed paths,
         hash→path-string dict for display) — reference extractor.py:12-49.
+        Raises ``ExtractorCrash`` on spawn/exit/timeout failures (stderr
+        included) and plain ``ValueError`` when the input simply yields
+        no paths.
         """
         command = self.command + [
             '--max_path_length', str(self.max_path_length),
             '--max_path_width', str(self.max_path_width),
             '--file', input_path, '--no_hash']
+        timeout = self.timeout_secs if self.timeout_secs > 0 else None
         try:
-            proc = subprocess.run(command, capture_output=True, text=True)
+            proc = subprocess.run(command, capture_output=True, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired as e:
+            stderr = _stderr_of(e)
+            raise ExtractorCrash(
+                'extractor %r timed out after %gs on `%s`%s'
+                % (self.command, timeout, input_path,
+                   ': ' + stderr if stderr else ''))
         except OSError as e:
-            # surfaced as ValueError so the REPL loop reports and continues
-            raise ValueError('failed to run extractor %r: %s'
-                             % (self.command, e))
+            raise ExtractorCrash('failed to run extractor %r: %s'
+                                 % (self.command, e))
         if proc.returncode != 0:
-            raise ValueError(proc.stderr.strip()
-                             or 'extractor failed with code %d'
-                             % proc.returncode)
+            stderr = _stderr_of(proc)
+            raise ExtractorCrash(
+                stderr or 'extractor failed with code %d' % proc.returncode)
         output_lines = [line for line in proc.stdout.splitlines()
                         if line.strip()]
         if not output_lines:
+            # a clean run with no extractable methods is a CONTENT error
+            # (bad input file), not an extractor failure: never retried,
+            # never counted against the circuit breaker
             raise ValueError('cannot extract any paths from the input file'
-                             + (': ' + proc.stderr.strip()
-                                if proc.stderr.strip() else ''))
+                             + (': ' + _stderr_of(proc)
+                                if _stderr_of(proc) else ''))
 
         # keyed by the DECIMAL STRING of the hash: attention contexts come
         # back from the model as strings (reference extractor.py:32-33)
@@ -105,3 +153,196 @@ class Extractor:
             result.append(method_name + ' ' + ' '.join(hashed_contexts)
                           + padding)
         return result, hash_to_string
+
+
+# breaker-state gauge encoding (serving/breaker_state)
+_CLOSED, _HALF_OPEN, _OPEN = 0, 1, 2
+_STATE_NAMES = {_CLOSED: 'closed', _HALF_OPEN: 'half-open', _OPEN: 'open'}
+
+
+class ExtractorPool:
+    """Persistent pooled extractor workers for raw-source serving
+    traffic: bounded concurrency, per-call timeout (via ``Extractor``),
+    retry-with-backoff on crash, and a circuit breaker.
+
+    Breaker protocol (the classic three states):
+
+    - **closed** — calls flow; ``EXTRACTOR_BREAKER_THRESHOLD``
+      consecutive crashed calls (each already retried
+      ``EXTRACTOR_RETRIES`` times) trip it open;
+    - **open** — every call fails fast with ``ExtractorUnavailable``
+      (no subprocess spawn, no timeout wait) until
+      ``EXTRACTOR_BREAKER_COOLDOWN_SECS`` elapses;
+    - **half-open** — ONE probe call runs for real (concurrent calls
+      keep failing fast); success closes the breaker, failure re-opens
+      it and restarts the cooldown.
+
+    Thread-safe; ``submit`` returns a Future, ``extract_paths`` is the
+    sync convenience. Use as a context manager or call ``close()``.
+    """
+
+    # workers, callers, and the breaker transition race on this state
+    # (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard ExtractorPool._state,_failures,_opened_at,_probing by _lock
+    def __init__(self, config: Config,
+                 extractor_command: Optional[List[str]] = None,
+                 workers: Optional[int] = None, log=None, **extractor_kw):
+        self.config = config
+        self.log = log if log is not None else (lambda msg: None)
+        self.extractor = Extractor(config, extractor_command,
+                                   **extractor_kw)
+        self.retries = config.EXTRACTOR_RETRIES
+        self.backoff_secs = config.EXTRACTOR_BACKOFF_SECS
+        self.breaker_threshold = config.EXTRACTOR_BREAKER_THRESHOLD
+        self.breaker_cooldown_secs = config.EXTRACTOR_BREAKER_COOLDOWN_SECS
+        self.retries_total = Counter('serving/extractor_retries_total')
+        self.breaker_open_total = Counter('serving/breaker_open_total')
+        self.breaker_state = Gauge('serving/breaker_state')
+        self._lock = threading.Lock()
+        self._state = _CLOSED
+        self._failures = 0        # consecutive crashed calls
+        self._opened_at = 0.0
+        self._probing = False     # a half-open probe is in flight
+        workers = (workers if workers is not None
+                   else config.EXTRACTOR_POOL_WORKERS)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, workers),
+            thread_name_prefix='extractor')
+
+    # ------------------------------------------------------------ breaker
+    def state(self) -> str:
+        """'closed' | 'half-open' | 'open' (for runbooks/tests)."""
+        with self._lock:
+            return _STATE_NAMES[self._state]
+
+    def _set_state_locked(self, state: int) -> None:
+        self._state = state
+        self.breaker_state.set(state)
+        if tele_core.enabled():
+            tele_core.registry().gauge('serving/breaker_state').set(state)
+
+    def _admit(self) -> Optional[bool]:
+        """Breaker gate for one call: None = rejected (fail fast),
+        False = a normal admitted call, True = this call OWNS the single
+        half-open probe slot. Ownership travels with the call so a
+        straggler admitted while the breaker was still closed can never
+        release (or be judged as) a probe it does not hold."""
+        with self._lock:
+            if self._state == _CLOSED:
+                return False
+            if self._state == _OPEN:
+                if time.monotonic() - self._opened_at \
+                        < self.breaker_cooldown_secs:
+                    return None
+                self._set_state_locked(_HALF_OPEN)
+                self._probing = True
+                return True
+            # half-open: exactly one probe at a time
+            if self._probing:
+                return None
+            self._probing = True
+            return True
+
+    def _on_success(self, probe: bool) -> None:
+        with self._lock:
+            self._failures = 0
+            recovered = False
+            if probe:
+                self._probing = False
+                if self._state != _CLOSED:
+                    recovered = True
+                    self._set_state_locked(_CLOSED)
+        if recovered:
+            self.log('extractor breaker: probe succeeded, closed')
+
+    def _on_crash(self, probe: bool) -> None:
+        with self._lock:
+            self._failures += 1
+            if probe:
+                self._probing = False
+            trip = (probe and self._state == _HALF_OPEN) or \
+                self._failures >= self.breaker_threshold
+            if trip and self._state != _OPEN:
+                self._set_state_locked(_OPEN)
+                self._opened_at = time.monotonic()
+                self.breaker_open_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'serving/breaker_open_total').inc()
+            else:
+                trip = False
+        if trip:
+            self.log('extractor breaker: OPEN after %d consecutive '
+                     'crashes (cooldown %gs)'
+                     % (self.breaker_threshold, self.breaker_cooldown_secs))
+
+    def _release_probe(self, probe: bool) -> None:
+        """Unwind path for exceptions OUTSIDE the crash/content
+        taxonomy (MemoryError, a parsing bug, ...): give the probe slot
+        back without judging the extractor, so one weird error cannot
+        wedge the breaker in half-open forever."""
+        if not probe:
+            return
+        with self._lock:
+            self._probing = False
+
+    # -------------------------------------------------------------- calls
+    def _call(self, input_path: str) -> Tuple[List[str], Dict[str, str]]:
+        probe = self._admit()
+        if probe is None:
+            raise ExtractorUnavailable(
+                'extractor circuit breaker is %s (cooldown %gs after %d '
+                'consecutive crashes); failing fast'
+                % (self.state(), self.breaker_cooldown_secs,
+                   self.breaker_threshold))
+        last_crash: Optional[ExtractorCrash] = None
+        try:
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    self.retries_total.inc()
+                    if tele_core.enabled():
+                        tele_core.registry().counter(
+                            'serving/extractor_retries_total').inc()
+                    time.sleep(self.backoff_secs * (2 ** (attempt - 1)))
+                try:
+                    if faults.maybe_fire('extractor_crash'):
+                        raise ExtractorCrash(
+                            'FAULT_INJECT: injected extractor crash')
+                    out = self.extractor.extract_paths(input_path)
+                except ExtractorCrash as crash:
+                    last_crash = crash
+                    continue
+                except ValueError:
+                    # content error: the extractor itself is healthy
+                    self._on_success(probe)
+                    raise
+                self._on_success(probe)
+                return out
+        except (ExtractorCrash, ValueError):
+            raise
+        except BaseException:
+            self._release_probe(probe)
+            raise
+        self._on_crash(probe)
+        raise last_crash
+
+    def submit(self, input_path: str) -> Future:
+        """Extract on a pool worker; Future of (lines, hash→path)."""
+        return self._pool.submit(self._call, input_path)
+
+    def extract_paths(self, input_path: str,
+                      timeout: Optional[float] = None
+                      ) -> Tuple[List[str], Dict[str, str]]:
+        """Synchronous ``submit().result()`` convenience — drop-in for
+        ``Extractor.extract_paths`` with the pool's resilience."""
+        return self.submit(input_path).result(timeout)
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> 'ExtractorPool':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -109,6 +109,40 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'serving/bulk_examples_per_sec': _m(GAUGE, 'examples/s', 'Streaming '
                                         'bulk predict / embedding-export '
                                         'throughput.'),
+    # ---- serving resilience (admission control / rollover / breaker) ----
+    'serving/shed_total': _m(COUNTER, 'requests', 'Requests rejected at '
+                             'admission (queue bound, drain-estimate vs '
+                             'deadline, or a reject_all drill).'),
+    'serving/expired_total': _m(COUNTER, 'requests', 'Admitted requests '
+                                'expired past their SLO deadline while '
+                                'queued (never dispatched).'),
+    'serving/degraded_total': _m(COUNTER, 'requests', 'Requests admitted '
+                                 'at a downgraded output tier by the '
+                                 'overload degradation ladder.'),
+    'serving/overload_level': _m(GAUGE, 'level', 'Degradation ladder '
+                                 'state: 0 normal, 1 full->attention, '
+                                 '2 everything->topk.'),
+    'serving/queue_peak_rows': _m(GAUGE, 'rows', 'High-water mark of '
+                                  'admitted rows queued (vs the '
+                                  'admission bound).'),
+    'serving/rollover_total': _m(COUNTER, 'rollovers', 'Live checkpoint '
+                                 'rollovers swapped in (canary passed '
+                                 'or canary disabled).'),
+    'serving/rollover_rollbacks_total': _m(COUNTER, 'rollovers',
+                                           'Canaried rollovers rolled '
+                                           'back (agreement below the '
+                                           'floor).'),
+    'serving/rollover_agreement': _m(GAUGE, 'fraction', 'Top-1 agreement '
+                                     '(candidate vs serving params) '
+                                     'measured by the last canary.'),
+    'serving/breaker_state': _m(GAUGE, 'state', 'Extractor circuit '
+                                'breaker: 0 closed, 1 half-open, '
+                                '2 open.'),
+    'serving/breaker_open_total': _m(COUNTER, 'trips', 'Extractor '
+                                     'circuit-breaker open transitions.'),
+    'serving/extractor_retries_total': _m(COUNTER, 'retries', 'Extractor '
+                                          'pool calls retried after a '
+                                          'crash-class failure.'),
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
                         'build.'),

@@ -1,0 +1,225 @@
+"""Extractor-bridge hardening (serving/extractor_bridge.py, ISSUE 7):
+per-call timeout with stderr surfaced, typed crash-vs-content errors,
+pool retry-with-backoff, and the circuit-breaker drill (injected crashes
+trip open -> fail fast -> half-open recovery). All drills run against
+tiny fake extractor scripts — no JVM, no native build needed."""
+import stat
+import sys
+import time
+
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.serving.errors import (ExtractorCrash, ExtractorError,
+                                         ExtractorUnavailable)
+from code2vec_tpu.serving.extractor_bridge import Extractor, ExtractorPool
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plan():
+    faults.configure('')
+    yield
+    faults.configure('')
+
+
+def _script(tmp_path, name, body):
+    """An executable fake-extractor python script; returns its command."""
+    path = tmp_path / name
+    path.write_text('#!/usr/bin/env python3\n' + body)
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return [sys.executable, str(path)]
+
+
+OK_BODY = "print('get|name a,somePath,b c,otherPath,d')\n"
+
+
+def _config(**overrides):
+    defaults = dict(MAX_CONTEXTS=6, EXTRACTOR_BACKOFF_SECS=0.0)
+    defaults.update(overrides)
+    return Config(**defaults)
+
+
+# ------------------------------------------------------------------ timeout
+def test_wedged_extractor_times_out_typed(tmp_path):
+    """Satellite bugfix: a wedged extractor must fail the CALL (typed,
+    bounded), never hang the caller forever."""
+    command = _script(tmp_path, 'wedge.py',
+                      "import sys, time\n"
+                      "sys.stderr.write('jvm stuck in GC')\n"
+                      "sys.stderr.flush()\n"
+                      "time.sleep(60)\n")
+    extractor = Extractor(_config(EXTRACTOR_TIMEOUT_SECS=0.3),
+                          extractor_command=command)
+    t0 = time.perf_counter()
+    with pytest.raises(ExtractorCrash, match='timed out'):
+        extractor.extract_paths(str(tmp_path / 'T.java'))
+    assert time.perf_counter() - t0 < 10.0  # bounded, not 60s
+
+
+def test_crash_surfaces_stderr(tmp_path):
+    command = _script(tmp_path, 'crash.py',
+                      "import sys\n"
+                      "sys.stderr.write('boom: parse table corrupt')\n"
+                      "sys.exit(3)\n")
+    extractor = Extractor(_config(), extractor_command=command)
+    with pytest.raises(ExtractorCrash, match='parse table corrupt'):
+        extractor.extract_paths(str(tmp_path / 'T.java'))
+
+
+def test_no_paths_is_content_error_not_crash(tmp_path):
+    command = _script(tmp_path, 'empty.py', "pass\n")
+    extractor = Extractor(_config(), extractor_command=command)
+    with pytest.raises(ValueError) as excinfo:
+        extractor.extract_paths(str(tmp_path / 'T.java'))
+    assert not isinstance(excinfo.value, ExtractorCrash)
+
+
+def test_extract_paths_output_contract(tmp_path):
+    command = _script(tmp_path, 'ok.py', OK_BODY)
+    extractor = Extractor(_config(), extractor_command=command)
+    lines, path_unhash = extractor.extract_paths(str(tmp_path / 'T.java'))
+    assert len(lines) == 1 and lines[0].startswith('get|name ')
+    assert set(path_unhash.values()) == {'somePath', 'otherPath'}
+
+
+# ---------------------------------------------------------------- pool/retry
+def test_pool_retries_transient_crashes_with_backoff(tmp_path):
+    """First two invocations crash, the third succeeds: retries absorb
+    the blips, the call succeeds, the breaker never trips."""
+    marker = tmp_path / 'attempts'
+    command = _script(
+        tmp_path, 'flaky.py',
+        "import os, sys\n"
+        "path = %r\n"
+        "n = int(open(path).read()) if os.path.exists(path) else 0\n"
+        "open(path, 'w').write(str(n + 1))\n"
+        "if n < 2:\n"
+        "    sys.stderr.write('transient')\n"
+        "    sys.exit(1)\n"
+        "%s" % (str(marker), OK_BODY))
+    with ExtractorPool(_config(EXTRACTOR_RETRIES=2),
+                       extractor_command=command) as pool:
+        lines, _ = pool.extract_paths(str(tmp_path / 'T.java'),
+                                      timeout=30)
+    assert len(lines) == 1
+    assert marker.read_text() == '3'
+    assert pool.retries_total.snapshot() == 2
+    assert pool.state() == 'closed'
+
+
+def test_pool_exhausted_retries_raise_last_crash(tmp_path):
+    command = _script(tmp_path, 'crash.py',
+                      "import sys\n"
+                      "sys.stderr.write('always down')\n"
+                      "sys.exit(1)\n")
+    with ExtractorPool(_config(EXTRACTOR_RETRIES=1,
+                               EXTRACTOR_BREAKER_THRESHOLD=99),
+                       extractor_command=command) as pool:
+        with pytest.raises(ExtractorCrash, match='always down'):
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.retries_total.snapshot() == 1
+
+
+def test_content_error_rides_pool_unretried(tmp_path):
+    command = _script(tmp_path, 'empty.py', "pass\n")
+    with ExtractorPool(_config(EXTRACTOR_RETRIES=3),
+                       extractor_command=command) as pool:
+        with pytest.raises(ValueError) as excinfo:
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert not isinstance(excinfo.value, ExtractorError)
+        assert pool.retries_total.snapshot() == 0  # never retried
+        assert pool.state() == 'closed'            # never counted
+
+
+# ------------------------------------------------------------ breaker drill
+def test_breaker_drill_open_fail_fast_half_open_recovery(tmp_path):
+    """The ISSUE 7 acceptance drill: injected extractor crashes trip the
+    breaker open -> calls fail fast (no subprocess) -> after the
+    cooldown a half-open probe succeeds and closes it again."""
+    command = _script(tmp_path, 'ok.py', OK_BODY)
+    config = _config(EXTRACTOR_RETRIES=0, EXTRACTOR_BREAKER_THRESHOLD=2,
+                     EXTRACTOR_BREAKER_COOLDOWN_SECS=0.3)
+    with ExtractorPool(config, extractor_command=command) as pool:
+        # calls 0 and 1 crash (injected): threshold 2 trips the breaker
+        faults.configure('extractor_crash@call=0..1')
+        for _ in range(2):
+            with pytest.raises(ExtractorCrash, match='FAULT_INJECT'):
+                pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'open'
+        assert pool.breaker_open_total.snapshot() == 1
+        # open: fail fast, typed, and FAST (no spawn, no timeout wait)
+        t0 = time.perf_counter()
+        with pytest.raises(ExtractorUnavailable):
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert time.perf_counter() - t0 < 0.1
+        # cooldown elapses; the half-open probe (fault window passed)
+        # succeeds and closes the breaker
+        time.sleep(0.35)
+        lines, _ = pool.extract_paths(str(tmp_path / 'T.java'),
+                                      timeout=30)
+        assert len(lines) == 1
+        assert pool.state() == 'closed'
+        # healthy again: subsequent calls flow normally
+        pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+
+
+def test_breaker_half_open_failure_reopens(tmp_path):
+    command = _script(tmp_path, 'ok.py', OK_BODY)
+    config = _config(EXTRACTOR_RETRIES=0, EXTRACTOR_BREAKER_THRESHOLD=1,
+                     EXTRACTOR_BREAKER_COOLDOWN_SECS=0.2)
+    with ExtractorPool(config, extractor_command=command) as pool:
+        # crash call 0 (trips open) AND call 1 (the half-open probe)
+        faults.configure('extractor_crash@call=0..1')
+        with pytest.raises(ExtractorCrash):
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'open'
+        time.sleep(0.25)
+        with pytest.raises(ExtractorCrash):  # probe runs, crashes
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'open'        # re-opened
+        assert pool.breaker_open_total.snapshot() == 2
+        time.sleep(0.25)                     # second probe succeeds
+        pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'closed'
+
+
+def test_unexpected_probe_exception_releases_slot(tmp_path):
+    """An exception OUTSIDE the crash/content taxonomy during the
+    half-open probe must release the probe slot (not wedge the breaker
+    half-open forever) without judging the extractor."""
+    command = _script(tmp_path, 'ok.py', OK_BODY)
+    config = _config(EXTRACTOR_RETRIES=0, EXTRACTOR_BREAKER_THRESHOLD=1,
+                     EXTRACTOR_BREAKER_COOLDOWN_SECS=0.2)
+    with ExtractorPool(config, extractor_command=command) as pool:
+        faults.configure('extractor_crash@call=0')
+        with pytest.raises(ExtractorCrash):
+            pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'open'
+        faults.configure('')
+        time.sleep(0.25)
+        real = pool.extractor.extract_paths
+        pool.extractor.extract_paths = lambda path: (_ for _ in ()).throw(
+            RuntimeError('weird'))
+        with pytest.raises(RuntimeError, match='weird'):
+            pool._call(str(tmp_path / 'T.java'))  # the half-open probe
+        pool.extractor.extract_paths = real
+        # the slot was released: the NEXT call claims the probe and
+        # closes the breaker — no permanent half-open wedge
+        pool.extract_paths(str(tmp_path / 'T.java'), timeout=30)
+        assert pool.state() == 'closed'
+
+
+def test_timeout_zero_disables_bound(tmp_path):
+    command = _script(tmp_path, 'ok.py', OK_BODY)
+    extractor = Extractor(_config(EXTRACTOR_TIMEOUT_SECS=0.0),
+                          extractor_command=command)
+    lines, _ = extractor.extract_paths(str(tmp_path / 'T.java'))
+    assert len(lines) == 1
+
+
+def test_spawn_failure_is_crash(tmp_path):
+    extractor = Extractor(
+        _config(), extractor_command=[str(tmp_path / 'does-not-exist')])
+    with pytest.raises(ExtractorCrash, match='failed to run'):
+        extractor.extract_paths(str(tmp_path / 'T.java'))
