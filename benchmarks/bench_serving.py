@@ -97,14 +97,15 @@ def run_naive(model, requests):
     return time.perf_counter() - t0, latencies
 
 
-def run_engine_open_loop(model, requests, tier: str, max_delay_ms: float):
+def run_engine_open_loop(model, requests, tier: str, max_delay_ms: float,
+                         **engine_kw):
     """Submit the whole stream up front; per-request latency is
     submit -> future-done (a done-callback stamps the clock)."""
     done_at = [0.0] * len(requests)
     # queue_bound=-1: the open-loop regime deliberately holds the WHOLE
     # stream in flight; the default (auto) admission bound would shed it
     with model.serving_engine(tiers=(tier,), max_delay_ms=max_delay_ms,
-                              queue_bound=-1) as engine:
+                              queue_bound=-1, **engine_kw) as engine:
         t0 = time.perf_counter()
         submit_at = []
         futures = []
@@ -124,10 +125,10 @@ def run_engine_open_loop(model, requests, tier: str, max_delay_ms: float):
 
 
 def run_engine_closed_loop(model, requests, tier: str, clients: int,
-                           max_delay_ms: float):
+                           max_delay_ms: float, **engine_kw):
     latencies = [[] for _ in range(clients)]
-    with model.serving_engine(tiers=(tier,),
-                              max_delay_ms=max_delay_ms) as engine:
+    with model.serving_engine(tiers=(tier,), max_delay_ms=max_delay_ms,
+                              **engine_kw) as engine:
         def client(idx):
             # closed-loop client: wait for each result before the next
             # submit, so `clients` bounds the in-flight requests
@@ -182,6 +183,11 @@ def main() -> None:
     parser.add_argument('--reps', type=int, default=3,
                         help='repetitions per variant; the best wall '
                              'time is reported (host-jitter control)')
+    parser.add_argument('--trace-dir', default=None,
+                        help='where the full-capture traced arm writes '
+                             'its span log (default: a temp dir); point '
+                             'it somewhere durable to keep the spans '
+                             'for scripts/latency_report.py')
     args = parser.parse_args()
 
     from code2vec_tpu.config import Config
@@ -236,6 +242,61 @@ def main() -> None:
     emit({'metric': 'serving_latency_ms', 'variant': 'engine',
           'p50': p50, 'p99': p99})
     emit({'metric': 'serving_speedup', 'value': naive_s / engine_s})
+
+    # ---- tracing overhead at the DEFAULT sample rate (ISSUE 8): the
+    # engine arm above ran with the config default (tracer armed,
+    # memory-only); an explicit rate-0 arm isolates the tracing cost
+    runner = run_engine_closed_loop if args.closed_loop \
+        else run_engine_open_loop
+    extra = (args.clients,) if args.closed_loop else ()
+    off_runs = [runner(model, requests, args.tier, *extra,
+                       args.max_delay_ms, tracing_sample_rate=0.0)
+                for _ in range(args.reps)]
+    off_s = min(rec[0] for rec in off_runs)
+    emit({'metric': 'serving_requests_per_sec', 'variant': 'engine_untraced',
+          'value': args.requests / off_s})
+    emit({'metric': 'serving_tracing_overhead_pct',
+          'value': round((engine_s - off_s) / off_s * 100, 2),
+          'note': 'engine wall at default TRACING_SAMPLE_RATE vs 0'})
+
+    # ---- full-capture traced arm: span-log-derived latency attribution
+    # (every request retained; scripts/latency_report.py reads the same
+    # file offline)
+    from code2vec_tpu.telemetry.tracing import Tracer
+    scripts_dir = os.path.join(REPO, 'scripts')
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import latency_report
+    trace_dir = args.trace_dir or os.path.join(workdir, 'trace')
+    stale = os.path.join(trace_dir, 'spans.jsonl')
+    if os.path.exists(stale):
+        # the tracer appends; a reused --trace-dir must not blend a
+        # prior run's spans into this run's percentiles
+        os.remove(stale)
+    tracer = Tracer(trace_dir, sample_rate=1.0,
+                    flight_traces=args.requests)
+    traced_s, _lat, _stats = runner(model, requests, args.tier, *extra,
+                                    args.max_delay_ms, tracer=tracer)
+    spans_path = os.path.join(trace_dir, 'spans.jsonl')
+    traces = latency_report.group_traces(
+        latency_report.load_spans(spans_path))
+    roots = sorted(
+        float(entry['root'].get('dur_ms', 0.0))
+        for entry in traces.values() if entry['root'] is not None)
+    emit({'metric': 'serving_latency_ms', 'variant': 'engine_spans',
+          'p50': latency_report.percentile(roots, 0.50),
+          'p99': latency_report.percentile(roots, 0.99),
+          'traces': len(roots), 'spans_path': spans_path})
+    per_phase = {}
+    for (phase, _tier, _bucket), durs in latency_report.phase_rows(
+            traces).items():
+        per_phase.setdefault(phase, []).extend(durs)
+    for phase, durs in sorted(per_phase.items()):
+        durs.sort()
+        emit({'metric': 'serving_phase_ms', 'phase': phase,
+              'count': len(durs),
+              'p50': round(latency_report.percentile(durs, 0.50), 3),
+              'p99': round(latency_report.percentile(durs, 0.99), 3)})
 
 
 if __name__ == '__main__':

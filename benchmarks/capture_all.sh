@@ -90,8 +90,17 @@ BENCH_CONTEXTS=1024 run_stage pallas_ab_c1024 900 \
   python benchmarks/bench_pallas_encode.py
 probe_or_record "after pallas_ab_c1024" || exit 3
 # serving engine A/B (ISSUE 4): naive per-request predict vs the
-# micro-batching engine — on-chip latency p50/p99 + throughput
-run_stage serving 900 python benchmarks/bench_serving.py
+# micro-batching engine — on-chip latency p50/p99 + throughput; the
+# traced arm (ISSUE 8) keeps its span log durable so the per-phase
+# attribution survives the round
+TRACE_DIR=benchmarks/results/serving_trace_${STAMP}
+run_stage serving 900 python benchmarks/bench_serving.py \
+  --trace-dir "${TRACE_DIR}"
+# phase x bucket x tier p50/p95/p99 off the span log (jax-free, cheap)
+if [ -f "${TRACE_DIR}/spans.jsonl" ]; then
+  run_stage serving_latency 120 python scripts/latency_report.py \
+    --spans "${TRACE_DIR}/spans.jsonl" --json
+fi
 probe_or_record "after serving" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline

@@ -26,6 +26,18 @@ def iter_records(path: str):
             if not isinstance(rec, dict):
                 continue
             stage = rec.get('stage')
+            # a durable wedged-tunnel reason record (capture_all.sh's
+            # probe_or_record): surface it EXPLICITLY — a wedged round
+            # must read as a gap with a reason in the bench trajectory,
+            # not as a silently empty file (PRs 4-5 on-chip numbers are
+            # owed to exactly this mode)
+            if 'tpu_unavailable' in rec:
+                yield stage, rec.get('rc'), {
+                    'measure': 'TPU UNAVAILABLE',
+                    'value': rec['tpu_unavailable'],
+                    'attempts': rec.get('attempts'),
+                    'secs': rec.get('secs')}
+                continue
             data = rec.get('data') if isinstance(rec.get('data'), dict) \
                 else (rec if 'stage' not in rec else None)
             # a stage wrapper with null data is a FAILED stage (run_stage
@@ -46,8 +58,10 @@ def main() -> None:
     args = parser.parse_args()
 
     names = sorted(n for n in os.listdir(args.dir) if n.endswith('.jsonl'))
+    wedged_rounds = 0
     for name in names:
         print(f'== {name}')
+        measured = False
         for stage, rc, data in iter_records(os.path.join(args.dir, name)):
             label = (data.get('measure') or data.get('metric')
                      or data.get('probe') or next(iter(data), '?'))
@@ -56,11 +70,22 @@ def main() -> None:
                       if k in ('examples_per_sec', 'unit', 'vs_baseline',
                                'variant', 'devices', 'opt_sharding',
                                'speedup', 'verdict', 'distribution',
-                               'step_ms', 'partition_overhead_vs_1dev')}
+                               'step_ms', 'partition_overhead_vs_1dev',
+                               'attempts', 'phase', 'tier', 'bucket',
+                               'p50', 'p99')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
+            if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):
+                measured = True
             print(f'{prefix} {label}: {value} '
                   + ' '.join(f'{k}={v}' for k, v in extras.items()) + flag)
+        if not measured:
+            wedged_rounds += 1
+            print('  (no measurements this round — an explicit GAP in '
+                  'the bench trajectory, not a skipped capture)')
+    if wedged_rounds:
+        print(f'\n{wedged_rounds}/{len(names)} round(s) produced no '
+              'measurements (wedged tunnel / failed stages above).')
     print('\nDecision rule (PERF.md): a knob flips default only on a '
           '>=2% measured step-time win at the java14m config; ties keep '
           'reference-parity behavior.')

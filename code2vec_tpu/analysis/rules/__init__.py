@@ -14,4 +14,5 @@ from code2vec_tpu.analysis.rules import (  # noqa: F401
     locks,
     metrics_schema,
     recompile_hazard,
+    span_catalog,
 )

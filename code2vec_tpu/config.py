@@ -257,6 +257,24 @@ class Config:
     # LIVE run with no restart — telemetry/trace.py).
     TELEMETRY_TRACE_AT_STEP: int = -1
     TELEMETRY_TRACE_NUM_STEPS: int = 5
+    # ---- per-request serving traces (telemetry/tracing.py) ----
+    # Head-based sample rate in [0, 1] for the serving engine's
+    # per-request span log (OBSERVABILITY.md "Per-request serving
+    # traces"). 0 disables tracing entirely (no spans, no flight
+    # recorder); any shed/expired/degraded/split/closed request, and any
+    # request slower than TRACING_SLOW_MS, is retained regardless of the
+    # rate (tail retention). -1 = UNSET: the TRACING_SAMPLE_RATE
+    # environment variable fills in (same convention as
+    # TELEMETRY_TRACE_AT_STEP), else the 0.01 default.
+    TRACING_SAMPLE_RATE: float = -1.0
+    # Tail-retention latency threshold in milliseconds: completed
+    # requests slower than this are written to the span log even when
+    # head sampling skipped them. 0 disables the latency tail.
+    TRACING_SLOW_MS: float = 250.0
+    # Flight-recorder ring capacity: the last N completed traces
+    # (sampled or not) held for the flight_<event>.jsonl dumps on
+    # overload bursts, canary rollback, breaker open, and close().
+    TRACING_FLIGHT_TRACES: int = 256
     # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
     # Divergence guard: check the windowed losses for NaN/Inf at each
     # log-window sync (zero extra host syncs — the losses come to host
@@ -869,6 +887,22 @@ class Config:
                      for part in str(self.SERVING_WARM_TIERS).split(',')
                      if part.strip())
 
+    @property
+    def tracing_sample_rate(self) -> float:
+        """Resolved head-sampling rate for per-request serving traces:
+        the TRACING_SAMPLE_RATE field when set (>= 0), else the
+        environment variable of the same name, else 0.01 — clamped to
+        [0, 1]."""
+        rate = self.TRACING_SAMPLE_RATE
+        if rate < 0:
+            try:
+                rate = float(os.environ.get('TRACING_SAMPLE_RATE', 0.01))
+            except ValueError:
+                raise ValueError(
+                    'TRACING_SAMPLE_RATE env var must be a float, got %r'
+                    % os.environ.get('TRACING_SAMPLE_RATE'))
+        return max(0.0, min(1.0, rate))
+
     def wire_format_for(self, process_count: int) -> str:
         """The EFFECTIVE batch wire format for a run of ``process_count``
         hosts. Multi-host runs always use 'planes': the packed format's
@@ -985,6 +1019,14 @@ class Config:
         if self.TELEMETRY_TRACE_NUM_STEPS < 1:
             raise ValueError(
                 'config.TELEMETRY_TRACE_NUM_STEPS must be >= 1.')
+        if self.TRACING_SAMPLE_RATE > 1.0:
+            raise ValueError('config.TRACING_SAMPLE_RATE must be in '
+                             '[0, 1] (or < 0 for env/default fallback).')
+        if self.TRACING_SLOW_MS < 0:
+            raise ValueError('config.TRACING_SLOW_MS must be >= 0 '
+                             '(0 disables latency tail retention).')
+        if self.TRACING_FLIGHT_TRACES < 1:
+            raise ValueError('config.TRACING_FLIGHT_TRACES must be >= 1.')
         if self.BATCH_WIRE_FORMAT not in {'planes', 'packed'}:
             raise ValueError("config.BATCH_WIRE_FORMAT must be in "
                              "{'planes', 'packed'}.")

@@ -78,6 +78,7 @@ from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
                                          EngineOverloaded)
 from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry import tracing as tracing_lib
 from code2vec_tpu.telemetry.core import Counter, Gauge, Timer
 from code2vec_tpu.training.trainer import PREDICT_TIERS
 
@@ -197,10 +198,11 @@ class _Aggregate:
     # decode workers race on the chunk slots (lock-discipline rule,
     # ANALYSIS.md):
     # graftlint: guard _Aggregate.parts,left by lock
-    def __init__(self, future: Future, n_chunks: int):
+    def __init__(self, future: Future, n_chunks: int, trace=None):
         self.future = future
         self.parts: List[Optional[list]] = [None] * n_chunks
         self.left = n_chunks
+        self.trace = trace  # the chunks' SHARED trace; finished at join
         self.lock = threading.Lock()
 
     def deliver(self, idx: int, results: list) -> None:
@@ -215,6 +217,11 @@ class _Aggregate:
             for part in done:
                 merged.extend(part)
             _resolve(self.future, merged)
+            if self.trace is not None:
+                self.trace.event('serving.join',
+                                 attrs={'chunks': len(done),
+                                        'rows': len(merged)})
+                self.trace.finish(status='ok')
 
     def fail(self, exc: BaseException) -> None:
         # first failure wins; set_exception on a done future raises
@@ -229,19 +236,26 @@ class _Request:
     """One queue entry: a tokenized chunk of <= max-bucket rows."""
 
     __slots__ = ('batch', 'rows', 'tier', 'future', 'aggregate',
-                 'chunk_idx', 't_enqueue', 't_deadline')
+                 'chunk_idx', 't_enqueue', 't_deadline', 'trace',
+                 'span_parent', 'queue_span')
 
     def __init__(self, batch: Batch, tier: str,
                  future: Optional[Future] = None,
                  aggregate: Optional[_Aggregate] = None,
                  chunk_idx: int = 0,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace=None, span_parent=None):
         self.batch = batch
         self.rows = int(batch.label.shape[0])
         self.tier = tier
         self.future = future
         self.aggregate = aggregate
         self.chunk_idx = chunk_idx
+        # this request's trace (chunks of one oversize submit SHARE it;
+        # span_parent is then the chunk span, phases nest under it)
+        self.trace = trace
+        self.span_parent = span_parent
+        self.queue_span = None  # open serving.queue_wait span
         self.t_enqueue = time.perf_counter()
         # absolute expiry instant on the t_enqueue clock; None = no SLO
         self.t_deadline = (self.t_enqueue + deadline_s
@@ -253,7 +267,34 @@ class _Request:
         else:
             _resolve(self.future, results)
 
+    def finish_trace(self) -> None:
+        """Trace bookkeeping after a successful deliver: chunks close
+        their chunk span (the shared trace finishes at the aggregate
+        join); single requests finish their trace here."""
+        if self.trace is None:
+            return
+        if self.aggregate is not None:
+            if self.span_parent is not None:
+                self.trace.end(self.span_parent)
+        else:
+            self.trace.finish(status='ok')
+
     def fail(self, exc: BaseException) -> None:
+        if self.trace is not None:
+            # every typed-failed future still gets a terminal span with
+            # its reason — no trace is ever truncated by shutdown
+            if isinstance(exc, EngineClosed):
+                self.trace.event('serving.closed',
+                                 parent=self.span_parent,
+                                 attrs={'reason': str(exc)})
+                self.trace.finish(status='closed')
+            elif isinstance(exc, DeadlineExceeded):
+                self.trace.event('serving.expired',
+                                 parent=self.span_parent,
+                                 attrs={'reason': str(exc)})
+                self.trace.finish(status='expired')
+            else:
+                self.trace.finish(status='error', reason=repr(exc))
         if self.aggregate is not None:
             self.aggregate.fail(exc)
         elif not self.future.done():
@@ -320,6 +361,8 @@ class ServingEngine:
                  canary_agreement: Optional[float] = None,
                  param_source=None,
                  params_step: Optional[int] = None,
+                 tracer: Optional[tracing_lib.Tracer] = None,
+                 tracing_sample_rate: Optional[float] = None,
                  log=None):
         self.config = config
         self.trainer = trainer
@@ -435,6 +478,30 @@ class ServingEngine:
         self._warm = False
         self._index = None  # attach_index() arms submit_neighbors
         self._warm_lock = threading.Lock()
+        # per-request tracing (telemetry/tracing.py; OBSERVABILITY.md
+        # "Per-request serving traces"): head-sampled span log + the
+        # always-on flight recorder. rate 0 = no tracer, and every
+        # instrumented site below reduces to one `is not None` check.
+        rate = (tracing_sample_rate if tracing_sample_rate is not None
+                else config.tracing_sample_rate)
+        if tracer is not None:
+            self._tracer: Optional[tracing_lib.Tracer] = tracer
+        elif rate > 0:
+            out_dir = None
+            if getattr(config, 'TELEMETRY_DIR', None) or \
+                    config.is_saving or config.is_loading:
+                # only write span logs where the run already keeps
+                # artifacts; with no such directory the tracer runs
+                # memory-only (ring works, nothing lands in the CWD)
+                from code2vec_tpu.telemetry.stepwatch import telemetry_dir
+                out_dir = telemetry_dir(config)
+            self._tracer = tracing_lib.Tracer(
+                out_dir, sample_rate=rate,
+                slow_ms=config.TRACING_SLOW_MS,
+                flight_traces=config.TRACING_FLIGHT_TRACES,
+                log=self.log)
+        else:
+            self._tracer = None
         self._follow_thread: Optional[threading.Thread] = None
         self._follow_stop = threading.Event()
         self._decode_pool = ThreadPoolExecutor(
@@ -613,35 +680,89 @@ class ServingEngine:
         self.requests_total.inc()
         if tele_core.enabled():
             tele_core.registry().counter('serving/requests_total').inc()
-        tier = self._admit(n, tier, deadline_s)  # raises typed on shed
+        trace = None
+        if self._tracer is not None:
+            trace = self._tracer.begin(
+                'serving.request',
+                attrs={'tier': tier, 'rows': n,
+                       'deadline_ms': (1e3 * deadline_s
+                                       if deadline_s else None)})
+        requested_tier = tier
+        t_admit0 = time.perf_counter()
+        try:
+            tier = self._admit(n, tier, deadline_s)  # raises typed on shed
+        except EngineOverloaded as exc:
+            if trace is not None:
+                trace.event('serving.shed', attrs={'reason': str(exc)})
+                trace.finish(status='shed')
+                self._tracer.note_shed()
+            raise
+        except EngineClosed as exc:
+            if trace is not None:
+                trace.event('serving.closed', attrs={'reason': str(exc)})
+                trace.finish(status='closed')
+            raise
+        t_admit1 = time.perf_counter()
+        if trace is not None:
+            trace.span_at('serving.admission', t_admit0, t_admit1)
+            if tier != requested_tier:
+                trace.event('serving.degraded',
+                            attrs={'requested': requested_tier,
+                                   'effective': tier})
         try:
             batch = self.reader.process_input_rows(lines)
+            if trace is not None:
+                trace.span_at('serving.tokenize', t_admit1,
+                              time.perf_counter())
             max_bucket = self.buckets[-1]
             if n <= max_bucket:
                 requests = [_Request(batch, tier, future=future,
-                                     deadline_s=deadline_s)]
+                                     deadline_s=deadline_s, trace=trace)]
             else:
                 n_chunks = -(-n // max_bucket)
-                aggregate = _Aggregate(future, n_chunks)
-                requests = [
-                    _Request(PathContextReader._take_rows(
-                        batch, slice(i * max_bucket, (i + 1) * max_bucket)),
-                        tier, aggregate=aggregate, chunk_idx=i,
-                        deadline_s=deadline_s)
-                    for i in range(n_chunks)]
-        except BaseException:
+                aggregate = _Aggregate(future, n_chunks, trace=trace)
+                requests = []
+                for i in range(n_chunks):
+                    chunk = PathContextReader._take_rows(
+                        batch, slice(i * max_bucket, (i + 1) * max_bucket))
+                    chunk_span = None
+                    if trace is not None:
+                        chunk_span = trace.span(
+                            'serving.chunk',
+                            attrs={'chunk': i, 'of': n_chunks,
+                                   'rows': int(chunk.label.shape[0])})
+                    requests.append(_Request(
+                        chunk, tier, aggregate=aggregate, chunk_idx=i,
+                        deadline_s=deadline_s, trace=trace,
+                        span_parent=chunk_span))
+        except BaseException as exc:
             with self._cond:
                 self._reserved_rows -= n
+            if trace is not None:
+                trace.finish(status='error', reason=repr(exc))
             raise
         with self._cond:
             self._reserved_rows -= n
             if self._closed:
-                raise EngineClosed('ServingEngine is closed')
-            for request in requests:
-                self._queues[tier].append(request)
-                self._pending_rows[tier] += request.rows
-            self._set_queue_depth_locked()
-            self._cond.notify_all()
+                closed_exc = EngineClosed('ServingEngine is closed')
+            else:
+                closed_exc = None
+                for request in requests:
+                    if request.trace is not None:
+                        request.queue_span = request.trace.span(
+                            'serving.queue_wait',
+                            parent=request.span_parent,
+                            t0=request.t_enqueue)
+                    self._queues[tier].append(request)
+                    self._pending_rows[tier] += request.rows
+                self._set_queue_depth_locked()
+                self._cond.notify_all()
+        if closed_exc is not None:
+            if trace is not None:
+                trace.event('serving.closed',
+                            attrs={'reason': str(closed_exc)})
+                trace.finish(status='closed')
+            raise closed_exc
         return future
 
     def predict(self, context_lines: Sequence[str], tier: str = 'topk',
@@ -810,6 +931,10 @@ class ServingEngine:
             self.rollover_total.inc()
         else:
             self.rollover_rollbacks_total.inc()
+            if self._tracer is not None:
+                # a rollback is a postmortem moment: dump the recent
+                # traces (incl. the canary_shadow tallies) while fresh
+                self._tracer.dump_flight('rollover_rollback')
         if agreement is not None:
             self.rollover_agreement.set(agreement)
         if tele_core.enabled():
@@ -1041,10 +1166,17 @@ class ServingEngine:
     def _dispatch_batch(self, tier: str, taken: List[_Request],
                         rows: int) -> None:
         t0 = time.perf_counter()
-        if faults.maybe_fire('slow_dispatch'):
+        traced = [r for r in taken if r.trace is not None]
+        for request in traced:
+            if request.queue_span is not None:
+                request.trace.end(request.queue_span, t0)
+                request.queue_span = None
+        stalled = faults.maybe_fire('slow_dispatch')
+        if stalled:
             # deterministic overload: the queue keeps filling while the
             # dispatcher stalls here, driving shed/expiry/degrade drills
             time.sleep(faults.SLOW_DISPATCH_SECONDS)
+        t_stall = time.perf_counter()
         merged = (taken[0].batch if len(taken) == 1 else
                   PathContextReader._concat([r.batch for r in taken]))
         bucket = pick_bucket(rows, self.buckets)
@@ -1053,9 +1185,11 @@ class ServingEngine:
             host_arrays, capacity = self._pack_padded(padded, bucket)
         else:
             host_arrays, capacity = padded.device_arrays(), 0
+        t_pack = time.perf_counter()
         arrays = mesh_lib.shard_batch(host_arrays, self.mesh,
                                       self.config.SHARD_CONTEXTS,
                                       direct=True)
+        t_h2d = time.perf_counter()
         stale = None
         with self._lock:
             params = self.params
@@ -1081,7 +1215,17 @@ class ServingEngine:
                 % self.canary_timeout_s))
         # async dispatch: returns with device futures; the decode pool
         # blocks on them, the dispatcher goes back to coalescing
-        out = self.trainer.predict_step_placed(params, arrays, tier=tier)
+        if self._tracer is not None:
+            # bridge into the profiler timeline (OBSERVABILITY.md): the
+            # dispatch shows up as a named host lane next to the
+            # trainer's StepTraceAnnotation scopes in captured traces
+            import jax
+            with jax.profiler.TraceAnnotation('serving/dispatch'):
+                out = self.trainer.predict_step_placed(params, arrays,
+                                                       tier=tier)
+        else:
+            out = self.trainer.predict_step_placed(params, arrays,
+                                                   tier=tier)
         shadow_out = None
         if rollover is not None and tier != 'vectors':
             # canary shadow: same arrays, same shapes/shardings — the
@@ -1090,7 +1234,26 @@ class ServingEngine:
             # is safe)
             shadow_out = self.trainer.predict_step_placed(
                 rollover.params, arrays, tier=tier)
-        dispatch_s = time.perf_counter() - t0
+        t_disp = time.perf_counter()
+        if traced:
+            t_head = min(request.t_enqueue for request in taken)
+            for request in traced:
+                tr, parent = request.trace, request.span_parent
+                tr.span_at('serving.coalesce', t_head, t0, parent=parent,
+                           attrs={'requests': len(taken),
+                                  'overlaps': 'queue_wait'})
+                if stalled:
+                    tr.span_at('serving.stall', t0, t_stall,
+                               parent=parent,
+                               attrs={'fault': 'slow_dispatch'})
+                tr.span_at('serving.pack', t_stall, t_pack, parent=parent,
+                           attrs={'bucket': bucket, 'capacity': capacity,
+                                  'batch_rows': rows, 'tier': tier})
+                tr.span_at('serving.h2d', t_pack, t_h2d, parent=parent)
+                tr.span_at('serving.dispatch', t_h2d, t_disp,
+                           parent=parent,
+                           attrs={'shadow': shadow_out is not None})
+        dispatch_s = t_disp - t0
         self.dispatch_timer.record(dispatch_s)
         self.batches_total.inc()
         self.fill_rate.set(rows / bucket)
@@ -1103,12 +1266,13 @@ class ServingEngine:
             reg.counter('serving/batches_total').inc()
             reg.gauge('serving/batch_fill_rate').set(rows / bucket)
         self._decode_pool.submit(self._decode, out, shadow_out, rollover,
-                                 padded, taken)
+                                 padded, taken, t_disp)
 
     # ----------------------------------------------------------- decode
     def _decode(self, out: dict, shadow_out: Optional[dict],
                 rollover: Optional[_Rollover], padded: Batch,
-                taken: List[_Request]) -> None:
+                taken: List[_Request],
+                t_dispatched: Optional[float] = None) -> None:
         try:
             t0 = time.perf_counter()
             # fetch ONLY the keys the tier produced (np.asarray blocks on
@@ -1125,9 +1289,33 @@ class ServingEngine:
             if tele_core.enabled():
                 tele_core.registry().timer(
                     'serving/decode_ms').record(decode_s)
+            t_fetch = t0 + fetch_s
+            t_decode = t0 + decode_s
             row = 0
             now = time.perf_counter()
             for request in taken:
+                deliver_span = None
+                if request.trace is not None:
+                    # record BEFORE deliver: the aggregate-completing
+                    # chunk finishes the shared trace inside deliver(),
+                    # and spans added after finish are dropped
+                    tr, parent = request.trace, request.span_parent
+                    # device time comes from the EXISTING async fetch
+                    # boundary (the blocking np.asarray above): dispatch
+                    # return -> fetch completion, never a new sync
+                    dev = tr.span_at(
+                        'serving.device_execute',
+                        t_dispatched if t_dispatched is not None else t0,
+                        t_fetch, parent=parent)
+                    tr.span_at('serving.fetch', t0, t_fetch, parent=dev)
+                    tr.span_at('serving.decode', t_fetch, t_decode,
+                               parent=parent)
+                    # deliver opens at decode end, so the wait behind
+                    # earlier requests' sequential deliveries in this
+                    # loop is attributed, not a phase gap
+                    deliver_span = tr.span(
+                        'serving.deliver', parent=parent, t0=t_decode,
+                        attrs={'rows': request.rows})
                 request.deliver(results[row:row + request.rows])
                 row += request.rows
                 latency = now - request.t_enqueue
@@ -1135,6 +1323,9 @@ class ServingEngine:
                 if tele_core.enabled():
                     tele_core.registry().timer(
                         'serving/latency_ms').record(latency)
+                if request.trace is not None:
+                    request.trace.end(deliver_span)
+                    request.finish_trace()
             self._note_service(n_rows, taken)
         except BaseException as exc:
             for request in taken:
@@ -1150,6 +1341,13 @@ class ServingEngine:
                 primary_top = fetched['topk_indices']
                 agree = int(np.sum(primary_top[:n_rows, 0]
                                    == shadow_top[:n_rows, 0]))
+                if self._tracer is not None:
+                    self._tracer.single(
+                        'serving.canary_shadow',
+                        attrs={'step': rollover.step, 'rows': n_rows,
+                               'agree_rows': agree,
+                               'shadow_fetch_ms': 1e3 * shadow_s},
+                        t0=t1, t1=t1 + shadow_s)
                 self._observe_canary(rollover, agree, n_rows,
                                      fetch_s, shadow_s)
             except BaseException as exc:
@@ -1214,6 +1412,8 @@ class ServingEngine:
             'rollover_rollbacks_total':
                 self.rollover_rollbacks_total.snapshot(),
             'params_step': params_step,
+            'tracing': (self._tracer.stats()
+                        if self._tracer is not None else None),
         }
 
     def close(self, drain: bool = False) -> None:
@@ -1251,6 +1451,11 @@ class ServingEngine:
             follow.join()
         self._dispatcher.join()
         self._decode_pool.shutdown(wait=True)
+        if self._tracer is not None:
+            # dispatcher + decode pool have drained: every in-flight
+            # trace is already finished (delivered or typed-failed), so
+            # the close dump is complete, never truncated
+            self._tracer.close()
 
     def __enter__(self) -> 'ServingEngine':
         return self

@@ -186,9 +186,14 @@ class ExtractorPool:
     # graftlint: guard ExtractorPool._state,_failures,_opened_at,_probing by _lock
     def __init__(self, config: Config,
                  extractor_command: Optional[List[str]] = None,
-                 workers: Optional[int] = None, log=None, **extractor_kw):
+                 workers: Optional[int] = None, log=None, tracer=None,
+                 **extractor_kw):
         self.config = config
         self.log = log if log is not None else (lambda msg: None)
+        # optional telemetry/tracing.py Tracer: every pool call then
+        # gets an `extractor.call` span (attempt count, breaker state),
+        # and a breaker-open transition dumps the flight recorder
+        self.tracer = tracer
         self.extractor = Extractor(config, extractor_command,
                                    **extractor_kw)
         self.retries = config.EXTRACTOR_RETRIES
@@ -275,6 +280,9 @@ class ExtractorPool:
             self.log('extractor breaker: OPEN after %d consecutive '
                      'crashes (cooldown %gs)'
                      % (self.breaker_threshold, self.breaker_cooldown_secs))
+            if self.tracer is not None:
+                # the traces leading into the trip are the postmortem
+                self.tracer.dump_flight('breaker_open')
 
     def _release_probe(self, probe: bool) -> None:
         """Unwind path for exceptions OUTSIDE the crash/content
@@ -288,16 +296,27 @@ class ExtractorPool:
 
     # -------------------------------------------------------------- calls
     def _call(self, input_path: str) -> Tuple[List[str], Dict[str, str]]:
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.begin(
+                'extractor.call',
+                attrs={'input': os.path.basename(input_path),
+                       'breaker': self.state()})
         probe = self._admit()
         if probe is None:
-            raise ExtractorUnavailable(
+            exc = ExtractorUnavailable(
                 'extractor circuit breaker is %s (cooldown %gs after %d '
                 'consecutive crashes); failing fast'
                 % (self.state(), self.breaker_cooldown_secs,
                    self.breaker_threshold))
+            if trace is not None:
+                trace.finish(status='unavailable', reason=str(exc))
+            raise exc
         last_crash: Optional[ExtractorCrash] = None
+        attempts = 0
         try:
             for attempt in range(self.retries + 1):
+                attempts = attempt + 1
                 if attempt:
                     self.retries_total.inc()
                     if tele_core.enabled():
@@ -312,18 +331,31 @@ class ExtractorPool:
                 except ExtractorCrash as crash:
                     last_crash = crash
                     continue
-                except ValueError:
+                except ValueError as content:
                     # content error: the extractor itself is healthy
                     self._on_success(probe)
+                    if trace is not None:
+                        trace.root.attrs['attempts'] = attempts
+                        trace.finish(status='content_error',
+                                     reason=str(content))
                     raise
                 self._on_success(probe)
+                if trace is not None:
+                    trace.root.attrs['attempts'] = attempts
+                    trace.finish(status='ok')
                 return out
         except (ExtractorCrash, ValueError):
             raise
-        except BaseException:
+        except BaseException as exc:
             self._release_probe(probe)
+            if trace is not None:
+                trace.finish(status='error', reason=repr(exc))
             raise
         self._on_crash(probe)
+        if trace is not None:
+            trace.root.attrs['attempts'] = attempts
+            trace.root.attrs['breaker_after'] = self.state()
+            trace.finish(status='crash', reason=str(last_crash))
         raise last_crash
 
     def submit(self, input_path: str) -> Future:

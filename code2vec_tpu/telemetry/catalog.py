@@ -170,6 +170,17 @@ CATALOG: Dict[str, Dict[str, str]] = {
     # ---- profiler capture ----
     'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
                                'trace captures completed.'),
+    # ---- per-request serving traces (telemetry/tracing.py) ----
+    'tracing/traces_total': _m(COUNTER, 'traces', 'Per-request serving '
+                               'traces completed (sampled or not).'),
+    'tracing/retained_total': _m(COUNTER, 'traces', 'Traces written to '
+                                 'the span log: head-sampled, or '
+                                 'tail-retained (shed/expired/degraded/'
+                                 'split/closed/slow).'),
+    'tracing/flight_dumps_total': _m(COUNTER, 'dumps', 'Flight-recorder '
+                                     'ring dumps (flight_<event>.jsonl: '
+                                     'overload burst, canary rollback, '
+                                     'breaker open, close).'),
     # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
     'resilience/rewinds_total': _m(COUNTER, 'rewinds', 'Divergence-guard '
                                    'rewinds: non-finite loss windows that '

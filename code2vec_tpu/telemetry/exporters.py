@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -23,12 +24,16 @@ class JsonlExporter:
     their stat fields), so one plotting script reads both streams.
 
     Opens the file per flush (append mode): no long-lived handle to leak,
-    and flushes are infrequent by design.
+    and flushes are infrequent by design.  The append itself is
+    serialized: the trainer flushes from the hot loop while a serving
+    engine (or a test harness) may flush the same exporter concurrently,
+    and interleaved buffered writes would tear records mid-line.
     """
 
     def __init__(self, logdir: str, filename: str = 'metrics.jsonl'):
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
+        self._lock = threading.Lock()
         self.path = os.path.join(logdir, filename)
 
     def flush(self, registry: Registry, step: int) -> None:
@@ -47,8 +52,10 @@ class JsonlExporter:
             lines.append(json.dumps(record))
         if not lines:
             return
-        with open(self.path, 'a') as f:
-            f.write('\n'.join(lines) + '\n')
+        payload = '\n'.join(lines) + '\n'
+        with self._lock:
+            with open(self.path, 'a') as f:
+                f.write(payload)
 
 
 class PrometheusExporter:

@@ -1,0 +1,325 @@
+"""Latency attribution from the serving span log (telemetry/tracing.py).
+
+Reads the flat span records of ``spans.jsonl`` (or any
+``flight_<event>.jsonl`` flight-recorder dump — header lines are
+skipped) and reports:
+
+- **phase x bucket x tier breakdown**: p50/p95/p99 (nearest-rank) and
+  count per span name, keyed by the trace's output tier and the batch
+  bucket it dispatched on;
+- **queue-wait vs device-time decomposition**: where end-to-end latency
+  actually went (the micro-batcher's direct tuning signal:
+  queue-dominated -> lower SERVING_MAX_DELAY_MS / raise buckets;
+  device-dominated -> the model is the bottleneck);
+- **terminal statuses**: how many traces ended ok / shed / expired /
+  closed / error — shed storms and deadline expiries show up here;
+- **top-K slowest traces** as full indented span trees, for the "why is
+  p99 like that" question.
+
+``--perfetto out.json`` converts the spans to the Chrome trace-event
+format, so serving traces open in the same Perfetto/chrome://tracing
+tooling as the ``jax.profiler`` captures that
+``benchmarks/analyze_trace.py`` decomposes.  ``--json`` emits one JSON
+line per phase row for machine consumers (benchmarks/capture_all.sh
+folds these into the capture trajectory).
+
+Usage:
+    python scripts/latency_report.py --spans <dir>/spans.jsonl \
+        [--top 5] [--json] [--perfetto out.json]
+
+Dependency-free (stdlib only), like the rest of the tracing layer.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: span names whose interval overlaps other phases by design (the
+#: coalescing window contains its members' queue_wait); reported, but
+#: excluded from phase-sum / decomposition arithmetic
+OVERLAPPING = frozenset(('serving.coalesce',))
+
+#: the disjoint per-request phase chain, in lifecycle order — these tile
+#: the root span (small scheduler gaps aside), so their sums approximate
+#: end-to-end latency (asserted in tests/test_tracing.py)
+PHASE_CHAIN = (
+    'serving.admission', 'serving.tokenize', 'serving.queue_wait',
+    'serving.stall', 'serving.pack', 'serving.h2d', 'serving.dispatch',
+    'serving.device_execute', 'serving.decode', 'serving.deliver',
+)
+
+
+def load_spans(path: str) -> List[dict]:
+    """Flat span records from a spans.jsonl or flight_<event>.jsonl
+    (flight header lines and garbage lines are skipped)."""
+    records = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and 'name' in rec and 'trace' in rec:
+                records.append(rec)
+    return records
+
+
+def group_traces(records: List[dict]) -> Dict[str, dict]:
+    """trace_id -> {'root': record|None, 'spans': [records]} (spans in
+    file order; the root is the parentless span)."""
+    traces: Dict[str, dict] = {}
+    for rec in records:
+        entry = traces.setdefault(rec['trace'],
+                                  {'root': None, 'spans': []})
+        entry['spans'].append(rec)
+        if rec.get('parent') is None:
+            entry['root'] = rec
+    return traces
+
+
+def percentile(sorted_ms: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (same convention
+    as telemetry.core.Timer.snapshot)."""
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, max(0, int(q * len(sorted_ms))))
+    return sorted_ms[idx]
+
+
+def trace_key(entry: dict) -> Tuple[str, str]:
+    """(tier, bucket) attribution for one trace: tier from the root
+    attrs, bucket from the pack span that dispatched it ('-' for traces
+    that never reached a dispatch: shed/expired/closed)."""
+    root = entry['root'] or {}
+    tier = str((root.get('attrs') or {}).get('tier', '-'))
+    bucket = '-'
+    for rec in entry['spans']:
+        if rec['name'] == 'serving.pack':
+            bucket = str((rec.get('attrs') or {}).get('bucket', '-'))
+            # the pack span also carries the EFFECTIVE tier (post-
+            # degradation); prefer it when present
+            tier = str((rec.get('attrs') or {}).get('tier', tier))
+            break
+    return tier, bucket
+
+
+def phase_rows(traces: Dict[str, dict]
+               ) -> Dict[Tuple[str, str, str], List[float]]:
+    """(phase, tier, bucket) -> ascending list of durations (ms)."""
+    rows: Dict[Tuple[str, str, str], List[float]] = {}
+    for entry in traces.values():
+        tier, bucket = trace_key(entry)
+        for rec in entry['spans']:
+            rows.setdefault((rec['name'], tier, bucket),
+                            []).append(float(rec.get('dur_ms', 0.0)))
+    for durs in rows.values():
+        durs.sort()
+    return rows
+
+
+def _union_ms(spans: List[dict], name: str) -> float:
+    """Total wall-clock covered by the named spans (ms): the union of
+    their [t0, t1] intervals — an oversize request's chunks run their
+    queue waits and device executes CONCURRENTLY, and summing the
+    overlapping durations would over-count by the chunk fan-out."""
+    intervals = sorted((float(r['t0']), float(r['t1']))
+                       for r in spans if r['name'] == name)
+    covered = 0.0
+    end = None
+    for t0, t1 in intervals:
+        if end is None or t0 > end:
+            covered += t1 - t0
+            end = t1
+        elif t1 > end:
+            covered += t1 - end
+            end = t1
+    return covered * 1e3
+
+
+def decomposition(traces: Dict[str, dict]) -> Dict[str, List[float]]:
+    """Per delivered trace: end-to-end, queue-wait, and device-time
+    (ms, ascending) — the queue-vs-device attribution."""
+    out: Dict[str, List[float]] = {'end_to_end': [], 'queue_wait': [],
+                                   'device': [], 'other': []}
+    for entry in traces.values():
+        root = entry['root']
+        if root is None or root.get('status') not in (None, 'ok'):
+            continue
+        total = float(root.get('dur_ms', 0.0))
+        queue = _union_ms(entry['spans'], 'serving.queue_wait')
+        device = _union_ms(entry['spans'], 'serving.device_execute')
+        out['end_to_end'].append(total)
+        out['queue_wait'].append(queue)
+        out['device'].append(device)
+        out['other'].append(max(0.0, total - queue - device))
+    for values in out.values():
+        values.sort()
+    return out
+
+
+def status_counts(traces: Dict[str, dict]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for entry in traces.values():
+        root = entry['root']
+        status = root.get('status', '?') if root else '?'
+        counts[status] = counts.get(status, 0) + 1
+    return counts
+
+
+def format_tree(entry: dict) -> List[str]:
+    """Indented span-tree lines for one trace (children under parents,
+    by span id)."""
+    spans = sorted(entry['spans'], key=lambda r: (r['t0'], r['span']))
+    children: Dict[Optional[int], List[dict]] = {}
+    for rec in spans:
+        children.setdefault(rec.get('parent'), []).append(rec)
+    lines: List[str] = []
+
+    def walk(rec: dict, depth: int) -> None:
+        attrs = rec.get('attrs') or {}
+        extra = ' '.join('%s=%s' % (k, v) for k, v in sorted(
+            attrs.items()) if k not in ('reason',))
+        reason = attrs.get('reason') or rec.get('attrs', {}).get('reason')
+        lines.append('  %s%-28s %9.2fms%s%s'
+                     % ('  ' * depth, rec['name'],
+                        float(rec.get('dur_ms', 0.0)),
+                        ('  [' + extra + ']') if extra else '',
+                        ('  reason: ' + str(reason)) if reason else ''))
+        for child in children.get(rec['span'], ()):
+            walk(child, depth + 1)
+
+    for root in children.get(None, ()):
+        walk(root, 0)
+    return lines
+
+
+def to_perfetto(traces: Dict[str, dict]) -> List[dict]:
+    """Chrome trace-event ('X' complete events) conversion: one tid lane
+    per trace, microsecond timestamps rebased to the earliest span."""
+    t_min = min((rec['t0'] for entry in traces.values()
+                 for rec in entry['spans']), default=0.0)
+    events = []
+    for lane, (trace_id, entry) in enumerate(sorted(traces.items()), 1):
+        tier, bucket = trace_key(entry)
+        for rec in entry['spans']:
+            attrs = dict(rec.get('attrs') or {})
+            attrs['trace'] = trace_id
+            if rec.get('status'):
+                attrs['status'] = rec['status']
+            events.append({
+                'name': rec['name'],
+                'cat': 'tier:%s,bucket:%s' % (tier, bucket),
+                'ph': 'X',
+                'ts': (rec['t0'] - t_min) * 1e6,
+                'dur': max(0.0, (rec['t1'] - rec['t0']) * 1e6),
+                'pid': 1,
+                'tid': lane,
+                'args': attrs,
+            })
+    return events
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='p50/p95/p99 latency attribution from a serving '
+                    'span log')
+    parser.add_argument('--spans', required=True,
+                        help='spans.jsonl or flight_<event>.jsonl path')
+    parser.add_argument('--top', type=int, default=5,
+                        help='slowest span trees to print (0 = none)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit machine-readable JSON lines instead '
+                             'of the table')
+    parser.add_argument('--perfetto', default=None, metavar='OUT.json',
+                        help='also write a Chrome-trace/Perfetto file')
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.spans):
+        print('no span log at %s' % args.spans, file=sys.stderr)
+        return 1
+    records = load_spans(args.spans)
+    traces = group_traces(records)
+    if not traces:
+        print('no traces in %s' % args.spans, file=sys.stderr)
+        return 1
+
+    rows = phase_rows(traces)
+    statuses = status_counts(traces)
+    decomp = decomposition(traces)
+
+    if args.json:
+        print(json.dumps({'measure': 'trace_statuses', 'value': statuses,
+                          'traces': len(traces)}))
+        for (phase, tier, bucket), durs in sorted(rows.items()):
+            print(json.dumps({
+                'measure': 'phase_latency_ms', 'phase': phase,
+                'tier': tier, 'bucket': bucket, 'count': len(durs),
+                'p50': round(percentile(durs, 0.50), 3),
+                'p95': round(percentile(durs, 0.95), 3),
+                'p99': round(percentile(durs, 0.99), 3),
+            }))
+        for part, values in sorted(decomp.items()):
+            if not values:
+                continue
+            print(json.dumps({
+                'measure': 'latency_decomposition_ms', 'part': part,
+                'count': len(values),
+                'p50': round(percentile(values, 0.50), 3),
+                'p99': round(percentile(values, 0.99), 3),
+            }))
+    else:
+        print('== %d trace(s) from %s' % (len(traces), args.spans))
+        print('statuses: ' + ', '.join('%s=%d' % kv
+                                       for kv in sorted(statuses.items())))
+        print()
+        print('%-26s %-10s %-7s %6s %9s %9s %9s'
+              % ('phase', 'tier', 'bucket', 'count', 'p50_ms',
+                 'p95_ms', 'p99_ms'))
+        for (phase, tier, bucket), durs in sorted(rows.items()):
+            print('%-26s %-10s %-7s %6d %9.2f %9.2f %9.2f'
+                  % (phase, tier, bucket, len(durs),
+                     percentile(durs, 0.50), percentile(durs, 0.95),
+                     percentile(durs, 0.99)))
+        if decomp['end_to_end']:
+            print()
+            print('decomposition over %d delivered trace(s):'
+                  % len(decomp['end_to_end']))
+            for part in ('end_to_end', 'queue_wait', 'device', 'other'):
+                values = decomp[part]
+                print('  %-12s p50 %9.2fms  p99 %9.2fms'
+                      % (part, percentile(values, 0.50),
+                         percentile(values, 0.99)))
+        if args.top > 0:
+            slowest = sorted(
+                (entry for entry in traces.values()
+                 if entry['root'] is not None),
+                key=lambda e: float(e['root'].get('dur_ms', 0.0)),
+                reverse=True)[:args.top]
+            for entry in slowest:
+                root = entry['root']
+                print()
+                print('trace %s  status=%s  %0.2fms'
+                      % (root['trace'], root.get('status', '?'),
+                         float(root.get('dur_ms', 0.0))))
+                for line in format_tree(entry):
+                    print(line)
+
+    if args.perfetto:
+        events = to_perfetto(traces)
+        with open(args.perfetto, 'w') as f:
+            json.dump({'traceEvents': events,
+                       'displayTimeUnit': 'ms'}, f)
+        print('perfetto trace (%d events) -> %s'
+              % (len(events), args.perfetto),
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

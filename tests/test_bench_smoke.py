@@ -169,3 +169,29 @@ def test_last_known_good_prefers_filename_stamp_over_mtime(tmp_path):
     os.utime(results / 'capture_2026-07-30T0100Z_new.jsonl', (older, older))
     got = bench._last_known_good(str(results))
     assert got['value'] == 222.0
+
+
+def test_summarize_captures_folds_tpu_unavailable_reasons(tmp_path):
+    """ISSUE 8 satellite: wedged rounds must show up in the bench
+    trajectory as EXPLICIT gaps with their reason record, not as
+    silently empty files."""
+    (tmp_path / 'capture_wedged.jsonl').write_text(
+        '{"stage": "probe", "tpu_unavailable": '
+        '"probe failed 3/3 attempts (before any stage)", '
+        '"attempts": 3, "secs": 95}\n')
+    (tmp_path / 'capture_ok.jsonl').write_text(
+        '{"stage": "bench", "rc": 0, "secs": 60, '
+        '"data": {"measure": "examples_per_sec", "value": 24948}}\n')
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, 'benchmarks', 'summarize_captures.py'),
+         '--dir', str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    assert 'TPU UNAVAILABLE' in out
+    assert 'probe failed 3/3 attempts (before any stage)' in out
+    assert 'no measurements this round' in out
+    assert '1/2 round(s) produced no measurements' in out
+    # the healthy round still reads normally
+    assert 'examples_per_sec: 24948' in out

@@ -448,6 +448,72 @@ def test_taint_analysis_is_cached_per_file(tmp_path):
     assert taint.analyze_file(source) is taint.analyze_file(source)
 
 
+# --------------------------------------------------------- span-catalog
+SEEDED_SPAN = '''
+def serve(tracer):
+    trace = tracer.begin('serving.bogus_phase')
+    trace.span_at('serving.also_bogus', 0.0, 1.0)
+    trace.finish()
+'''
+
+
+def test_span_catalog_fires_on_uncataloged_span(tmp_path):
+    report = lint(tmp_path, SEEDED_SPAN, ['span-catalog'])
+    messages = [f.message for f in by_rule(report, 'span-catalog')]
+    assert any('serving.bogus_phase' in m for m in messages), messages
+    assert any('serving.also_bogus' in m for m in messages), messages
+
+
+def test_span_catalog_quiet_on_cataloged_names(tmp_path):
+    code = SEEDED_SPAN.replace('serving.bogus_phase', 'serving.request') \
+                      .replace('serving.also_bogus', 'serving.pack')
+    report = lint(tmp_path, code, ['span-catalog'])
+    # the synthetic module itself is clean (the tree-wide stale-entry /
+    # doc findings attach to the catalog file and the doc, not pkg/)
+    offending = [f for f in by_rule(report, 'span-catalog')
+                 if f.file.startswith('pkg')]
+    assert not offending, offending
+
+
+def test_span_catalog_flags_stale_unwired_entries(tmp_path):
+    # a tree that wires nothing: every cataloged span is a stale entry
+    report = lint(tmp_path, 'X = 1\n', ['span-catalog'])
+    messages = [f.message for f in by_rule(report, 'span-catalog')]
+    assert any('no emission site' in m and 'serving.request' in m
+               for m in messages), messages
+
+
+def test_span_catalog_doc_coverage(tmp_path):
+    from code2vec_tpu.telemetry.tracing import SPAN_CATALOG
+    # doc names every span -> no 'undocumented' findings; drop one name
+    # -> exactly that finding appears
+    full_doc = '\n'.join(SPAN_CATALOG)
+    report = lint(tmp_path, 'X = 1\n', ['span-catalog'],
+                  extra_files={'OBSERVABILITY.md': full_doc})
+    assert not any('undocumented' in f.message
+                   for f in by_rule(report, 'span-catalog'))
+    partial = full_doc.replace('serving.device_execute', '')
+    report = lint(tmp_path, 'X = 1\n', ['span-catalog'],
+                  extra_files={'OBSERVABILITY.md': partial})
+    undocumented = [f.message for f in by_rule(report, 'span-catalog')
+                    if 'undocumented' in f.message]
+    assert undocumented == ["cataloged span 'serving.device_execute' "
+                            'is undocumented'], undocumented
+
+
+def test_span_catalog_ignores_non_dotted_and_variable_names(tmp_path):
+    # threading.Event()/argparse-ish calls and variable-name forwarding
+    # must not count as span sites
+    code = ('def f(trace, name, evt):\n'
+            "    evt.begin('not_dotted')\n"
+            '    trace.span(name)\n'
+            "    d = {}.get('a/b')\n")
+    report = lint(tmp_path, code, ['span-catalog'])
+    offending = [f for f in by_rule(report, 'span-catalog')
+                 if f.file.startswith('pkg')]
+    assert not offending, offending
+
+
 # ------------------------------------------------- suppression mechanics
 def test_suppression_with_reason_silences(tmp_path):
     code = SEEDED_DONATION.replace(
@@ -594,7 +660,7 @@ def test_every_rule_is_registered_and_documented():
     names = {rule.name for rule in all_rules()}
     assert {'recompile-hazard', 'host-sync', 'donation-safety',
             'jit-purity', 'lock-discipline', 'config-knob-docs',
-            'metrics-schema', 'fault-points'} <= names
+            'metrics-schema', 'fault-points', 'span-catalog'} <= names
     with open(os.path.join(REPO, 'ANALYSIS.md')) as f:
         doc = f.read()
     for name in sorted(names):

@@ -352,3 +352,75 @@ def test_fit_phase_breakdown_tiny_corpus(tmp_path):
     core.enable()  # as a live telemetry run would be
     TokenCache.build_or_load(config, model.vocabs, reader)
     assert core.registry().counter('input/cache_hit_total').value >= 1
+
+
+# --------------------------------------- ISSUE 8: concurrency coverage
+def test_trace_controller_touch_during_active_capture_defers(
+        tmp_path, monkeypatch):
+    """A TRACE_NOW touched while a capture is ALIVE must not try to nest
+    (jax.profiler cannot); it stays on disk and arms the next window."""
+    fake = _FakeProfiler(monkeypatch)
+    ctl = TraceController(str(tmp_path), trace_at_step=-1, num_steps=4,
+                          poll_every=1)
+    (tmp_path / 'TRACE_NOW').touch()
+    ctl.maybe_update(0)  # consume + start; active through step 3
+    assert [c[0] for c in fake.calls] == ['start']
+    (tmp_path / 'TRACE_NOW').touch()  # touched mid-capture
+    ctl.maybe_update(1)
+    ctl.maybe_update(2)
+    assert [c[0] for c in fake.calls] == ['start'], 'nested start'
+    ctl.maybe_update(4)  # window over: stop
+    assert [c[0] for c in fake.calls] == ['start', 'stop']
+    # the deferred touch arms the NEXT window and is consumed exactly once
+    ctl.maybe_update(5)
+    assert [c[0] for c in fake.calls] == ['start', 'stop', 'start']
+    assert not (tmp_path / 'TRACE_NOW').exists()
+
+
+def test_trace_controller_touch_consumed_exactly_once(
+        tmp_path, monkeypatch):
+    """One touch = one capture: after the armed window starts, later
+    poll steps must not re-start from the same (deleted) touch file."""
+    fake = _FakeProfiler(monkeypatch)
+    ctl = TraceController(str(tmp_path), trace_at_step=-1, num_steps=1,
+                          poll_every=1)
+    (tmp_path / 'TRACE_NOW').touch()
+    ctl.maybe_update(0)
+    ctl.maybe_update(1)  # stop
+    for step in range(2, 6):
+        ctl.maybe_update(step)  # no touch file: must stay idle
+    assert [c[0] for c in fake.calls] == ['start', 'stop']
+
+
+def test_jsonl_exporter_concurrent_flushers_no_torn_lines(tmp_path):
+    """ISSUE 8 satellite: the trainer's hot-loop flush and a serving
+    engine's (or harness's) flush may share one exporter; concurrent
+    appends must never interleave mid-record."""
+    import json as json_lib
+    import threading
+    core.reset()
+    reg = core.registry()
+    for i in range(40):  # a payload big enough to span write buffers
+        reg.gauge('stress/gauge_with_a_deliberately_long_name_%03d'
+                  % i).set(float(i))
+    exporter = JsonlExporter(str(tmp_path))
+    n_threads, n_flushes = 8, 25
+
+    def flusher(idx):
+        for k in range(n_flushes):
+            exporter.flush(reg, step=idx * n_flushes + k)
+
+    threads = [threading.Thread(target=flusher, args=(i,))
+               for i in range(n_threads)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    with open(tmp_path / 'metrics.jsonl') as f:
+        lines = [line for line in f.read().splitlines() if line]
+    # every line parses (no torn/interleaved records) and the record
+    # count is exactly flushes x instruments
+    records = [json_lib.loads(line) for line in lines]
+    assert len(records) == n_threads * n_flushes * 40
+    assert all(r['tag'].startswith('stress/') for r in records)
+    core.reset()
