@@ -142,6 +142,10 @@ def run_measurement() -> None:
             per_chip / benchlib.V100_BASELINE_EXAMPLES_PER_SEC, 3)),
         'recipe': BENCH_RECIPE,
         'wire_bytes_per_batch': wire,
+        # per-stage peak HBM (ISSUE 9): footprint rides the headline
+        # record so the bench trajectory tracks memory next to
+        # throughput (None on stats-less backends, an explicit gap)
+        **benchlib.device_memory_record(),
     }
     if SMOKE:
         # echo the RESOLVED knobs so the smoke test can assert the recipe

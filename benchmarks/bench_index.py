@@ -167,6 +167,10 @@ def main() -> None:
           'nprobe': ivf.nprobe, 'clusters': ivf.n_clusters,
           'vectors': args.vectors})
     emit({'metric': 'index_ivf_curve', 'points': points})
+    # per-stage peak HBM (ISSUE 9): covers the exact store residency
+    # AND the IVF cluster-sorted copy on this backend
+    emit({'metric': 'index_peak_hbm_bytes',
+          **benchlib.device_memory_record()})
 
 
 if __name__ == '__main__':

@@ -242,6 +242,10 @@ def main() -> None:
     emit({'metric': 'serving_latency_ms', 'variant': 'engine',
           'p50': p50, 'p99': p99})
     emit({'metric': 'serving_speedup', 'value': naive_s / engine_s})
+    # per-stage peak HBM (ISSUE 9): measured after both arms, so the
+    # peak covers naive AND engine serving on this backend
+    emit({'metric': 'serving_peak_hbm_bytes',
+          **benchlib.device_memory_record()})
 
     # ---- tracing overhead at the DEFAULT sample rate (ISSUE 8): the
     # engine arm above ran with the config default (tracer armed,

@@ -7,6 +7,13 @@
 # appending raw results to benchmarks/results/capture_<date>.jsonl so a
 # mid-run wedge still leaves durable artifacts.
 #
+# Every stage's JSON records now carry per-stage peak HBM
+# (peak_hbm_bytes / hbm_bytes_in_use from the runtime's memory_stats —
+# benchlib.device_memory_record, ISSUE 9), so the bench trajectory
+# tracks footprint alongside throughput; summarize_captures.py surfaces
+# both, and a stats-less backend reports an explicit null, not a
+# missing column.
+#
 #   bash benchmarks/capture_all.sh
 set -u
 cd "$(dirname "$0")/.."

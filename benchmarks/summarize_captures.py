@@ -72,7 +72,11 @@ def main() -> None:
                                'speedup', 'verdict', 'distribution',
                                'step_ms', 'partition_overhead_vs_1dev',
                                'attempts', 'phase', 'tier', 'bucket',
-                               'p50', 'p99')}
+                               'p50', 'p99',
+                               # the memory axis (ISSUE 9): per-stage
+                               # peak HBM; None = stats-less backend,
+                               # an explicit gap
+                               'peak_hbm_bytes', 'hbm_bytes_in_use')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

@@ -6,6 +6,7 @@ import time.  Adding a rule = adding a module here + importing it below
 row in ANALYSIS.md (the test file asserts the doc row exists).
 """
 from code2vec_tpu.analysis.rules import (  # noqa: F401
+    alloc_catalog,
     config_knobs,
     donation,
     fault_points,

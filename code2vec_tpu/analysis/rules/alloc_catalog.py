@@ -1,0 +1,137 @@
+"""Rule ``alloc-catalog``: device allocations in the owner modules are
+ledger-accounted.
+
+The memory analogue of ``host-sync``: the device-memory ledger
+(``telemetry/memory.py``, OBSERVABILITY.md "Device memory ledger") only
+stays honest if every allocation owner actually registers what it
+allocates — so every device-allocation site in the cataloged owner
+modules (``ALLOC_OWNER_FILES``) must sit inside a function cataloged in
+``ALLOC_CATALOG`` (each entry records WHY its accounting treatment is
+right) or carry an inline ``# graftlint: disable=alloc-catalog -- why``
+suppression.  Counts are pinned per function, so a NEW ``device_put``
+slipped into an already-cataloged owner still fails; an entry whose
+function no longer allocates is stale and fails too.
+
+Allocation sites (AST-matched, so comments/docstrings never count):
+
+- ``device_put``                        — direct device placement;
+- ``shard_batch`` / ``shard_params``    — mesh placement of batches
+                                          (the donated staging wire)
+                                          and parameter trees;
+- ``jnp.zeros`` / ``jnp.empty`` / ``jnp.full`` / ``jnp.asarray``
+                                        — host-initiated device
+                                          buffers.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+from typing import Dict, List, Tuple
+
+from code2vec_tpu.analysis.core import Finding, Rule, register
+from code2vec_tpu.analysis.walker import (SourceTree, dotted_name,
+                                          terminal_name)
+
+CATALOG_FILE = 'code2vec_tpu/telemetry/memory.py'
+
+_TERMINAL_ALLOCS = frozenset(('device_put', 'shard_batch',
+                              'shard_params'))
+_DOTTED_ALLOCS = frozenset(('jnp.zeros', 'jnp.empty', 'jnp.full',
+                            'jnp.asarray'))
+
+
+def find_sites(tree: SourceTree, owner_files) -> List[Tuple[str, str,
+                                                            int, str]]:
+    """[(relpath, enclosing_function, lineno, site_name)] across the
+    cataloged owner modules present in the tree."""
+    out = []
+    for rel in owner_files:
+        source = tree.get(rel)
+        if source is None or source.tree is None:
+            continue
+        for node in ast.walk(source.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            terminal = terminal_name(node.func)
+            if terminal in _TERMINAL_ALLOCS or dotted in _DOTTED_ALLOCS:
+                func = source.enclosing_function(node.lineno) or ''
+                out.append((rel, func, node.lineno,
+                            dotted or terminal or '?'))
+    return out
+
+
+@register
+class AllocCatalogRule(Rule):
+    name = 'alloc-catalog'
+    doc = ('every device-allocation site in the cataloged owner modules '
+           '(telemetry/memory.py ALLOC_CATALOG) is ledger-accounted; '
+           'counts are pinned and stale entries fail')
+    scope = 'package'
+
+    def run(self, tree: SourceTree) -> List[Finding]:
+        try:
+            from code2vec_tpu.telemetry.memory import (ALLOC_CATALOG,
+                                                       ALLOC_OWNER_FILES)
+        except ImportError:
+            return [self.finding(
+                CATALOG_FILE, 0, 'alloc catalog is not importable')]
+        findings: List[Finding] = []
+        catalog: Dict[Tuple[str, str], dict] = {}
+        for entry in ALLOC_CATALOG:
+            key = (entry['file'], entry['func'])
+            if key in catalog:
+                findings.append(self.finding(
+                    CATALOG_FILE, 0,
+                    'duplicate alloc-catalog entry for %s::%s'
+                    % key))
+            if not entry.get('reason'):
+                findings.append(self.finding(
+                    CATALOG_FILE, 0,
+                    'alloc-catalog entry %s::%s has no reason — the '
+                    'accounting treatment must be justified where '
+                    'reviewers see it' % key))
+            if entry['file'] not in ALLOC_OWNER_FILES:
+                findings.append(self.finding(
+                    CATALOG_FILE, 0,
+                    'alloc-catalog entry %s::%s names a file outside '
+                    'ALLOC_OWNER_FILES — the rule never scans it, so '
+                    'the entry is unverifiable' % key))
+            catalog[key] = entry
+
+        sites = find_sites(tree, ALLOC_OWNER_FILES)
+        by_func: Dict[Tuple[str, str], List[Tuple[int, str]]] = \
+            collections.defaultdict(list)
+        for rel, func, lineno, site in sites:
+            by_func[(rel, func)].append((lineno, site))
+
+        for key, found in sorted(by_func.items()):
+            rel, func = key
+            entry = catalog.get(key)
+            if entry is None:
+                for lineno, site in found:
+                    findings.append(self.finding(
+                        rel, lineno,
+                        'allocation site %s in %s is not in the alloc '
+                        'catalog (telemetry/memory.py ALLOC_CATALOG) — '
+                        'register the allocation with the memory '
+                        'ledger and catalog the owner, or suppress '
+                        'with a reason' % (site, func or '<module>')))
+            elif entry['count'] != len(found):
+                findings.append(self.finding(
+                    rel, found[0][0],
+                    'alloc catalog pins %d allocation site(s) in %s '
+                    'but found %d — a new (or removed) allocation must '
+                    'update the catalog entry and its ledger '
+                    'accounting' % (entry['count'], func, len(found))))
+
+        scanned = {rel for rel in ALLOC_OWNER_FILES
+                   if tree.get(rel) is not None}
+        for key, entry in sorted(catalog.items()):
+            if key[0] in scanned and key not in by_func:
+                findings.append(self.finding(
+                    CATALOG_FILE, 0,
+                    'alloc-catalog entry %s::%s is stale — the '
+                    'function no longer contains an allocation site'
+                    % key))
+        return findings

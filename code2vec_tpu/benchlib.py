@@ -73,6 +73,23 @@ def bench_timer_wall(fn) -> float:
     return sw.last
 
 
+def device_memory_record() -> dict:
+    """Per-stage HBM footprint for the bench JSON records (ISSUE 9):
+    ``peak_bytes_in_use`` / ``bytes_in_use`` summed over local devices
+    from the runtime's ``memory_stats()``.  Backends without memory
+    stats (CPU smoke) report None — an EXPLICIT gap on the memory axis,
+    not a silently absent key, so summarize_captures.py can show that a
+    round is missing its footprint numbers the same way it shows
+    ``tpu_unavailable``."""
+    from code2vec_tpu.telemetry.memory import backend_memory
+    devices = backend_memory()['devices']  # one stats-reading code path
+    if not devices:
+        return {'peak_hbm_bytes': None, 'hbm_bytes_in_use': None}
+    return {'peak_hbm_bytes': sum(d['peak_bytes_in_use']
+                                  for d in devices),
+            'hbm_bytes_in_use': sum(d['bytes_in_use'] for d in devices)}
+
+
 def honor_env_platforms() -> None:
     """Honor the caller's JAX_PLATFORMS even though the sitecustomize
     preimport pins a platform list before this process's env is read (same

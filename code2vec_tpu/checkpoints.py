@@ -724,6 +724,19 @@ class CheckpointStore:
 
         return with_rows, adapt
 
+    def _check_restore_budget(self, abstract_tree, what: str) -> None:
+        """HBM-budget precheck at the restore boundary
+        (telemetry/memory.py): params-only restores bring up a NEW set
+        next to whatever is already resident (the serving rollover
+        candidate above all), so the predicted footprint — known
+        exactly from the abstract target — is refused typed BEFORE
+        orbax allocates anything.  Training resume is exempt: it
+        replaces the state it restores into."""
+        from code2vec_tpu.telemetry import memory as memory_lib
+        memory_lib.ledger().check_budget(
+            memory_lib.tree_nbytes(abstract_tree),
+            '%s (`%s`)' % (what, self.model_path))
+
     def restore_params_step(self, abstract_params, step: int) -> Any:
         """Params-only restore pinned to ONE retained step (canaried
         serving rollover: ``ServingEngine.load_params(step)``). Unlike
@@ -731,6 +744,8 @@ class CheckpointStore:
         asked for this step, so a missing or unrestorable artifact is an
         error, not a silent downgrade."""
         self.verify_metadata()
+        self._check_restore_budget(abstract_params,
+                                   'params restore at step %d' % step)
         with_rows, adapt = self._params_adapters(abstract_params)
         candidates = [(m, s) for m, s in self._restore_candidates()
                       if s == step]
@@ -751,6 +766,7 @@ class CheckpointStore:
         fall back to the newest full checkpoint (reference load order:
         whatever exists under the load path)."""
         self.verify_metadata()
+        self._check_restore_budget(abstract_params, 'params-only restore')
         with_rows, adapt = self._params_adapters(abstract_params)
 
         if os.path.isdir(self.weights_dir):

@@ -92,6 +92,12 @@ def main(args=None) -> None:
         predictor.predict()
     if config.RELEASE and config.is_loading:
         model.release_model()
+    # --memory-report: a reconciled device-memory ledger snapshot of
+    # whatever this invocation ran — train, eval, serve, index
+    # (telemetry/memory.py; render with scripts/memory_report.py)
+    if config.MEMORY_REPORT:
+        from code2vec_tpu.telemetry import memory as memory_lib
+        memory_lib.write_report(config)
 
 
 if __name__ == '__main__':

@@ -275,6 +275,19 @@ class Config:
     # (sampled or not) held for the flight_<event>.jsonl dumps on
     # overload bursts, canary rollback, breaker open, and close().
     TRACING_FLIGHT_TRACES: int = 256
+    # ---- device-memory ledger (telemetry/memory.py) ----
+    # HBM budget in bytes for the ledger's predictive admission checks:
+    # an index attach or serving rollover whose predicted footprint
+    # would cross it fails typed (MemoryBudgetExceeded) BEFORE
+    # allocating, with a forensic oom_ledger.json dump. -1 = UNSET: the
+    # HBM_BUDGET_BYTES environment variable fills in (the
+    # TELEMETRY_TRACE_AT_STEP convention), else 0 = unlimited.
+    HBM_BUDGET_BYTES: int = -1
+    # Write a reconciled device-memory ledger snapshot
+    # (memory_report.json, rendered by scripts/memory_report.py) when
+    # the run's work completes (--memory-report). Live runs can instead
+    # `touch <telemetry_dir>/MEM_NOW` for a snapshot with no restart.
+    MEMORY_REPORT: bool = False
     # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
     # Divergence guard: check the windowed losses for NaN/Inf at each
     # log-window sync (zero extra host syncs — the losses come to host
@@ -569,6 +582,22 @@ class Config:
                                  'when global step N is reached (implies '
                                  '--telemetry; live runs can instead touch '
                                  '<telemetry_dir>/TRACE_NOW)')
+        parser.add_argument('--memory-report', dest='memory_report',
+                            action='store_true',
+                            help='write a reconciled device-memory '
+                                 'ledger snapshot (memory_report.json) '
+                                 'when the run completes; render with '
+                                 'scripts/memory_report.py '
+                                 '(OBSERVABILITY.md)')
+        parser.add_argument('--hbm-budget-bytes', dest='hbm_budget_bytes',
+                            type=int, default=None, metavar='BYTES',
+                            help='HBM budget for the memory ledger\'s '
+                                 'predictive admission checks: index '
+                                 'attaches / serving rollovers that '
+                                 'would cross it fail typed before '
+                                 'allocating (0 = unlimited; the '
+                                 'HBM_BUDGET_BYTES env var fills in '
+                                 'when unset)')
         parser.add_argument('--fault-inject', dest='fault_inject',
                             default=None, metavar='SPEC',
                             help='deterministic fault injection: '
@@ -771,6 +800,10 @@ class Config:
             if env_step >= 0:
                 self.TELEMETRY_TRACE_AT_STEP = env_step
                 self.TELEMETRY = True
+        if parsed.memory_report:
+            self.MEMORY_REPORT = True
+        if parsed.hbm_budget_bytes is not None:
+            self.HBM_BUDGET_BYTES = parsed.hbm_budget_bytes
         if parsed.fault_inject is not None:
             # an explicit --fault-inject '' DISABLES injection even when
             # the env var is set (the control arm of a drill)
@@ -1027,6 +1060,9 @@ class Config:
                              '(0 disables latency tail retention).')
         if self.TRACING_FLIGHT_TRACES < 1:
             raise ValueError('config.TRACING_FLIGHT_TRACES must be >= 1.')
+        if self.HBM_BUDGET_BYTES < -1:
+            raise ValueError('config.HBM_BUDGET_BYTES must be >= -1 '
+                             '(-1 = env fallback, 0 = unlimited).')
         if self.BATCH_WIRE_FORMAT not in {'planes', 'packed'}:
             raise ValueError("config.BATCH_WIRE_FORMAT must be in "
                              "{'planes', 'packed'}.")

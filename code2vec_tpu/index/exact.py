@@ -100,14 +100,32 @@ class ExactIndex:
         self.padded_rows = n_pad
         neg_mask = np.zeros((n_pad,), np.float32)
         neg_mask[self.count:] = -np.inf
-        if mesh is not None and mesh.size > 1:
-            self._matrix = jax.device_put(
-                vectors, NamedSharding(mesh, P(DATA_AXIS, None)))
-            self._neg_mask = jax.device_put(
-                neg_mask, NamedSharding(mesh, P(DATA_AXIS)))
-        else:
-            self._matrix = jax.device_put(vectors)
-            self._neg_mask = jax.device_put(neg_mask)
+        # HBM budget gate (telemetry/memory.py): the attach boundary —
+        # predict the device footprint from the host arrays and fail
+        # typed BEFORE anything is placed, so a store that cannot fit
+        # never half-allocates into a RESOURCE_EXHAUSTED
+        from code2vec_tpu.telemetry import memory as memory_lib
+        self.device_nbytes = int(vectors.nbytes) + int(neg_mask.nbytes)
+        memory_lib.ledger().check_budget(
+            self.device_nbytes,
+            'index attach (exact tier: %d vectors x %d dims, %s)'
+            % (self.count, self.dim, np.dtype(vectors.dtype).name))
+        try:
+            if mesh is not None and mesh.size > 1:
+                self._matrix = jax.device_put(
+                    vectors, NamedSharding(mesh, P(DATA_AXIS, None)))
+                self._neg_mask = jax.device_put(
+                    neg_mask, NamedSharding(mesh, P(DATA_AXIS)))
+            else:
+                self._matrix = jax.device_put(vectors)
+                self._neg_mask = jax.device_put(neg_mask)
+        except Exception as exc:
+            memory_lib.ledger().note_oom(exc, 'index.attach')
+            raise
+        memory_lib.ledger().register(
+            'index', 'exact:%x' % id(self), self.device_nbytes,
+            owner=self, attrs={'tier': 'exact', 'vectors': self.count,
+                               'dim': self.dim})
         if tele_core.enabled():
             reg = tele_core.registry()
             reg.gauge('index/vectors_total').set(self.count)

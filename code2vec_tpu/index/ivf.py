@@ -130,8 +130,26 @@ class IVFIndex:
         # keeps the STORE dtype either way (f16 stores stay halved).
         rows = (np.asarray(vectors, store.dtype) if vectors is not None
                 else store.all_rows())[self.list_ids]
-        self._sorted_rows = jax.device_put(rows)
-        self._centroids_dev = jax.device_put(self.centroids)
+        # HBM budget gate + ledger registration (telemetry/memory.py):
+        # same attach-boundary contract as the exact tier
+        from code2vec_tpu.telemetry import memory as memory_lib
+        self.device_nbytes = (int(rows.nbytes)
+                              + int(self.centroids.nbytes))
+        memory_lib.ledger().check_budget(
+            self.device_nbytes,
+            'index attach (IVF tier: %d vectors x %d dims, %d clusters)'
+            % (self.count, self.dim, self.n_clusters))
+        try:
+            self._sorted_rows = jax.device_put(rows)
+            self._centroids_dev = jax.device_put(self.centroids)
+        except Exception as exc:
+            memory_lib.ledger().note_oom(exc, 'index.attach')
+            raise
+        memory_lib.ledger().register(
+            'index', 'ivf:%x' % id(self), self.device_nbytes,
+            owner=self, attrs={'tier': 'ivf', 'vectors': self.count,
+                               'dim': self.dim,
+                               'clusters': self.n_clusters})
         self._programs: Dict[Tuple[int, int, int], object] = {}
 
     # ------------------------------------------------------------- build

@@ -115,6 +115,16 @@ class Code2VecModel:
             dtype=object)
         self._target_index_to_word[:true_decode.shape[0]] = true_decode
         self.mesh = mesh_lib.create_mesh(config)
+        # device-memory ledger (telemetry/memory.py, OBSERVABILITY.md):
+        # pin the HBM budget from config (env var otherwise) and land
+        # forensic dumps (oom_ledger.json) with the run's other
+        # artifacts instead of the CWD
+        from code2vec_tpu.telemetry import memory as memory_lib
+        from code2vec_tpu.telemetry.stepwatch import telemetry_dir
+        memory_lib.configure(
+            budget_bytes=(config.HBM_BUDGET_BYTES
+                          if config.HBM_BUDGET_BYTES >= 0 else None),
+            dump_dir=telemetry_dir(config))
         self.trainer = Trainer(config, self.backend, mesh=self.mesh)
         self.state: Optional[TrainerState] = None
         self.params: Optional[Any] = None
@@ -207,6 +217,10 @@ class Code2VecModel:
                     step=jnp.asarray(restored.step, jnp.int32),
                     rng=jax.random.PRNGKey(42))
                 self.params = self.state.params
+                # checkpoint restore is an allocation owner: attribute
+                # the restored state (telemetry/memory.py)
+                self.trainer.register_state_memory(self.state.params,
+                                                   self.state.opt_state)
                 self._start_epoch = restored.epoch + 1
                 self.log('Resumed from `%s` at epoch %d (step %d)' % (
                     self.config.MODEL_LOAD_PATH, restored.epoch,
@@ -230,6 +244,7 @@ class Code2VecModel:
                     raise ValueError('No checkpoint found under `%s`.'
                                      % self.config.MODEL_LOAD_PATH)
                 self.params = self.backend.from_canonical(params)
+                self.trainer.register_state_memory(self.params)
                 self._start_epoch = 0
         else:
             self.state = self.trainer.init_state()
@@ -484,11 +499,16 @@ class Code2VecModel:
             # 'last saved' value may name a just-purged key, and the
             # re-trained states at those steps must be saved again
             last_saved_step[0] = restored.step
-            return TrainerState(
+            rewound = TrainerState(
                 params=self.backend.from_canonical(restored.params),
                 opt_state=restored.opt_state,
                 step=jnp.asarray(restored.step, jnp.int32),
                 rng=jax.random.PRNGKey(42))
+            # the rewind restore is an allocation owner too: re-register
+            # replaces the trainer's entries (telemetry/memory.py)
+            self.trainer.register_state_memory(rewound.params,
+                                               rewound.opt_state)
+            return rewound
 
         start = getattr(self, '_start_epoch', 0)
         try:

@@ -78,6 +78,7 @@ from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
                                          EngineOverloaded)
 from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry import memory as memory_lib
 from code2vec_tpu.telemetry import tracing as tracing_lib
 from code2vec_tpu.telemetry.core import Counter, Gauge, Timer
 from code2vec_tpu.training.trainer import PREDICT_TIERS
@@ -502,6 +503,17 @@ class ServingEngine:
                 log=self.log)
         else:
             self._tracer = None
+        # device-memory ledger (telemetry/memory.py): the engine's
+        # initial params are the MODEL's allocation (registered by its
+        # owner — trainer init or checkpoint restore), so the engine
+        # registers nothing at construction; it owns only the sets IT
+        # brings in — a rollover candidate while armed, and the
+        # swapped-in serving set afterwards (fixed per-engine keys, so
+        # replacement is release).  The abstract param bytes feed the
+        # load_params budget precheck.
+        self._mem_prefix = 'engine:%x' % id(self)
+        self._params_nbytes = memory_lib.tree_nbytes(
+            trainer.backend.param_shapes())
         self._follow_thread: Optional[threading.Thread] = None
         self._follow_stop = threading.Event()
         self._decode_pool = ThreadPoolExecutor(
@@ -549,16 +561,60 @@ class ServingEngine:
                 params = self.params
             t0 = time.perf_counter()
             programs = 0
-            for bucket in self.buckets:
-                for host_arrays in self._warm_batches(bucket):
-                    arrays = mesh_lib.shard_batch(
-                        host_arrays, self.mesh, self.config.SHARD_CONTEXTS,
-                        direct=True)
-                    for tier in self.tiers:
-                        out = self.trainer.predict_step_placed(
-                            params, arrays, tier=tier)
-                        jax.block_until_ready(out)
-                        programs += 1
+            # executables-bucket accounting (telemetry/memory.py): one
+            # AOT memory_analysis per ladder program — an extra compile
+            # each, so only for runs that opted into the telemetry
+            # LAYER (config), not merely a registry something else
+            # enabled in-process (steady state stays compile-free
+            # either way; the guards count POST-warmup)
+            measure_memory = (tele_core.enabled()
+                              and getattr(self.config, 'TELEMETRY',
+                                          False))
+            ledger = memory_lib.ledger()
+            try:
+                for bucket in self.buckets:
+                    for host_arrays in self._warm_batches(bucket):
+                        arrays = mesh_lib.shard_batch(
+                            host_arrays, self.mesh,
+                            self.config.SHARD_CONTEXTS, direct=True)
+                        capacity = (int(host_arrays[0].shape[1])
+                                    if self.wire == 'packed' else 0)
+                        for tier in self.tiers:
+                            out = self.trainer.predict_step_placed(
+                                params, arrays, tier=tier)
+                            jax.block_until_ready(out)
+                            programs += 1
+                            if not measure_memory:
+                                continue
+                            info = self.trainer.predict_program_memory(
+                                params, arrays, tier=tier)
+                            if info is not None:
+                                # keyed and owned by the TRAINER, not
+                                # this engine: the compiled programs
+                                # live in the trainer's jit caches, so
+                                # they survive engine.close() and are
+                                # shared by every engine over the same
+                                # trainer — trainer-keyed entries match
+                                # that lifetime exactly and re-warm as
+                                # a replace, never a double-count
+                                ledger.register(
+                                    'executables',
+                                    '%s/%s/b%d/c%d'
+                                    % (self.trainer._mem_key, tier,
+                                       bucket, capacity),
+                                    (info['generated_code_bytes']
+                                     + info['temp_bytes']),
+                                    kind='executable',
+                                    owner=self.trainer,
+                                    attrs={'tier': tier,
+                                           'bucket': bucket,
+                                           'capacity': capacity,
+                                           **info})
+            except Exception as exc:
+                # OOM forensics at the warm-compile boundary: a ladder
+                # that does not fit dumps attribution before dying
+                ledger.note_oom(exc, 'serving.warmup')
+                raise
             warm_s = time.perf_counter() - t0
             if tele_core.enabled():
                 reg = tele_core.registry()
@@ -775,7 +831,14 @@ class ServingEngine:
         """Arm ``submit_neighbors`` with a k-NN index over the corpus
         (code2vec_tpu/index/, INDEX.md). The engine must have the
         'vectors' tier warmed — neighbor queries ride it through the
-        same micro-batching dispatcher as every other tier."""
+        same micro-batching dispatcher as every other tier.
+
+        Memory accounting (telemetry/memory.py): the attach path's
+        HBM budget gate lives in the index constructors — ``ExactIndex``
+        / ``IVFIndex`` predict their device footprint and fail typed
+        (``MemoryBudgetExceeded``) BEFORE placing anything, so by the
+        time an index reaches here it is both resident and
+        ledger-registered under the ``index`` bucket."""
         if 'vectors' not in self.tiers:
             raise ValueError(
                 "submit_neighbors needs the 'vectors' tier warmed on "
@@ -887,6 +950,13 @@ class ServingEngine:
                     'params pytree' % (source,))
             if isinstance(source, int):
                 step = source
+            # budget precheck (telemetry/memory.py): the candidate is a
+            # FULL second param set resident next to the serving one for
+            # the whole canary — predict its footprint from the abstract
+            # shapes and fail typed BEFORE the restore allocates
+            memory_lib.ledger().check_budget(
+                self._params_nbytes,
+                'serving rollover candidate (%r)' % (source,))
             params = self._param_source.load(source)
         else:
             params = source
@@ -904,17 +974,35 @@ class ServingEngine:
                 'swap without a canary, or warm a topk tier'
                 % list(self.tiers))
         report = None
-        with self._cond:
-            self._check_rollover_clear_locked()
-            rollover = _Rollover(params, step, handle, n_canary, floor)
-            if n_canary <= 0:
-                self.params = params
-                if step is not None:
-                    self._params_step = step
-                report = rollover.report(True, 'no canary configured')
-            else:
-                self._rollover = rollover
+        if n_canary > 0:
+            # the armed canary's SECOND param-set copy is visible in the
+            # ledger for exactly as long as it is resident. Registered
+            # BEFORE arming: every path that can retire the candidate
+            # (a decode worker concluding the canary, the dispatch-time
+            # timeout, close) only becomes reachable once the entry
+            # exists, so none of them can race a late register into a
+            # phantom entry.
+            memory_lib.ledger().register(
+                'params', self._mem_prefix + '/candidate', params,
+                owner=self, attrs={'step': step, 'state': 'candidate'})
+        try:
+            with self._cond:
+                self._check_rollover_clear_locked()
+                rollover = _Rollover(params, step, handle, n_canary,
+                                     floor)
+                if n_canary <= 0:
+                    self.params = params
+                    if step is not None:
+                        self._params_step = step
+                    report = rollover.report(True, 'no canary configured')
+                else:
+                    self._rollover = rollover
+        except BaseException:
+            if n_canary > 0:
+                self._mem_drop_candidate()  # arming refused: not resident
+            raise
         if report is not None:
+            self._mem_swap_in(params, step)
             self._count_rollover(True, None)
             self.log('serving: params swapped without canary (step %s)'
                      % step)
@@ -924,6 +1012,21 @@ class ServingEngine:
                      'live batches, agreement floor %.2f'
                      % (step, n_canary, floor))
         return handle
+
+    def _mem_swap_in(self, params, step: Optional[int]) -> None:
+        """Ledger bookkeeping for a concluded swap: the candidate entry
+        (if any) retires and the engine's serving entry re-registers
+        with the new set — replacement releases the previously
+        swapped-in set, so repeated rollovers hold a constant params
+        footprint (the leak drill in tests/test_memory_ledger.py)."""
+        led = memory_lib.ledger()
+        led.release('params', self._mem_prefix + '/candidate')
+        led.register('params', self._mem_prefix + '/serving', params,
+                     owner=self, attrs={'step': step, 'state': 'serving'})
+
+    def _mem_drop_candidate(self) -> None:
+        memory_lib.ledger().release('params',
+                                    self._mem_prefix + '/candidate')
 
     def _count_rollover(self, swapped: bool,
                         agreement: Optional[float]) -> None:
@@ -969,6 +1072,10 @@ class ServingEngine:
                 decided = (swapped, agreement)
         if decided is not None:
             swapped, agreement = decided
+            if swapped:
+                self._mem_swap_in(rollover.params, rollover.step)
+            else:
+                self._mem_drop_candidate()
             self._count_rollover(swapped, agreement)
             reason = ('canary passed' if swapped else
                       'agreement %.3f below floor %.2f'
@@ -989,6 +1096,7 @@ class ServingEngine:
                 self._rollover = None
             elif rollover.handle.done():
                 return
+        self._mem_drop_candidate()
         if not rollover.handle.done():
             try:
                 rollover.handle.set_exception(exc)
@@ -1145,6 +1253,11 @@ class ServingEngine:
                 try:
                     self._dispatch_batch(tier, taken, rows)
                 except BaseException as exc:  # keep the dispatcher alive
+                    # OOM forensics at the jit-dispatch boundary
+                    # (telemetry/memory.py): a RESOURCE_EXHAUSTED here
+                    # dumps the attribution ledger before the typed
+                    # failure reaches the callers
+                    memory_lib.ledger().note_oom(exc, 'serving.dispatch')
                     for request in taken:
                         request.fail(exc)
 
@@ -1204,6 +1317,7 @@ class ServingEngine:
                 self._rollover = None
                 stale, rollover = rollover, None
         if stale is not None:
+            self._mem_drop_candidate()
             self._count_rollover(False, None)
             self.log('serving: rollover ROLLED BACK (step %s): canary '
                      'timed out after %.0fs with %d/%d batches scored '
@@ -1328,6 +1442,9 @@ class ServingEngine:
                     request.finish_trace()
             self._note_service(n_rows, taken)
         except BaseException as exc:
+            # async dispatches surface device OOM at this fetch
+            # boundary — same forensics as the dispatch side
+            memory_lib.ledger().note_oom(exc, 'serving.decode')
             for request in taken:
                 request.fail(exc)
             return
@@ -1451,6 +1568,14 @@ class ServingEngine:
             follow.join()
         self._dispatcher.join()
         self._decode_pool.shutdown(wait=True)
+        # retire this engine's ledger entries: the params it swapped in
+        # and an armed candidate (release is no-op-safe, so racing the
+        # weakref finalizer is fine). The warm-ladder executables stay
+        # registered on purpose — they live in the TRAINER's jit
+        # caches, which a closed engine does not free
+        led = memory_lib.ledger()
+        led.release('params', self._mem_prefix + '/serving')
+        led.release('params', self._mem_prefix + '/candidate')
         if self._tracer is not None:
             # dispatcher + decode pool have drained: every in-flight
             # trace is already finished (delivered or typed-failed), so

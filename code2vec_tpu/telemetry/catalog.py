@@ -181,6 +181,43 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                      'ring dumps (flight_<event>.jsonl: '
                                      'overload burst, canary rollback, '
                                      'breaker open, close).'),
+    # ---- device-memory ledger (telemetry/memory.py) ----
+    'mem/params_bytes': _m(GAUGE, 'bytes', 'Ledger-attributed device '
+                           'bytes held by model parameter sets (one '
+                           'entry per set — a canary candidate is a '
+                           'second entry).'),
+    'mem/opt_state_bytes': _m(GAUGE, 'bytes', 'Ledger-attributed '
+                              'optimizer-state (Adam moment) bytes.'),
+    'mem/staging_bytes': _m(GAUGE, 'bytes', 'Bytes held by batches '
+                            'resident in the device staging ring.'),
+    'mem/index_bytes': _m(GAUGE, 'bytes', 'Bytes held by embedding-'
+                          'index residents (exact store shards, IVF '
+                          'rows + centroids).'),
+    'mem/executables_bytes': _m(GAUGE, 'bytes', 'Measured footprint of '
+                                'the warm serving compilation ladder '
+                                '(code + temp, AOT memory_analysis; '
+                                'excluded from array reconciliation).'),
+    'mem/attributed_bytes': _m(GAUGE, 'bytes', 'Sum of all array-kind '
+                               'ledger entries (the reconciliation '
+                               'numerator).'),
+    'mem/unattributed_bytes': _m(GAUGE, 'bytes', 'Backend live bytes '
+                                 'minus attributed — the residual the '
+                                 'reconciliation keeps honest.'),
+    'mem/backend_live_bytes': _m(GAUGE, 'bytes', 'Backend-reported '
+                                 'live device bytes (live_arrays '
+                                 'logical basis; memory_stats rides '
+                                 'in snapshots).'),
+    'mem/watermark_bytes': _m(GAUGE, 'bytes', 'High-water mark of '
+                              'attributed bytes since process start.'),
+    'mem/budget_bytes': _m(GAUGE, 'bytes', 'Effective HBM_BUDGET_BYTES '
+                           '(0 = unlimited).'),
+    'mem/oom_dumps_total': _m(COUNTER, 'dumps', 'oom_ledger.json '
+                              'forensic dumps written on '
+                              'RESOURCE_EXHAUSTED or a budget-exceeded '
+                              'refusal.'),
+    'mem/snapshots_total': _m(COUNTER, 'snapshots', 'Ledger snapshots '
+                              'written (MEM_NOW, --memory-report, '
+                              'forensic dumps).'),
     # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
     'resilience/rewinds_total': _m(COUNTER, 'rewinds', 'Divergence-guard '
                                    'rewinds: non-finite loss windows that '

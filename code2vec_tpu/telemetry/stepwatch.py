@@ -14,6 +14,7 @@ import time
 from typing import Optional
 
 from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry import memory as memory_lib
 from code2vec_tpu.telemetry.exporters import (ConsoleExporter, JsonlExporter,
                                               PrometheusExporter)
 from code2vec_tpu.telemetry.jit_tracker import (CapacityTracker,
@@ -60,6 +61,12 @@ class StepTelemetry:
             trace_at_step=getattr(config, 'TELEMETRY_TRACE_AT_STEP', -1),
             num_steps=getattr(config, 'TELEMETRY_TRACE_NUM_STEPS', 5),
             log=self.log)
+        # MEM_NOW touch-file ledger snapshots (telemetry/memory.py),
+        # polled at the flush cadence like the exporters — and route
+        # the ledger's forensic dumps next to the other artifacts
+        self.memwatch = memory_lib.MemoryReportController(self.dir,
+                                                          log=self.log)
+        memory_lib.configure(dump_dir=self.dir)
         self.flush_every = max(1, getattr(config,
                                           'TELEMETRY_FLUSH_EVERY_STEPS', 50))
         self.exporters = [
@@ -99,8 +106,12 @@ class StepTelemetry:
         self._window_t0 = now
         self._window_examples = 0
         self._window_contexts = 0
+        # refresh the mem/* gauges so every flush exports the current
+        # ledger attribution alongside the phase timers
+        memory_lib.ledger().export_gauges()
         for exporter in self.exporters:
             exporter.flush(reg, step)
+        self.memwatch.poll(step)
 
     def resume(self) -> None:
         """Re-arm recording (fit entry) — the counterpart of shutdown()'s

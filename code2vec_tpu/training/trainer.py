@@ -153,6 +153,11 @@ class Trainer:
             self._telemetry = StepTelemetry(
                 config, log=config.log,
                 process_index=jax.process_index())
+        # Device-memory ledger (telemetry/memory.py, OBSERVABILITY.md):
+        # this trainer's state registers under a per-instance key, so
+        # restores replace (never double-count) and a garbage-collected
+        # trainer auto-releases its entries.
+        self._mem_key = 'trainer:%x' % id(self)
         # Resilience (ROBUSTNESS.md): arm the process-global fault plan
         # from config. None = unset -> the env var fills in (launches
         # whose scripts you can't edit); '' = explicitly disabled, so an
@@ -352,11 +357,27 @@ class Trainer:
         self._path_pad = path_pad
 
     # --------------------------------------------------------------- state
+    def register_state_memory(self, params, opt_state=None) -> None:
+        """Attribute this trainer's state to the device-memory ledger
+        (telemetry/memory.py): called by every allocation owner of the
+        training state — fresh init, params load, checkpoint restore
+        (model_api) — under ONE per-trainer key, so a restore replaces
+        the previous registration instead of double-counting.  Bytes
+        are shape-constant across steps, so this is one-time
+        bookkeeping, never hot-path work."""
+        from code2vec_tpu.telemetry import memory as memory_lib
+        led = memory_lib.ledger()
+        led.register('params', self._mem_key, params, owner=self)
+        if opt_state is not None:
+            led.register('opt_state', self._mem_key, opt_state,
+                         owner=self)
+
     def init_state(self, seed: int = 42) -> TrainerState:
         init_rng, train_rng = jax.random.split(jax.random.PRNGKey(seed))
         params = self.backend.init(init_rng)
         params = mesh_lib.shard_params(params, self.mesh)
         opt_state = self._init_opt_state(params)
+        self.register_state_memory(params, opt_state)
         return TrainerState(params=params, opt_state=opt_state,
                             step=jnp.zeros((), jnp.int32), rng=train_rng)
 
@@ -393,6 +414,7 @@ class Trainer:
                           seed: int = 42) -> TrainerState:
         params = mesh_lib.shard_params(params, self.mesh)
         opt_state = self._init_opt_state(params)
+        self.register_state_memory(params, opt_state)
         return TrainerState(params=params, opt_state=opt_state,
                             step=jnp.asarray(step, jnp.int32),
                             rng=jax.random.PRNGKey(seed))
@@ -446,30 +468,56 @@ class Trainer:
         shard_contexts = self.config.SHARD_CONTEXTS
         staged = collections.deque()
         tele = self._telemetry
+        # staging-bucket ledger accounting (telemetry/memory.py) rides
+        # the telemetry gate: register on placement, release at pop —
+        # metadata-only (.nbytes), zero host syncs; the plain path
+        # carries nothing
+        led = None
+        mem_keys: collections.deque = collections.deque()
+        mem_seq = 0
         if tele is not None:
+            from code2vec_tpu.telemetry import memory as memory_lib
+            led = memory_lib.ledger()
             tele.registry.gauge('staging/ring_depth').set(depth)
-        for batch in batches:
-            if tele is not None:
-                # the DISPATCH cost of the async per-device placement —
-                # jax transfers complete in the background, so a spike
-                # here means host-side slicing/copy, not wire time
-                with jax.profiler.TraceAnnotation('host/h2d_place'), \
-                        tele.h2d.time():
+        try:
+            for batch in batches:
+                if tele is not None:
+                    # the DISPATCH cost of the async per-device placement —
+                    # jax transfers complete in the background, so a spike
+                    # here means host-side slicing/copy, not wire time
+                    with jax.profiler.TraceAnnotation('host/h2d_place'), \
+                            tele.h2d.time():
+                        placed = mesh_lib.shard_batch(batch.device_arrays(),
+                                                      self.mesh,
+                                                      shard_contexts,
+                                                      direct=True)
+                    tele.ring_occupancy.set(len(staged) + 1)
+                    key = '%s/%d' % (self._mem_key, mem_seq)
+                    mem_seq += 1
+                    led.register('staging', key,
+                                 sum(int(a.nbytes) for a in placed))
+                    mem_keys.append(key)
+                else:
                     placed = mesh_lib.shard_batch(batch.device_arrays(),
                                                   self.mesh, shard_contexts,
                                                   direct=True)
-                tele.ring_occupancy.set(len(staged) + 1)
-            else:
-                placed = mesh_lib.shard_batch(batch.device_arrays(),
-                                              self.mesh, shard_contexts,
-                                              direct=True)
-            staged.append((placed, batch))
-            if len(staged) > depth:
+                staged.append((placed, batch))
+                if len(staged) > depth:
+                    if led is not None:
+                        led.release('staging', mem_keys.popleft())
+                    yield staged.popleft()
+            while staged:
+                if tele is not None:
+                    tele.ring_occupancy.set(len(staged) - 1)
+                if led is not None:
+                    led.release('staging', mem_keys.popleft())
                 yield staged.popleft()
-        while staged:
-            if tele is not None:
-                tele.ring_occupancy.set(len(staged) - 1)
-            yield staged.popleft()
+        finally:
+            # an abandoned generator (early break, exception) must not
+            # leave phantom staging entries in the ledger
+            if led is not None:
+                while mem_keys:
+                    led.release('staging', mem_keys.popleft())
 
     def train_step_placed(self, state: TrainerState, arrays
                           ) -> Tuple[TrainerState, jax.Array]:
@@ -506,6 +554,28 @@ class Trainer:
             self._check_packed(arrays)
             return self._predict_steps[(tier, 'packed')](params, arrays)
         return self._predict_steps[(tier, 'planes')](params, arrays)
+
+    def predict_program_memory(self, params, arrays, tier: str = 'full'
+                               ) -> Optional[dict]:
+        """AOT memory analysis of ONE warm predict program (the shapes
+        of ``arrays``): generated-code/temp/argument/output bytes, for
+        the ledger's executables bucket (telemetry/memory.py).  Costs
+        one extra XLA compile, so the serving engine only calls it at
+        warmup with telemetry enabled; returns None where the backend
+        has no memory analysis."""
+        wire = 'packed' if len(arrays) == 4 else 'planes'
+        fn = self._predict_steps[(tier, wire)]
+        try:
+            analysis = fn.lower(params, arrays).compile().memory_analysis()
+            return {
+                'generated_code_bytes':
+                    int(analysis.generated_code_size_in_bytes),
+                'temp_bytes': int(analysis.temp_size_in_bytes),
+                'argument_bytes': int(analysis.argument_size_in_bytes),
+                'output_bytes': int(analysis.output_size_in_bytes),
+            }
+        except Exception:
+            return None
 
     def predict_step(self, params, batch: Batch, tier: str = 'full'
                      ) -> dict:
@@ -592,6 +662,14 @@ class Trainer:
                 window_examples, window_start, log_every, on_epoch_time,
                 guard=guard, watchdog=watchdog, preemption=preemption,
                 on_preempt=on_preempt)
+        except Exception as exc:
+            # OOM forensics (telemetry/memory.py): a RESOURCE_EXHAUSTED
+            # surfacing anywhere in the hot loop — dispatch or the
+            # blocking window sync — dumps the attribution ledger
+            # before the run dies with an otherwise bare XLA error
+            from code2vec_tpu.telemetry import memory as memory_lib
+            memory_lib.ledger().note_oom(exc, 'trainer.fit')
+            raise
         finally:
             if watchdog is not None:
                 watchdog.shutdown()

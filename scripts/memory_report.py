@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Render device-memory ledger snapshots (telemetry/memory.py).
+
+Reads the JSON the ledger dumps — ``memory_report.json``
+(``--memory-report``), ``memory_step<N>.json`` (``MEM_NOW``), or
+``oom_ledger.json`` (forensics) — and prints:
+
+- the bucket table: bytes, share of attributed, watermark;
+- the reconciliation line: attributed vs backend live bytes and the
+  unattributed residual (the honesty check);
+- the per-bucket x capacity executable-size table of the warm serving
+  ladder;
+- the recent allocation-event tail;
+- with ``--diff OLDER.json``: per-bucket and per-entry deltas — the
+  leak check between two moments of a run.
+
+jax-free by design (OBSERVABILITY.md "Device memory ledger").
+
+    python scripts/memory_report.py telemetry/memory_report.json
+    python scripts/memory_report.py oom_ledger.json --diff baseline.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def human(nbytes) -> str:
+    if nbytes is None:
+        return '-'
+    value = float(nbytes)
+    for unit in ('B', 'KiB', 'MiB', 'GiB', 'TiB'):
+        if abs(value) < 1024.0 or unit == 'TiB':
+            return ('%+.1f %s' % (value, unit) if nbytes < 0
+                    else '%.1f %s' % (value, unit))
+        value /= 1024.0
+    return str(nbytes)
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def print_buckets(snap: dict, entries_per_bucket: int) -> None:
+    attributed = max(1, snap.get('attributed_bytes', 0))
+    watermarks = snap.get('watermarks', {})
+    print('%-14s %14s %7s %14s' % ('bucket', 'bytes', 'share',
+                                   'watermark'))
+    for bucket, record in sorted(snap['buckets'].items(),
+                                 key=lambda kv: -kv[1]['bytes']):
+        print('%-14s %14s %6.1f%% %14s'
+              % (bucket, human(record['bytes']),
+                 100.0 * record['bytes'] / attributed,
+                 human(watermarks.get(bucket))))
+        for entry in record['entries'][:entries_per_bucket]:
+            print('    %-40s %14s  %s'
+                  % (entry['key'], human(entry['bytes']),
+                     entry.get('attrs', '')))
+        hidden = len(record['entries']) - entries_per_bucket
+        if hidden > 0:
+            print('    ... %d more entries (--entries N)' % hidden)
+
+
+def print_reconciliation(snap: dict) -> None:
+    backend = snap.get('backend')
+    attributed = snap.get('attributed_bytes', 0)
+    print('attributed: %s  (executables, reported separately: %s)'
+          % (human(attributed), human(snap.get('executables_bytes', 0))))
+    budget = snap.get('budget_bytes', 0)
+    if budget:
+        print('budget:     %s  (headroom %s)'
+              % (human(budget), human(budget - attributed)))
+    if backend is None:
+        print('backend:    (snapshot was not reconciled)')
+        return
+    live = backend['live_bytes']
+    residual = snap.get('unattributed_bytes', live - attributed)
+    print('backend:    %s live across %d arrays (%s)'
+          % (human(live), backend.get('live_arrays', 0),
+             backend.get('source', '?')))
+    print('unattributed residual: %s (%.1f%% of live)'
+          % (human(residual), 100.0 * residual / max(1, live)))
+    for dev in backend.get('devices', []):
+        print('  device %s: in_use %s, peak %s'
+              % (dev.get('id'), human(dev.get('bytes_in_use')),
+                 human(dev.get('peak_bytes_in_use'))))
+
+
+def print_executables(snap: dict) -> None:
+    entries = snap['buckets'].get('executables', {}).get('entries', [])
+    rows = [e for e in entries if 'attrs' in e
+            and 'bucket' in e['attrs']]
+    if not rows:
+        return
+    print()
+    print('warm serving ladder (per bucket x capacity executable sizes):')
+    print('%-10s %7s %9s %12s %12s %12s %12s'
+          % ('tier', 'bucket', 'capacity', 'code', 'temp', 'args',
+             'outputs'))
+    for entry in sorted(rows, key=lambda e: (
+            e['attrs'].get('tier', ''), e['attrs'].get('bucket', 0),
+            e['attrs'].get('capacity', 0))):
+        attrs = entry['attrs']
+        print('%-10s %7s %9s %12s %12s %12s %12s'
+              % (attrs.get('tier', '?'), attrs.get('bucket', '?'),
+                 attrs.get('capacity', '?'),
+                 human(attrs.get('generated_code_bytes')),
+                 human(attrs.get('temp_bytes')),
+                 human(attrs.get('argument_bytes')),
+                 human(attrs.get('output_bytes'))))
+
+
+def print_events(snap: dict, tail: int) -> None:
+    events = snap.get('events', [])[-tail:]
+    if not events:
+        return
+    print()
+    print('recent allocation events:')
+    for event in events:
+        print('  %s %-8s %-10s %-40s %s'
+              % (time.strftime('%H:%M:%S',
+                               time.localtime(event.get('t', 0))),
+                 event.get('op'), event.get('bucket'),
+                 event.get('key'), human(event.get('bytes'))))
+
+
+def print_diff(before: dict, after: dict) -> None:
+    print('diff (%s -> %s):'
+          % (before.get('reason', '?'), after.get('reason', '?')))
+    delta = after.get('attributed_bytes', 0) \
+        - before.get('attributed_bytes', 0)
+    print('attributed delta: %s' % human(delta))
+    if 'backend' in before and 'backend' in after:
+        print('backend live delta: %s'
+              % human(after['backend']['live_bytes']
+                      - before['backend']['live_bytes']))
+        print('unattributed delta: %s'
+              % human(after.get('unattributed_bytes', 0)
+                      - before.get('unattributed_bytes', 0)))
+    for bucket in sorted(after['buckets']):
+        b_rec = before['buckets'].get(bucket, {'bytes': 0, 'entries': []})
+        a_rec = after['buckets'][bucket]
+        bucket_delta = a_rec['bytes'] - b_rec['bytes']
+        b_entries = {e['key']: e['bytes'] for e in b_rec['entries']}
+        a_entries = {e['key']: e['bytes'] for e in a_rec['entries']}
+        changed = {key: a_entries.get(key, 0) - b_entries.get(key, 0)
+                   for key in set(b_entries) | set(a_entries)
+                   if a_entries.get(key, 0) != b_entries.get(key, 0)}
+        if not bucket_delta and not changed:
+            continue
+        print('%-14s %14s' % (bucket, human(bucket_delta)))
+        for key, entry_delta in sorted(changed.items(),
+                                       key=lambda kv: -abs(kv[1])):
+            state = ('added' if key not in b_entries else
+                     'removed' if key not in a_entries else 'resized')
+            print('    %-40s %14s  (%s)'
+                  % (key, human(entry_delta), state))
+    if delta > 0:
+        print('NOTE: attributed bytes grew — if this spans a drill that '
+              'should be footprint-neutral (e.g. a rollover swap), the '
+              'grown entries above are the leak suspects.')
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description='Render device-memory ledger snapshots '
+                    '(OBSERVABILITY.md "Device memory ledger").')
+    parser.add_argument('snapshot', help='ledger snapshot JSON '
+                        '(memory_report.json / memory_step<N>.json / '
+                        'oom_ledger.json)')
+    parser.add_argument('--diff', metavar='OLDER.json', default=None,
+                        help='print deltas from an older snapshot '
+                             '(leak check) instead of the full render')
+    parser.add_argument('--entries', type=int, default=4,
+                        help='entries shown per bucket (default 4)')
+    parser.add_argument('--events', type=int, default=10,
+                        help='allocation events shown (default 10)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit one machine-readable JSON line '
+                             'instead of tables')
+    args = parser.parse_args(argv)
+
+    snap = load(args.snapshot)
+    if args.diff:
+        before = load(args.diff)
+        if args.json:
+            from code2vec_tpu.telemetry.memory import MemoryLedger
+            print(json.dumps(MemoryLedger.diff(before, snap)))
+            return 0
+        print_diff(before, snap)
+        return 0
+    if args.json:
+        print(json.dumps({
+            'reason': snap.get('reason'),
+            'attributed_bytes': snap.get('attributed_bytes'),
+            'unattributed_bytes': snap.get('unattributed_bytes'),
+            'backend_live_bytes': snap.get('backend', {}).get(
+                'live_bytes'),
+            'budget_bytes': snap.get('budget_bytes'),
+            'buckets': {bucket: record['bytes'] for bucket, record
+                        in snap['buckets'].items()},
+            'watermarks': snap.get('watermarks', {}),
+        }))
+        return 0
+    print('ledger snapshot: %s (reason: %s)'
+          % (args.snapshot, snap.get('reason', '?')))
+    print_reconciliation(snap)
+    print()
+    print_buckets(snap, args.entries)
+    print_executables(snap)
+    print_events(snap, args.events)
+    return 0
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `memory_report.py ... | head` closes the pipe mid-table; die
+        # quietly like any well-behaved filter
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

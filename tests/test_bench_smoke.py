@@ -25,10 +25,14 @@ def test_bench_smoke_emits_one_json_line():
     lines, record = run_bench_smoke()
     assert len(lines) == 1
     assert set(record) == {'metric', 'value', 'unit', 'vs_baseline',
-                           'recipe', 'knobs', 'wire_bytes_per_batch'}
+                           'recipe', 'knobs', 'wire_bytes_per_batch',
+                           'peak_hbm_bytes', 'hbm_bytes_in_use'}
     # the packed wire format must be strictly smaller at realistic fill
     wire = record['wire_bytes_per_batch']
     assert 0 < wire['packed'] < wire['planes']
+    # the memory axis (ISSUE 9) rides every headline record; the CPU
+    # smoke backend has no memory_stats, so the gap is an EXPLICIT null
+    assert record['peak_hbm_bytes'] is None
     # a smoke line must never masquerade as the java14m number
     assert record['metric'] == 'train_examples_per_sec_SMOKE_ONLY'
     assert record['vs_baseline'] == 0.0
@@ -93,9 +97,13 @@ def test_bench_index_smoke_meets_acceptance():
     IVF recall@10 >= 0.95 at the default nprobe."""
     env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
                PYTHONPATH=REPO)
+    # best-of-4 reps: the >=10x floor is a warm-dispatch-vs-numpy ratio
+    # (nominal ~20x); best-of-2 was observed tipping to ~9.5x under
+    # full-suite machine load, so give min() more draws rather than
+    # weaken the acceptance threshold
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, 'benchmarks',
-                                      'bench_index.py'), '--reps', '2'],
+                                      'bench_index.py'), '--reps', '4'],
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     records = {r['metric']: r for r in

@@ -1,0 +1,503 @@
+"""Device-memory ledger tests (ISSUE 9): attribution semantics,
+snapshot diffs as the leak check, the HBM budget gate (typed failure
+BEFORE allocation + forensic dump), OOM forensics, MEM_NOW, the
+reconciliation acceptance on a CPU fit and a serving+index smoke, the
+canaried-rollover leak drill, zero-host-sync bookkeeping, and the
+graftlint ``alloc-catalog`` rule."""
+import gc
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry import memory
+from code2vec_tpu.telemetry.memory import (MemoryBudgetExceeded,
+                                           MemoryLedger)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Ledger + registry reset around every test: both are
+    process-global by design."""
+    memory.reset()
+    core.reset()
+    core.disable()
+    yield
+    memory.reset()
+    core.reset()
+    core.disable()
+
+
+def live_device_bytes() -> int:
+    gc.collect()
+    return memory.backend_memory()['live_bytes']
+
+
+# ---------------------------------------------------------------- units
+def test_tree_nbytes_arrays_and_abstract():
+    import jax
+    import jax.numpy as jnp
+    tree = {'a': jnp.zeros((4, 8), jnp.float32),
+            'b': np.zeros((3,), np.int32),
+            'c': jax.ShapeDtypeStruct((2, 2), jnp.bfloat16)}
+    assert memory.tree_nbytes(tree) == 4 * 8 * 4 + 3 * 4 + 2 * 2 * 2
+
+
+def test_register_replace_release_and_watermarks():
+    led = memory.ledger()
+    assert led.register('params', 'k', 1000) == 1000
+    # replace, not accumulate: same (bucket, key) is one owner
+    led.register('params', 'k', 400)
+    assert led.bucket_bytes('params') == 400
+    assert led.attributed_bytes() == 400
+    # the watermark remembers the peak
+    snap = led.snapshot(reconcile=False)
+    assert snap['watermarks']['params'] == 1000
+    assert led.release('params', 'k') == 400
+    assert led.release('params', 'k') == 0  # idempotent
+    with pytest.raises(ValueError, match='unknown ledger bucket'):
+        led.register('bogus', 'k', 1)
+
+
+def test_executables_excluded_from_attribution():
+    led = memory.ledger()
+    led.register('params', 'p', 100)
+    led.register('executables', 'e', 900, kind='executable',
+                 attrs={'tier': 'topk', 'bucket': 8, 'capacity': 64})
+    assert led.attributed_bytes() == 100
+    snap = led.snapshot(reconcile=False)
+    assert snap['executables_bytes'] == 900
+    assert snap['buckets']['executables']['entries'][0]['attrs'][
+        'tier'] == 'topk'
+
+
+def test_owner_finalizer_releases_on_gc():
+    led = memory.ledger()
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    led.register('index', 'fin', 256, owner=owner)
+    assert led.bucket_bytes('index') == 256
+    del owner
+    gc.collect()
+    assert led.bucket_bytes('index') == 0
+
+
+def test_snapshot_diff_flags_intentionally_retained_buffer():
+    """The leak-detection primitive: a device buffer retained between
+    two snapshots shows up either as unattributed growth (nobody
+    registered it) or as a grown ledger entry (its owner did)."""
+    import jax.numpy as jnp
+    led = memory.ledger()
+    before = led.snapshot()
+    retained = jnp.zeros((256, 128), jnp.float32)  # noqa: F841 — the leak
+    gc.collect()
+    after = led.snapshot()
+    diff = MemoryLedger.diff(before, after)
+    assert diff['backend_live_delta'] >= retained.nbytes
+    assert diff['unattributed_delta'] >= retained.nbytes
+    # once its owner registers it, the residual clears and the entry
+    # names the holder
+    led.register('staging', 'retained', retained)
+    attributed = led.snapshot()
+    diff2 = MemoryLedger.diff(before, attributed)
+    assert diff2['buckets']['staging']['entries']['retained'] \
+        == retained.nbytes
+    assert diff2['unattributed_delta'] < retained.nbytes
+
+
+# ------------------------------------------------------ budget + forensics
+def test_budget_blocks_exact_index_attach_without_allocating(tmp_path):
+    from code2vec_tpu.index.exact import ExactIndex
+    memory.configure(budget_bytes=10_000, dump_dir=str(tmp_path))
+    vectors = np.random.default_rng(0).normal(
+        size=(4096, 64)).astype(np.float32)  # ~1 MiB >> budget
+    before = live_device_bytes()
+    with pytest.raises(MemoryBudgetExceeded, match='index attach'):
+        ExactIndex(vectors, mesh=None)
+    # typed failure BEFORE allocation: nothing landed on device
+    assert live_device_bytes() == before
+    # and the forensic ledger dump exists and parses
+    dump = tmp_path / memory.OOM_DUMP_NAME
+    assert dump.is_file()
+    payload = json.loads(dump.read_text())
+    assert payload['reason'].startswith('budget')
+    assert payload['budget_bytes'] == 10_000
+    # with headroom the same attach succeeds and registers
+    memory.configure(budget_bytes=100 * 1024 * 1024)
+    index = ExactIndex(vectors, mesh=None)
+    assert memory.ledger().bucket_bytes('index') >= vectors.nbytes
+    del index
+    gc.collect()
+    assert memory.ledger().bucket_bytes('index') == 0
+
+
+def test_budget_resolves_from_env_var(monkeypatch):
+    monkeypatch.setenv(memory.ENV_BUDGET, '12345')
+    assert memory.ledger().budget_bytes() == 12345
+    memory.configure(budget_bytes=99)  # config pins over env
+    assert memory.ledger().budget_bytes() == 99
+
+
+def test_note_oom_dumps_only_on_oom_errors(tmp_path):
+    memory.configure(dump_dir=str(tmp_path))
+    led = memory.ledger()
+    led.register('params', 'p', 777)
+    assert led.note_oom(ValueError('unrelated'), 'ctx') is None
+    assert not (tmp_path / memory.OOM_DUMP_NAME).exists()
+    path = led.note_oom(
+        RuntimeError('RESOURCE_EXHAUSTED: Out of memory allocating '
+                     '1073741824 bytes'), 'serving.dispatch')
+    assert path is not None
+    payload = json.loads(open(path).read())
+    assert payload['reason'].startswith('oom: serving.dispatch')
+    assert payload['buckets']['params']['bytes'] == 777
+    assert payload['events'][-1]['key'] == 'p'
+
+
+def test_ledger_bookkeeping_never_syncs_the_device(monkeypatch,
+                                                   tmp_path):
+    """The zero-host-sync contract: register / snapshot / dump touch
+    array METADATA only — never device_get / block_until_ready."""
+    import jax
+    import jax.numpy as jnp
+    memory.configure(dump_dir=str(tmp_path))
+    params = {'w': jnp.ones((64, 32)), 'b': jnp.zeros((32,))}
+
+    def forbidden(*_a, **_k):
+        raise AssertionError('ledger bookkeeping synced the device')
+
+    monkeypatch.setattr(jax, 'device_get', forbidden)
+    monkeypatch.setattr(jax, 'block_until_ready', forbidden)
+    led = memory.ledger()
+    led.register('params', 'p', params)
+    led.export_gauges()
+    snap = led.snapshot()  # reconciles via live_arrays: still no sync
+    assert snap['attributed_bytes'] == memory.tree_nbytes(params)
+    led.dump(reason='guard')
+    led.release('params', 'p')
+
+
+# ------------------------------------------------- e2e: CPU fit acceptance
+def test_fit_reconciliation_mem_now_and_gauges(tmp_path):
+    """ISSUE 9 acceptance, training half: on a CPU fit with telemetry
+    on, attributed + unattributed ≡ backend live bytes (the snapshot
+    identity) and the unattributed residual of the run's own growth
+    stays under 10%; MEM_NOW yields a live snapshot; the mem/* gauges
+    land in metrics.jsonl; the staging bucket drains to zero."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from tests.test_train_overfit import make_dataset
+
+    prefix = make_dataset(tmp_path)
+    tele_dir = tmp_path / 'tele'
+    tele_dir.mkdir()
+    (tele_dir / memory.TOUCH_FILE_NAME).touch()  # MEM_NOW pre-armed
+    gc.collect()
+    before = memory.ledger().snapshot()
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        NUM_BATCHES_TO_LOG_PROGRESS=2, TELEMETRY=True,
+        TELEMETRY_DIR=str(tele_dir), TELEMETRY_FLUSH_EVERY_STEPS=1)
+    model = Code2VecModel(config)
+    model.train()
+    gc.collect()
+    after = memory.ledger().snapshot()
+
+    # the snapshot identity: attributed + unattributed == live, exactly
+    assert (after['attributed_bytes'] + after['unattributed_bytes']
+            == after['backend']['live_bytes'])
+    # this run's growth reconciles: the residual (loss scalars, rng
+    # keys, in-flight batch) is under 10% of what the run brought up
+    diff = MemoryLedger.diff(before, after)
+    assert diff['backend_live_delta'] > 0
+    assert diff['attributed_delta'] > 0
+    assert abs(diff['unattributed_delta']) \
+        < 0.10 * diff['backend_live_delta'], diff
+    # params + opt state attributed; the staging ring drained clean
+    assert after['buckets']['params']['bytes'] > 0
+    assert after['buckets']['opt_state']['bytes'] > 0
+    assert diff['buckets']['staging']['bytes_delta'] == 0
+    assert not diff['buckets']['staging']['entries']
+
+    # MEM_NOW: consumed, snapshot written, renderable
+    assert not (tele_dir / memory.TOUCH_FILE_NAME).exists()
+    mem_snaps = sorted(tele_dir.glob('memory_step*.json'))
+    assert mem_snaps, list(tele_dir.iterdir())
+    payload = json.loads(mem_snaps[0].read_text())
+    assert payload['reason'].startswith('MEM_NOW')
+    assert payload['buckets']['params']['bytes'] > 0
+
+    # mem/* gauges exported through the standard JSONL stream
+    tags = set()
+    with open(tele_dir / 'metrics.jsonl') as f:
+        for line in f:
+            tags.add(json.loads(line)['tag'])
+    for tag in ('mem/params_bytes', 'mem/opt_state_bytes',
+                'mem/staging_bytes', 'mem/attributed_bytes',
+                'mem/budget_bytes'):
+        assert tag in tags, sorted(t for t in tags if t.startswith('mem'))
+
+
+# --------------------------------- e2e: serving + index smoke acceptance
+@pytest.fixture(scope='module')
+def served_model(tmp_path_factory):
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from tests.test_train_overfit import make_dataset
+    prefix = make_dataset(tmp_path_factory.mktemp('memserve'))
+    save_path = str(tmp_path_factory.mktemp('memserve_model') / 'model')
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), MODEL_SAVE_PATH=save_path,
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1,
+        SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8', SERVING_CANARY_TIMEOUT_SECS=0.0,
+        # the telemetry LAYER gates the warmup executable measurement
+        TELEMETRY=True)
+    return Code2VecModel(config)
+
+
+PREDICT_LINES = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+]
+
+
+def test_serving_index_smoke_reconciles_and_stays_warm(served_model):
+    """ISSUE 9 acceptance, serving half: engine + attached exact index
+    reconcile (residual < 10% of the smoke's growth), the warm ladder's
+    executables are measured per (bucket x capacity x tier), and ledger
+    work adds ZERO post-warmup compiles."""
+    from code2vec_tpu.index.exact import ExactIndex
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+
+    # the model (and its params) predate this test (and the autouse
+    # ledger reset): re-register its state — same keys, so this is the
+    # idempotent replace — then measure the smoke's own growth
+    served_model.trainer.register_state_memory(
+        served_model.params, served_model.state.opt_state)
+    gc.collect()
+    before = memory.ledger().snapshot()
+    core.enable()  # as a telemetry-on serving run would be
+    install_compile_listener()
+    compiles = core.registry().counter('jit/compiles_total')
+    rng = np.random.default_rng(0)
+    store = rng.normal(size=(128, 384)).astype(np.float32)
+    engine = served_model.serving_engine(tiers=('topk', 'vectors'),
+                                         max_delay_ms=0.0)
+    try:
+        index = ExactIndex(store, mesh=None,
+                           labels=np.array(['m%d' % i
+                                            for i in range(128)]))
+        index.warmup(k=4)
+        engine.attach_index(index)
+        warm_compiles = compiles.value
+        for _ in range(2):
+            engine.predict(PREDICT_LINES, tier='topk')
+        neighbors = engine.predict_neighbors(PREDICT_LINES, k=4)
+        assert len(neighbors) == len(PREDICT_LINES)
+        gc.collect()
+        after = memory.ledger().snapshot()
+        # zero post-warmup compiles: ledger bookkeeping (register,
+        # snapshot, reconcile) never traces or dispatches
+        assert compiles.value == warm_compiles
+
+        # the index is attributed, and the warm ladder was measured
+        assert after['buckets']['index']['bytes'] >= store.nbytes
+        executables = after['buckets']['executables']['entries']
+        assert executables, 'warmup measured no executables'
+        seen = {(e['attrs']['tier'], e['attrs']['bucket'])
+                for e in executables}
+        assert ('topk', 8) in seen and ('vectors', 8) in seen
+        for entry in executables:
+            assert entry['attrs']['argument_bytes'] > 0
+
+        # reconciliation: identity holds, and the smoke's residual
+        # (tokenizer tables, decode buffers) is bounded
+        assert (after['attributed_bytes'] + after['unattributed_bytes']
+                == after['backend']['live_bytes'])
+        diff = MemoryLedger.diff(before, after)
+        assert diff['backend_live_delta'] > 0
+        assert abs(diff['unattributed_delta']) \
+            < 0.10 * max(diff['backend_live_delta'],
+                         after['buckets']['index']['bytes']), diff
+    finally:
+        engine.close()
+    # a closed engine retires its entries; the index releases on GC
+    assert memory.ledger().bucket_bytes('params') \
+        == memory.tree_nbytes(served_model.params)
+
+
+def test_rollover_leak_drill_params_return_to_baseline(served_model):
+    """The rollover leak drill (ISSUE 9 satellite): repeated CANARIED
+    load_params rollovers must return the params-bucket footprint to
+    baseline after every swap — the old set is actually freed, not
+    pinned by the shadow scorer or an armed-rollover remnant."""
+    served_model.save(state=served_model.state, epoch=0, wait=True)
+    served_model.trainer.register_state_memory(
+        served_model.params, served_model.state.opt_state)
+    set_bytes = memory.tree_nbytes(served_model.params)
+    engine = served_model.serving_engine(tiers=('topk',),
+                                         max_delay_ms=0.0)
+    try:
+        def one_rollover():
+            handle = engine.load_params(0, canary_batches=1,
+                                        min_agreement=0.0)
+            # the armed canary's SECOND copy is ledger-visible
+            snap = memory.ledger().snapshot(reconcile=False)
+            keys = [e['key'] for e in
+                    snap['buckets']['params']['entries']]
+            assert any(k.endswith('/candidate') for k in keys), keys
+            engine.predict(PREDICT_LINES, tier='topk')  # concludes it
+            report = handle.result(timeout=60)
+            assert report['swapped'] is True
+            gc.collect()
+            return (memory.ledger().bucket_bytes('params'),
+                    live_device_bytes())
+
+        baseline_params, baseline_live = one_rollover()
+        # baseline holds the model's set + the engine's swapped-in set
+        assert baseline_params >= 2 * set_bytes
+        for _ in range(2):
+            params_bytes, live = one_rollover()
+            # ledger: exactly back to baseline after every swap
+            assert params_bytes == baseline_params
+            # backend: no param-set accumulation (a leak of even one
+            # retained set would show up whole)
+            assert abs(live - baseline_live) < 0.5 * set_bytes, \
+                (live, baseline_live, set_bytes)
+    finally:
+        engine.close()
+    gc.collect()
+    assert memory.ledger().bucket_bytes('params') \
+        == memory.tree_nbytes(served_model.params)
+
+
+# ------------------------------------------------------- report CLI
+def test_memory_report_cli_render_diff_and_json(tmp_path, capsys):
+    scripts_dir = os.path.join(REPO, 'scripts')
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import memory_report
+    led = memory.ledger()
+    led.register('params', 'trainer', 4096)
+    led.register('executables', 'engine/topk/b8/c64', 512,
+                 kind='executable',
+                 attrs={'tier': 'topk', 'bucket': 8, 'capacity': 64,
+                        'generated_code_bytes': 512, 'temp_bytes': 0,
+                        'argument_bytes': 1024, 'output_bytes': 64})
+    a_path = str(tmp_path / 'a.json')
+    led.dump(a_path, reason='before')
+    led.register('staging', 'leaked', 2048)
+    b_path = str(tmp_path / 'b.json')
+    led.dump(b_path, reason='after')
+
+    assert memory_report.main([a_path]) == 0
+    out = capsys.readouterr().out
+    assert 'params' in out and 'unattributed residual' in out
+    assert 'warm serving ladder' in out and 'topk' in out
+
+    assert memory_report.main([b_path, '--diff', a_path]) == 0
+    out = capsys.readouterr().out
+    assert 'leaked' in out and 'staging' in out and 'added' in out
+
+    assert memory_report.main([b_path, '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['buckets']['staging'] == 2048
+
+
+# ------------------------------------------------ graftlint alloc-catalog
+CLEAN_OWNER = '''
+import jax
+
+class ExactIndex:
+    def __init__(self, vectors, neg_mask):
+        self._matrix = jax.device_put(vectors)
+        self._neg_mask = jax.device_put(neg_mask)
+        self._a = jax.device_put(vectors)
+        self._b = jax.device_put(neg_mask)
+'''
+
+
+def lint_alloc(tmp_path, exact_py_text):
+    from code2vec_tpu.analysis import engine as lint_engine
+    pkg = tmp_path / 'code2vec_tpu' / 'index'
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / 'exact.py').write_text(exact_py_text)
+    return lint_engine.run(root=str(tmp_path),
+                           rule_names=['alloc-catalog'],
+                           baseline_path='')
+
+
+def test_alloc_catalog_quiet_on_cataloged_counts(tmp_path):
+    # 4 device_put sites in ExactIndex.__init__ — exactly the pinned
+    # count, so the owner file is clean
+    report = lint_alloc(tmp_path, CLEAN_OWNER)
+    assert not report.findings, report.findings
+
+
+def test_alloc_catalog_fires_on_uncataloged_site(tmp_path):
+    code = CLEAN_OWNER + '''
+
+def rogue(x):
+    return jax.device_put(x)
+'''
+    report = lint_alloc(tmp_path, code)
+    messages = [f.message for f in report.findings]
+    assert any('rogue' in m and 'not in the alloc catalog' in m
+               for m in messages), messages
+
+
+def test_alloc_catalog_pins_counts(tmp_path):
+    # a FIFTH device_put inside the cataloged function: count drift
+    code = CLEAN_OWNER.replace(
+        '        self._b = jax.device_put(neg_mask)',
+        '        self._b = jax.device_put(neg_mask)\n'
+        '        self._extra = jax.device_put(vectors)')
+    report = lint_alloc(tmp_path, code)
+    messages = [f.message for f in report.findings]
+    assert any('pins 4 allocation site(s)' in m and 'found 5' in m
+               for m in messages), messages
+
+
+def test_alloc_catalog_flags_stale_entries(tmp_path):
+    # the owner file exists but the cataloged function allocates
+    # nothing: stale entry
+    report = lint_alloc(tmp_path, 'X = 1\n')
+    messages = [f.message for f in report.findings]
+    assert any('ExactIndex.__init__ is stale' in m
+               for m in messages), messages
+
+
+def test_alloc_catalog_suppression_with_reason(tmp_path):
+    code = CLEAN_OWNER + '''
+
+def rogue(x):
+    # graftlint: disable=alloc-catalog -- test: sanctioned one-off
+    return jax.device_put(x)
+'''
+    report = lint_alloc(tmp_path, code)
+    assert not report.findings, report.findings
+    assert len(report.suppressed) == 1
+
+
+def test_alloc_catalog_ignores_docstrings(tmp_path):
+    code = CLEAN_OWNER + '''
+
+def documented(x):
+    """Mentions jax.device_put(x) and jnp.zeros(n) in prose only."""
+    return x
+'''
+    report = lint_alloc(tmp_path, code)
+    assert not report.findings, report.findings
