@@ -1,0 +1,223 @@
+"""On-chip A/B for the ragged packed-wire fusion (ISSUE 10).
+
+Measures the packed TRAIN step and the packed PREDICT step (attention
+tier — the encoder + attention softmax both fused paths replace) with
+``USE_PALLAS_RAGGED_FUSION`` off (unpack-then-dense, the PR-1 path) and
+on (ops/pallas_ragged.py), at the java14m headline shape and realistic
+fill. Each arm runs in its OWN subprocess so the per-arm
+``peak_hbm_bytes`` (benchlib.device_memory_record) is that arm's peak,
+not the max over both — the fused path's claim is a step-time AND an
+HBM-footprint win, so both axes ride every record.
+
+Knobs (the capture stages set them):
+
+  BENCH_SMOKE=1       tiny CPU shapes, metrics renamed *_SMOKE_ONLY
+  BENCH_CONTEXTS=N    override max_contexts (the fused path's best case
+                      is high capacity / low fill, where the dense
+                      planes are mostly padding)
+  BENCH_FILL=F        mean fill fraction of the packed batches
+                      (default benchlib.JAVA14M_FILL = 0.25)
+
+Emits one JSON line per (arm x step kind), then the fused/unfused
+speedup + peak-HBM ratio records summarize_captures.py surfaces:
+
+  {"measure": "step_ms_ragged_train_fused", "value": ..., "fill": ...}
+  {"measure": "ragged_fusion_train_speedup", "value": ..., ...}
+  {"verdict": "keep-fused" | "keep-unfused", ...}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+
+SMOKE = benchlib.smoke_requested()
+SHAPES = benchlib.SMOKE_SHAPES if SMOKE else benchlib.JAVA14M
+_contexts = int(os.environ.get('BENCH_CONTEXTS', '0'))
+if _contexts:
+    SHAPES = SHAPES._replace(max_contexts=_contexts)
+FILL = float(os.environ.get('BENCH_FILL', str(benchlib.JAVA14M_FILL)))
+WARMUP_STEPS, MEASURE_STEPS = benchlib.bench_steps(SMOKE)
+VARIANTS = ('unfused', 'fused')
+
+
+def _suffix(name: str) -> str:
+    name = name + ('_SMOKE_ONLY' if SMOKE else '')
+    return name + (('_c%d' % _contexts) if _contexts else '')
+
+
+def measure(fused: bool):
+    """One arm: (train_ms_per_step, predict_ms_per_step, engaged)."""
+    import jax
+    import jax.numpy as jnp
+
+    config = benchlib.headline_config(
+        SHAPES, USE_PALLAS_RAGGED_FUSION=fused)
+    trainer, state = benchlib.build_trainer(config, SHAPES)
+    host = benchlib.random_batches(SHAPES, 4, seed=1, fill=FILL)
+    packed = benchlib.pack_batches(host, trainer)
+    placed = benchlib.staged(trainer, packed)
+
+    # engagement check (TPU fused arm only): the compiled attention-tier
+    # packed program must contain the Mosaic custom-call, or the "A/B"
+    # compares XLA against itself (bench_pallas_encode precedent)
+    engaged = False
+    if fused and not SMOKE:
+        fn = trainer._predict_steps[('attention', 'packed')]
+        engaged = benchlib.mosaic_engaged(fn, state.params, placed[0])
+
+    # ---- train: steps serialize on the state dependency; block once
+    def train_chain(steps: int) -> float:
+        nonlocal state
+        loss = None
+        for i in range(steps):
+            state, loss = trainer.train_step_placed(
+                state, placed[i % len(placed)])
+        return float(loss)
+
+    train_chain(WARMUP_STEPS)
+    t0 = time.perf_counter()
+    train_chain(MEASURE_STEPS)
+    train_ms = 1e3 * (time.perf_counter() - t0) / MEASURE_STEPS
+
+    # ---- predict (attention tier): thread a scalar from each output
+    # into the next input's count so the chain serializes on device
+    # exactly like train's state dependency (bench.py methodology)
+    chain_count = jax.jit(
+        lambda count, token: count + (token * 0).astype(jnp.int32))
+
+    def predict_chain(steps: int) -> float:
+        token = jnp.zeros((), jnp.float32)
+        for i in range(steps):
+            ctx, count, label, weight = placed[i % len(placed)]
+            out = trainer.predict_step_placed(
+                state.params, (ctx, chain_count(count, token), label,
+                               weight), tier='attention')
+            token = out['topk_scores'].sum()
+        return float(token)
+
+    predict_chain(WARMUP_STEPS)
+    t0 = time.perf_counter()
+    predict_chain(MEASURE_STEPS)
+    predict_ms = 1e3 * (time.perf_counter() - t0) / MEASURE_STEPS
+    return train_ms, predict_ms, engaged
+
+
+def run_variant(variant: str) -> None:
+    """Child mode: one arm in this process (own peak-HBM watermark)."""
+    import jax
+    benchlib.honor_env_platforms()
+    platform = jax.devices()[0].platform.lower()
+    if not SMOKE:
+        from code2vec_tpu.ops._pallas_common import tpu_backend_active
+        if not tpu_backend_active():
+            print(json.dumps({'error': 'tpu_unavailable',
+                              'detail': f'platform={platform}'}),
+                  flush=True)
+            sys.exit(2)
+    fused = variant == 'fused'
+    try:
+        train_ms, predict_ms, engaged = measure(fused)
+    except Exception as exc:  # a kernel compile failure IS the answer
+        print(json.dumps({'variant': variant, 'error': str(exc)[:300]}),
+              flush=True)
+        sys.exit(1)
+    if fused and not engaged and not SMOKE:
+        print(json.dumps({
+            'variant': variant, 'error': 'kernel_not_engaged',
+            'detail': 'compiled packed predict HLO has no Mosaic '
+                      'custom-call'}), flush=True)
+        sys.exit(3)
+    memory = benchlib.device_memory_record()
+    for kind, value in (('train', train_ms), ('predict', predict_ms)):
+        print(json.dumps({
+            'measure': _suffix('step_ms_ragged_%s_%s' % (kind, variant)),
+            'value': round(value, 3), 'unit': 'ms/step',
+            'variant': variant, 'fill': FILL,
+            'contexts': SHAPES.max_contexts,
+            'batch': SHAPES.batch_size, **memory}), flush=True)
+
+
+def main() -> None:
+    """Parent: each arm in its own subprocess under a per-arm timeout
+    (a Mosaic compile stall costs one arm, not the healthy window);
+    the parent imports no jax and never touches the tunnel."""
+    variant = os.environ.get('BENCH_PALLAS_RAGGED_VARIANT', '')
+    if variant:
+        run_variant(variant)
+        return
+    import subprocess
+    per_arm = float(os.environ.get('BENCH_PALLAS_ARM_TIMEOUT',
+                                   '240' if SMOKE else '780'))
+    values: dict = {}
+    hbm: dict = {}
+    for variant in VARIANTS:
+        env = dict(os.environ, BENCH_PALLAS_RAGGED_VARIANT=variant)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=per_arm)
+            out, rc = proc.stdout, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout.decode(errors='replace')
+                   if isinstance(e.stdout, bytes) else (e.stdout or ''))
+            rc = -1
+            print(json.dumps({'variant': variant, 'error': 'arm_timeout',
+                              'timeout_s': per_arm}), flush=True)
+        for line in out.splitlines():
+            line = line.strip()
+            if not line.startswith('{'):
+                continue
+            print(line, flush=True)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            measure_name = rec.get('measure', '')
+            if rec.get('variant') == variant and 'value' in rec:
+                for kind in ('train', 'predict'):
+                    if ('_%s_' % kind) in measure_name:
+                        values[(kind, variant)] = rec['value']
+                        hbm[variant] = rec.get('peak_hbm_bytes')
+            if rec.get('error') == 'tpu_unavailable':
+                # keep the watcher stage PENDING on a wedge mid-A/B
+                sys.exit(2)
+        if rc != 0:
+            if variant == 'fused':
+                print(json.dumps({
+                    'verdict': 'keep-unfused',
+                    'reason': 'fused arm failed or timed out'}),
+                    flush=True)
+            sys.exit(4)
+    speedups = {}
+    for kind in ('train', 'predict'):
+        if (kind, 'unfused') in values and (kind, 'fused') in values \
+                and values[(kind, 'fused')] > 0:
+            speedups[kind] = values[(kind, 'unfused')] \
+                / values[(kind, 'fused')]
+            print(json.dumps({
+                'measure': _suffix('ragged_fusion_%s_speedup' % kind),
+                'value': round(speedups[kind], 4), 'fill': FILL,
+                'contexts': SHAPES.max_contexts}), flush=True)
+    if hbm.get('unfused') and hbm.get('fused'):
+        print(json.dumps({
+            'measure': _suffix('ragged_fusion_peak_hbm_ratio'),
+            'value': round(hbm['fused'] / hbm['unfused'], 4),
+            'fill': FILL, 'contexts': SHAPES.max_contexts}), flush=True)
+    if 'train' in speedups:
+        # the >=2% flip rule (PERF.md) keys on the train step
+        print(json.dumps({
+            'verdict': ('keep-fused' if speedups['train'] > 1.02
+                        else 'keep-unfused'),
+            'speedup': round(speedups['train'], 4)}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -96,6 +96,21 @@ probe_or_record "after pallas_ab" || exit 3
 BENCH_CONTEXTS=1024 run_stage pallas_ab_c1024 900 \
   python benchmarks/bench_pallas_encode.py
 probe_or_record "after pallas_ab_c1024" || exit 3
+# ragged packed-wire fusion A/B (ISSUE 10): packed train + predict step
+# time AND per-arm peak HBM, fused vs unpack-then-dense — first at the
+# java14m headline fill, then the fused path's best case (high
+# max_contexts, low fill, where the dense planes are mostly padding).
+# Per-arm timeout pinned so BOTH arms fit inside the 900 s stage budget
+# (the default 780 s/arm would let one stalled arm eat the stage);
+# watch_and_capture.sh carries the big-budget variant for compile
+# stalls that need it.
+BENCH_PALLAS_ARM_TIMEOUT=390 run_stage pallas_ragged 900 \
+  python benchmarks/bench_pallas_ragged.py
+probe_or_record "after pallas_ragged" || exit 3
+BENCH_CONTEXTS=1024 BENCH_FILL=0.1 BENCH_PALLAS_ARM_TIMEOUT=390 \
+  run_stage pallas_ragged_c1024 900 \
+  python benchmarks/bench_pallas_ragged.py
+probe_or_record "after pallas_ragged_c1024" || exit 3
 # serving engine A/B (ISSUE 4): naive per-request predict vs the
 # micro-batching engine — on-chip latency p50/p99 + throughput; the
 # traced arm (ISSUE 8) keeps its span log durable so the per-phase

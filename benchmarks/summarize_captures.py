@@ -73,6 +73,11 @@ def main() -> None:
                                'step_ms', 'partition_overhead_vs_1dev',
                                'attempts', 'phase', 'tier', 'bucket',
                                'p50', 'p99',
+                               # ragged-fusion A/B axes (ISSUE 10): the
+                               # fused-vs-unfused step-time records key
+                               # on these to be comparable across
+                               # capture rounds
+                               'fill', 'contexts',
                                # the memory axis (ISSUE 9): per-stage
                                # peak HBM; None = stats-less backend,
                                # an explicit gap

@@ -111,7 +111,7 @@ run_stage() {  # run_stage <name> <timeout> <cmd...>
   return ${rc}
 }
 
-ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes headline_v3 accuracy_tpu_bf16nu profile_v2"
+ALL_STAGES="headline diag embed_grad fused_ce rbg_dropout accuracy_tpu pallas_c1024 headline_v2 accuracy_tpu_bf16mu moment_dtypes headline_v3 accuracy_tpu_bf16nu profile_v2 pallas_ragged pallas_ragged_c1024"
 
 all_captured() {
   local s
@@ -207,6 +207,17 @@ probe || { hb "wedged after accuracy_tpu_bf16nu"; exit 3; }
 # defaults (capture_profile.py uses the default recipe): updates the
 # roofline decomposition from the 49 ms era to the post-flip step
 run_stage profile_v2 1200 python benchmarks/capture_profile.py
+probe || { hb "wedged after profile_v2"; exit 3; }
+# ragged packed-wire fusion A/B (ISSUE 10): fused vs unpack-then-dense
+# packed train/predict step time + per-arm peak HBM, at the headline
+# fill and at the fused path's best case (C=1024, fill 0.1). The fused
+# arm pays one Mosaic compile; the persistent compile cache above makes
+# later windows a disk hit.
+run_stage pallas_ragged 1800 python benchmarks/bench_pallas_ragged.py
+probe || { hb "wedged after pallas_ragged"; exit 3; }
+BENCH_CONTEXTS=1024 BENCH_FILL=0.1 BENCH_PALLAS_ARM_TIMEOUT=2400 \
+  run_stage pallas_ragged_c1024 3100 \
+  python benchmarks/bench_pallas_ragged.py
 
 # Exit 0 ONLY when every stage holds a fresh capture — otherwise the
 # supervisor must keep respawning us for the stages still pending (a

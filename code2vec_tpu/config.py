@@ -227,6 +227,24 @@ class Config:
     # re-measuring for long-context configs (MAX_CONTEXTS >> 200) where
     # the encode block dominates.
     USE_PALLAS_FUSED_ENCODE: bool = False
+    # Run encode + attention straight off the packed wire
+    # (ops/pallas_ragged.py): the (D, cap, 3) triples + counts feed a
+    # ragged fused encoder — gather, row-split transform, tanh, score,
+    # and a FuseMax-style single-pass per-example softmax + weighted sum
+    # — so the (B, max_contexts) segment-scatter unpack and every dense
+    # (B, C, .) intermediate disappear from the packed train/eval/
+    # predict/serving programs. On a real TPU backend the deterministic
+    # forward runs the Pallas kernel; training (dropout, backward) and
+    # non-TPU backends run the differentiable jnp twin on the same
+    # packed layout. Outputs match the unpack-then-dense path to fp32
+    # rounding (tests/test_pallas_ragged.py); dropout draws its mask
+    # over the packed layout (a different seed-keyed stream, the
+    # DROPOUT_PRNG_IMPL precedent). OFF by default until the on-chip
+    # A/B (benchmarks/bench_pallas_ragged.py) clears the >=2% flip rule
+    # at the java14m shape; biggest expected wins at high MAX_CONTEXTS /
+    # low fill, where the dense path is mostly padding (PERF.md "Ragged
+    # fusion").
+    USE_PALLAS_RAGGED_FUSION: bool = False
     # When set, capture a jax.profiler trace of a few training steps into
     # this directory (viewable with TensorBoard/Perfetto) — the step-level
     # profiler the reference lacked (SURVEY.md §5 'Tracing / profiling').
@@ -551,6 +569,13 @@ class Config:
                             help='train-time CE via the flash-style fused '
                                  'Pallas kernel: no (B, V) logits in HBM '
                                  '(ops/pallas_ce.py, PERF.md)')
+        parser.add_argument('--ragged-fusion', dest='ragged_fusion',
+                            action='store_true',
+                            help='fuse encode + attention straight off '
+                                 'the packed wire: no device-side '
+                                 'unpack, no dense (B, C, .) '
+                                 'intermediates (ops/pallas_ragged.py, '
+                                 'PERF.md)')
         parser.add_argument('--remat-encode', dest='remat_encode',
                             action='store_true',
                             help='recompute encode activations in the '
@@ -773,6 +798,8 @@ class Config:
             self.EMBED_GRAD_IMPL = parsed.embed_grad_impl
         if parsed.fused_ce:
             self.USE_PALLAS_FUSED_CE = True
+        if parsed.ragged_fusion:
+            self.USE_PALLAS_RAGGED_FUSION = True
         if parsed.remat_encode:
             self.REMAT_ENCODE = True
         if parsed.opt_state_sharding:

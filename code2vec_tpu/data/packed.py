@@ -288,6 +288,46 @@ def unpack_batch_host(packed: PackedBatch, max_contexts: int,
                  target_strings=packed.target_strings)
 
 
+def segment_structure(count2, cap: int):
+    """Segment structure of the packed stream, per shard — THE single
+    definition of the parity-critical slot->example arithmetic, shared
+    by the device unpack below and the ragged fused encoder
+    (ops/pallas_ragged.py).
+
+    ``count2`` is the ``(data_shards, per_shard)`` per-example lengths
+    (a device array inside jit); returns ``(seg, pos, in_range)``, each
+    ``(data_shards, cap)``:
+
+    - ``seg``: segment ids — +1 at each example's start offset,
+      cumsummed; repeated starts (zero-length examples) accumulate, and
+      slots past the shard's retained total all map to the LAST example
+      (the unpack scatters them onto its PAD tail; the fused encoder
+      masks them via ``in_range``). The inc row index must be shaped
+      like ``starts[:, 1:]`` — (D, Bs-1), NOT a slice of the (D, cap)
+      grid: per-shard batch can exceed capacity.
+    - ``pos``: the slot's position within its example — its plane
+      column (past-the-count for capacity padding).
+    - ``in_range``: slot < the shard's retained total (capacity padding
+      is not).
+    """
+    import jax.numpy as jnp
+
+    shards, per_shard = count2.shape
+    starts = jnp.cumsum(count2, axis=1) - count2            # (D, Bs)
+    inc = jnp.zeros((shards, cap), jnp.int32)
+    if per_shard > 1:
+        row_idx = jnp.broadcast_to(
+            jnp.arange(shards, dtype=jnp.int32)[:, None],
+            (shards, per_shard - 1))
+        inc = inc.at[row_idx, starts[:, 1:]].add(1, mode='drop')
+    seg = jnp.cumsum(inc, axis=1)                           # (D, cap)
+    pos = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+           - jnp.take_along_axis(starts, seg, axis=1))      # (D, cap)
+    in_range = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                < count2.sum(axis=1)[:, None])              # (D, cap)
+    return seg, pos, in_range
+
+
 def unpack_device(ctx, count, max_contexts: int, token_pad: int,
                   path_pad: int):
     """Jitted device-side inverse of ``pack_batch``: segment-scatter the
@@ -310,23 +350,9 @@ def unpack_device(ctx, count, max_contexts: int, token_pad: int,
     batch = count.shape[0]
     per_shard = batch // shards
     count2 = count.reshape(shards, per_shard)
-    starts = jnp.cumsum(count2, axis=1) - count2            # (D, Bs)
+    seg, pos, _in_range = segment_structure(count2, cap)
     shard_idx = jnp.broadcast_to(
         jnp.arange(shards, dtype=jnp.int32)[:, None], (shards, cap))
-    # segment ids: +1 at each example's start offset, cumsummed; repeated
-    # starts (zero-length examples) accumulate, rows past the shard's
-    # total all map to the last example and scatter onto its PAD tail.
-    # The row index must be shaped like starts[:, 1:] — (D, Bs-1), NOT a
-    # slice of the (D, cap) grid: per-shard batch can exceed capacity.
-    inc = jnp.zeros((shards, cap), jnp.int32)
-    if per_shard > 1:
-        row_idx = jnp.broadcast_to(
-            jnp.arange(shards, dtype=jnp.int32)[:, None],
-            (shards, per_shard - 1))
-        inc = inc.at[row_idx, starts[:, 1:]].add(1, mode='drop')
-    seg = jnp.cumsum(inc, axis=1)                           # (D, cap)
-    pos = (jnp.arange(cap, dtype=jnp.int32)[None, :]
-           - jnp.take_along_axis(starts, seg, axis=1))      # (D, cap)
 
     def scatter(vals, fill):
         out = jnp.full((shards, per_shard, max_contexts), fill, jnp.int32)

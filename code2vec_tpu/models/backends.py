@@ -118,6 +118,42 @@ class JaxBackend:
             num_valid_targets=self.num_valid_targets)
         return code_vectors, attention, logits
 
+    def loss_fn_packed(self, params, packed_arrays, dropout_rng,
+                       mesh=None) -> Tuple[jax.Array, Any]:
+        """``loss_fn`` straight off the packed wire (USE_PALLAS_RAGGED_
+        FUSION): the ragged fused encoder consumes the (D, cap, 3)
+        triples + counts directly — no device-side unpack, no (B, C, .)
+        planes (ops/pallas_ragged.py)."""
+        ctx, count, label, weight = packed_arrays
+        return functional.loss_and_aux_packed(
+            params, ctx, count, label, weight,
+            max_contexts=self.config.MAX_CONTEXTS,
+            token_pad=self.token_pad_index,
+            path_pad=self.path_pad_index,
+            dropout_rng=dropout_rng,
+            dropout_keep_rate=self.config.DROPOUT_KEEP_RATE,
+            dropout_prng_impl=self.config.DROPOUT_PRNG_IMPL,
+            dtype=self.dtype, num_valid_targets=self.num_valid_targets,
+            embed_grad_impl=self.config.EMBED_GRAD_IMPL,
+            use_fused_ce=self.config.USE_PALLAS_FUSED_CE,
+            fused_ce_mesh=mesh,
+            remat_encode=self.config.REMAT_ENCODE)
+
+    def forward_packed(self, params, packed_arrays, mesh=None):
+        """Deterministic forward off the packed wire: on a real TPU
+        backend the fused Pallas kernel runs (shard_mapped over ``mesh``
+        when multi-device); elsewhere the jnp twin."""
+        ctx, count = packed_arrays[0], packed_arrays[1]
+        code_vectors, attention = functional.encode_packed(
+            params, ctx, count, max_contexts=self.config.MAX_CONTEXTS,
+            token_pad=self.token_pad_index,
+            path_pad=self.path_pad_index, dtype=self.dtype,
+            embed_grad_impl=self.config.EMBED_GRAD_IMPL, mesh=mesh)
+        logits = functional.compute_logits(
+            params, code_vectors, dtype=self.dtype,
+            num_valid_targets=self.num_valid_targets)
+        return code_vectors, attention, logits
+
     def named_params(self, params) -> functional.Code2VecParams:
         return params
 
@@ -173,6 +209,18 @@ class FlaxBackend:
         source, path, target, mask = arrays[:4]
         return self.module.apply(params, source, path, target, mask,
                                  deterministic=True)
+
+    def loss_fn_packed(self, params, packed_arrays, dropout_rng,
+                       mesh=None) -> Tuple[jax.Array, Any]:
+        # same delegation as loss_fn: the packed-wire math is identical
+        # across backends by construction
+        return self._jax_twin.loss_fn_packed(
+            self.named_params(params), packed_arrays, dropout_rng,
+            mesh=mesh)
+
+    def forward_packed(self, params, packed_arrays, mesh=None):
+        return self._jax_twin.forward_packed(
+            self.named_params(params), packed_arrays, mesh=mesh)
 
     def named_params(self, params) -> functional.Code2VecParams:
         inner = params['params']

@@ -94,6 +94,20 @@ def init_params(rng: jax.Array, *, token_vocab_size: int,
     )
 
 
+def dropout_keep_mask(dropout_rng: jax.Array, keep_rate: float, shape,
+                      prng_impl: str) -> jax.Array:
+    """Bernoulli keep mask for inverted dropout — THE single definition
+    of the PRNG routing shared by the dense encode below and the ragged
+    packed encoder (ops/pallas_ragged.py). ``prng_impl='rbg'`` rewraps
+    onto the hardware RngBitGenerator: the incoming (checkpoint-portable)
+    threefry key seeds 4 words of rbg state, so the big mask draw costs
+    hardware RNG throughput instead of per-element threefry rounds."""
+    if prng_impl == 'rbg':
+        dropout_rng = jax.random.wrap_key_data(
+            jax.random.bits(dropout_rng, (4,), jnp.uint32), impl='rbg')
+    return jax.random.bernoulli(dropout_rng, keep_rate, shape)
+
+
 def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
            target: jax.Array, mask: jax.Array, *,
            dropout_rng: Optional[jax.Array] = None,
@@ -149,16 +163,9 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
         context_embed = jnp.concatenate(
             [source_embed, path_embed, target_embed], axis=-1)  # (B, C, 3d)
         if apply_dropout:
-            if dropout_prng_impl == 'rbg':
-                # rewrap onto the hardware RngBitGenerator: the incoming
-                # (checkpoint-portable) threefry key seeds 4 words of rbg
-                # state, so the big (B, C, 3d) mask draw costs hardware
-                # RNG throughput instead of ~131M threefry rounds
-                dropout_rng = jax.random.wrap_key_data(
-                    jax.random.bits(dropout_rng, (4,), jnp.uint32),
-                    impl='rbg')
-            keep_mask = jax.random.bernoulli(
-                dropout_rng, dropout_keep_rate, context_embed.shape)
+            keep_mask = dropout_keep_mask(dropout_rng, dropout_keep_rate,
+                                          context_embed.shape,
+                                          dropout_prng_impl)
             context_embed = jnp.where(
                 keep_mask, context_embed / dropout_keep_rate,
                 jnp.zeros_like(context_embed))
@@ -188,6 +195,37 @@ def encode(params: Code2VecParams, source: jax.Array, path: jax.Array,
             'bc,bcd->bd', attention_weights.astype(x.dtype), x,
             preferred_element_type=jnp.float32)                   # (B, D)
     return code_vectors, attention_weights
+
+
+def encode_packed(params: Code2VecParams, ctx: jax.Array, count: jax.Array,
+                  *, max_contexts: int, token_pad: int, path_pad: int,
+                  dropout_rng: Optional[jax.Array] = None,
+                  dropout_keep_rate: float = 1.0,
+                  dropout_prng_impl: str = 'threefry2x32',
+                  dtype: jnp.dtype = jnp.float32,
+                  embed_grad_impl: str = 'dense',
+                  use_kernel: Optional[bool] = None,
+                  interpret: Optional[bool] = None,
+                  mesh=None) -> Tuple[jax.Array, jax.Array]:
+    """``encode`` straight off the packed wire (data/packed.py): consumes
+    the ``(data_shards, capacity, 3)`` triples + per-example counts and
+    produces the same ``(code_vectors (B, D) fp32, attention (B, C)
+    fp32)`` outputs to fp32 rounding — without ever materializing the
+    ``(B, C)`` index planes or the ``(B, C, 3d)`` context embeddings the
+    unpack-then-dense path pays for (ops/pallas_ragged.py; gated by
+    ``Config.USE_PALLAS_RAGGED_FUSION``). On a real TPU backend the
+    deterministic forward runs the fused Pallas kernel; everywhere else
+    (and whenever dropout applies) the differentiable jnp twin runs —
+    never the interpreter."""
+    from code2vec_tpu.ops import pallas_ragged
+    return pallas_ragged.ragged_encode(
+        params.token_embedding, params.path_embedding, params.transform,
+        params.attention, ctx, count, max_contexts=max_contexts,
+        token_pad=token_pad, path_pad=path_pad, dtype=dtype,
+        dropout_rng=dropout_rng, dropout_keep_rate=dropout_keep_rate,
+        dropout_prng_impl=dropout_prng_impl,
+        embed_grad_impl=embed_grad_impl, use_kernel=use_kernel,
+        interpret=interpret, mesh=mesh)
 
 
 def compute_logits(params: Code2VecParams, code_vectors: jax.Array,
@@ -269,6 +307,15 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
     if remat_encode:
         _encode = jax.checkpoint(_encode)
     code_vectors = _encode(params, source, path, target, mask, dropout_rng)
+    return _loss_from_code(params, code_vectors, label, weight, dtype,
+                           num_valid_targets, use_fused_ce, fused_ce_mesh)
+
+
+def _loss_from_code(params, code_vectors, label, weight, dtype,
+                    num_valid_targets, use_fused_ce, fused_ce_mesh):
+    """The loss tail shared by the plane and packed wires: code vectors
+    -> weighted-mean CE, via materialized logits or the fused CE kernel
+    (the wires differ only in how ``code_vectors`` was encoded)."""
     if use_fused_ce:
         from code2vec_tpu.ops import pallas_ce
         if not pallas_ce.PALLAS_AVAILABLE:
@@ -292,3 +339,38 @@ def loss_and_aux(params: Code2VecParams, source: jax.Array, path: jax.Array,
     loss = ce_sum / jnp.maximum(weight_sum, 1.0)
     return loss, {'code_vectors': code_vectors,
                   'num_valid': weight_sum}
+
+
+def loss_and_aux_packed(params: Code2VecParams, ctx: jax.Array,
+                        count: jax.Array, label: jax.Array,
+                        weight: jax.Array, *,
+                        max_contexts: int, token_pad: int, path_pad: int,
+                        dropout_rng: Optional[jax.Array] = None,
+                        dropout_keep_rate: float = 1.0,
+                        dropout_prng_impl: str = 'threefry2x32',
+                        dtype: jnp.dtype = jnp.float32,
+                        num_valid_targets: Optional[int] = None,
+                        embed_grad_impl: str = 'dense',
+                        use_fused_ce: bool = False,
+                        fused_ce_mesh=None,
+                        remat_encode: bool = False):
+    """``loss_and_aux`` straight off the packed wire: the ragged fused
+    encoder replaces unpack + dense encode (USE_PALLAS_RAGGED_FUSION;
+    ops/pallas_ragged.py), the CE tail is shared with the plane path.
+    The backward differentiates the jnp twin (``use_kernel=False``): the
+    Pallas kernel is forward-only, and at training defaults dropout is
+    active anyway — the structural win here is packed-layout math, which
+    both implementations share."""
+    def _encode(params_, ctx_, count_, rng_):
+        return encode_packed(
+            params_, ctx_, count_, max_contexts=max_contexts,
+            token_pad=token_pad, path_pad=path_pad, dropout_rng=rng_,
+            dropout_keep_rate=dropout_keep_rate,
+            dropout_prng_impl=dropout_prng_impl, dtype=dtype,
+            embed_grad_impl=embed_grad_impl, use_kernel=False)[0]
+
+    if remat_encode:
+        _encode = jax.checkpoint(_encode)
+    code_vectors = _encode(params, ctx, count, dropout_rng)
+    return _loss_from_code(params, code_vectors, label, weight, dtype,
+                           num_valid_targets, use_fused_ce, fused_ce_mesh)

@@ -1,0 +1,33 @@
+"""Shared plumbing for the Pallas TPU kernel modules.
+
+One definition of the soft Pallas import and the TPU-backend predicate,
+used by all three kernels (``ops/pallas_encode.py``, ``ops/pallas_ce.py``,
+``ops/pallas_ragged.py``) so the routing discipline cannot drift between
+them: the kernels engage only when the DEVICE platform is a real TPU, and
+every module keeps importing cleanly on CPU-only installs.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # pallas is TPU-oriented; keep the import soft for CPU-only installs
+    from jax.experimental import pallas as pl                 # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu          # noqa: F401
+    PALLAS_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    pl = None
+    pltpu = None
+    PALLAS_AVAILABLE = False
+
+
+def tpu_backend_active() -> bool:
+    """True iff the default backend's devices are real TPUs. Checks the
+    DEVICE platform, not ``jax.default_backend()``: behind device-tunnel
+    plugins the backend may register under another name (e.g. 'axon')
+    while its devices report platform 'tpu' — gating on the backend name
+    silently reroutes the kernel to the plain XLA path."""
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return False
+    return bool(devices) and devices[0].platform.lower() == 'tpu'

@@ -48,15 +48,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # pallas is TPU-oriented; keep the import soft for CPU-only installs
+from code2vec_tpu.ops._pallas_common import (PALLAS_AVAILABLE,
+                                             tpu_backend_active)
+
+if PALLAS_AVAILABLE:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    PALLAS_AVAILABLE = True
-except ImportError:  # pragma: no cover
-    PALLAS_AVAILABLE = False
 
 from code2vec_tpu.ops._shard_map import shard_map
-from code2vec_tpu.ops.pallas_encode import tpu_backend_active
 from code2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 # vocab columns per grid step. VMEM at java14m shapes (B=1024, D=384,

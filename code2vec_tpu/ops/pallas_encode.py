@@ -11,10 +11,19 @@ first — materializing an (N, 3d) intermediate in HBM), the add/tanh/score
 matvec all stay in VMEM, and the transform weights are resident in VMEM for
 the whole grid.
 
-OFF by default (``Config.USE_PALLAS_FUSED_ENCODE``): enable after the
-``--profile`` trace shows the encode block is bandwidth-bound on your chip.
-Correctness is tested in interpreter mode on CPU; numerics match the jnp
-path to fp32 rounding.
+OFF by default (``Config.USE_PALLAS_FUSED_ENCODE``; the on-chip A/B
+measured it 0.99x vs XLA at the java14m bag size — PERF.md "Pallas
+fused-encode kernel"). This kernel consumes DENSE ``(N, d)`` rows, i.e. it
+runs after the packed wire has already been scattered back to plane
+layout, and it stops at the attention scores — the softmax and weighted
+sum stay in XLA. Its successor ``ops/pallas_ragged.py``
+(``Config.USE_PALLAS_RAGGED_FUSION``) subsumes both limitations for
+packed-wire batches: it walks the packed segments directly (no dense
+materialization at all) and carries the fusion through the per-example
+attention softmax + reduction in the same pass. This module remains the
+plane-wire fallback and the minimal staging ground for row-tile encode
+experiments. Correctness is tested in interpreter mode on CPU; numerics
+match the jnp path to fp32 rounding.
 """
 from __future__ import annotations
 
@@ -24,27 +33,17 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-try:  # pallas is TPU-oriented; keep the import soft for CPU-only installs
+# shared soft import + TPU predicate (ops/_pallas_common.py); the names
+# are re-exported here because model code and the benches historically
+# import them from this module
+from code2vec_tpu.ops._pallas_common import (PALLAS_AVAILABLE,  # noqa: F401
+                                             tpu_backend_active)
+
+if PALLAS_AVAILABLE:
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    PALLAS_AVAILABLE = True
-except ImportError:  # pragma: no cover
-    PALLAS_AVAILABLE = False
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
 ROW_TILE = 512  # context rows per grid step; N is padded to a multiple
-
-
-def tpu_backend_active() -> bool:
-    """True iff the default backend's devices are real TPUs. Checks the
-    DEVICE platform, not ``jax.default_backend()``: behind device-tunnel
-    plugins the backend may register under another name (e.g. 'axon')
-    while its devices report platform 'tpu' — gating on the backend name
-    silently reroutes the kernel to the plain XLA path."""
-    try:
-        devices = jax.devices()
-    except RuntimeError:
-        return False
-    return bool(devices) and devices[0].platform.lower() == 'tpu'
 
 
 def _kernel(src_ref, path_ref, tgt_ref, w_src_ref, w_path_ref, w_tgt_ref,

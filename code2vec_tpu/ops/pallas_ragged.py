@@ -1,0 +1,418 @@
+"""Ragged fused encode + attention straight off the packed wire.
+
+The packed wire format (data/packed.py) ships each batch as per-shard
+dense ``(data_shards, capacity, 3)`` context triples plus per-example
+``count``s. Until now every consumer paid a full ``(B, max_contexts)``
+segment-scatter (``unpack_device``) back to plane layout BEFORE the
+encoder ran — at the java14m fill rate (contexts/method p50 28 of 200)
+that materializes ~4x more context slots than the batch actually holds,
+and the dense encode then spends FLOPs and HBM traffic on every one of
+them. This module walks the packed segments directly instead:
+
+  per slot t of the packed stream (slots past a shard's total and
+  interior all-PAD holes are masked OUT, matching the dense path's
+  ``log(1e-30)`` masking to fp32 rounding):
+
+    e_t = [tok[src_t] ; path[pth_t] ; tok[tgt_t]]            gather
+    x_t = tanh(e_t @ TRANSFORM)        (row-split, no concat) encode
+    s_t = x_t . ATTENTION                                     score
+
+  per example i (a SEGMENT of the stream, delimited by ``count``):
+
+    m_i  = max_t s_t                 \\  single-pass max-sum softmax
+    z_i  = sum_t exp(s_t - m_i)       |  (FuseMax, arxiv 2406.10491):
+    c_i  = sum_t exp(s_t - m_i) x_t  /   one walk, no separate sweeps
+    code_i = c_i / z_i
+
+Two interchangeable implementations produce the same ``(scores, m, z,
+acc)`` statistics:
+
+- ``_stats_jnp`` — the reference twin: plain jnp segment ops (scatter
+  max/add over the shard-structured stream), fully differentiable, runs
+  everywhere and partitions under GSPMD (leading data_shards axis, like
+  ``unpack_device``). This is the TRAIN path: dropout and the backward
+  pass live here, and skipping the dense scatter + dense encode is
+  already the structural win.
+- ``_stats_pallas`` — the Pallas TPU kernel: one grid walk over slot
+  tiles with the per-example running ``(m, z, acc)`` resident in VMEM,
+  segment membership resolved per tile with an indicator matrix so the
+  reductions ride the MXU/VPU (the FuseMax single pass — later tiles
+  rescale earlier sums by ``exp(m_old - m_new)``). Deterministic forward
+  only (eval / predict / the serving ladder), mirroring
+  ``ops/pallas_encode.py``'s dropout discipline. On multi-device meshes
+  it must be ``shard_map``-ped over the data axis — a ``pallas_call`` is
+  opaque to GSPMD and would otherwise be replicated (same reasoning as
+  ``ops/pallas_ce.py``).
+
+VMEM at java14m serving shapes (per-shard segments Bs=1024, D=384,
+SLOT_TILE=512, d=128): tile inputs ~0.8 MB, weights ~0.6 MB resident,
+the (T, Bs) indicator + its two masked copies ~6 MB, the (D, Bs) f32
+accumulator 1.5 MB — comfortably under the ~16 MB/core budget, and
+independent of capacity (the grid scales instead).
+
+Dense-path parity (``tests/test_pallas_ragged.py``): the dense encode
+gives masked slots attention ``~e-30`` — zero at fp32 resolution — so
+excluding them here matches to fp32 rounding; the one real divergence,
+rows with ``count == 0`` (static-shape padding, weight 0), is fixed up
+analytically (uniform ``1/C`` attention, ``code = x_pad``) to match the
+dense path's finite-uniform behavior exactly. Dropout draws its keep
+mask over the PACKED ``(shards, cap, 3d)`` layout rather than the dense
+``(B, C, 3d)`` one — same keep probability, a different (still
+deterministic, seed-keyed) stream, the ``DROPOUT_PRNG_IMPL='rbg'``
+precedent.
+
+Gated by ``Config.USE_PALLAS_RAGGED_FUSION`` (threaded through
+models/backends.py and training/trainer.py) with the same
+``tpu_backend_active()`` fallback discipline as the other kernels: off
+TPU the jnp twin runs — never the interpreter.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from code2vec_tpu.ops._pallas_common import (PALLAS_AVAILABLE,
+                                             tpu_backend_active)
+
+if PALLAS_AVAILABLE:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+from code2vec_tpu.ops._shard_map import shard_map
+from code2vec_tpu.parallel.mesh import DATA_AXIS
+
+SLOT_TILE = 512     # packed slots per grid step; capacity pads to a multiple
+_NEG = -1e30        # finite -inf stand-in (denormal-safe, like pallas_ce)
+
+
+def _precision(dtype) -> jax.lax.Precision:
+    """Mirror the dense encode: fp32 asks for true-fp32 MXU passes, bf16
+    uses the fast path (models/functional.py::encode)."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+# ------------------------------------------------------------ jnp twin
+def _stats_jnp(src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path, w_tgt,
+               attn_vec, per_shard: int, precision):
+    """Reference twin of the kernel: (scores, m, z, acc) via jnp segment
+    ops on the shard-structured stream. Differentiable (the segment max
+    is stop-gradiented — softmax is shift-invariant, so the gradient is
+    exact) and GSPMD-partitionable along the leading shards axis."""
+    shards, cap = seg.shape
+    x = jnp.tanh(jnp.matmul(src_e, w_src, precision=precision)
+                 + jnp.matmul(pth_e, w_path, precision=precision)
+                 + jnp.matmul(tgt_e, w_tgt, precision=precision))
+    scores = jnp.matmul(x, attn_vec,
+                        precision=precision)[..., 0]         # (D, cap)
+    scores = jnp.where(slot_valid, scores.astype(jnp.float32), _NEG)
+    shard_idx = jnp.broadcast_to(
+        jnp.arange(shards, dtype=jnp.int32)[:, None], (shards, cap))
+    m = jnp.full((shards, per_shard), _NEG, jnp.float32)
+    m = m.at[shard_idx, seg].max(scores, mode='drop')
+    m = jax.lax.stop_gradient(m)
+    p = jnp.exp(scores - jnp.take_along_axis(m, seg, axis=1))
+    p = jnp.where(slot_valid, p, 0.0)                        # (D, cap)
+    z = jnp.zeros((shards, per_shard), jnp.float32)
+    z = z.at[shard_idx, seg].add(p, mode='drop')
+    acc = jnp.zeros((shards, per_shard, x.shape[-1]), jnp.float32)
+    acc = acc.at[shard_idx, seg].add(
+        p[..., None] * x.astype(jnp.float32), mode='drop')
+    return scores, m, z, acc
+
+
+# -------------------------------------------------------- pallas kernel
+def _ragged_kernel(precision, src_ref, pth_ref, tgt_ref, seg_ref, valid_ref,
+                   wsrc_ref, wpath_ref, wtgt_ref, attn_ref,
+                   scores_ref, m_out_ref, z_out_ref, acc_out_ref,
+                   m_ref, z_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        z_ref[:] = jnp.zeros_like(z_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # encode: row-split transform + tanh + score, fp32 accumulation
+    x = jnp.dot(src_ref[:], wsrc_ref[:], precision=precision,
+                preferred_element_type=jnp.float32)
+    x += jnp.dot(pth_ref[:], wpath_ref[:], precision=precision,
+                 preferred_element_type=jnp.float32)
+    x += jnp.dot(tgt_ref[:], wtgt_ref[:], precision=precision,
+                 preferred_element_type=jnp.float32)
+    x = jnp.tanh(x)                                          # (T, D) f32
+    sc = jnp.dot(x, attn_ref[:], precision=precision,
+                 preferred_element_type=jnp.float32)         # (T, 1)
+    valid = valid_ref[:] > 0.0                               # (T, 1)
+    sc = jnp.where(valid, sc, _NEG)
+    scores_ref[:] = sc
+
+    # segment membership for this tile: a (T, n_seg) indicator so every
+    # per-example reduction is one masked reduce / one MXU contraction
+    n_seg = m_ref.shape[1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (sc.shape[0], n_seg), 1)
+    onehot_b = (seg_ref[:] == lanes) & valid                 # (T, n_seg)
+    onehot = onehot_b.astype(jnp.float32)
+
+    # FuseMax single pass: fold this tile's per-segment max into the
+    # running max, rescale the running sums, accumulate the tile
+    m_tile = jnp.max(jnp.where(onehot_b, sc, _NEG),
+                     axis=0, keepdims=True)                  # (1, n_seg)
+    m_new = jnp.maximum(m_ref[:], m_tile)
+    corr = jnp.exp(m_ref[:] - m_new)                         # (1, n_seg)
+    m_ref[:] = m_new
+    m_slot = jnp.sum(onehot * m_new, axis=1, keepdims=True)  # (T, 1)
+    p = jnp.where(valid, jnp.exp(sc - m_slot), 0.0)          # (T, 1)
+    pz = onehot * p                                          # (T, n_seg)
+    z_ref[:] = z_ref[:] * corr + jnp.sum(pz, axis=0, keepdims=True)
+    # acc lives (D, n_seg) so the rescale broadcasts along rows and the
+    # tile contraction is a single dot_general over the slot axis
+    acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        x, pz, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # (D, n_seg)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _emit():
+        m_out_ref[:] = m_ref[:]
+        z_out_ref[:] = z_ref[:]
+        acc_out_ref[:] = acc_ref[:]
+
+
+def _stats_pallas(src_e, pth_e, tgt_e, seg, valid, w_src, w_path, w_tgt,
+                  attn_vec, n_seg: int, interpret: bool, precision):
+    """One shard's flat packed stream ``(cap, d)`` -> ``(scores (cap,),
+    m (n_seg,), z (n_seg,), acc (n_seg, D))`` via the fused kernel."""
+    cap, token_dim = src_e.shape
+    path_dim = pth_e.shape[1]
+    code_dim = w_src.shape[1]
+    padded = -(-cap // SLOT_TILE) * SLOT_TILE
+    pad = padded - cap
+    if pad:
+        src_e = jnp.pad(src_e, ((0, pad), (0, 0)))
+        pth_e = jnp.pad(pth_e, ((0, pad), (0, 0)))
+        tgt_e = jnp.pad(tgt_e, ((0, pad), (0, 0)))
+        seg = jnp.pad(seg, (0, pad))
+        valid = jnp.pad(valid, (0, pad))     # False: pad slots are inert
+    seg2 = seg.reshape(padded, 1).astype(jnp.int32)
+    valid2 = valid.reshape(padded, 1).astype(jnp.float32)
+    grid = (padded // SLOT_TILE,)
+    row_block = lambda dim: pl.BlockSpec((SLOT_TILE, dim),
+                                         lambda i: (i, 0))
+    full_block = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))
+    kernel = functools.partial(_ragged_kernel, precision)
+    scores, m, z, acc = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_block(token_dim), row_block(path_dim), row_block(token_dim),
+            row_block(1), row_block(1),
+            full_block(w_src.shape), full_block(w_path.shape),
+            full_block(w_tgt.shape), full_block(attn_vec.shape),
+        ],
+        out_specs=[
+            row_block(1),
+            full_block((1, n_seg)), full_block((1, n_seg)),
+            full_block((code_dim, n_seg)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_seg), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_seg), jnp.float32),
+            jax.ShapeDtypeStruct((code_dim, n_seg), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, n_seg), jnp.float32),       # running max
+            pltpu.VMEM((1, n_seg), jnp.float32),       # running sumexp
+            pltpu.VMEM((code_dim, n_seg), jnp.float32),  # weighted sum
+        ],
+        interpret=interpret,
+    )(src_e, pth_e, tgt_e, seg2, valid2, w_src, w_path, w_tgt, attn_vec)
+    return scores[:cap, 0], m[0], z[0], acc.T
+
+
+def _stats_kernel_path(src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                       w_tgt, attn_vec, per_shard: int, mesh,
+                       interpret: bool, precision):
+    """Kernel stats over the shard-structured stream. With a multi-device
+    mesh the per-shard kernel is shard_mapped over the data axis (a
+    pallas_call is opaque to GSPMD); otherwise the shards collapse into
+    one flat stream with globally-offset segment ids — one kernel call,
+    one set of scratch accumulators."""
+    shards, cap = seg.shape
+
+    def one_shard(src_l, pth_l, tgt_l, seg_l, valid_l, ws, wp, wt, av):
+        sc, m, z, acc = _stats_pallas(
+            src_l[0], pth_l[0], tgt_l[0], seg_l[0], valid_l[0],
+            ws, wp, wt, av, per_shard, interpret, precision)
+        return (sc[None], m[None], z[None], acc[None])
+
+    if mesh is not None and mesh.size > 1:
+        # check_vma=False: outputs follow the data axis exactly like the
+        # inputs, but the static checker can't see through the kernel
+        # (same as ops/pallas_ce.py::_sharded_forward)
+        return shard_map(
+            one_shard, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                      P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(None, None), P(None, None),
+                      P(None, None), P(None, None)),
+            out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
+                       P(DATA_AXIS, None), P(DATA_AXIS, None, None)),
+            check_vma=False)(src_e, pth_e, tgt_e, seg, slot_valid,
+                             w_src, w_path, w_tgt, attn_vec)
+    # single device: one flat stream, segment ids offset per shard
+    flat = shards * cap
+    offsets = (jnp.arange(shards, dtype=jnp.int32) * per_shard)[:, None]
+    seg_flat = (seg + offsets).reshape(flat)
+    sc, m, z, acc = _stats_pallas(
+        src_e.reshape(flat, -1), pth_e.reshape(flat, -1),
+        tgt_e.reshape(flat, -1), seg_flat, slot_valid.reshape(flat),
+        w_src, w_path, w_tgt, attn_vec, shards * per_shard, interpret,
+        precision)
+    return (sc.reshape(shards, cap), m.reshape(shards, per_shard),
+            z.reshape(shards, per_shard),
+            acc.reshape(shards, per_shard, -1))
+
+
+# ------------------------------------------------------------- finish
+def _finish(scores, m, z, acc, seg, pos, slot_valid, count2, x_pad,
+            max_contexts: int):
+    """(stats, segment structure) -> (code_vectors (B, D) fp32, attention
+    planes (B, C) fp32). The count == 0 fixups reproduce the dense
+    path's finite-uniform behavior for all-padding rows exactly."""
+    shards, per_shard = count2.shape
+    cap = seg.shape[1]
+    nonempty = count2 > 0                                    # (D, Bs)
+    # guard empty segments' 0/0 (the fixup below overwrites them). NOT
+    # jnp.maximum(z, 1.0): a single-valid-slot segment has z == 1.0
+    # exactly (its max slot contributes exp(0)), and jax halves the
+    # gradient of maximum at ties — which would silently halve those
+    # rows' softmax-normalization gradient
+    z_safe = jnp.where(nonempty, z, 1.0)
+    code = acc / z_safe[..., None]
+    code = jnp.where(nonempty[..., None], code,
+                     x_pad.astype(jnp.float32)[None, None, :])
+    p = jnp.exp(scores - jnp.take_along_axis(m, seg, axis=1))
+    w = jnp.where(slot_valid,
+                  p / jnp.take_along_axis(z_safe, seg, axis=1), 0.0)
+    shard_idx = jnp.broadcast_to(
+        jnp.arange(shards, dtype=jnp.int32)[:, None], (shards, cap))
+    attn = jnp.zeros((shards, per_shard, max_contexts), jnp.float32)
+    # capacity-pad slots carry w == 0 and positions past their example's
+    # count, so add-with-drop can only write zeros onto tail columns
+    attn = attn.at[shard_idx, seg, pos].add(w, mode='drop')
+    attn = jnp.where(nonempty[..., None], attn, 1.0 / max_contexts)
+    batch = shards * per_shard
+    return code.reshape(batch, -1), attn.reshape(batch, max_contexts)
+
+
+# --------------------------------------------------------------- entry
+def ragged_encode(token_embedding: jax.Array, path_embedding: jax.Array,
+                  transform: jax.Array, attention: jax.Array,
+                  ctx: jax.Array, count: jax.Array, *,
+                  max_contexts: int, token_pad: int, path_pad: int,
+                  dtype: jnp.dtype = jnp.float32,
+                  dropout_rng: Optional[jax.Array] = None,
+                  dropout_keep_rate: float = 1.0,
+                  dropout_prng_impl: str = 'threefry2x32',
+                  embed_grad_impl: str = 'dense',
+                  use_kernel: Optional[bool] = None,
+                  interpret: Optional[bool] = None,
+                  mesh=None) -> Tuple[jax.Array, jax.Array]:
+    """Packed wire arrays -> (code_vectors (B, D) fp32, attention planes
+    (B, C) fp32), with no ``(B, C, .)`` intermediate anywhere.
+
+    ``use_kernel`` None routes the Pallas kernel iff a real TPU backend
+    is active AND no dropout applies (the kernel is forward-only); False
+    forces the jnp twin; True forces the kernel (tests run it with
+    ``interpret=True`` on CPU). ``mesh`` shard_maps the kernel over the
+    data axis on multi-device meshes; the twin ignores it (its segment
+    ops partition under GSPMD by the leading shards axis).
+    """
+    shards, cap, _ = ctx.shape
+    batch = count.shape[0]
+    per_shard = batch // shards
+    count2 = count.reshape(shards, per_shard).astype(jnp.int32)
+    # THE segment arithmetic, shared with unpack_device (data/packed.py)
+    # so the parity-critical slot->example mapping has one definition
+    from code2vec_tpu.data.packed import segment_structure
+    seg, pos, in_range = segment_structure(count2, cap)
+    src, pth, tgt = ctx[..., 0], ctx[..., 1], ctx[..., 2]
+    # the reader.context_valid_mask predicate, applied on the packed
+    # stream: interior holes (all three parts PAD) drop out here exactly
+    # as the dense path's log-mask drops them out of its softmax
+    slot_valid = in_range & ((src != token_pad) | (tgt != token_pad)
+                             | (pth != path_pad))            # (D, cap)
+
+    apply_dropout = dropout_rng is not None and dropout_keep_rate < 1.0
+    if use_kernel is None:
+        use_kernel = (PALLAS_AVAILABLE and tpu_backend_active()
+                      and not apply_dropout)
+    if use_kernel and apply_dropout:
+        raise ValueError(
+            'the Pallas ragged kernel serves the deterministic forward '
+            'only; dropout routes through the jnp twin (pass '
+            'use_kernel=False or no dropout_rng)')
+    if interpret is None:
+        interpret = not tpu_backend_active()
+
+    from code2vec_tpu.ops.embed_grad import take_rows
+    src_e = take_rows(token_embedding, src,
+                      impl=embed_grad_impl).astype(dtype)    # (D, cap, d)
+    pth_e = take_rows(path_embedding, pth,
+                      impl=embed_grad_impl).astype(dtype)
+    tgt_e = take_rows(token_embedding, tgt,
+                      impl=embed_grad_impl).astype(dtype)
+    token_dim = src_e.shape[-1]
+    path_dim = pth_e.shape[-1]
+
+    if apply_dropout:
+        # THE shared PRNG routing (models/functional.py::
+        # dropout_keep_mask — lazy import; functional's import of this
+        # module is deferred, so there is no cycle). The draw is over
+        # retained slots only: the packed layout also SHRINKS the mask
+        # draw by the fill factor
+        from code2vec_tpu.models.functional import dropout_keep_mask
+        keep = dropout_keep_mask(dropout_rng, dropout_keep_rate,
+                                 (shards, cap, 2 * token_dim + path_dim),
+                                 dropout_prng_impl)
+
+        def drop(e, lo, hi):
+            return jnp.where(keep[..., lo:hi], e / dropout_keep_rate,
+                             jnp.zeros_like(e))
+        src_e = drop(src_e, 0, token_dim)
+        pth_e = drop(pth_e, token_dim, token_dim + path_dim)
+        tgt_e = drop(tgt_e, token_dim + path_dim,
+                     2 * token_dim + path_dim)
+
+    t = transform.astype(dtype)
+    w_src = t[:token_dim]
+    w_path = t[token_dim:token_dim + path_dim]
+    w_tgt = t[token_dim + path_dim:]
+    attn_vec = attention.astype(dtype)                       # (D, 1)
+    precision = _precision(dtype)
+
+    # the dense path's value for every all-PAD slot — the analytic
+    # stand-in for count == 0 rows (deterministic: such rows carry
+    # weight 0, so dropout on them is loss-invisible either way)
+    pad_ctx = jnp.concatenate([
+        token_embedding[token_pad], path_embedding[path_pad],
+        token_embedding[token_pad]]).astype(dtype)
+    x_pad = jnp.tanh(jnp.matmul(pad_ctx[None, :], t,
+                                precision=precision))[0]     # (D,)
+
+    if use_kernel:
+        scores, m, z, acc = _stats_kernel_path(
+            src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path, w_tgt,
+            attn_vec, per_shard, mesh, interpret, precision)
+    else:
+        scores, m, z, acc = _stats_jnp(
+            src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path, w_tgt,
+            attn_vec, per_shard, precision)
+    return _finish(scores, m, z, acc, seg, pos, slot_valid, count2,
+                   x_pad, max_contexts)

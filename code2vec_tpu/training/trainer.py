@@ -196,32 +196,59 @@ class Trainer:
                 lambda p: p.astype(jnp.bfloat16)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
 
-        def train_step(state: TrainerState, arrays) -> Tuple[TrainerState, jax.Array]:
-            dropout_rng = jax.random.fold_in(state.rng, state.step)
+        # Ragged fusion (USE_PALLAS_RAGGED_FUSION, ops/pallas_ragged.py):
+        # the packed twins below consume the (D, cap, 3) wire directly —
+        # fused gather + encode + single-pass attention softmax, no
+        # device-side unpack, no (B, C, .) planes. Lazy Adam keeps the
+        # unpack path for TRAINING only: its sparse-row update consumes
+        # the unpacked plane indices.
+        ragged = (self.config.USE_PALLAS_RAGGED_FUSION
+                  and hasattr(backend, 'forward_packed'))
+        ragged_train = ragged and not lazy
+        if ragged and lazy:
+            logger.warning(
+                'USE_PALLAS_RAGGED_FUSION: the packed TRAIN step keeps '
+                'the unpack path under LAZY_EMBEDDING_ADAM (the sparse '
+                'update needs plane indices); eval/predict stay fused.')
 
-            def loss_fn(params):
-                loss, _aux = backend.loss_fn(params, arrays, dropout_rng,
-                                             mesh=loss_mesh)
-                return loss
+        def make_train_step(loss_call):
+            def train_step(state: TrainerState, arrays
+                           ) -> Tuple[TrainerState, jax.Array]:
+                dropout_rng = jax.random.fold_in(state.rng, state.step)
 
-            diff_params = (cast_for_grads(state.params) if grads_bf16
-                           else state.params)
-            loss, grads = jax.value_and_grad(loss_fn)(diff_params)
-            if lazy:
-                source, path, target = arrays[0], arrays[1], arrays[2]
-                new_params, new_opt_state = optimizer.update_sparse(
-                    state.params, grads, state.opt_state, state.step,
-                    source, path, target)
-            else:
-                updates, new_opt_state = optimizer.update(
-                    grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
-            new_state = TrainerState(params=new_params,
-                                     opt_state=new_opt_state,
-                                     step=state.step + 1, rng=state.rng)
-            return new_state, loss
+                def loss_fn(params):
+                    loss, _aux = loss_call(params, arrays, dropout_rng)
+                    return loss
+
+                diff_params = (cast_for_grads(state.params) if grads_bf16
+                               else state.params)
+                loss, grads = jax.value_and_grad(loss_fn)(diff_params)
+                if lazy:
+                    # plane arrays only: the ragged-train route is
+                    # disabled under lazy Adam above
+                    source, path, target = arrays[0], arrays[1], arrays[2]
+                    new_params, new_opt_state = optimizer.update_sparse(
+                        state.params, grads, state.opt_state, state.step,
+                        source, path, target)
+                else:
+                    updates, new_opt_state = optimizer.update(
+                        grads, state.opt_state, state.params)
+                    new_params = optax.apply_updates(state.params, updates)
+                new_state = TrainerState(params=new_params,
+                                         opt_state=new_opt_state,
+                                         step=state.step + 1, rng=state.rng)
+                return new_state, loss
+            return train_step
+
+        train_step = make_train_step(
+            lambda params, arrays, rng:
+            backend.loss_fn(params, arrays, rng, mesh=loss_mesh))
 
         mesh = self.mesh
+        # the forward's mesh only matters where the ragged Pallas kernel
+        # must be shard_mapped (GSPMD cannot partition a pallas_call);
+        # None keeps single-device tracing mesh-free, like loss_mesh
+        fwd_mesh = self.mesh if self.mesh.size > 1 else None
 
         def take_top_k(logits):
             # cross-shard merge on model-parallel meshes, plain lax.top_k
@@ -230,25 +257,32 @@ class Trainer:
 
         export_vectors = self.config.EXPORT_CODE_VECTORS
 
-        def eval_step(params, arrays):
-            code_vectors, attention, logits = backend.forward(params, arrays)
-            topk_scores, topk_indices = take_top_k(logits)
-            # weighted CE sums (not the mean): exact streaming aggregation
-            # across batches and hosts — the reference's Keras backend
-            # reports eval loss (keras_model.py:179-193); padded rows have
-            # weight 0 and drop out
-            _source, _path, _target, _mask, label, weight = arrays
-            loss_sum, weight_sum = functional.weighted_ce_sums(
-                logits, label, weight)
-            out = {'topk_indices': topk_indices,
-                   'topk_scores': topk_scores,
-                   'loss_sum': loss_sum,
-                   'weight_sum': weight_sum}
-            if export_vectors:
-                # only ship (B, D) code vectors to host when exporting —
-                # it is per-batch device->host traffic otherwise wasted
-                out['code_vectors'] = code_vectors
-            return out
+        def make_eval_step(forward_call, labels_of):
+            def eval_step(params, arrays):
+                code_vectors, attention, logits = forward_call(params,
+                                                               arrays)
+                topk_scores, topk_indices = take_top_k(logits)
+                # weighted CE sums (not the mean): exact streaming
+                # aggregation across batches and hosts — the reference's
+                # Keras backend reports eval loss (keras_model.py:
+                # 179-193); padded rows have weight 0 and drop out
+                label, weight = labels_of(arrays)
+                loss_sum, weight_sum = functional.weighted_ce_sums(
+                    logits, label, weight)
+                out = {'topk_indices': topk_indices,
+                       'topk_scores': topk_scores,
+                       'loss_sum': loss_sum,
+                       'weight_sum': weight_sum}
+                if export_vectors:
+                    # only ship (B, D) code vectors to host when
+                    # exporting — per-batch device->host traffic
+                    # otherwise wasted
+                    out['code_vectors'] = code_vectors
+                return out
+            return eval_step
+
+        eval_step = make_eval_step(backend.forward,
+                                   lambda arrays: (arrays[4], arrays[5]))
 
         # Predict programs come in OUTPUT TIERS (PREDICT_TIERS), each its
         # own jitted program, so the cheap path stops paying for the
@@ -259,14 +293,14 @@ class Trainer:
         # the dominant FLOPs at java14m's 261K-target vocab) for bulk
         # embedding export. The serving engine pre-compiles these per
         # batch/capacity bucket (serving/engine.py, SERVING.md).
-        def make_predict_step(tier):
+        def make_predict_step(tier, forward_call):
             with_topk = tier != 'vectors'
             with_attention = tier in ('attention', 'full')
             with_vectors = tier in ('vectors', 'full')
 
             def predict_step(params, arrays):
-                code_vectors, attention, logits = backend.forward(params,
-                                                                  arrays)
+                code_vectors, attention, logits = forward_call(params,
+                                                               arrays)
                 out = {}
                 if with_topk:
                     topk_scores, topk_indices = take_top_k(logits)
@@ -298,12 +332,17 @@ class Trainer:
                 abstract_opt, mesh, zero_partition=self._zero_opt),
             step=replicated, rng=replicated)
 
-        # Packed-wire twins: the same step functions behind the jitted
-        # device-side unpack (data/packed.py) — the unpack scatters the
-        # dense context stream back to the exact (B, C) planes + mask
-        # INSIDE the compiled program, so the model sees bit-identical
-        # batches and the wire carries 3-5x fewer bytes. PAD indices
-        # must match the reader's pack-time fill (models/backends.py).
+        # Packed-wire twins. Default: the same step functions behind the
+        # jitted device-side unpack (data/packed.py) — the unpack
+        # scatters the dense context stream back to the exact (B, C)
+        # planes + mask INSIDE the compiled program, so the model sees
+        # bit-identical batches and the wire carries 3-5x fewer bytes.
+        # With USE_PALLAS_RAGGED_FUSION the twins skip the unpack
+        # entirely: the ragged fused encoder (ops/pallas_ragged.py)
+        # walks the packed segments directly, matching the
+        # unpack-then-dense outputs to fp32 rounding
+        # (tests/test_pallas_ragged.py). PAD indices must match the
+        # reader's pack-time fill (models/backends.py).
         token_pad = getattr(backend, 'token_pad_index', 0)
         path_pad = getattr(backend, 'path_pad_index', 0)
         max_contexts = self.config.MAX_CONTEXTS
@@ -314,11 +353,24 @@ class Trainer:
                 ctx, count, max_contexts, token_pad, path_pad)
             return (source, path, target, mask, label, weight)
 
-        def train_step_packed(state, packed_arrays):
-            return train_step(state, unpack(packed_arrays))
+        if ragged_train:
+            train_step_packed = make_train_step(
+                lambda params, arrays, rng:
+                backend.loss_fn_packed(params, arrays, rng,
+                                       mesh=loss_mesh))
+        else:
+            def train_step_packed(state, packed_arrays):
+                return train_step(state, unpack(packed_arrays))
 
-        def eval_step_packed(params, packed_arrays):
-            return eval_step(params, unpack(packed_arrays))
+        if ragged:
+            forward_packed = (lambda params, arrays:
+                              backend.forward_packed(params, arrays,
+                                                     mesh=fwd_mesh))
+            eval_step_packed = make_eval_step(
+                forward_packed, lambda arrays: (arrays[2], arrays[3]))
+        else:
+            def eval_step_packed(params, packed_arrays):
+                return eval_step(params, unpack(packed_arrays))
 
         # donate the consumed staging buffers alongside the state: the
         # ring (stage_batches) keeps DEVICE_PREFETCH_BATCHES uploads in
@@ -346,11 +398,17 @@ class Trainer:
         # re-feeds warm placed buffers and predict batches are tiny
         self._predict_steps = {}
         for tier in PREDICT_TIERS:
-            step_fn = make_predict_step(tier)
+            step_fn = make_predict_step(tier, backend.forward)
             self._predict_steps[(tier, 'planes')] = jax.jit(step_fn)
-            self._predict_steps[(tier, 'packed')] = jax.jit(
-                lambda params, packed_arrays, _fn=step_fn:
-                _fn(params, unpack(packed_arrays)))
+            if ragged:
+                # XLA dead-code-eliminates the attention plane scatter
+                # for the tiers that never ship attention, exactly as it
+                # DCEs the logits matmul for 'vectors'
+                packed_fn = make_predict_step(tier, forward_packed)
+            else:
+                packed_fn = (lambda params, packed_arrays, _fn=step_fn:
+                             _fn(params, unpack(packed_arrays)))
+            self._predict_steps[(tier, 'packed')] = jax.jit(packed_fn)
         self._predict_step = self._predict_steps[('full', 'planes')]
         self._predict_step_packed = self._predict_steps[('full', 'packed')]
         self._token_pad = token_pad

@@ -91,6 +91,41 @@ def test_bench_fused_ce_smoke_runs_all_arms():
             'step_ms_ce_fused_rbg_bf16mu_SMOKE_ONLY'} <= measures
 
 
+def test_bench_pallas_ragged_smoke_runs_both_arms():
+    """ISSUE 10: the ragged-fusion A/B harness must survive import/
+    config rot, run BOTH arms, carry the peak-HBM fields on every arm
+    record (None on the stats-less CPU backend — an explicit gap), and
+    emit the fused-vs-unfused speedup records summarize_captures
+    surfaces."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'bench_pallas_ragged.py')],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line)
+               for line in proc.stdout.splitlines() if line.strip()]
+    measures = {r['measure']: r for r in records if 'measure' in r}
+    assert {'step_ms_ragged_train_unfused_SMOKE_ONLY',
+            'step_ms_ragged_train_fused_SMOKE_ONLY',
+            'step_ms_ragged_predict_unfused_SMOKE_ONLY',
+            'step_ms_ragged_predict_fused_SMOKE_ONLY',
+            'ragged_fusion_train_speedup_SMOKE_ONLY',
+            'ragged_fusion_predict_speedup_SMOKE_ONLY'} <= set(measures)
+    for name, rec in measures.items():
+        if name.startswith('step_ms_'):
+            assert rec['value'] > 0
+            # the memory axis rides every arm record; CPU smoke has no
+            # memory_stats, so the gap is an EXPLICIT null
+            assert 'peak_hbm_bytes' in rec and \
+                rec['peak_hbm_bytes'] is None
+            assert rec['fill'] == 0.25
+    verdicts = [r for r in records if 'verdict' in r]
+    assert verdicts and verdicts[-1]['verdict'] in ('keep-fused',
+                                                    'keep-unfused')
+
+
 def test_bench_index_smoke_meets_acceptance():
     """ISSUE 5 acceptance on the CPU smoke shapes: >= 10x the naive
     NumPy host loop, zero post-warmup compiles on the query path, and

@@ -60,7 +60,7 @@ def test_cli_parsing(tmp_path):
         '--data', 'd/prefix', '--test', 'd/prefix.val.c2v',
         '--save', str(tmp_path / 'model'), '--framework', 'jax',
         '--mesh', '4x2', '--dtype', 'float32', '--batch-size', '256',
-        '--embed-grad', 'dedup', '--fused-ce'])
+        '--embed-grad', 'dedup', '--fused-ce', '--ragged-fusion'])
     assert config.TRAIN_DATA_PATH_PREFIX == 'd/prefix'
     assert config.TEST_DATA_PATH == 'd/prefix.val.c2v'
     assert config.DL_FRAMEWORK == 'jax'
@@ -70,12 +70,14 @@ def test_cli_parsing(tmp_path):
     assert config.TRAIN_BATCH_SIZE == 256
     assert config.EMBED_GRAD_IMPL == 'dedup'
     assert config.USE_PALLAS_FUSED_CE is True
+    assert config.USE_PALLAS_RAGGED_FUSION is True
     config.verify()
 
     # the perf knobs must default OFF (reference-parity behavior until
     # their on-chip A/Bs decide otherwise)
     plain = Config().load_from_args(['--data', 'd/prefix'])
     assert plain.USE_PALLAS_FUSED_CE is False
+    assert plain.USE_PALLAS_RAGGED_FUSION is False
     assert plain.EMBED_GRAD_IMPL == 'dense'
 
 

@@ -1,0 +1,392 @@
+"""Ragged fused encode + attention (ops/pallas_ragged.py) vs the
+unpack-then-dense path, under the tests/test_packed.py property regime:
+interior holes, pad rows, capacity < batch, fill rates from empty to
+full, nonzero PAD indices, per-shard packing. The jnp twin is exercised
+everywhere (it is the train path and the non-TPU fallback); the Pallas
+kernel runs in interpreter mode on CPU, single-shard, flat multi-shard,
+and shard_mapped over the 8-virtual-device mesh. Trainer integration
+covers packed train/eval and all four predict tiers, plus the
+zero-post-warmup-compiles guard on the fused programs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from code2vec_tpu.data import packed as packed_lib
+from code2vec_tpu.models import functional
+from code2vec_tpu.ops import pallas_ragged
+
+from tests.test_packed import random_plane_batch
+from tests.test_stage_batches import make_trainer
+
+pytestmark = pytest.mark.skipif(not pallas_ragged.PALLAS_AVAILABLE,
+                                reason='pallas unavailable')
+
+
+def small_params(rng_seed=0, token_vocab=32, path_vocab=16,
+                 target_vocab=16, token_dim=8, path_dim=6, code_dim=24):
+    return functional.init_params(
+        jax.random.PRNGKey(rng_seed), token_vocab_size=token_vocab,
+        path_vocab_size=path_vocab, target_vocab_size=target_vocab,
+        token_dim=token_dim, path_dim=path_dim, code_dim=code_dim)
+
+
+def dense_reference(params, batch):
+    """The unpack-then-dense ground truth: the packed round trip is
+    BIT-exact (tests/test_packed.py), so encoding the original planes IS
+    encoding the unpacked wire."""
+    return functional.encode(params, batch.source, batch.path,
+                             batch.target, batch.mask)
+
+
+def ragged(params, packed, max_contexts, token_pad, path_pad, **kw):
+    return pallas_ragged.ragged_encode(
+        params.token_embedding, params.path_embedding, params.transform,
+        params.attention, jnp.asarray(packed.ctx),
+        jnp.asarray(packed.count), max_contexts=max_contexts,
+        token_pad=token_pad, path_pad=path_pad, **kw)
+
+
+def assert_encode_close(got, want, rtol=2e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                               rtol=rtol, atol=atol, err_msg='code')
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]),
+                               rtol=rtol, atol=atol, err_msg='attention')
+
+
+class TestTwinVsDense:
+    """The jnp twin (train path / non-TPU fallback) against the dense
+    encode, over the full structural property space."""
+
+    @pytest.mark.parametrize('token_pad,path_pad', [(0, 0), (1, 2)])
+    @pytest.mark.parametrize('data_shards', [1, 2, 4])
+    def test_property_regime(self, token_pad, path_pad, data_shards):
+        rng = np.random.default_rng(7)
+        params = small_params()
+        for _trial in range(8):
+            contexts = int(rng.choice([3, 5, 8, 13]))
+            batch = random_plane_batch(rng, 8, contexts, token_pad,
+                                       path_pad)
+            packed = packed_lib.pack_batch(batch, token_pad, path_pad,
+                                           data_shards=data_shards,
+                                           capacity_minimum=4)
+            got = ragged(params, packed, contexts, token_pad, path_pad,
+                         use_kernel=False)
+            assert_encode_close(got, dense_reference(params, batch))
+
+    def test_capacity_rungs_agree(self):
+        """The same batch packed at every serving-ladder capacity rung
+        must produce identical outputs — capacity padding is inert."""
+        rng = np.random.default_rng(3)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 6)
+        want = dense_reference(params, batch)
+        for rung in (4, 16, 64, 256):
+            packed = packed_lib.pack_batch(batch, 0, 0,
+                                           capacity_minimum=rung)
+            assert packed.ctx.shape[1] >= rung
+            got = ragged(params, packed, 6, 0, 0, use_kernel=False)
+            assert_encode_close(got, want)
+
+    def test_all_padding_batch_matches_dense_uniform(self):
+        """count == 0 rows: the dense path produces a FINITE uniform
+        attention (1/C) and code = x_pad; the fused fixup must match."""
+        contexts = 5
+        from code2vec_tpu.data.reader import Batch
+        zero = Batch(source=np.zeros((4, contexts), np.int32),
+                     path=np.zeros((4, contexts), np.int32),
+                     target=np.zeros((4, contexts), np.int32),
+                     mask=np.zeros((4, contexts), np.float32),
+                     label=np.zeros((4,), np.int32),
+                     weight=np.zeros((4,), np.float32))
+        params = small_params()
+        packed = packed_lib.pack_batch(zero, 0, 0, capacity_minimum=4)
+        got = ragged(params, packed, contexts, 0, 0, use_kernel=False)
+        assert_encode_close(got, dense_reference(params, zero))
+        np.testing.assert_allclose(np.asarray(got[1]),
+                                   np.full((4, contexts), 1.0 / contexts))
+
+    def test_capacity_smaller_than_batch(self):
+        """More examples than context rows (the sparse-eval regression
+        shape from tests/test_packed.py)."""
+        from code2vec_tpu.data.reader import Batch, context_valid_mask
+        contexts, batch_size = 6, 64
+        rng = np.random.default_rng(2)
+        batch = random_plane_batch(rng, batch_size, contexts)
+        lengths = np.zeros((batch_size,), np.int64)
+        lengths[:4] = [1, 2, 0, 3]
+        dead = np.arange(contexts)[None, :] >= lengths[:, None]
+        source = batch.source.copy(); source[dead] = 0
+        path = batch.path.copy(); path[dead] = 0
+        target = batch.target.copy(); target[dead] = 0
+        mask = context_valid_mask(source, path, target, 0, 0)
+        batch = batch._replace(source=source, path=path, target=target,
+                               mask=mask)
+        params = small_params()
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+        assert packed.ctx.shape[1] < batch_size
+        got = ragged(params, packed, contexts, 0, 0, use_kernel=False)
+        assert_encode_close(got, dense_reference(params, batch))
+
+    def test_gradients_match_dense(self):
+        """loss_and_aux_packed's backward (the fused TRAIN path) against
+        the unpack-then-dense loss, all five parameter gradients."""
+        rng = np.random.default_rng(1)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 6)
+        batch = batch._replace(
+            label=np.clip(batch.label, 0, 15).astype(np.int32))
+        packed = packed_lib.pack_batch(batch, 0, 0, data_shards=2,
+                                       capacity_minimum=4)
+
+        def dense_loss(p):
+            return functional.loss_and_aux(
+                p, batch.source, batch.path, batch.target, batch.mask,
+                batch.label, batch.weight, num_valid_targets=16)[0]
+
+        def ragged_loss(p):
+            return functional.loss_and_aux_packed(
+                p, jnp.asarray(packed.ctx), jnp.asarray(packed.count),
+                jnp.asarray(packed.label), jnp.asarray(packed.weight),
+                max_contexts=6, token_pad=0, path_pad=0,
+                num_valid_targets=16)[0]
+
+        loss_d, grads_d = jax.value_and_grad(dense_loss)(params)
+        loss_r, grads_r = jax.value_and_grad(ragged_loss)(params)
+        np.testing.assert_allclose(float(loss_r), float(loss_d),
+                                   rtol=1e-5)
+        for name, got, want in zip(params._fields,
+                                   jax.tree_util.tree_leaves(grads_r),
+                                   jax.tree_util.tree_leaves(grads_d)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=name)
+
+    def test_dropout_runs_and_is_finite(self):
+        """Dropout draws over the PACKED layout (a different seed-keyed
+        stream than the dense path — the DROPOUT_PRNG_IMPL precedent),
+        so the contract is a finite loss + finite grads, not bit
+        parity."""
+        params = small_params()
+        batch = random_plane_batch(np.random.default_rng(5), 8, 6)
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+
+        def loss(p):
+            return functional.loss_and_aux_packed(
+                p, jnp.asarray(packed.ctx), jnp.asarray(packed.count),
+                jnp.asarray(np.clip(packed.label, 0, 15)),
+                jnp.asarray(packed.weight),
+                max_contexts=6, token_pad=0, path_pad=0,
+                num_valid_targets=16,
+                dropout_rng=jax.random.PRNGKey(7),
+                dropout_keep_rate=0.75)[0]
+
+        value, grads = jax.value_and_grad(loss)(params)
+        assert np.isfinite(float(value))
+        assert all(np.isfinite(np.asarray(g)).all()
+                   for g in jax.tree_util.tree_leaves(grads))
+
+    def test_kernel_refuses_dropout(self):
+        params = small_params()
+        packed = packed_lib.pack_batch(
+            random_plane_batch(np.random.default_rng(0), 8, 4), 0, 0,
+            capacity_minimum=4)
+        with pytest.raises(ValueError, match='deterministic forward'):
+            ragged(params, packed, 4, 0, 0, use_kernel=True,
+                   interpret=True, dropout_rng=jax.random.PRNGKey(0),
+                   dropout_keep_rate=0.5)
+
+
+class TestKernelInterpret:
+    """The Pallas kernel in interpreter mode — no TPU needed for the
+    FuseMax single-pass logic."""
+
+    @pytest.mark.parametrize('data_shards', [1, 2])
+    def test_kernel_matches_dense(self, data_shards):
+        rng = np.random.default_rng(11)
+        params = small_params()
+        for _trial in range(6):
+            contexts = int(rng.choice([3, 5, 8]))
+            batch = random_plane_batch(rng, 8, contexts, 1, 2)
+            packed = packed_lib.pack_batch(batch, 1, 2,
+                                           data_shards=data_shards,
+                                           capacity_minimum=4)
+            got = ragged(params, packed, contexts, 1, 2,
+                         use_kernel=True, interpret=True)
+            assert_encode_close(got, dense_reference(params, batch))
+
+    def test_multi_tile_online_rescale(self, monkeypatch):
+        """Force several grid steps (tiny slot tile) so segments SPAN
+        tiles and the running (m, z, acc) rescale actually runs, with
+        the per-example stream crossing every tile boundary."""
+        monkeypatch.setattr(pallas_ragged, 'SLOT_TILE', 8)
+        rng = np.random.default_rng(13)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 13, hole_rate=0.4)
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+        assert packed.ctx.shape[1] > 8  # really multi-tile
+        got = ragged(params, packed, 13, 0, 0, use_kernel=True,
+                     interpret=True)
+        assert_encode_close(got, dense_reference(params, batch))
+
+    def test_kernel_shard_mapped_on_mesh(self):
+        """The multi-device route: pallas_call is opaque to GSPMD, so
+        the kernel must be shard_mapped over the data axis — parity on
+        the 8-virtual-device mesh."""
+        from code2vec_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.create_mesh()
+        shards = mesh.shape['data']
+        rng = np.random.default_rng(17)
+        params = small_params()
+        batch = random_plane_batch(rng, 2 * shards, 5, 1, 2)
+        packed = packed_lib.pack_batch(batch, 1, 2, data_shards=shards,
+                                       capacity_minimum=4)
+        got = ragged(params, packed, 5, 1, 2, use_kernel=True,
+                     interpret=True, mesh=mesh)
+        assert_encode_close(got, dense_reference(params, batch))
+
+    def test_bf16_compute_smoke(self):
+        """bf16 is the production compute dtype: the kernel and twin
+        must agree with the dense bf16 path to bf16 resolution."""
+        rng = np.random.default_rng(19)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 6)
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+        want = functional.encode(params, batch.source, batch.path,
+                                 batch.target, batch.mask,
+                                 dtype=jnp.bfloat16)
+        for kw in ({'use_kernel': False},
+                   {'use_kernel': True, 'interpret': True}):
+            got = ragged(params, packed, 6, 0, 0, dtype=jnp.bfloat16,
+                         **kw)
+            assert_encode_close(got, want, rtol=0.03, atol=0.02)
+
+
+@pytest.fixture(scope='module')
+def trainer_pair():
+    """One (plain, fused) trainer pair shared by the integration tests:
+    Trainer construction compiles the full step-program family on the
+    8-device mesh, so rebuilding per test would dominate the file's
+    tier-1 budget. Dropout off: the two layouts draw different masks."""
+    plain = make_trainer(DROPOUT_KEEP_RATE=1.0)
+    fused = make_trainer(DROPOUT_KEEP_RATE=1.0,
+                         USE_PALLAS_RAGGED_FUSION=True)
+    return plain, fused
+
+
+class TestTrainerIntegration:
+    """USE_PALLAS_RAGGED_FUSION threaded through the packed train/eval/
+    predict steps: fused vs unpack-then-dense on the 8-virtual-device
+    mesh (CPU, so the twin runs — the same code the TPU train path
+    uses)."""
+
+    def _packed(self, trainer, n=3):
+        rng = np.random.default_rng(5)
+        shards = trainer.mesh.shape['data']
+        out = []
+        for _ in range(n):
+            batch = random_plane_batch(rng, 8, 4, pad_row_rate=0.1)
+            batch = batch._replace(
+                label=np.clip(batch.label, 0, 15).astype(np.int32))
+            out.append(packed_lib.pack_batch(batch, 0, 0,
+                                             data_shards=shards,
+                                             capacity_minimum=4))
+        return out
+
+    def test_train_steps_match(self, trainer_pair):
+        plain, fused = trainer_pair
+        packed = self._packed(plain)
+        state_a = plain.init_state(seed=0)
+        state_b = fused.init_state(seed=0)
+        for pb in packed:
+            state_a, loss_a = plain.train_step(state_a, pb)
+            state_b, loss_b = fused.train_step(state_b, pb)
+            np.testing.assert_allclose(float(loss_b), float(loss_a),
+                                       rtol=1e-5)
+        for leaf_a, leaf_b in zip(
+                jax.tree_util.tree_leaves(state_a.params),
+                jax.tree_util.tree_leaves(state_b.params)):
+            np.testing.assert_allclose(np.asarray(leaf_b),
+                                       np.asarray(leaf_a),
+                                       rtol=2e-4, atol=1e-6)
+
+    def test_eval_and_all_predict_tiers_match(self, trainer_pair):
+        plain, fused = trainer_pair
+        packed = self._packed(plain, n=1)
+        params = plain.init_state(seed=1).params
+        out_a = plain.eval_step(params, packed[0])
+        out_b = fused.eval_step(params, packed[0])
+        np.testing.assert_array_equal(np.asarray(out_a['topk_indices']),
+                                      np.asarray(out_b['topk_indices']))
+        np.testing.assert_allclose(float(out_b['loss_sum']),
+                                   float(out_a['loss_sum']), rtol=1e-5)
+        assert float(out_a['weight_sum']) == float(out_b['weight_sum'])
+        from code2vec_tpu.training.trainer import PREDICT_TIERS
+        for tier in PREDICT_TIERS:
+            pa = plain.predict_step(params, packed[0], tier=tier)
+            pb = fused.predict_step(params, packed[0], tier=tier)
+            assert set(pa) == set(pb), tier
+            for key in pa:
+                np.testing.assert_allclose(
+                    np.asarray(pb[key]).astype(np.float64),
+                    np.asarray(pa[key]).astype(np.float64),
+                    rtol=1e-5, atol=1e-6, err_msg='%s/%s' % (tier, key))
+
+    def test_zero_postwarm_compiles(self, trainer_pair):
+        """The fused packed programs must be as shape-stable as the
+        unpack path: repeated dispatches on warm (bucket, capacity,
+        tier) shapes add NOTHING to the compile counter — the serving
+        ladder's steady-state contract. (Predict is deterministic, so
+        the shared dropout-off trainer is exactly the serving shape.)"""
+        from code2vec_tpu.parallel import mesh as mesh_lib
+        from code2vec_tpu.telemetry import core
+        from code2vec_tpu.telemetry.jit_tracker import \
+            install_compile_listener
+        from code2vec_tpu.training.trainer import PREDICT_TIERS
+        fused = trainer_pair[1]
+        packed = self._packed(fused, n=2)
+        params = fused.init_state(seed=0).params
+        placed = [mesh_lib.shard_batch(pb.device_arrays(), fused.mesh,
+                                       False) for pb in packed]
+        assert placed[0][0].shape == placed[1][0].shape  # same capacity
+        core.reset()
+        core.enable()
+        try:
+            assert install_compile_listener()
+            compiles = core.registry().counter('jit/compiles_total')
+            for tier in PREDICT_TIERS:  # warm every fused program
+                fused.predict_step_placed(params, placed[0], tier=tier)
+            warm = compiles.value
+            for tier in PREDICT_TIERS:
+                for arrays in placed:
+                    out = fused.predict_step_placed(params, arrays,
+                                                    tier=tier)
+                    jax.block_until_ready(out)
+            assert compiles.value - warm == 0, (
+                '%d XLA compiles after warmup on fixed packed shapes'
+                % (compiles.value - warm))
+        finally:
+            core.disable()
+            core.reset()
+        # the ledger's executables bucket stays complete: the AOT
+        # memory_analysis the serving warmup records per (bucket x
+        # capacity x tier) must measure the FUSED program too
+        info = fused.predict_program_memory(params, placed[0],
+                                            tier='attention')
+        assert info is not None and set(info) == {
+            'generated_code_bytes', 'temp_bytes', 'argument_bytes',
+            'output_bytes'}
+
+    def test_lazy_adam_falls_back_for_train_only(self):
+        """LAZY_EMBEDDING_ADAM needs the unpacked plane indices: the
+        packed TRAIN step keeps the unpack path (and still runs), while
+        predict stays fused."""
+        fused = make_trainer(DROPOUT_KEEP_RATE=1.0,
+                             USE_PALLAS_RAGGED_FUSION=True,
+                             LAZY_EMBEDDING_ADAM=True)
+        packed = self._packed(fused, n=1)
+        state = fused.init_state(seed=0)
+        state, loss = fused.train_step(state, packed[0])
+        assert np.isfinite(float(loss))
+        out = fused.predict_step(state.params, packed[0], tier='topk')
+        assert np.asarray(out['topk_indices']).shape[0] == 8
