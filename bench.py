@@ -73,13 +73,13 @@ if BENCH_RECIPE not in ('default', 'default_v2', 'parity', 'ragged'):
     BENCH_RECIPE = 'default'
 RECIPE_OVERRIDES = {
     'default': {},
-    # the shipped defaults plus USE_PALLAS_RAGGED_FUSION (ISSUE 10):
-    # the headline train metric with encode + attention running
-    # straight off the packed wire — the dedicated fused-vs-unfused
-    # step-time/HBM A/B lives in benchmarks/bench_pallas_ragged.py;
-    # this recipe lets the HEADLINE metric be re-captured under the
-    # fused path once the flip rule clears
-    'ragged': dict(USE_PALLAS_RAGGED_FUSION=True),
+    # the full ragged-fusion candidate (ISSUEs 10 + 12): the fusion is
+    # the shipped default now, so this recipe adds the train-side
+    # Pallas kernel pair (RAGGED_TRAIN_KERNEL) — the headline re-capture
+    # arm once scripts/flip_verdict.py records the >=2% train win from
+    # the bench_pallas_ragged A/B
+    'ragged': dict(USE_PALLAS_RAGGED_FUSION=True,
+                   RAGGED_TRAIN_KERNEL=True),
     # the 2026-07-31 morning default set (rbg + bf16 mu, fp32 nu/grads),
     # pinned so the headline_v2 capture stays reproducible now that the
     # shipped default moved on (bf16 nu) — a 'default' re-run would

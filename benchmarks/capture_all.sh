@@ -96,19 +96,27 @@ probe_or_record "after pallas_ab" || exit 3
 BENCH_CONTEXTS=1024 run_stage pallas_ab_c1024 900 \
   python benchmarks/bench_pallas_encode.py
 probe_or_record "after pallas_ab_c1024" || exit 3
-# ragged packed-wire fusion A/B (ISSUE 10): packed train + predict step
-# time AND per-arm peak HBM, fused vs unpack-then-dense — first at the
-# java14m headline fill, then the fused path's best case (high
-# max_contexts, low fill, where the dense planes are mostly padding).
-# Per-arm timeout pinned so BOTH arms fit inside the 900 s stage budget
-# (the default 780 s/arm would let one stalled arm eat the stage);
-# watch_and_capture.sh carries the big-budget variant for compile
-# stalls that need it.
-BENCH_PALLAS_ARM_TIMEOUT=390 run_stage pallas_ragged 900 \
+# ragged packed-wire fusion A/B (ISSUEs 10 + 12): packed train, train-
+# BACKWARD (value_and_grad step time + grad-program AOT temp bytes, the
+# custom-VJP recompute's residual axis) and predict step time AND
+# per-arm peak HBM, across THREE arms: unfused (unpack-then-dense),
+# fused (the SHIPPED default: fusion + custom-VJP twin train), and
+# fused_kernel (+ RAGGED_TRAIN_KERNEL, the Pallas train pair). The
+# fusion speedups confirm the default flip vs unpack; the kernel
+# verdict (ragged_train_kernel_speedup) compares the pair against the
+# fused twin it would replace — first at the java14m headline fill,
+# then the fused path's best case (high max_contexts, low fill, where
+# the dense planes are mostly padding). scripts/flip_verdict.py
+# settles the >=2% flips from these records after the round.
+# Per-arm timeout pinned so all THREE arms fit inside the 1300 s stage
+# budget (the default 780 s/arm would let one stalled arm eat the
+# stage); watch_and_capture.sh carries the big-budget variant for
+# compile stalls that need it.
+BENCH_PALLAS_ARM_TIMEOUT=390 run_stage pallas_ragged 1300 \
   python benchmarks/bench_pallas_ragged.py
 probe_or_record "after pallas_ragged" || exit 3
 BENCH_CONTEXTS=1024 BENCH_FILL=0.1 BENCH_PALLAS_ARM_TIMEOUT=390 \
-  run_stage pallas_ragged_c1024 900 \
+  run_stage pallas_ragged_c1024 1300 \
   python benchmarks/bench_pallas_ragged.py
 probe_or_record "after pallas_ragged_c1024" || exit 3
 # serving engine A/B (ISSUE 4): naive per-request predict vs the
@@ -127,5 +135,10 @@ probe_or_record "after serving" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline
 run_stage index 900 python benchmarks/bench_index.py
+
+# settle the queued >=2% flip verdicts from everything this round (and
+# prior rounds) captured — durable rows in results/flip_verdicts.json.
+# Non-fatal: a partial round still records PENDING with provenance.
+python scripts/flip_verdict.py --write || true
 
 echo "capture complete: ${OUT}" >&2

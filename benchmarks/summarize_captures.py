@@ -76,12 +76,17 @@ def main() -> None:
                                # ragged-fusion A/B axes (ISSUE 10): the
                                # fused-vs-unfused step-time records key
                                # on these to be comparable across
-                               # capture rounds
-                               'fill', 'contexts',
+                               # capture rounds; 'kind' disambiguates
+                               # train vs train_bwd arms (ISSUE 12)
+                               'fill', 'contexts', 'kind',
                                # the memory axis (ISSUE 9): per-stage
                                # peak HBM; None = stats-less backend,
-                               # an explicit gap
-                               'peak_hbm_bytes', 'hbm_bytes_in_use')}
+                               # an explicit gap. 'temp_bytes' is the
+                               # grad program's AOT temp allocation —
+                               # the residual footprint the custom-VJP
+                               # recompute backward cuts (ISSUE 12)
+                               'peak_hbm_bytes', 'hbm_bytes_in_use',
+                               'temp_bytes')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

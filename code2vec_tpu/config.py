@@ -239,12 +239,25 @@ class Config:
     # packed layout. Outputs match the unpack-then-dense path to fp32
     # rounding (tests/test_pallas_ragged.py); dropout draws its mask
     # over the packed layout (a different seed-keyed stream, the
-    # DROPOUT_PRNG_IMPL precedent). OFF by default until the on-chip
-    # A/B (benchmarks/bench_pallas_ragged.py) clears the >=2% flip rule
-    # at the java14m shape; biggest expected wins at high MAX_CONTEXTS /
-    # low fill, where the dense path is mostly padding (PERF.md "Ragged
-    # fusion").
-    USE_PALLAS_RAGGED_FUSION: bool = False
+    # DROPOUT_PRNG_IMPL precedent). ON by default: the deterministic
+    # paths run the kernel only on a real TPU backend (jnp twin
+    # everywhere else — never the interpreter), and the train path runs
+    # the custom-VJP twin whose recompute backward saves no (B, C, .)/
+    # (D, cap, .) residuals (structural wins on every backend; CPU
+    # harness smoke 1.59x train / 1.91x predict, PERF.md "Ragged
+    # fusion"). --no-ragged-fusion restores the unpack-then-dense
+    # (bit-exact vs planes) path.
+    USE_PALLAS_RAGGED_FUSION: bool = True
+    # Route the packed TRAIN step's forward AND recompute-backward
+    # through the Pallas kernel pair on a real TPU backend
+    # (ops/pallas_ragged.py::_ragged_kernel/_bwd_kernel). This is the
+    # on-chip train flip the >=2% rule still gates: OFF until
+    # scripts/flip_verdict.py reads a healthy capture round
+    # (benchmarks/bench_pallas_ragged.py train arms) clearing 1.02x —
+    # the verdicts have been queued since the 2026-07-31 TPU wedge.
+    # Inert off-TPU (the custom-VJP jnp twin runs regardless) and
+    # without USE_PALLAS_RAGGED_FUSION (the train step then unpacks).
+    RAGGED_TRAIN_KERNEL: bool = False
     # When set, capture a jax.profiler trace of a few training steps into
     # this directory (viewable with TensorBoard/Perfetto) — the step-level
     # profiler the reference lacked (SURVEY.md §5 'Tracing / profiling').
@@ -575,7 +588,19 @@ class Config:
                                  'the packed wire: no device-side '
                                  'unpack, no dense (B, C, .) '
                                  'intermediates (ops/pallas_ragged.py, '
-                                 'PERF.md)')
+                                 'PERF.md; the default since the '
+                                 'custom-VJP backward landed)')
+        parser.add_argument('--no-ragged-fusion', dest='no_ragged_fusion',
+                            action='store_true',
+                            help='restore the unpack-then-dense packed '
+                                 'path (bit-exact vs the plane wire)')
+        parser.add_argument('--ragged-train-kernel',
+                            dest='ragged_train_kernel',
+                            action='store_true',
+                            help='run the packed TRAIN step through the '
+                                 'Pallas forward+backward kernel pair '
+                                 'on TPU (pending the >=2% flip '
+                                 'verdict, scripts/flip_verdict.py)')
         parser.add_argument('--remat-encode', dest='remat_encode',
                             action='store_true',
                             help='recompute encode activations in the '
@@ -800,6 +825,10 @@ class Config:
             self.USE_PALLAS_FUSED_CE = True
         if parsed.ragged_fusion:
             self.USE_PALLAS_RAGGED_FUSION = True
+        if parsed.no_ragged_fusion:
+            self.USE_PALLAS_RAGGED_FUSION = False
+        if parsed.ragged_train_kernel:
+            self.RAGGED_TRAIN_KERNEL = True
         if parsed.remat_encode:
             self.REMAT_ENCODE = True
         if parsed.opt_state_sharding:

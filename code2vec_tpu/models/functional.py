@@ -214,9 +214,11 @@ def encode_packed(params: Code2VecParams, ctx: jax.Array, count: jax.Array,
     ``(B, C)`` index planes or the ``(B, C, 3d)`` context embeddings the
     unpack-then-dense path pays for (ops/pallas_ragged.py; gated by
     ``Config.USE_PALLAS_RAGGED_FUSION``). On a real TPU backend the
-    deterministic forward runs the fused Pallas kernel; everywhere else
-    (and whenever dropout applies) the differentiable jnp twin runs —
-    never the interpreter."""
+    forward runs the fused Pallas kernel (dropout, when given, is drawn
+    over the packed layout outside the kernel and applied to its
+    inputs); everywhere else the differentiable jnp twin runs — never
+    the interpreter. Training differentiates through
+    ``loss_and_aux_packed``'s custom-VJP route, not this one."""
     from code2vec_tpu.ops import pallas_ragged
     return pallas_ragged.ragged_encode(
         params.token_embedding, params.path_embedding, params.transform,
@@ -353,21 +355,40 @@ def loss_and_aux_packed(params: Code2VecParams, ctx: jax.Array,
                         embed_grad_impl: str = 'dense',
                         use_fused_ce: bool = False,
                         fused_ce_mesh=None,
-                        remat_encode: bool = False):
+                        remat_encode: bool = False,
+                        use_ragged_kernel: Optional[bool] = False,
+                        ragged_mesh=None,
+                        ragged_custom_vjp: bool = True):
     """``loss_and_aux`` straight off the packed wire: the ragged fused
     encoder replaces unpack + dense encode (USE_PALLAS_RAGGED_FUSION;
     ops/pallas_ragged.py), the CE tail is shared with the plane path.
-    The backward differentiates the jnp twin (``use_kernel=False``): the
-    Pallas kernel is forward-only, and at training defaults dropout is
-    active anyway — the structural win here is packed-layout math, which
-    both implementations share."""
+
+    The encode runs under :func:`pallas_ragged.ragged_encode_code`'s
+    custom VJP: the backward recomputes the per-slot state off the
+    packed segments instead of storing the (D, cap, 3d) gathered
+    embeddings / (D, cap, D) activations as residuals, and emits the
+    token/path table gradients as packed-stream scatter-adds
+    (EMBED_GRAD_IMPL / lazy-Adam compatible). ``use_ragged_kernel``
+    routes both passes through the Pallas pair (None = auto on TPU —
+    callers gate it with Config.RAGGED_TRAIN_KERNEL pending the >=2%
+    flip verdict; False = the jnp twin pair, the CPU/fallback default).
+    ``max_contexts`` only shapes the attention planes the loss never
+    reads; it stays in the signature so the packed twins share one call
+    shape. ``ragged_custom_vjp=False`` keeps the autodiff twin — the
+    residual-storing reference the tests compare against."""
+    del max_contexts  # loss consumes code vectors only
+    from code2vec_tpu.ops import pallas_ragged
+
     def _encode(params_, ctx_, count_, rng_):
-        return encode_packed(
-            params_, ctx_, count_, max_contexts=max_contexts,
+        return pallas_ragged.ragged_encode_code(
+            params_.token_embedding, params_.path_embedding,
+            params_.transform, params_.attention, ctx_, count_,
             token_pad=token_pad, path_pad=path_pad, dropout_rng=rng_,
             dropout_keep_rate=dropout_keep_rate,
             dropout_prng_impl=dropout_prng_impl, dtype=dtype,
-            embed_grad_impl=embed_grad_impl, use_kernel=False)[0]
+            embed_grad_impl=embed_grad_impl,
+            use_kernel=use_ragged_kernel, mesh=ragged_mesh,
+            custom_vjp=ragged_custom_vjp)
 
     if remat_encode:
         _encode = jax.checkpoint(_encode)

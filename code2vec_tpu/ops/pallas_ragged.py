@@ -30,19 +30,40 @@ acc)`` statistics:
 - ``_stats_jnp`` — the reference twin: plain jnp segment ops (scatter
   max/add over the shard-structured stream), fully differentiable, runs
   everywhere and partitions under GSPMD (leading data_shards axis, like
-  ``unpack_device``). This is the TRAIN path: dropout and the backward
-  pass live here, and skipping the dense scatter + dense encode is
-  already the structural win.
+  ``unpack_device``).
 - ``_stats_pallas`` — the Pallas TPU kernel: one grid walk over slot
   tiles with the per-example running ``(m, z, acc)`` resident in VMEM,
   segment membership resolved per tile with an indicator matrix so the
   reductions ride the MXU/VPU (the FuseMax single pass — later tiles
-  rescale earlier sums by ``exp(m_old - m_new)``). Deterministic forward
-  only (eval / predict / the serving ladder), mirroring
-  ``ops/pallas_encode.py``'s dropout discipline. On multi-device meshes
-  it must be ``shard_map``-ped over the data axis — a ``pallas_call`` is
-  opaque to GSPMD and would otherwise be replicated (same reasoning as
-  ``ops/pallas_ce.py``).
+  rescale earlier sums by ``exp(m_old - m_new)``). On multi-device
+  meshes it must be ``shard_map``-ped over the data axis — a
+  ``pallas_call`` is opaque to GSPMD and would otherwise be replicated
+  (same reasoning as ``ops/pallas_ce.py``).
+
+TRAIN path (``ragged_encode_code``, the custom VJP): the code-vector
+encode is wrapped in ``jax.custom_vjp`` so the backward never stores a
+per-slot residual. The forward saves only the per-example softmax stats
+``(m, z)``, the ``(B, D)`` code vectors, and the inputs it was handed
+(indices + params + the dropout PRNG key); the backward re-gathers the
+embeddings, re-draws the SAME dropout mask from the threaded key, and
+recomputes ``x``/``scores``/``w`` per slot tile — the FuseMax
+recompute-over-store schedule — before emitting exact softmax-backward
+gradients: TRANSFORM/ATTENTION densely (per-tile MXU accumulation) and
+the token/path table gradients as segment scatter-adds through
+``ops/embed_grad.table_grad`` (so ``EMBED_GRAD_IMPL`` and the lazy-Adam
+sparse-row substrate compose). The ``(D, cap, 3d)`` gathered context
+embeddings and the ``(D, cap, D)`` activations exist only transiently
+inside each pass, never as residuals between them — the autodiff twin
+saved all of them (tests/test_pallas_ragged.py asserts the residual set
+via the vjp closure). Like the forward, the backward has two
+implementations sharing one contract: a jnp twin (CPU/fallback — the
+residual win applies there too) and a second Pallas kernel walking the
+same packed ``(D, cap, 3)`` segments (``_bwd_kernel``), gated on-chip by
+``Config.RAGGED_TRAIN_KERNEL`` pending the >=2% flip rule
+(scripts/flip_verdict.py). Dropout now rides BOTH implementations: the
+keep mask is drawn over the packed ``(shards, cap, 3d)`` layout outside
+the kernels and applied to their embedding inputs, so the fused train
+draw bit-matches the jnp twin's draw by construction.
 
 VMEM at java14m serving shapes (per-shard segments Bs=1024, D=384,
 SLOT_TILE=512, d=128): tile inputs ~0.8 MB, weights ~0.6 MB resident,
@@ -280,13 +301,9 @@ def _stats_kernel_path(src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
 
 
 # ------------------------------------------------------------- finish
-def _finish(scores, m, z, acc, seg, pos, slot_valid, count2, x_pad,
-            max_contexts: int):
-    """(stats, segment structure) -> (code_vectors (B, D) fp32, attention
-    planes (B, C) fp32). The count == 0 fixups reproduce the dense
-    path's finite-uniform behavior for all-padding rows exactly."""
-    shards, per_shard = count2.shape
-    cap = seg.shape[1]
+def _code_from_stats(z, acc, count2, x_pad):
+    """(z, acc) stats -> (D, Bs, Dc) fp32 code vectors, with the
+    count == 0 analytic fixup (code = x_pad)."""
     nonempty = count2 > 0                                    # (D, Bs)
     # guard empty segments' 0/0 (the fixup below overwrites them). NOT
     # jnp.maximum(z, 1.0): a single-valid-slot segment has z == 1.0
@@ -295,8 +312,20 @@ def _finish(scores, m, z, acc, seg, pos, slot_valid, count2, x_pad,
     # rows' softmax-normalization gradient
     z_safe = jnp.where(nonempty, z, 1.0)
     code = acc / z_safe[..., None]
-    code = jnp.where(nonempty[..., None], code,
+    return jnp.where(nonempty[..., None], code,
                      x_pad.astype(jnp.float32)[None, None, :])
+
+
+def _finish(scores, m, z, acc, seg, pos, slot_valid, count2, x_pad,
+            max_contexts: int):
+    """(stats, segment structure) -> (code_vectors (B, D) fp32, attention
+    planes (B, C) fp32). The count == 0 fixups reproduce the dense
+    path's finite-uniform behavior for all-padding rows exactly."""
+    shards, per_shard = count2.shape
+    cap = seg.shape[1]
+    nonempty = count2 > 0                                    # (D, Bs)
+    z_safe = jnp.where(nonempty, z, 1.0)
+    code = _code_from_stats(z, acc, count2, x_pad)
     p = jnp.exp(scores - jnp.take_along_axis(m, seg, axis=1))
     w = jnp.where(slot_valid,
                   p / jnp.take_along_axis(z_safe, seg, axis=1), 0.0)
@@ -309,6 +338,64 @@ def _finish(scores, m, z, acc, seg, pos, slot_valid, count2, x_pad,
     attn = jnp.where(nonempty[..., None], attn, 1.0 / max_contexts)
     batch = shards * per_shard
     return code.reshape(batch, -1), attn.reshape(batch, max_contexts)
+
+
+# ------------------------------------------------- shared preparation
+def _segment_inputs(ctx, count, token_pad: int, path_pad: int):
+    """Packed wire arrays -> the segment structure + index planes every
+    pass (forward AND recompute-backward) derives identically."""
+    from code2vec_tpu.data.packed import segment_structure
+    shards, cap, _ = ctx.shape
+    per_shard = count.shape[0] // shards
+    count2 = count.reshape(shards, per_shard).astype(jnp.int32)
+    seg, pos, in_range = segment_structure(count2, cap)
+    src, pth, tgt = ctx[..., 0], ctx[..., 1], ctx[..., 2]
+    # the reader.context_valid_mask predicate, applied on the packed
+    # stream: interior holes (all three parts PAD) drop out here exactly
+    # as the dense path's log-mask drops them out of its softmax
+    slot_valid = in_range & ((src != token_pad) | (tgt != token_pad)
+                             | (pth != path_pad))            # (D, cap)
+    return count2, seg, pos, slot_valid, src, pth, tgt
+
+
+def _dropout_parts(dropout_rng, dropout_keep_rate: float,
+                   dropout_prng_impl: str, shards: int, cap: int,
+                   token_dim: int, path_dim: int):
+    """The packed-layout keep mask, split per embedding part — THE one
+    draw both the forward and the recompute backward make from the
+    threaded key, so fused-vs-twin and fwd-vs-bwd masks bit-match by
+    construction (models/functional.py::dropout_keep_mask routing)."""
+    from code2vec_tpu.models.functional import dropout_keep_mask
+    keep = dropout_keep_mask(dropout_rng, dropout_keep_rate,
+                             (shards, cap, 2 * token_dim + path_dim),
+                             dropout_prng_impl)
+    return (keep[..., :token_dim],
+            keep[..., token_dim:token_dim + path_dim],
+            keep[..., token_dim + path_dim:])
+
+
+def _apply_keep(e, keep, keep_rate: float):
+    return jnp.where(keep, e / keep_rate, jnp.zeros_like(e))
+
+
+def _split_weights(transform, attention, token_dim: int, path_dim: int,
+                   dtype):
+    t = transform.astype(dtype)
+    return (t[:token_dim], t[token_dim:token_dim + path_dim],
+            t[token_dim + path_dim:], attention.astype(dtype))
+
+
+def _pad_forward(token_embedding, path_embedding, transform,
+                 token_pad: int, path_pad: int, dtype, precision):
+    """(pad_ctx (3d,), x_pad (Dc,)) — the dense path's value for every
+    all-PAD slot, the analytic stand-in for count == 0 rows. No dropout
+    (such rows carry weight 0, so dropout on them is loss-invisible)."""
+    pad_ctx = jnp.concatenate([
+        token_embedding[token_pad], path_embedding[path_pad],
+        token_embedding[token_pad]]).astype(dtype)
+    x_pad = jnp.tanh(jnp.matmul(pad_ctx[None, :], transform.astype(dtype),
+                                precision=precision))[0]     # (Dc,)
+    return pad_ctx, x_pad
 
 
 # --------------------------------------------------------------- entry
@@ -328,36 +415,28 @@ def ragged_encode(token_embedding: jax.Array, path_embedding: jax.Array,
     (B, C) fp32), with no ``(B, C, .)`` intermediate anywhere.
 
     ``use_kernel`` None routes the Pallas kernel iff a real TPU backend
-    is active AND no dropout applies (the kernel is forward-only); False
-    forces the jnp twin; True forces the kernel (tests run it with
-    ``interpret=True`` on CPU). ``mesh`` shard_maps the kernel over the
-    data axis on multi-device meshes; the twin ignores it (its segment
-    ops partition under GSPMD by the leading shards axis).
+    is active; False forces the jnp twin; True forces the kernel (tests
+    run it with ``interpret=True`` on CPU). Dropout (the fused TRAIN
+    draw) rides either implementation: the packed-layout keep mask is
+    applied to the gathered embeddings BEFORE the stats pass, so the
+    kernel and the twin consume bit-identical inputs. NB the kernel
+    itself is still not reverse-differentiable — training routes
+    through :func:`ragged_encode_code`, whose custom VJP recomputes.
+    ``mesh`` shard_maps the kernel over the data axis on multi-device
+    meshes; the twin ignores it (its segment ops partition under GSPMD
+    by the leading shards axis).
     """
     shards, cap, _ = ctx.shape
     batch = count.shape[0]
     per_shard = batch // shards
-    count2 = count.reshape(shards, per_shard).astype(jnp.int32)
     # THE segment arithmetic, shared with unpack_device (data/packed.py)
     # so the parity-critical slot->example mapping has one definition
-    from code2vec_tpu.data.packed import segment_structure
-    seg, pos, in_range = segment_structure(count2, cap)
-    src, pth, tgt = ctx[..., 0], ctx[..., 1], ctx[..., 2]
-    # the reader.context_valid_mask predicate, applied on the packed
-    # stream: interior holes (all three parts PAD) drop out here exactly
-    # as the dense path's log-mask drops them out of its softmax
-    slot_valid = in_range & ((src != token_pad) | (tgt != token_pad)
-                             | (pth != path_pad))            # (D, cap)
+    count2, seg, pos, slot_valid, src, pth, tgt = _segment_inputs(
+        ctx, count, token_pad, path_pad)
 
     apply_dropout = dropout_rng is not None and dropout_keep_rate < 1.0
     if use_kernel is None:
-        use_kernel = (PALLAS_AVAILABLE and tpu_backend_active()
-                      and not apply_dropout)
-    if use_kernel and apply_dropout:
-        raise ValueError(
-            'the Pallas ragged kernel serves the deterministic forward '
-            'only; dropout routes through the jnp twin (pass '
-            'use_kernel=False or no dropout_rng)')
+        use_kernel = PALLAS_AVAILABLE and tpu_backend_active()
     if interpret is None:
         interpret = not tpu_backend_active()
 
@@ -373,38 +452,23 @@ def ragged_encode(token_embedding: jax.Array, path_embedding: jax.Array,
 
     if apply_dropout:
         # THE shared PRNG routing (models/functional.py::
-        # dropout_keep_mask — lazy import; functional's import of this
-        # module is deferred, so there is no cycle). The draw is over
-        # retained slots only: the packed layout also SHRINKS the mask
-        # draw by the fill factor
-        from code2vec_tpu.models.functional import dropout_keep_mask
-        keep = dropout_keep_mask(dropout_rng, dropout_keep_rate,
-                                 (shards, cap, 2 * token_dim + path_dim),
-                                 dropout_prng_impl)
+        # dropout_keep_mask via _dropout_parts — lazy import;
+        # functional's import of this module is deferred, so there is
+        # no cycle). The draw is over retained slots only: the packed
+        # layout also SHRINKS the mask draw by the fill factor
+        keep_src, keep_pth, keep_tgt = _dropout_parts(
+            dropout_rng, dropout_keep_rate, dropout_prng_impl,
+            shards, cap, token_dim, path_dim)
+        src_e = _apply_keep(src_e, keep_src, dropout_keep_rate)
+        pth_e = _apply_keep(pth_e, keep_pth, dropout_keep_rate)
+        tgt_e = _apply_keep(tgt_e, keep_tgt, dropout_keep_rate)
 
-        def drop(e, lo, hi):
-            return jnp.where(keep[..., lo:hi], e / dropout_keep_rate,
-                             jnp.zeros_like(e))
-        src_e = drop(src_e, 0, token_dim)
-        pth_e = drop(pth_e, token_dim, token_dim + path_dim)
-        tgt_e = drop(tgt_e, token_dim + path_dim,
-                     2 * token_dim + path_dim)
-
-    t = transform.astype(dtype)
-    w_src = t[:token_dim]
-    w_path = t[token_dim:token_dim + path_dim]
-    w_tgt = t[token_dim + path_dim:]
-    attn_vec = attention.astype(dtype)                       # (D, 1)
+    w_src, w_path, w_tgt, attn_vec = _split_weights(
+        transform, attention, token_dim, path_dim, dtype)
     precision = _precision(dtype)
-
-    # the dense path's value for every all-PAD slot — the analytic
-    # stand-in for count == 0 rows (deterministic: such rows carry
-    # weight 0, so dropout on them is loss-invisible either way)
-    pad_ctx = jnp.concatenate([
-        token_embedding[token_pad], path_embedding[path_pad],
-        token_embedding[token_pad]]).astype(dtype)
-    x_pad = jnp.tanh(jnp.matmul(pad_ctx[None, :], t,
-                                precision=precision))[0]     # (D,)
+    _pad_ctx, x_pad = _pad_forward(token_embedding, path_embedding,
+                                   transform, token_pad, path_pad, dtype,
+                                   precision)
 
     if use_kernel:
         scores, m, z, acc = _stats_kernel_path(
@@ -416,3 +480,429 @@ def ragged_encode(token_embedding: jax.Array, path_embedding: jax.Array,
             attn_vec, per_shard, precision)
     return _finish(scores, m, z, acc, seg, pos, slot_valid, count2,
                    x_pad, max_contexts)
+
+
+# ------------------------------------------------- recompute backward
+def _bwd_kernel(precision, src_ref, pth_ref, tgt_ref, seg_ref, valid_ref,
+                wsrc_ref, wpath_ref, wtgt_ref, attn_row_ref,
+                m_ref, z_ref, gc_ref, g_ref,
+                de_src_ref, de_pth_ref, de_tgt_ref,
+                dw_src_ref, dw_pth_ref, dw_tgt_ref, dattn_ref):
+    """The second Pallas kernel: exact softmax-backward gradients off
+    the SAME packed slot tiles the forward walked, with the per-slot
+    state (x, scores, softmax weights) RECOMPUTED from the saved
+    per-example ``(m, z)`` — recompute-over-store, so the forward never
+    banks a ``(D, cap, .)`` residual. Per-slot cotangent streams
+    (``de_*``) are emitted per tile; the dense TRANSFORM/ATTENTION
+    gradients accumulate in the output blocks across grid steps (same
+    index map every step keeps them VMEM-resident)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_src_ref[:] = jnp.zeros_like(dw_src_ref)
+        dw_pth_ref[:] = jnp.zeros_like(dw_pth_ref)
+        dw_tgt_ref[:] = jnp.zeros_like(dw_tgt_ref)
+        dattn_ref[:] = jnp.zeros_like(dattn_ref)
+
+    # recompute this tile's forward state
+    x = jnp.dot(src_ref[:], wsrc_ref[:], precision=precision,
+                preferred_element_type=jnp.float32)
+    x += jnp.dot(pth_ref[:], wpath_ref[:], precision=precision,
+                 preferred_element_type=jnp.float32)
+    x += jnp.dot(tgt_ref[:], wtgt_ref[:], precision=precision,
+                 preferred_element_type=jnp.float32)
+    x = jnp.tanh(x)                                          # (T, Dc) f32
+    attn_row = attn_row_ref[:]                               # (1, Dc)
+    sc = jax.lax.dot_general(x, attn_row, (((1,), (1,)), ((), ())),
+                             precision=precision,
+                             preferred_element_type=jnp.float32)  # (T, 1)
+    valid = valid_ref[:] > 0.0                               # (T, 1)
+    n_seg = m_ref.shape[1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (sc.shape[0], n_seg), 1)
+    onehot = ((seg_ref[:] == lanes) & valid).astype(jnp.float32)
+    # per-slot views of the per-example stats/cotangents, via the same
+    # indicator contraction the forward used (MXU/VPU, no gathers)
+    m_slot = jnp.sum(onehot * m_ref[:], axis=1, keepdims=True)
+    z_slot = jnp.sum(onehot * z_ref[:], axis=1, keepdims=True)
+    gc_slot = jnp.sum(onehot * gc_ref[:], axis=1, keepdims=True)
+    g_slot = jnp.dot(onehot, g_ref[:],
+                     preferred_element_type=jnp.float32)     # (T, Dc)
+    p = jnp.where(valid, jnp.exp(sc - m_slot), 0.0)
+    w = p / jnp.where(z_slot > 0.0, z_slot, 1.0)             # (T, 1)
+    # exact softmax backward (the stop-gradiented running max drops out:
+    # softmax is shift-invariant)
+    gdot = jnp.sum(x * g_slot, axis=1, keepdims=True)        # (T, 1)
+    ds = w * (gdot - gc_slot)                                # (T, 1)
+    dx = w * g_slot + ds * attn_row.astype(jnp.float32)
+    du = (1.0 - x * x) * dx                                  # (T, Dc) f32
+    de_src_ref[:] = jax.lax.dot_general(
+        du, wsrc_ref[:], (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    de_pth_ref[:] = jax.lax.dot_general(
+        du, wpath_ref[:], (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    de_tgt_ref[:] = jax.lax.dot_general(
+        du, wtgt_ref[:], (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    dw_src_ref[:] += jax.lax.dot_general(
+        src_ref[:], du, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    dw_pth_ref[:] += jax.lax.dot_general(
+        pth_ref[:], du, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    dw_tgt_ref[:] += jax.lax.dot_general(
+        tgt_ref[:], du, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)
+    dattn_ref[:] += jax.lax.dot_general(
+        x, ds, (((0,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)                  # (Dc, 1)
+
+
+def _grads_pallas(src_e, pth_e, tgt_e, seg, valid, w_src, w_path, w_tgt,
+                  attn_vec, m, z, gc, g, n_seg: int, interpret: bool,
+                  precision):
+    """One shard's flat packed stream + saved ``(m, z)`` stats +
+    per-example cotangents ``g`` (n_seg, Dc) / ``gc`` (n_seg,) ->
+    (de_src/de_pth/de_tgt (cap, d) f32, dw_src/dw_pth/dw_tgt (d, Dc)
+    f32, d_attn (Dc, 1) f32) via the recompute backward kernel."""
+    cap, token_dim = src_e.shape
+    path_dim = pth_e.shape[1]
+    code_dim = w_src.shape[1]
+    padded = -(-cap // SLOT_TILE) * SLOT_TILE
+    pad = padded - cap
+    if pad:
+        src_e = jnp.pad(src_e, ((0, pad), (0, 0)))
+        pth_e = jnp.pad(pth_e, ((0, pad), (0, 0)))
+        tgt_e = jnp.pad(tgt_e, ((0, pad), (0, 0)))
+        seg = jnp.pad(seg, (0, pad))
+        valid = jnp.pad(valid, (0, pad))     # False: pad slots are inert
+    seg2 = seg.reshape(padded, 1).astype(jnp.int32)
+    valid2 = valid.reshape(padded, 1).astype(jnp.float32)
+    attn_row = attn_vec.reshape(1, code_dim)
+    grid = (padded // SLOT_TILE,)
+    row_block = lambda dim: pl.BlockSpec((SLOT_TILE, dim),
+                                         lambda i: (i, 0))
+    full_block = lambda shape: pl.BlockSpec(shape, lambda i: (0, 0))
+    kernel = functools.partial(_bwd_kernel, precision)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_block(token_dim), row_block(path_dim), row_block(token_dim),
+            row_block(1), row_block(1),
+            full_block(w_src.shape), full_block(w_path.shape),
+            full_block(w_tgt.shape), full_block((1, code_dim)),
+            full_block((1, n_seg)), full_block((1, n_seg)),
+            full_block((1, n_seg)), full_block((n_seg, code_dim)),
+        ],
+        out_specs=[
+            row_block(token_dim), row_block(path_dim), row_block(token_dim),
+            full_block((token_dim, code_dim)),
+            full_block((path_dim, code_dim)),
+            full_block((token_dim, code_dim)),
+            full_block((code_dim, 1)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded, token_dim), jnp.float32),
+            jax.ShapeDtypeStruct((padded, path_dim), jnp.float32),
+            jax.ShapeDtypeStruct((padded, token_dim), jnp.float32),
+            jax.ShapeDtypeStruct((token_dim, code_dim), jnp.float32),
+            jax.ShapeDtypeStruct((path_dim, code_dim), jnp.float32),
+            jax.ShapeDtypeStruct((token_dim, code_dim), jnp.float32),
+            jax.ShapeDtypeStruct((code_dim, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(src_e, pth_e, tgt_e, seg2, valid2, w_src, w_path, w_tgt, attn_row,
+      m.reshape(1, n_seg).astype(jnp.float32),
+      z.reshape(1, n_seg).astype(jnp.float32),
+      gc.reshape(1, n_seg).astype(jnp.float32),
+      g.astype(jnp.float32))
+    de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt, d_attn = outs
+    return (de_src[:cap], de_pth[:cap], de_tgt[:cap],
+            dw_src, dw_pth, dw_tgt, d_attn)
+
+
+def _grads_kernel_path(src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                       w_tgt, attn_vec, m, z, gc, g2, per_shard: int, mesh,
+                       interpret: bool, precision):
+    """Kernel backward over the shard-structured stream — the
+    _stats_kernel_path discipline: shard_mapped over the data axis on
+    multi-device meshes (pallas_call is opaque to GSPMD), one flat
+    stream with offset segment ids on a single device. Returns
+    (de_src/de_pth/de_tgt (D, cap, d) f32, dw parts (d, Dc) f32,
+    d_attn (Dc, 1) f32), the dense parts summed over shards."""
+    shards, cap = seg.shape
+
+    def one_shard(src_l, pth_l, tgt_l, seg_l, valid_l, m_l, z_l, gc_l,
+                  g_l, ws, wp, wt, av):
+        outs = _grads_pallas(src_l[0], pth_l[0], tgt_l[0], seg_l[0],
+                             valid_l[0], ws, wp, wt, av, m_l[0], z_l[0],
+                             gc_l[0], g_l[0], per_shard, interpret,
+                             precision)
+        return tuple(o[None] for o in outs)
+
+    if mesh is not None and mesh.size > 1:
+        # check_vma=False: same reasoning as the forward kernel route
+        outs = shard_map(
+            one_shard, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                      P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(DATA_AXIS, None),
+                      P(DATA_AXIS, None), P(DATA_AXIS, None),
+                      P(DATA_AXIS, None, None), P(None, None),
+                      P(None, None), P(None, None), P(None, None)),
+            out_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                       P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                       P(DATA_AXIS, None, None), P(DATA_AXIS, None, None),
+                       P(DATA_AXIS, None, None)),
+            check_vma=False)(src_e, pth_e, tgt_e, seg, slot_valid,
+                             m, z, gc, g2, w_src, w_path, w_tgt, attn_vec)
+        de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt, d_attn = outs
+        return (de_src, de_pth, de_tgt, dw_src.sum(axis=0),
+                dw_pth.sum(axis=0), dw_tgt.sum(axis=0),
+                d_attn.sum(axis=0))
+    flat = shards * cap
+    n_seg = shards * per_shard
+    offsets = (jnp.arange(shards, dtype=jnp.int32) * per_shard)[:, None]
+    seg_flat = (seg + offsets).reshape(flat)
+    outs = _grads_pallas(
+        src_e.reshape(flat, -1), pth_e.reshape(flat, -1),
+        tgt_e.reshape(flat, -1), seg_flat, slot_valid.reshape(flat),
+        w_src, w_path, w_tgt, attn_vec, m.reshape(n_seg),
+        z.reshape(n_seg), gc.reshape(n_seg), g2.reshape(n_seg, -1),
+        n_seg, interpret, precision)
+    de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt, d_attn = outs
+    return (de_src.reshape(shards, cap, -1),
+            de_pth.reshape(shards, cap, -1),
+            de_tgt.reshape(shards, cap, -1),
+            dw_src, dw_pth, dw_tgt, d_attn)
+
+
+def _grads_jnp(src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path, w_tgt,
+               attn_vec, m, z, gc, g2, precision):
+    """jnp twin of the backward kernel — the CPU/fallback recompute
+    backward (the residual win applies there too: under the custom VJP
+    these per-slot tensors are transients of THIS function, not saved
+    forward state). ``g2`` (D, Bs, Dc) f32 per-example cotangents,
+    ``gc`` (D, Bs) f32 = sum(g2 * code2). Returns the same tuple as
+    _grads_kernel_path."""
+    x = jnp.tanh(jnp.matmul(src_e, w_src, precision=precision)
+                 + jnp.matmul(pth_e, w_path, precision=precision)
+                 + jnp.matmul(tgt_e, w_tgt, precision=precision))
+    scores = jnp.matmul(x, attn_vec,
+                        precision=precision)[..., 0].astype(jnp.float32)
+    m_slot = jnp.take_along_axis(m, seg, axis=1)
+    z_slot = jnp.take_along_axis(z, seg, axis=1)
+    p = jnp.where(slot_valid, jnp.exp(scores - m_slot), 0.0)
+    w = p / jnp.where(z_slot > 0.0, z_slot, 1.0)             # (D, cap)
+    g_slot = jnp.take_along_axis(g2, seg[..., None], axis=1)  # (D,cap,Dc)
+    gc_slot = jnp.take_along_axis(gc, seg, axis=1)            # (D, cap)
+    xf = x.astype(jnp.float32)
+    gdot = jnp.sum(xf * g_slot, axis=-1)                      # (D, cap)
+    ds = w * (gdot - gc_slot)                                 # (D, cap)
+    dx = (w[..., None] * g_slot
+          + ds[..., None] * attn_vec[:, 0].astype(jnp.float32))
+    du = (1.0 - xf * xf) * dx                                 # (D,cap,Dc)
+    d_attn = jnp.einsum('sc,scd->d', ds, xf,
+                        precision=precision)[:, None]         # (Dc, 1)
+    f32 = jnp.float32
+    dw_src = jnp.einsum('sci,scj->ij', src_e.astype(f32), du,
+                        precision=precision)
+    dw_pth = jnp.einsum('sci,scj->ij', pth_e.astype(f32), du,
+                        precision=precision)
+    dw_tgt = jnp.einsum('sci,scj->ij', tgt_e.astype(f32), du,
+                        precision=precision)
+    de_src = jnp.matmul(du, w_src.astype(f32).T, precision=precision)
+    de_pth = jnp.matmul(du, w_path.astype(f32).T, precision=precision)
+    de_tgt = jnp.matmul(du, w_tgt.astype(f32).T, precision=precision)
+    return de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt, d_attn
+
+
+# ------------------------------------------------- custom-VJP train path
+def ragged_encode_code(token_embedding: jax.Array,
+                       path_embedding: jax.Array, transform: jax.Array,
+                       attention: jax.Array, ctx: jax.Array,
+                       count: jax.Array, *, token_pad: int, path_pad: int,
+                       dtype: jnp.dtype = jnp.float32,
+                       dropout_rng: Optional[jax.Array] = None,
+                       dropout_keep_rate: float = 1.0,
+                       dropout_prng_impl: str = 'threefry2x32',
+                       embed_grad_impl: str = 'dense',
+                       use_kernel: Optional[bool] = None,
+                       interpret: Optional[bool] = None,
+                       mesh=None, custom_vjp: bool = True) -> jax.Array:
+    """The TRAIN-path encode: packed wire arrays -> code vectors
+    ``(B, D) fp32`` under a ``jax.custom_vjp`` whose backward RECOMPUTES
+    the per-slot state instead of storing it (module docstring). Only
+    the four encoder params are differentiable; ``ctx``/``count``/the
+    PRNG key get ``None`` cotangents (the embed_grad.take_rows
+    precedent).
+
+    ``use_kernel`` routes BOTH passes: None engages the Pallas pair iff
+    a real TPU backend is active (callers gate train-side engagement via
+    ``Config.RAGGED_TRAIN_KERNEL`` pending the >=2% flip verdict), False
+    pins the jnp twin pair, True forces the kernels (tests:
+    ``interpret=True``). ``custom_vjp=False`` is the autodiff reference
+    — the twin differentiated by jax, storing its residuals — kept for
+    the parity/residual tests."""
+    apply_dropout = dropout_rng is not None and dropout_keep_rate < 1.0
+    if use_kernel is None:
+        use_kernel = PALLAS_AVAILABLE and tpu_backend_active()
+    if interpret is None:
+        interpret = not tpu_backend_active()
+    if not custom_vjp:
+        if use_kernel:
+            raise ValueError(
+                'custom_vjp=False differentiates the jnp twin via '
+                'autodiff; the Pallas kernels have no autodiff rule '
+                '(pass use_kernel=False)')
+        # max_contexts only shapes the attention output, discarded here
+        return ragged_encode(
+            token_embedding, path_embedding, transform, attention, ctx,
+            count, max_contexts=1, token_pad=token_pad, path_pad=path_pad,
+            dtype=dtype, dropout_rng=dropout_rng,
+            dropout_keep_rate=dropout_keep_rate,
+            dropout_prng_impl=dropout_prng_impl,
+            embed_grad_impl=embed_grad_impl, use_kernel=False,
+            interpret=interpret, mesh=mesh)[0]
+
+    precision = _precision(dtype)
+
+    def _fwd_compute(tok_t, path_t, trans, attn, ctx_, count_, rng_):
+        count2, seg, _pos, slot_valid, src, pth, tgt = _segment_inputs(
+            ctx_, count_, token_pad, path_pad)
+        shards, cap = seg.shape
+        per_shard = count2.shape[1]
+        token_dim = tok_t.shape[1]
+        path_dim = path_t.shape[1]
+        # plain takes: the custom VJP below owns the whole backward, so
+        # take_rows' selectable-gradient wrapper would be dead weight
+        src_e = jnp.take(tok_t, src, axis=0).astype(dtype)
+        pth_e = jnp.take(path_t, pth, axis=0).astype(dtype)
+        tgt_e = jnp.take(tok_t, tgt, axis=0).astype(dtype)
+        if apply_dropout:
+            keep_src, keep_pth, keep_tgt = _dropout_parts(
+                rng_, dropout_keep_rate, dropout_prng_impl, shards, cap,
+                token_dim, path_dim)
+            src_e = _apply_keep(src_e, keep_src, dropout_keep_rate)
+            pth_e = _apply_keep(pth_e, keep_pth, dropout_keep_rate)
+            tgt_e = _apply_keep(tgt_e, keep_tgt, dropout_keep_rate)
+        w_src, w_path, w_tgt, attn_vec = _split_weights(
+            trans, attn, token_dim, path_dim, dtype)
+        _pad_ctx, x_pad = _pad_forward(tok_t, path_t, trans, token_pad,
+                                       path_pad, dtype, precision)
+        if use_kernel:
+            _scores, m, z, acc = _stats_kernel_path(
+                src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                w_tgt, attn_vec, per_shard, mesh, interpret, precision)
+        else:
+            _scores, m, z, acc = _stats_jnp(
+                src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                w_tgt, attn_vec, per_shard, precision)
+        code = _code_from_stats(z, acc, count2, x_pad)
+        return code.reshape(count_.shape[0], -1), m, z
+
+    def _bwd_compute(tok_t, path_t, trans, attn, ctx_, count_, rng_,
+                     m, z, code, g):
+        count2, seg, _pos, slot_valid, src, pth, tgt = _segment_inputs(
+            ctx_, count_, token_pad, path_pad)
+        shards, cap = seg.shape
+        per_shard = count2.shape[1]
+        token_dim = tok_t.shape[1]
+        path_dim = path_t.shape[1]
+        # recompute: re-gather the embeddings and re-draw the SAME keep
+        # mask from the threaded key — nothing per-slot was saved
+        src_e = jnp.take(tok_t, src, axis=0).astype(dtype)
+        pth_e = jnp.take(path_t, pth, axis=0).astype(dtype)
+        tgt_e = jnp.take(tok_t, tgt, axis=0).astype(dtype)
+        keep_parts = None
+        if apply_dropout:
+            keep_parts = _dropout_parts(
+                rng_, dropout_keep_rate, dropout_prng_impl, shards, cap,
+                token_dim, path_dim)
+            src_e = _apply_keep(src_e, keep_parts[0], dropout_keep_rate)
+            pth_e = _apply_keep(pth_e, keep_parts[1], dropout_keep_rate)
+            tgt_e = _apply_keep(tgt_e, keep_parts[2], dropout_keep_rate)
+        w_src, w_path, w_tgt, attn_vec = _split_weights(
+            trans, attn, token_dim, path_dim, dtype)
+        g32 = g.astype(jnp.float32)
+        g2 = g32.reshape(shards, per_shard, -1)
+        code2 = code.reshape(shards, per_shard, -1)
+        gc = jnp.sum(g2 * code2, axis=-1)                    # (D, Bs)
+        if use_kernel:
+            (de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt,
+             d_attn) = _grads_kernel_path(
+                src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                w_tgt, attn_vec, m, z, gc, g2, per_shard, mesh,
+                interpret, precision)
+        else:
+            (de_src, de_pth, de_tgt, dw_src, dw_pth, dw_tgt,
+             d_attn) = _grads_jnp(
+                src_e, pth_e, tgt_e, seg, slot_valid, w_src, w_path,
+                w_tgt, attn_vec, m, z, gc, g2, precision)
+        if apply_dropout:
+            # inverted-dropout backward: same mask, same 1/keep scale
+            de_src = _apply_keep(de_src, keep_parts[0], dropout_keep_rate)
+            de_pth = _apply_keep(de_pth, keep_parts[1], dropout_keep_rate)
+            de_tgt = _apply_keep(de_tgt, keep_parts[2], dropout_keep_rate)
+        # count == 0 rows took code = x_pad = tanh(pad_ctx @ W): route
+        # their cotangent through that expression. Zero in training
+        # (weight-0 rows get zero loss cotangent) but exact for any
+        # caller, matching the autodiff twin.
+        nonempty = count2 > 0
+        g_empty = jnp.where(nonempty[..., None], 0.0,
+                            g2).sum(axis=(0, 1))             # (Dc,)
+        pad_ctx, x_pad = _pad_forward(tok_t, path_t, trans, token_pad,
+                                      path_pad, dtype, precision)
+        x_pad32 = x_pad.astype(jnp.float32)
+        du_pad = (1.0 - x_pad32 * x_pad32) * g_empty         # (Dc,)
+        dw_pad = (pad_ctx.astype(jnp.float32)[:, None]
+                  * du_pad[None, :])                         # (3d, Dc)
+        de_pad = jnp.matmul(trans.astype(jnp.float32), du_pad,
+                            precision=precision)             # (3d,)
+        d_trans = (jnp.concatenate([dw_src, dw_pth, dw_tgt], axis=0)
+                   + dw_pad).astype(trans.dtype)
+        # table grads as segment scatter-adds over the packed index
+        # stream — THE reshaped-scatter substrate (ops/embed_grad.py),
+        # so EMBED_GRAD_IMPL composes exactly as on the dense path
+        from code2vec_tpu.ops.embed_grad import table_grad
+        tok_idx = jnp.concatenate([src.reshape(-1), tgt.reshape(-1)])
+        tok_cot = jnp.concatenate([de_src.reshape(-1, token_dim),
+                                   de_tgt.reshape(-1, token_dim)])
+        d_tok = table_grad(tok_cot, tok_idx, tok_t.shape[0], tok_t.dtype,
+                           embed_grad_impl)
+        d_tok = d_tok.at[token_pad].add(
+            (de_pad[:token_dim]
+             + de_pad[token_dim + path_dim:]).astype(tok_t.dtype))
+        d_path = table_grad(de_pth.reshape(-1, path_dim), pth.reshape(-1),
+                            path_t.shape[0], path_t.dtype,
+                            embed_grad_impl)
+        d_path = d_path.at[path_pad].add(
+            de_pad[token_dim:token_dim + path_dim].astype(path_t.dtype))
+        return d_tok, d_path, d_trans, d_attn.astype(attn.dtype)
+
+    @jax.custom_vjp
+    def encode_code(tok_t, path_t, trans, attn, ctx_, count_, rng_):
+        return _fwd_compute(tok_t, path_t, trans, attn, ctx_, count_,
+                            rng_)[0]
+
+    def fwd(tok_t, path_t, trans, attn, ctx_, count_, rng_):
+        code, m, z = _fwd_compute(tok_t, path_t, trans, attn, ctx_,
+                                  count_, rng_)
+        # residuals: the inputs (live anyway) + per-example (m, z) +
+        # the (B, D) code — NO per-slot tensor
+        return code, (tok_t, path_t, trans, attn, ctx_, count_, rng_,
+                      m, z, code)
+
+    def bwd(res, g):
+        tok_t, path_t, trans, attn, ctx_, count_, rng_, m, z, code = res
+        grads = _bwd_compute(tok_t, path_t, trans, attn, ctx_, count_,
+                             rng_, m, z, code, g)
+        return grads + (None, None, None)
+
+    encode_code.defvjp(fwd, bwd)
+    rng_arg = (dropout_rng if apply_dropout
+               else jnp.zeros((0,), jnp.uint32))
+    return encode_code(token_embedding, path_embedding, transform,
+                       attention, ctx, count, rng_arg)

@@ -103,7 +103,10 @@ class ConsoleExporter:
     def __init__(self, log, min_interval_s: float = 30.0):
         self.log = log
         self.min_interval_s = min_interval_s
-        self._last_emit = 0.0
+        # None, not 0.0: time.monotonic() starts near zero on a fresh
+        # boot, so a 0.0 sentinel would suppress the FIRST emit for the
+        # whole first min_interval_s of machine uptime
+        self._last_emit = None
 
     @staticmethod
     def _ms(registry: Registry, name: str) -> float:
@@ -112,7 +115,8 @@ class ConsoleExporter:
 
     def flush(self, registry: Registry, step: int) -> None:
         now = time.monotonic()
-        if now - self._last_emit < self.min_interval_s:
+        if self._last_emit is not None \
+                and now - self._last_emit < self.min_interval_s:
             return
         self._last_emit = now
 

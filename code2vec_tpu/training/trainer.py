@@ -199,19 +199,21 @@ class Trainer:
         # Ragged fusion (USE_PALLAS_RAGGED_FUSION, ops/pallas_ragged.py):
         # the packed twins below consume the (D, cap, 3) wire directly —
         # fused gather + encode + single-pass attention softmax, no
-        # device-side unpack, no (B, C, .) planes. Lazy Adam keeps the
-        # unpack path for TRAINING only: its sparse-row update consumes
-        # the unpacked plane indices.
+        # device-side unpack, no (B, C, .) planes — and the TRAIN step's
+        # custom-VJP backward recomputes off the same segments instead
+        # of storing per-slot residuals. Lazy Adam now runs fused too:
+        # its sparse-row update reads the touched rows straight off the
+        # packed index stream (rows_of below), which covers exactly the
+        # rows the plane wire would touch — every slot up to each
+        # example's effective length plus the PAD row.
         ragged = (self.config.USE_PALLAS_RAGGED_FUSION
                   and hasattr(backend, 'forward_packed'))
-        ragged_train = ragged and not lazy
-        if ragged and lazy:
-            logger.warning(
-                'USE_PALLAS_RAGGED_FUSION: the packed TRAIN step keeps '
-                'the unpack path under LAZY_EMBEDDING_ADAM (the sparse '
-                'update needs plane indices); eval/predict stay fused.')
+        ragged_train = ragged
 
-        def make_train_step(loss_call):
+        def plane_rows(arrays):
+            return arrays[0], arrays[1], arrays[2]
+
+        def make_train_step(loss_call, rows_of=plane_rows):
             def train_step(state: TrainerState, arrays
                            ) -> Tuple[TrainerState, jax.Array]:
                 dropout_rng = jax.random.fold_in(state.rng, state.step)
@@ -224,9 +226,7 @@ class Trainer:
                                else state.params)
                 loss, grads = jax.value_and_grad(loss_fn)(diff_params)
                 if lazy:
-                    # plane arrays only: the ragged-train route is
-                    # disabled under lazy Adam above
-                    source, path, target = arrays[0], arrays[1], arrays[2]
+                    source, path, target = rows_of(arrays)
                     new_params, new_opt_state = optimizer.update_sparse(
                         state.params, grads, state.opt_state, state.step,
                         source, path, target)
@@ -353,11 +353,29 @@ class Trainer:
                 ctx, count, max_contexts, token_pad, path_pad)
             return (source, path, target, mask, label, weight)
 
+        def packed_rows(arrays):
+            # lazy Adam's touched-row sets off the packed wire: the ctx
+            # stream holds every slot up to each example's effective
+            # length (capacity padding carries the PAD triple). The PAD
+            # rows are appended explicitly so the x_pad-path gradient of
+            # count==0 rows is covered even when a batch packs with zero
+            # capacity padding — O(1), and duplicates are idempotent
+            # (ops/lazy_adam.py module doc).
+            ctx = arrays[0]
+            source = jnp.concatenate([
+                ctx[..., 0].reshape(-1),
+                jnp.full((1,), token_pad, jnp.int32)])
+            path = jnp.concatenate([
+                ctx[..., 1].reshape(-1),
+                jnp.full((1,), path_pad, jnp.int32)])
+            return source, path, ctx[..., 2].reshape(-1)
+
         if ragged_train:
             train_step_packed = make_train_step(
                 lambda params, arrays, rng:
                 backend.loss_fn_packed(params, arrays, rng,
-                                       mesh=loss_mesh))
+                                       mesh=loss_mesh),
+                rows_of=packed_rows)
         else:
             def train_step_packed(state, packed_arrays):
                 return train_step(state, unpack(packed_arrays))
@@ -622,9 +640,16 @@ class Trainer:
         warmup with telemetry enabled; returns None where the backend
         has no memory analysis."""
         wire = 'packed' if len(arrays) == 4 else 'planes'
-        fn = self._predict_steps[(tier, wire)]
+        return self._program_memory(self._predict_steps[(tier, wire)],
+                                    params, arrays)
+
+    @staticmethod
+    def _program_memory(fn, *args) -> Optional[dict]:
+        """One jitted program's AOT memory record — the single
+        definition of the record shape shared by the serving ledger
+        (predict) and the bench A/B (train)."""
         try:
-            analysis = fn.lower(params, arrays).compile().memory_analysis()
+            analysis = fn.lower(*args).compile().memory_analysis()
             return {
                 'generated_code_bytes':
                     int(analysis.generated_code_size_in_bytes),
@@ -634,6 +659,21 @@ class Trainer:
             }
         except Exception:
             return None
+
+    def train_program_memory(self, state: TrainerState, arrays
+                             ) -> Optional[dict]:
+        """AOT memory analysis of the train-step program for the shapes
+        of ``arrays`` (either wire) — same record shape as
+        ``predict_program_memory``. ``temp_bytes`` is the axis the
+        ragged custom-VJP backward moves: the recompute schedule holds
+        no (D, cap, .) residuals across the loss tail, so the fused
+        train executable's temporary allocation drops against the
+        autodiff twin's (benchmarks/bench_pallas_ragged.py records the
+        per-arm value). Costs one extra XLA compile — bench/offline use
+        only, never the hot path."""
+        fn = (self._train_step_packed if len(arrays) == 4
+              else self._train_step)
+        return self._program_memory(fn, state, arrays)
 
     def predict_step(self, params, batch: Batch, tier: str = 'full'
                      ) -> dict:

@@ -91,28 +91,39 @@ def test_bench_fused_ce_smoke_runs_all_arms():
             'step_ms_ce_fused_rbg_bf16mu_SMOKE_ONLY'} <= measures
 
 
-def test_bench_pallas_ragged_smoke_runs_both_arms():
-    """ISSUE 10: the ragged-fusion A/B harness must survive import/
-    config rot, run BOTH arms, carry the peak-HBM fields on every arm
-    record (None on the stats-less CPU backend — an explicit gap), and
-    emit the fused-vs-unfused speedup records summarize_captures
-    surfaces."""
+def test_bench_pallas_ragged_smoke_runs_all_arms():
+    """ISSUEs 10 + 12: the ragged-fusion A/B harness must survive
+    import/config rot, run all THREE arms (unfused / fused-twin /
+    fused_kernel), carry the peak-HBM fields on every arm record (None
+    on the stats-less CPU backend — an explicit gap), measure the
+    train-BACKWARD arm (value_and_grad step time + the grad program's
+    AOT temp bytes, the residual-footprint axis), and emit both verdict
+    families: fusion-vs-unpack speedups AND the kernel-vs-shipped-twin
+    records that actually gate RAGGED_TRAIN_KERNEL."""
     env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
                PYTHONPATH=REPO)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, 'benchmarks',
                                       'bench_pallas_ragged.py')],
-        capture_output=True, text=True, timeout=600, env=env)
+        capture_output=True, text=True, timeout=900, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     records = [json.loads(line)
                for line in proc.stdout.splitlines() if line.strip()]
     measures = {r['measure']: r for r in records if 'measure' in r}
     assert {'step_ms_ragged_train_unfused_SMOKE_ONLY',
             'step_ms_ragged_train_fused_SMOKE_ONLY',
+            'step_ms_ragged_train_fused_kernel_SMOKE_ONLY',
+            'step_ms_ragged_train_bwd_unfused_SMOKE_ONLY',
+            'step_ms_ragged_train_bwd_fused_SMOKE_ONLY',
+            'step_ms_ragged_train_bwd_fused_kernel_SMOKE_ONLY',
             'step_ms_ragged_predict_unfused_SMOKE_ONLY',
             'step_ms_ragged_predict_fused_SMOKE_ONLY',
             'ragged_fusion_train_speedup_SMOKE_ONLY',
-            'ragged_fusion_predict_speedup_SMOKE_ONLY'} <= set(measures)
+            'ragged_fusion_train_bwd_speedup_SMOKE_ONLY',
+            'ragged_fusion_predict_speedup_SMOKE_ONLY',
+            'ragged_train_kernel_speedup_SMOKE_ONLY',
+            'ragged_train_kernel_bwd_speedup_SMOKE_ONLY'} <= \
+        set(measures)
     for name, rec in measures.items():
         if name.startswith('step_ms_'):
             assert rec['value'] > 0
@@ -121,9 +132,17 @@ def test_bench_pallas_ragged_smoke_runs_both_arms():
             assert 'peak_hbm_bytes' in rec and \
                 rec['peak_hbm_bytes'] is None
             assert rec['fill'] == 0.25
+        if '_train_bwd_' in name and name.startswith('step_ms_'):
+            # XLA:CPU supports memory_analysis, so the smoke asserts a
+            # REAL temp-bytes number (on-chip it feeds the temp ratio)
+            assert rec['kind'] == 'train_bwd'
+            assert isinstance(rec['temp_bytes'], int)
+    # the temp-bytes ratio record (the residual win axis) must ride
+    assert 'ragged_fusion_train_bwd_temp_ratio_SMOKE_ONLY' in measures
     verdicts = [r for r in records if 'verdict' in r]
-    assert verdicts and verdicts[-1]['verdict'] in ('keep-fused',
-                                                    'keep-unfused')
+    assert len(verdicts) == 2
+    assert verdicts[0]['verdict'] in ('keep-fused', 'keep-unfused')
+    assert verdicts[1]['verdict'] in ('kernel-on', 'kernel-off')
 
 
 def test_bench_index_smoke_meets_acceptance():

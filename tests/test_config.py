@@ -73,12 +73,23 @@ def test_cli_parsing(tmp_path):
     assert config.USE_PALLAS_RAGGED_FUSION is True
     config.verify()
 
-    # the perf knobs must default OFF (reference-parity behavior until
-    # their on-chip A/Bs decide otherwise)
+    # undecided perf knobs default OFF (reference-parity behavior until
+    # their on-chip A/Bs decide otherwise); the ragged fusion flipped ON
+    # when its custom-VJP backward landed (structural win on every
+    # backend), with --no-ragged-fusion as the opt-out and the TRAIN
+    # kernel pair still gated behind the >=2% on-chip verdict
     plain = Config().load_from_args(['--data', 'd/prefix'])
     assert plain.USE_PALLAS_FUSED_CE is False
-    assert plain.USE_PALLAS_RAGGED_FUSION is False
+    assert plain.USE_PALLAS_RAGGED_FUSION is True
+    assert plain.RAGGED_TRAIN_KERNEL is False
     assert plain.EMBED_GRAD_IMPL == 'dense'
+
+    unfused = Config().load_from_args(['--data', 'd/prefix',
+                                       '--no-ragged-fusion'])
+    assert unfused.USE_PALLAS_RAGGED_FUSION is False
+    kernel = Config().load_from_args(['--data', 'd/prefix',
+                                      '--ragged-train-kernel'])
+    assert kernel.RAGGED_TRAIN_KERNEL is True
 
 
 def test_iter_yields_fields():

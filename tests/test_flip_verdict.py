@@ -1,0 +1,118 @@
+"""scripts/flip_verdict.py: the >=2% flip decisions settle mechanically
+from capture rounds — pending while every round is wedged, flip/keep the
+moment a healthy on-chip record lands, smoke lines never decide, and the
+--write record is durable JSON with provenance."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, 'scripts', 'flip_verdict.py')
+
+
+def run_cli(results_dir, root, *extra):
+    proc = subprocess.run(
+        [sys.executable, CLI, '--dir', str(results_dir), '--root',
+         str(root), '--json', *extra],
+        capture_output=True, text=True, timeout=60)
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.strip().startswith('{')]
+    return proc, {r['measure']: r for r in rows}
+
+
+def write_jsonl(path, records):
+    with open(path, 'w') as f:
+        for rec in records:
+            f.write(json.dumps(rec) + '\n')
+
+
+def test_all_wedged_rounds_stay_pending(tmp_path):
+    results = tmp_path / 'results'
+    results.mkdir()
+    write_jsonl(results / 'capture_a.jsonl', [
+        {'stage': 'probe',
+         'tpu_unavailable': 'probe failed 3/3 attempts', 'attempts': 3},
+    ])
+    # a smoke line must NOT settle an on-chip verdict
+    write_jsonl(results / 'capture_b.jsonl', [
+        {'measure': 'ragged_train_kernel_speedup_SMOKE_ONLY',
+         'value': 2.1},
+        {'stage': 'probe', 'tpu_unavailable': 'wedged again'},
+    ])
+    proc, rows = run_cli(results, tmp_path)
+    assert proc.returncode == 3  # all pending, scriptable
+    assert rows['ragged_train_kernel_speedup']['verdict'] == 'pending'
+    assert rows['ragged_fusion_train_speedup']['verdict'] == 'pending'
+    assert rows['ragged_fusion_predict_speedup']['verdict'] == 'pending'
+    assert rows['ragged_train_kernel_speedup'][
+        'wedged_capture_rounds'] == 2
+
+
+def test_healthy_round_settles_flip_and_keep(tmp_path):
+    results = tmp_path / 'results'
+    results.mkdir()
+    # an older wedged round, then a healthy one — newest wins
+    write_jsonl(results / 'capture_a.jsonl', [
+        {'stage': 'probe', 'tpu_unavailable': 'wedged'}])
+    write_jsonl(results / 'capture_b.jsonl', [
+        {'stage': 'pallas_ragged', 'rc': 0, 'secs': 100, 'data': {
+            'measure': 'ragged_train_kernel_speedup', 'value': 1.07,
+            'fill': 0.25, 'contexts': 200}},
+        {'stage': 'pallas_ragged_c1024', 'rc': 0, 'secs': 90, 'data': {
+            'measure': 'ragged_train_kernel_speedup_c1024',
+            'value': 1.31, 'fill': 0.1, 'contexts': 1024}},
+        # raw (un-wrapped) measure lines are the other capture shape
+        {'measure': 'ragged_fusion_predict_speedup', 'value': 1.01},
+    ])
+    proc, rows = run_cli(results, tmp_path, '--write')
+    assert proc.returncode == 0
+    kernel = rows['ragged_train_kernel_speedup']
+    assert kernel['verdict'] == 'flip'
+    assert kernel['value'] == 1.07
+    assert kernel['knob'] == 'RAGGED_TRAIN_KERNEL'
+    assert kernel['source'] == 'capture_b.jsonl'
+    # the capacity-suffixed arm corroborates, it does not decide
+    assert kernel['corroborating'] == {
+        'ragged_train_kernel_speedup_c1024': 1.31}
+    assert rows['ragged_fusion_predict_speedup']['verdict'] == 'keep'
+    # no record of the fusion-train confirmation yet: stays pending
+    assert rows['ragged_fusion_train_speedup']['verdict'] == 'pending'
+    # the durable record (rows in TRACKED order)
+    with open(results / 'flip_verdicts.json') as f:
+        history = json.load(f)
+    assert [h['verdict'] for h in history] == ['flip', 'pending', 'keep']
+    assert all('checked_at' in h for h in history)
+    # a second --write APPENDS (history, not overwrite)
+    proc2, _ = run_cli(results, tmp_path, '--write')
+    with open(results / 'flip_verdicts.json') as f:
+        assert len(json.load(f)) == 6
+
+
+def test_driver_snapshots_counted_as_wedged_queue(tmp_path):
+    results = tmp_path / 'results'
+    results.mkdir()
+    (tmp_path / 'BENCH_r09.json').write_text(json.dumps({
+        'n': 9, 'rc': 0, 'parsed': {
+            'metric': 'train_examples_per_sec_per_chip_java14m',
+            'value': 0.0, 'error': 'tpu_unavailable'}}))
+    # a second mode: rc!=0 with only the probe-timeout message in the
+    # raw tail (BENCH_r03-style) must count as wedged too
+    (tmp_path / 'BENCH_r03.json').write_text(json.dumps({
+        'n': 3, 'rc': 124, 'parsed': None,
+        'tail': 'probe child timed out after 90s (wedged backend?)'}))
+    proc, rows = run_cli(results, tmp_path)
+    assert proc.returncode == 3
+    assert rows['ragged_train_kernel_speedup'][
+        'wedged_driver_snapshots'] == '2/2'
+
+
+def test_unknown_measure_rejected(tmp_path):
+    results = tmp_path / 'results'
+    results.mkdir()
+    proc = subprocess.run(
+        [sys.executable, CLI, '--dir', str(results), '--root',
+         str(tmp_path), '--measure', 'not_a_tracked_measure'],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert 'unknown measure' in proc.stderr

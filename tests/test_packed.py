@@ -164,7 +164,13 @@ def test_wire_bytes_shrink_at_realistic_fill():
 class TestTrainerIntegration:
     """Packed and plane wires must be indistinguishable past the device
     unpack: identical losses, updated params, and eval/predict outputs,
-    on the full 8-virtual-device data-parallel mesh."""
+    on the full 8-virtual-device data-parallel mesh.
+
+    These tests pin USE_PALLAS_RAGGED_FUSION=False: they assert the
+    UNPACK path's defining property — bit-exactness against the plane
+    wire — which the (now default-ON) ragged fused encoder trades for
+    fp32-rounding parity (tests/test_pallas_ragged.py owns that
+    regime)."""
 
     def _batches_and_packed(self, trainer, n=3):
         rng = np.random.default_rng(5)
@@ -184,7 +190,7 @@ class TestTrainerIntegration:
     def test_train_steps_bit_equal(self):
         import jax
 
-        trainer = make_trainer()
+        trainer = make_trainer(USE_PALLAS_RAGGED_FUSION=False)
         batches, packed = self._batches_and_packed(trainer)
         state_a = trainer.init_state(seed=0)
         state_b = trainer.init_state(seed=0)
@@ -199,7 +205,7 @@ class TestTrainerIntegration:
                                           np.asarray(leaf_b))
 
     def test_eval_and_predict_outputs_equal(self):
-        trainer = make_trainer()
+        trainer = make_trainer(USE_PALLAS_RAGGED_FUSION=False)
         batches, packed = self._batches_and_packed(trainer, n=1)
         params = trainer.init_state(seed=1).params
         out_planes = trainer.eval_step(params, batches[0])
@@ -224,7 +230,8 @@ class TestTrainerIntegration:
     def test_staged_fit_loop_runs_on_packed(self):
         """stage_batches -> train_step_placed end to end over packed
         batches (the fit() hot path), donation enabled (the default)."""
-        trainer = make_trainer(DEVICE_PREFETCH_BATCHES=2)
+        trainer = make_trainer(DEVICE_PREFETCH_BATCHES=2,
+                               USE_PALLAS_RAGGED_FUSION=False)
         _batches, packed = self._batches_and_packed(trainer, n=4)
         state = trainer.init_state(seed=0)
         steps = 0

@@ -2,11 +2,16 @@
 unpack-then-dense path, under the tests/test_packed.py property regime:
 interior holes, pad rows, capacity < batch, fill rates from empty to
 full, nonzero PAD indices, per-shard packing. The jnp twin is exercised
-everywhere (it is the train path and the non-TPU fallback); the Pallas
-kernel runs in interpreter mode on CPU, single-shard, flat multi-shard,
-and shard_mapped over the 8-virtual-device mesh. Trainer integration
-covers packed train/eval and all four predict tiers, plus the
-zero-post-warmup-compiles guard on the fused programs."""
+everywhere (it is the non-TPU fallback); both Pallas kernels — the
+forward and the custom-VJP recompute backward — run in interpreter mode
+on CPU, single-shard, flat multi-shard, multi-tile, and shard_mapped
+over the 8-virtual-device mesh. TestFusedBackward owns the train-path
+acceptance: five-param gradient parity across the regime, dropout-mask
+bit-match between the fused pair and the twin, bf16 smoke, and the
+no-per-slot-residuals contract (vjp-closure assertion). Trainer
+integration covers packed train/eval and all four predict tiers, the
+zero-post-warmup-compiles guards on predict AND the fused train step,
+and lazy Adam training fused off the packed-stream rows."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -186,15 +191,24 @@ class TestTwinVsDense:
         assert all(np.isfinite(np.asarray(g)).all()
                    for g in jax.tree_util.tree_leaves(grads))
 
-    def test_kernel_refuses_dropout(self):
+    def test_kernel_dropout_bit_matches_twin_draw(self):
+        """Dropout moved INTO the fused pair: the packed-layout keep
+        mask is drawn outside the kernel and applied to its embedding
+        inputs, so with the same threaded key the kernel forward and
+        the jnp twin consume bit-identical inputs — outputs agree to
+        fp32 rounding, across prng impls."""
         params = small_params()
         packed = packed_lib.pack_batch(
             random_plane_batch(np.random.default_rng(0), 8, 4), 0, 0,
             capacity_minimum=4)
-        with pytest.raises(ValueError, match='deterministic forward'):
-            ragged(params, packed, 4, 0, 0, use_kernel=True,
-                   interpret=True, dropout_rng=jax.random.PRNGKey(0),
-                   dropout_keep_rate=0.5)
+        for impl in ('threefry2x32', 'rbg'):
+            kw = dict(dropout_rng=jax.random.PRNGKey(3),
+                      dropout_keep_rate=0.5, dropout_prng_impl=impl)
+            twin = ragged(params, packed, 4, 0, 0, use_kernel=False,
+                          **kw)
+            kern = ragged(params, packed, 4, 0, 0, use_kernel=True,
+                          interpret=True, **kw)
+            assert_encode_close(kern, twin)
 
 
 class TestKernelInterpret:
@@ -262,15 +276,258 @@ class TestKernelInterpret:
             assert_encode_close(got, want, rtol=0.03, atol=0.02)
 
 
+def _packed_losses(params, packed, contexts, token_pad=0, path_pad=0,
+                   **kw):
+    """value_and_grad-ready packed loss closure (custom VJP by
+    default; kw overrides select the kernel pair / autodiff twin)."""
+    def loss(p):
+        return functional.loss_and_aux_packed(
+            p, jnp.asarray(packed.ctx), jnp.asarray(packed.count),
+            jnp.asarray(np.clip(packed.label, 0, 15)),
+            jnp.asarray(packed.weight), max_contexts=contexts,
+            token_pad=token_pad, path_pad=path_pad,
+            num_valid_targets=16, **kw)[0]
+    return loss
+
+
+def _dense_loss(params, batch):
+    def loss(p):
+        return functional.loss_and_aux(
+            p, batch.source, batch.path, batch.target, batch.mask,
+            np.clip(batch.label, 0, 15).astype(np.int32), batch.weight,
+            num_valid_targets=16)[0]
+    return loss
+
+
+def assert_grads_close(got, want, fields, rtol=2e-4, atol=1e-6):
+    for name, a, b in zip(fields, jax.tree_util.tree_leaves(got),
+                          jax.tree_util.tree_leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol, err_msg=name)
+
+
+class TestFusedBackward:
+    """The custom-VJP recompute backward (ragged_encode_code): gradient
+    parity for all five params against the unpack-then-dense loss across
+    the packed property regime, the Pallas backward kernel in
+    interpreter mode (single-shard, flat multi-shard, multi-tile,
+    shard_mapped), dropout-mask bit-match between the fused pair and the
+    twin, bf16 smoke, and the no-per-slot-residuals contract."""
+
+    def test_grad_parity_property_regime(self):
+        """Holes, pad rows (count == 0), fill rates, shard counts: the
+        custom-VJP gradients must match the dense path's for all five
+        params (the fp32-rounding regime)."""
+        rng = np.random.default_rng(23)
+        params = small_params()
+        for shards in (1, 2, 4):
+            contexts = int(rng.choice([3, 5, 8, 13]))
+            batch = random_plane_batch(rng, 8, contexts, hole_rate=0.4,
+                                       pad_row_rate=0.3)
+            packed = packed_lib.pack_batch(batch, 0, 0,
+                                           data_shards=shards,
+                                           capacity_minimum=4)
+            loss_d, grads_d = jax.value_and_grad(
+                _dense_loss(params, batch))(params)
+            loss_r, grads_r = jax.value_and_grad(
+                _packed_losses(params, packed, contexts))(params)
+            np.testing.assert_allclose(float(loss_r), float(loss_d),
+                                       rtol=1e-5)
+            assert_grads_close(grads_r, grads_d, params._fields)
+
+    def test_grad_parity_capacity_rungs(self):
+        """The same batch packed at every serving-ladder rung must
+        produce identical gradients — backward capacity padding is as
+        inert as forward's."""
+        rng = np.random.default_rng(29)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 6)
+        _, grads_d = jax.value_and_grad(_dense_loss(params,
+                                                    batch))(params)
+        for rung in (4, 16, 64, 256):
+            packed = packed_lib.pack_batch(batch, 0, 0,
+                                           capacity_minimum=rung)
+            _, grads_r = jax.value_and_grad(
+                _packed_losses(params, packed, 6))(params)
+            assert_grads_close(grads_r, grads_d, params._fields)
+
+    def test_kernel_backward_matches_dense(self):
+        """The Pallas backward kernel (interpreter mode), single-shard
+        and flat multi-shard, against the dense gradients."""
+        rng = np.random.default_rng(31)
+        params = small_params()
+        for shards in (1, 2):
+            batch = random_plane_batch(rng, 8, 7, 1, 2)
+            packed = packed_lib.pack_batch(batch, 1, 2,
+                                           data_shards=shards,
+                                           capacity_minimum=4)
+            _, grads_d = jax.value_and_grad(_dense_loss(params,
+                                                        batch))(params)
+            _, grads_k = jax.value_and_grad(_packed_losses(
+                params, packed, 7, 1, 2,
+                use_ragged_kernel=True))(params)
+            assert_grads_close(grads_k, grads_d, params._fields)
+
+    def test_kernel_backward_multi_tile(self, monkeypatch):
+        """Segments spanning several grid steps: the backward kernel
+        reads saved (m, z) — no running rescale — but its per-tile
+        accumulation of the dense grads must still sum across tiles."""
+        monkeypatch.setattr(pallas_ragged, 'SLOT_TILE', 8)
+        rng = np.random.default_rng(37)
+        params = small_params()
+        batch = random_plane_batch(rng, 8, 13, hole_rate=0.4)
+        packed = packed_lib.pack_batch(batch, 0, 0, capacity_minimum=4)
+        assert packed.ctx.shape[1] > 8
+        _, grads_t = jax.value_and_grad(
+            _packed_losses(params, packed, 13))(params)
+        _, grads_k = jax.value_and_grad(_packed_losses(
+            params, packed, 13, use_ragged_kernel=True))(params)
+        assert_grads_close(grads_k, grads_t, params._fields)
+
+    def test_kernel_backward_shard_mapped_on_mesh(self):
+        """The multi-device route: forward AND backward kernels
+        shard_mapped over the data axis, gradient parity on the
+        8-virtual-device mesh."""
+        from code2vec_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.create_mesh()
+        shards = mesh.shape['data']
+        rng = np.random.default_rng(41)
+        params = small_params()
+        batch = random_plane_batch(rng, 2 * shards, 5, 1, 2)
+        packed = packed_lib.pack_batch(batch, 1, 2, data_shards=shards,
+                                       capacity_minimum=4)
+        _, grads_d = jax.value_and_grad(_dense_loss(params,
+                                                    batch))(params)
+        _, grads_k = jax.value_and_grad(_packed_losses(
+            params, packed, 5, 1, 2, use_ragged_kernel=True,
+            ragged_mesh=mesh))(params)
+        assert_grads_close(grads_k, grads_d, params._fields)
+
+    def test_dropout_bit_match_fused_vs_twin(self):
+        """One threaded key, three consumers — the autodiff twin, the
+        custom-VJP twin pair, the custom-VJP kernel pair — must all
+        draw the SAME packed-layout mask: identical losses to fp32
+        rounding and matching gradients (the recompute backward
+        re-draws the mask rather than storing it)."""
+        rng = np.random.default_rng(43)
+        params = small_params()
+        packed = packed_lib.pack_batch(
+            random_plane_batch(rng, 8, 6), 0, 0, data_shards=2,
+            capacity_minimum=4)
+        for impl in ('threefry2x32', 'rbg'):
+            kw = dict(dropout_rng=jax.random.PRNGKey(11),
+                      dropout_keep_rate=0.75, dropout_prng_impl=impl)
+            loss_a, grads_a = jax.value_and_grad(_packed_losses(
+                params, packed, 6, ragged_custom_vjp=False,
+                use_ragged_kernel=False, **kw))(params)
+            loss_v, grads_v = jax.value_and_grad(_packed_losses(
+                params, packed, 6, **kw))(params)
+            loss_k, grads_k = jax.value_and_grad(_packed_losses(
+                params, packed, 6, use_ragged_kernel=True,
+                **kw))(params)
+            np.testing.assert_allclose(float(loss_v), float(loss_a),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(float(loss_k), float(loss_a),
+                                       rtol=1e-6)
+            assert_grads_close(grads_v, grads_a, params._fields)
+            assert_grads_close(grads_k, grads_a, params._fields)
+
+    def test_bf16_backward_smoke(self):
+        """bf16 compute: the custom-VJP gradients track the autodiff
+        twin's to bf16 resolution."""
+        rng = np.random.default_rng(47)
+        params = small_params()
+        packed = packed_lib.pack_batch(
+            random_plane_batch(rng, 8, 6), 0, 0, capacity_minimum=4)
+        _, grads_a = jax.value_and_grad(_packed_losses(
+            params, packed, 6, dtype=jnp.bfloat16,
+            ragged_custom_vjp=False))(params)
+        _, grads_v = jax.value_and_grad(_packed_losses(
+            params, packed, 6, dtype=jnp.bfloat16))(params)
+        assert_grads_close(grads_v, grads_a, params._fields,
+                           rtol=0.05, atol=0.02)
+
+    def test_count_zero_rows_route_through_x_pad(self):
+        """count == 0 rows take code = x_pad = tanh(pad_ctx @ W): a
+        NONZERO cotangent on their code vectors (sum-of-code, unlike
+        the weight-masked loss) must flow through that expression
+        exactly as the autodiff twin's does."""
+        contexts = 5
+        from code2vec_tpu.data.reader import Batch
+        zero = Batch(source=np.ones((4, contexts), np.int32),
+                     path=np.ones((4, contexts), np.int32),
+                     target=np.ones((4, contexts), np.int32),
+                     mask=np.zeros((4, contexts), np.float32),
+                     label=np.zeros((4,), np.int32),
+                     weight=np.zeros((4,), np.float32))
+        zero = zero._replace(source=np.zeros_like(zero.source),
+                             path=np.zeros_like(zero.path),
+                             target=np.zeros_like(zero.target))
+        params = small_params()
+        packed = packed_lib.pack_batch(zero, 0, 0, capacity_minimum=4)
+
+        def code_sum(p, custom_vjp):
+            return pallas_ragged.ragged_encode_code(
+                p.token_embedding, p.path_embedding, p.transform,
+                p.attention, jnp.asarray(packed.ctx),
+                jnp.asarray(packed.count), token_pad=0, path_pad=0,
+                use_kernel=False, custom_vjp=custom_vjp).sum()
+
+        grads_a = jax.grad(lambda p: code_sum(p, False))(params)
+        grads_v = jax.grad(lambda p: code_sum(p, True))(params)
+        # encoder params only: target_embedding is out of scope here
+        for name in ('token_embedding', 'path_embedding', 'transform',
+                     'attention'):
+            np.testing.assert_allclose(
+                np.asarray(getattr(grads_v, name)),
+                np.asarray(getattr(grads_a, name)),
+                rtol=2e-4, atol=1e-6, err_msg=name)
+        assert float(jnp.abs(grads_v.transform).sum()) > 0.0
+
+    def test_custom_vjp_saves_no_per_slot_residuals(self):
+        """THE residual contract (acceptance): the vjp closure of the
+        custom-VJP packed loss holds NO floating residual of per-slot
+        rank — the (D, cap, 3d) gathered embeddings, the dropout masks
+        and the (D, cap, D) activations are recomputed, not stored —
+        while the autodiff twin's closure demonstrably stores them
+        (the check would catch a silent regression to storing)."""
+        rng = np.random.default_rng(53)
+        params = small_params()
+        packed = packed_lib.pack_batch(
+            random_plane_batch(rng, 8, 6), 0, 0, data_shards=2,
+            capacity_minimum=4)
+        kw = dict(dropout_rng=jax.random.PRNGKey(5),
+                  dropout_keep_rate=0.75)
+
+        def residual_shapes(ragged_custom_vjp):
+            # floating rank-3+ residuals = the per-slot tensors ((D,
+            # cap, d) embeddings, (D, cap, Dc) activations); the int32
+            # ctx wire and tiny CE-tail leaves are inputs/bookkeeping
+            loss = _packed_losses(params, packed, 6,
+                                  ragged_custom_vjp=ragged_custom_vjp,
+                                  **kw)
+            _, f_vjp = jax.vjp(loss, params)
+            return [tuple(leaf.shape)
+                    for leaf in jax.tree_util.tree_leaves(f_vjp)
+                    if hasattr(leaf, 'ndim') and leaf.ndim >= 3
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)]
+
+        assert residual_shapes(True) == []
+        assert len(residual_shapes(False)) > 0
+
+
 @pytest.fixture(scope='module')
 def trainer_pair():
     """One (plain, fused) trainer pair shared by the integration tests:
     Trainer construction compiles the full step-program family on the
     8-device mesh, so rebuilding per test would dominate the file's
-    tier-1 budget. Dropout off: the two layouts draw different masks."""
-    plain = make_trainer(DROPOUT_KEEP_RATE=1.0)
-    fused = make_trainer(DROPOUT_KEEP_RATE=1.0,
-                         USE_PALLAS_RAGGED_FUSION=True)
+    tier-1 budget. Dropout off: the two layouts draw different masks.
+    The fused trainer deliberately relies on the config DEFAULT (ON
+    since the custom-VJP backward landed); the plain arm pins the
+    unpack path."""
+    plain = make_trainer(DROPOUT_KEEP_RATE=1.0,
+                         USE_PALLAS_RAGGED_FUSION=False)
+    fused = make_trainer(DROPOUT_KEEP_RATE=1.0)
     return plain, fused
 
 
@@ -377,16 +634,74 @@ class TestTrainerIntegration:
             'generated_code_bytes', 'temp_bytes', 'argument_bytes',
             'output_bytes'}
 
-    def test_lazy_adam_falls_back_for_train_only(self):
-        """LAZY_EMBEDDING_ADAM needs the unpacked plane indices: the
-        packed TRAIN step keeps the unpack path (and still runs), while
-        predict stays fused."""
-        fused = make_trainer(DROPOUT_KEEP_RATE=1.0,
-                             USE_PALLAS_RAGGED_FUSION=True,
-                             LAZY_EMBEDDING_ADAM=True)
-        packed = self._packed(fused, n=1)
+    def test_zero_postwarm_compiles_fused_train(self, trainer_pair):
+        """The custom-VJP train step is as shape-stable as the rest:
+        repeated train dispatches on a warm (shards, capacity) shape add
+        NOTHING to the compile counter — the recompute backward, the
+        dropout re-draw and the table scatter-adds all key on the same
+        packed shapes."""
+        from code2vec_tpu.telemetry import core
+        from code2vec_tpu.telemetry.jit_tracker import \
+            install_compile_listener
+        fused = trainer_pair[1]
+        packed = self._packed(fused, n=3)
+        assert packed[0].ctx.shape == packed[1].ctx.shape
         state = fused.init_state(seed=0)
-        state, loss = fused.train_step(state, packed[0])
-        assert np.isfinite(float(loss))
-        out = fused.predict_step(state.params, packed[0], tier='topk')
+        core.reset()
+        core.enable()
+        try:
+            assert install_compile_listener()
+            compiles = core.registry().counter('jit/compiles_total')
+            state, _ = fused.train_step(state, packed[0])  # warm
+            warm = compiles.value
+            for pb in packed:
+                state, loss = fused.train_step(state, pb)
+                jax.block_until_ready(loss)
+            assert compiles.value - warm == 0, (
+                '%d XLA compiles after warmup on the fused train step'
+                % (compiles.value - warm))
+        finally:
+            core.disable()
+            core.reset()
+        # the bench A/B's memory axis: the train program's AOT analysis
+        # must resolve on this backend too (temp_bytes is the residual
+        # claim's measurable)
+        from code2vec_tpu.parallel import mesh as mesh_lib
+        placed = mesh_lib.shard_batch(packed[0].device_arrays(),
+                                      fused.mesh, False)
+        info = fused.train_program_memory(state, placed)
+        assert info is not None and 'temp_bytes' in info
+
+    def test_lazy_adam_trains_fused_with_parity(self):
+        """The lifted `ragged and lazy` exclusion (ISSUE 12): lazy Adam
+        now trains FUSED — the custom-VJP backward's table grads are
+        dense scatter-adds over the packed stream, and the sparse-row
+        update reads its touched rows straight off the packed ctx
+        indices. Touched-row sets are provably identical to the unpack
+        path's (every slot up to each example's effective length + the
+        PAD row), so params must match the unpack-then-dense lazy step
+        to fp32 rounding — including rows a batch did NOT touch staying
+        bit-identical (the lazy semantics)."""
+        fused = make_trainer(DROPOUT_KEEP_RATE=1.0,
+                             LAZY_EMBEDDING_ADAM=True)
+        plain = make_trainer(DROPOUT_KEEP_RATE=1.0,
+                             LAZY_EMBEDDING_ADAM=True,
+                             USE_PALLAS_RAGGED_FUSION=False)
+        packed = self._packed(fused, n=2)
+        state_f = fused.init_state(seed=0)
+        state_p = plain.init_state(seed=0)
+        for pb in packed:
+            state_f, loss_f = fused.train_step(state_f, pb)
+            state_p, loss_p = plain.train_step(state_p, pb)
+            np.testing.assert_allclose(float(loss_f), float(loss_p),
+                                       rtol=1e-5)
+        for name, leaf_f, leaf_p in zip(
+                state_f.params._fields,
+                jax.tree_util.tree_leaves(state_f.params),
+                jax.tree_util.tree_leaves(state_p.params)):
+            np.testing.assert_allclose(np.asarray(leaf_f),
+                                       np.asarray(leaf_p),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=name)
+        out = fused.predict_step(state_f.params, packed[0], tier='topk')
         assert np.asarray(out['topk_indices']).shape[0] == 8
