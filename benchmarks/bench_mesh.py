@@ -1,0 +1,331 @@
+"""Serving-mesh benchmark: p99-under-load at FIXED offered load as the
+replica count scales (SERVING.md "Serving mesh").
+
+An open-loop load generator submits a mixed tier/size profile — topk +
+attention predict requests and ``submit_neighbors`` vectors traffic in
+ONE dispatch stream — at a fixed offered rate against a 1-, 2-, and
+4-replica mesh over the same model.  Offered load is calibrated to
+~2.2x one replica's measured capacity, so the single-replica arm
+saturates (admission sheds the excess) while the larger fleets absorb
+it: the measured gate is SUSTAINED ADMITTED THROUGHPUT, plus p99
+latency over delivered requests, shed/expired rates, per-replica
+device fill, and dispatch share.  The telemetry compile counter runs
+across every arm — steady-state mesh serving (mixed tiers included)
+must compile NOTHING after warmup.
+
+Prints one JSON line per metric:
+  {"metric": "mesh_offered_rows_per_sec", "value": ...}
+  {"metric": "mesh_admitted_rows_per_sec", "replicas": N, "value": ...,
+   "p50_ms": ..., "p99_ms": ..., "shed_rate": ..., "per_replica_fill":
+   [...], "dispatch_share": [...], "postwarm_compiles": 0, ...}
+  {"metric": "mesh_scaling_2x", "value": admitted_2/admitted_1, ...}
+
+Interpreting the scaling number: replica threads parallelize the
+per-batch host pipeline (pack/h2d/dispatch/decode) and concurrent XLA
+executions — on a MULTI-core host 2 replicas sustain >= 1.8x one
+replica's admitted throughput at this profile; a 1-core container
+cannot parallelize anything, so the record carries ``host_cores`` and
+the smoke guard (tests/test_bench_smoke.py) gates the ratio assertion
+on it.  On-chip runs go through benchmarks/capture_all.sh (stage
+``mesh``).
+
+BENCH_SMOKE=1 shrinks shapes, rates, and durations for the CPU smoke
+(metrics carry a ``smoke`` field).
+
+Usage: python benchmarks/bench_mesh.py [--replica-counts 1,2,4]
+       [--offered-factor 2.2] [--secs S] [--deadline-ms MS]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+from benchmarks.bench_serving import synthesize_dataset  # noqa: E402
+
+
+class _MiniIndex:
+    """Tiny host-side k-NN over a handful of corpus vectors: enough to
+    give the ``submit_neighbors`` leg its real shape (vectors-tier
+    dispatch through the shared stream, then an index lookup on the
+    completion path) without dragging an index build into the bench."""
+
+    def __init__(self, dim: int, n: int = 64, seed: int = 7):
+        rng = np.random.default_rng(seed)
+        self.vectors = rng.standard_normal((n, dim)).astype(np.float32)
+        self.labels = np.array(['method|%d' % i for i in range(n)],
+                               dtype=object)
+
+    def search(self, queries, k):
+        scores = queries.astype(np.float32) @ self.vectors.T
+        idx = np.argsort(-scores, axis=1)[:, :k]
+        return np.take_along_axis(scores, idx, axis=1), idx
+
+
+def make_profile(lines, n_requests: int, max_lines: int, seed: int = 3):
+    """Mixed tier/size request profile: ragged sizes, 60% topk / 20%
+    attention / 20% neighbors (vectors tier through submit_neighbors)."""
+    rng = random.Random(seed)
+    profile = []
+    for _ in range(n_requests):
+        draw = rng.random()
+        kind = ('topk' if draw < 0.6 else
+                'attention' if draw < 0.8 else 'neighbors')
+        request_lines = [rng.choice(lines)
+                         for _ in range(rng.randint(1, max_lines))]
+        profile.append((kind, request_lines))
+    return profile
+
+
+def run_arm(model, index, profile, replicas: int, offered_rows_per_s: float,
+            deadline_ms: float, compiles, generators: int = 4) -> dict:
+    """One fixed-offered-load arm against an n-replica mesh.  The
+    arrival schedule (request i lands at cumulative_rows_before_i /
+    offered rate) is precomputed and driven by ``generators`` paced
+    submitter threads — caller-thread tokenize is part of the serving
+    contract, so a single generator thread would itself become the
+    bottleneck and silently under-offer the fleet (the achieved rate is
+    reported so a generator-limited arm is visible, not hidden)."""
+    import threading
+    from code2vec_tpu.serving.errors import (DeadlineExceeded,
+                                             EngineOverloaded)
+    mesh = model.serving_mesh(
+        replicas=replicas, tiers=('topk', 'attention', 'vectors'),
+        max_delay_ms=2.0, deadline_ms=deadline_ms)
+    mesh.attach_index(index)
+    warm_compiles = compiles.value if compiles is not None else 0
+    delivered_rows = [0]
+    latencies = []
+    lat_lock = threading.Lock()
+    # absolute arrival offsets for the whole profile
+    offsets = []
+    cum_rows = 0
+    for _kind, lines in profile:
+        offsets.append(cum_rows / offered_rows_per_s)
+        cum_rows += len(lines)
+    shed_counts = [0] * generators
+    expired_counts = [0] * generators
+    futures_per: list = [[] for _ in range(generators)]
+    last_submit = [0.0] * generators
+    t0 = time.perf_counter()
+
+    def generator(g: int) -> None:
+        for i in range(g, len(profile), generators):
+            kind, lines = profile[i]
+            target = t0 + offsets[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            t_submit = time.perf_counter()
+            try:
+                if kind == 'neighbors':
+                    future = mesh.submit_neighbors(lines)
+                else:
+                    future = mesh.submit(lines, tier=kind)
+            except EngineOverloaded:
+                shed_counts[g] += 1
+                last_submit[g] = time.perf_counter()
+                continue
+
+            def stamp(done, t_submit=t_submit, rows=len(lines)):
+                if done.exception() is None:
+                    with lat_lock:
+                        latencies.append(time.perf_counter() - t_submit)
+                        delivered_rows[0] += rows
+            future.add_done_callback(stamp)
+            futures_per[g].append(future)
+            last_submit[g] = time.perf_counter()
+
+    try:
+        threads = [threading.Thread(target=generator, args=(g,))
+                   for g in range(generators)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        for g in range(generators):
+            for future in futures_per[g]:
+                try:
+                    future.result(timeout=600)
+                except DeadlineExceeded:
+                    expired_counts[g] += 1
+                except EngineOverloaded:
+                    shed_counts[g] += 1
+        wall = time.perf_counter() - t0
+        submit_wall = max(last_submit) - t0
+        stats = mesh.stats()
+        per_replica = mesh.replica_stats()
+    finally:
+        mesh.close()
+    postwarm = (compiles.value - warm_compiles
+                if compiles is not None else None)
+    shed = sum(shed_counts)
+    expired = sum(expired_counts)
+    lat_ms = np.asarray(sorted(latencies)) * 1e3
+    total = len(profile)
+    return {
+        'replicas': replicas,
+        'value': round(delivered_rows[0] / wall, 1),
+        'delivered_rows': delivered_rows[0],
+        'offered_rows_per_sec': round(offered_rows_per_s, 1),
+        'achieved_offer_rows_per_sec':
+            round(cum_rows / max(1e-9, submit_wall), 1),
+        'wall_s': round(wall, 2),
+        'p50_ms': (round(float(np.percentile(lat_ms, 50)), 2)
+                   if len(lat_ms) else None),
+        'p99_ms': (round(float(np.percentile(lat_ms, 99)), 2)
+                   if len(lat_ms) else None),
+        'shed_rate': round(shed / total, 3),
+        'expired_rate': round(expired / total, 3),
+        'mesh_shed_total': stats['shed_total'],
+        'mesh_expired_total': stats['expired_total'],
+        'per_replica_fill': [
+            round(float(s['batch_fill_rate']), 3) for s in per_replica],
+        'dispatch_share': [
+            round(r['dispatch_share'], 3) for r in stats['replicas']],
+        'replica_batches': [r['batches'] for r in stats['replicas']],
+        'postwarm_compiles': postwarm,
+    }
+
+
+def measure_capacity(model, index, profile, reps: int = 2) -> float:
+    """One replica's sustainable rows/s: open-loop firehose (no arrival
+    pacing, no deadline) through a 1-replica mesh — delivered rows over
+    the drain wall clock, best of ``reps`` (the first rep pays
+    first-dispatch warm-in; under-measuring capacity would under-size
+    the offered load and starve every arm of its saturation regime)."""
+    # queue_bound=-1: the firehose deliberately holds the whole profile
+    # in flight; the admission bound is the LOAD arms' regime, not the
+    # capacity probe's
+    mesh = model.serving_mesh(replicas=1,
+                             tiers=('topk', 'attention', 'vectors'),
+                             max_delay_ms=2.0, queue_bound=-1)
+    mesh.attach_index(index)
+    best = 0.0
+    try:
+        for _ in range(reps):
+            rows = 0
+            futures = []
+            t0 = time.perf_counter()
+            for kind, lines in profile:
+                rows += len(lines)
+                if kind == 'neighbors':
+                    futures.append(mesh.submit_neighbors(lines))
+                else:
+                    futures.append(mesh.submit(lines, tier=kind))
+            for future in futures:
+                future.result(timeout=600)
+            best = max(best, rows / (time.perf_counter() - t0))
+    finally:
+        mesh.close()
+    return best
+
+
+def main() -> None:
+    benchlib.honor_env_platforms()
+    smoke = benchlib.smoke_requested()
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--replica-counts', default='1,2,4',
+                        help='mesh sizes to drive, comma-separated')
+    parser.add_argument('--offered-factor', type=float, default=2.2,
+                        help='offered load as a multiple of one '
+                             "replica's measured capacity")
+    parser.add_argument('--secs', type=float,
+                        default=4.0 if smoke else 20.0,
+                        help='load duration per arm (approximate: the '
+                             'profile is sized as offered x secs)')
+    parser.add_argument('--deadline-ms', type=float,
+                        default=2000.0,
+                        help='per-request SLO deadline under load '
+                             '(drives shed/expiry at saturation)')
+    parser.add_argument('--max-request-lines', type=int,
+                        default=4 if smoke else 8)
+    parser.add_argument('--rows', type=int, default=200 if smoke else 2000)
+    parser.add_argument('--contexts', type=int, default=6 if smoke else 200)
+    parser.add_argument('--tokens', type=int, default=500 if smoke else 20000)
+    parser.add_argument('--paths', type=int, default=500 if smoke else 30000)
+    parser.add_argument('--labels', type=int, default=100 if smoke else 5000)
+    parser.add_argument('--buckets', default='8,32' if smoke else '8,32,128')
+    args = parser.parse_args()
+
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.telemetry import core as tele_core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+
+    workdir = tempfile.mkdtemp(prefix='c2v_meshbench_')
+    prefix = os.path.join(workdir, 'synth')
+    lines = synthesize_dataset(prefix, args.rows, args.contexts,
+                               args.tokens, args.paths, args.labels)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=prefix, DL_FRAMEWORK='jax',
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        MAX_CONTEXTS=args.contexts, SERVING_BATCH_BUCKETS=args.buckets)
+    model = Code2VecModel(config)
+    index = _MiniIndex(config.CODE_VECTOR_SIZE)
+
+    tele_core.enable()
+    install_compile_listener()
+    compiles = tele_core.registry().counter('jit/compiles_total')
+
+    def emit(record):
+        if smoke:
+            record['smoke'] = True
+        print(json.dumps(record), flush=True)
+
+    counts = [int(c) for c in args.replica_counts.split(',') if c.strip()]
+
+    # calibration: one replica's capacity on the same mixed profile
+    cal_profile = make_profile(lines, 192 if smoke else 512,
+                               args.max_request_lines, seed=11)
+    capacity = measure_capacity(model, index, cal_profile)
+    offered = args.offered_factor * capacity
+    emit({'metric': 'mesh_capacity_rows_per_sec_1r',
+          'value': round(capacity, 1)})
+    emit({'metric': 'mesh_offered_rows_per_sec',
+          'value': round(offered, 1), 'factor': args.offered_factor,
+          'host_cores': os.cpu_count()})
+
+    # profile sized to ~secs of offered load; mean rows/request =
+    # (1 + max)/2
+    mean_rows = (1 + args.max_request_lines) / 2
+    n_requests = max(32, int(offered * args.secs / mean_rows))
+    profile = make_profile(lines, n_requests, args.max_request_lines)
+    tiers_served = sorted({kind for kind, _ in profile})
+
+    admitted = {}
+    for n in counts:
+        arm = run_arm(model, index, profile, n, offered,
+                      args.deadline_ms, compiles)
+        arm.update({'metric': 'mesh_admitted_rows_per_sec',
+                    'tiers': tiers_served,
+                    'host_cores': os.cpu_count()})
+        admitted[n] = arm['value']
+        emit(arm)
+
+    base = counts[0]
+    for n in counts[1:]:
+        emit({'metric': 'mesh_scaling_%dx' % (n // base),
+              'value': round(admitted[n] / max(1e-9, admitted[base]), 3),
+              'replicas': n, 'vs_replicas': base,
+              'host_cores': os.cpu_count(),
+              'note': 'admitted-throughput ratio at fixed offered '
+                      'load; >=1.8 expected at 2x on multi-core hosts '
+                      '/ on chip'})
+    emit({'metric': 'mesh_peak_hbm_bytes',
+          **benchlib.device_memory_record()})
+
+
+if __name__ == '__main__':
+    main()

@@ -292,8 +292,8 @@ def main() -> None:
           'p99': latency_report.percentile(roots, 0.99),
           'traces': len(roots), 'spans_path': spans_path})
     per_phase = {}
-    for (phase, _tier, _bucket), durs in latency_report.phase_rows(
-            traces).items():
+    for (phase, _tier, _bucket, _replica), durs in \
+            latency_report.phase_rows(traces).items():
         per_phase.setdefault(phase, []).extend(durs)
     for phase, durs in sorted(per_phase.items()):
         durs.sort()

@@ -132,6 +132,12 @@ if [ -f "${TRACE_DIR}/spans.jsonl" ]; then
     --spans "${TRACE_DIR}/spans.jsonl" --json
 fi
 probe_or_record "after serving" || exit 3
+# serving mesh (ISSUE 13): fixed offered load against 1/2/4 replicas —
+# sustained admitted throughput, p99-under-load, shed rate, per-replica
+# device fill, dispatch share, and the zero-postwarm-compile check over
+# the mixed predict + submit_neighbors stream
+run_stage mesh 900 python benchmarks/bench_mesh.py
+probe_or_record "after mesh" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline
 run_stage index 900 python benchmarks/bench_index.py

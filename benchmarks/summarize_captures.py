@@ -86,7 +86,16 @@ def main() -> None:
                                # the residual footprint the custom-VJP
                                # recompute backward cuts (ISSUE 12)
                                'peak_hbm_bytes', 'hbm_bytes_in_use',
-                               'temp_bytes')}
+                               'temp_bytes',
+                               # serving-mesh load axes (ISSUE 13):
+                               # p99-at-offered-load keyed by replica
+                               # count, with shed rate, per-replica
+                               # device fill, and the postwarm-compile
+                               # check riding each arm record
+                               'replicas', 'offered_rows_per_sec',
+                               'p50_ms', 'p99_ms', 'shed_rate',
+                               'per_replica_fill', 'dispatch_share',
+                               'postwarm_compiles', 'host_cores')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

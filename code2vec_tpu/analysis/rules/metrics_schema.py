@@ -49,9 +49,13 @@ class MetricsSchemaRule(Rule):
             return [self.finding(
                 'code2vec_tpu/telemetry/catalog.py', 0,
                 'telemetry catalog is not importable')]
+        from code2vec_tpu.telemetry.catalog import base_name
         findings: List[Finding] = []
         for rel, lineno, name in find_emissions(tree):
-            if name not in CATALOG:
+            # an instance-labeled literal ('m{replica=r0}') validates
+            # against its label-free catalog family, same resolution as
+            # the Prometheus exporter (catalog.base_name)
+            if base_name(name) not in CATALOG:
                 findings.append(self.finding(
                     rel, lineno,
                     'metric %r is not in the catalog '

@@ -399,8 +399,36 @@ class Config:
     SERVING_CANARY_TIMEOUT_SECS: float = 300.0
     # Poll the checkpoint store every this-many seconds for a newer
     # retained step and roll it over through the canary
-    # (--serve-follow-checkpoints; 0 disables).
+    # (--serve-follow-checkpoints; 0 disables). On a serving mesh the
+    # poller runs at the MESH (one coordinated fleet rollover), never
+    # per replica.
     SERVE_FOLLOW_CHECKPOINTS_SECS: float = 0.0
+    # ---- serving mesh (code2vec_tpu/serving/mesh.py, SERVING.md) ----
+    # Engine replicas behind the ONE shared front queue
+    # (--mesh-replicas). 1 keeps single-replica behavior behind the
+    # mesh API.
+    MESH_REPLICAS: int = 1
+    # Shared front-queue admission bound in ROWS across all tiers and
+    # replicas (--mesh-queue-bound). 0 = auto (replicas x 8 x the top
+    # batch bucket — the fleet's absorbable backlog scales with its
+    # size); -1 = unbounded.
+    MESH_QUEUE_BOUND: int = 0
+    # Per-replica in-flight window: dispatched-but-undecoded
+    # micro-batches a replica may hold before its puller stops claiming
+    # queue work. The mesh's dispatch weighting knob — a canarying
+    # replica runs at half this, a half-open breaker probes with 1.
+    MESH_MAX_INFLIGHT: int = 2
+    # Replica dispatch circuit breaker: consecutive dispatch failures
+    # that weight a replica OUT of queue pulling, and how long it stays
+    # out before a single half-open probe batch.
+    MESH_BREAKER_THRESHOLD: int = 3
+    MESH_BREAKER_COOLDOWN_SECS: float = 10.0
+    # Replica placement: 'thread' = in-process engine replicas sharing
+    # the trainer's warm programs; 'process' = one spawned worker
+    # process per replica speaking the same dispatch wire over a pipe
+    # (requires a checkpointed model — workers restore params from the
+    # store). SERVING.md "Serving mesh".
+    MESH_REPLICA_MODE: str = 'thread'
     # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
     # Per-invocation extractor timeout (--extractor-timeout): a wedged
     # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
@@ -695,6 +723,25 @@ class Config:
                                  'bound in rows; excess submissions '
                                  'are shed with a typed error (0 = '
                                  'auto, -1 = unbounded; SERVING.md)')
+        parser.add_argument('--mesh-replicas', dest='mesh_replicas',
+                            type=int, default=None, metavar='N',
+                            help='serving-engine replicas behind the '
+                                 'shared mesh front queue '
+                                 '(MESH_REPLICAS; SERVING.md "Serving '
+                                 'mesh")')
+        parser.add_argument('--mesh-queue-bound', dest='mesh_queue_bound',
+                            type=int, default=None, metavar='ROWS',
+                            help='shared mesh front-queue admission '
+                                 'bound in rows across all replicas '
+                                 '(0 = auto: replicas x 8 x top '
+                                 'bucket, -1 = unbounded; SERVING.md)')
+        parser.add_argument('--mesh-replica-mode',
+                            dest='mesh_replica_mode',
+                            choices=['thread', 'process'], default=None,
+                            help='replica placement: in-process engine '
+                                 'threads (shared warm programs) or one '
+                                 'worker process per replica on the '
+                                 'same dispatch wire (SERVING.md)')
         parser.add_argument('--serve-follow-checkpoints',
                             dest='serve_follow_checkpoints', type=float,
                             default=None, metavar='SECS',
@@ -882,6 +929,12 @@ class Config:
             self.SERVING_DEADLINE_MS = parsed.serving_deadline_ms
         if parsed.serving_queue_bound is not None:
             self.SERVING_QUEUE_BOUND = parsed.serving_queue_bound
+        if parsed.mesh_replicas is not None:
+            self.MESH_REPLICAS = parsed.mesh_replicas
+        if parsed.mesh_queue_bound is not None:
+            self.MESH_QUEUE_BOUND = parsed.mesh_queue_bound
+        if parsed.mesh_replica_mode:
+            self.MESH_REPLICA_MODE = parsed.mesh_replica_mode
         if parsed.serve_follow_checkpoints is not None:
             self.SERVE_FOLLOW_CHECKPOINTS_SECS = \
                 parsed.serve_follow_checkpoints
@@ -1147,6 +1200,22 @@ class Config:
         if self.SERVING_QUEUE_BOUND < -1:
             raise ValueError('config.SERVING_QUEUE_BOUND must be >= -1 '
                              '(0 = auto, -1 = unbounded).')
+        if self.MESH_REPLICAS < 1:
+            raise ValueError('config.MESH_REPLICAS must be >= 1.')
+        if self.MESH_QUEUE_BOUND < -1:
+            raise ValueError('config.MESH_QUEUE_BOUND must be >= -1 '
+                             '(0 = auto, -1 = unbounded).')
+        if self.MESH_MAX_INFLIGHT < 1:
+            raise ValueError('config.MESH_MAX_INFLIGHT must be >= 1.')
+        if self.MESH_BREAKER_THRESHOLD < 1:
+            raise ValueError('config.MESH_BREAKER_THRESHOLD must be '
+                             '>= 1.')
+        if self.MESH_BREAKER_COOLDOWN_SECS < 0:
+            raise ValueError('config.MESH_BREAKER_COOLDOWN_SECS must '
+                             'be >= 0.')
+        if self.MESH_REPLICA_MODE not in ('thread', 'process'):
+            raise ValueError("config.MESH_REPLICA_MODE must be 'thread' "
+                             "or 'process'.")
         if self.SERVING_CANARY_BATCHES < 0:
             raise ValueError('config.SERVING_CANARY_BATCHES must be >= 0 '
                              '(0 = swap without canary).')

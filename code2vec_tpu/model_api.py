@@ -827,6 +827,30 @@ class Code2VecModel:
             raise
         return engine
 
+    def serving_mesh(self, replicas=None, tiers=None, warmup: bool = True,
+                     **overrides):
+        """Build a ``ServingMesh`` over this model: ``replicas``
+        (default ``MESH_REPLICAS``) serving-engine replicas behind ONE
+        shared front queue with continuous cross-tier batching and
+        coordinated canaried rollover (serving/mesh.py, SERVING.md
+        "Serving mesh").  With ``--serve-follow-checkpoints`` the MESH
+        polls the checkpoint store and rolls the whole fleet as a unit
+        — replica engines never run their own pollers."""
+        from code2vec_tpu.serving.mesh import ServingMesh
+        mesh = ServingMesh(self, replicas=replicas, tiers=tiers,
+                           **overrides)
+        try:
+            if warmup:
+                mesh.warmup()
+            if self.config.SERVE_FOLLOW_CHECKPOINTS_SECS > 0:
+                mesh.follow_checkpoints()
+        except BaseException:
+            # never leak N dispatchers/decode pools: the caller gets
+            # the exception, not the mesh
+            mesh.close()
+            raise
+        return mesh
+
     # ----------------------------------------------------- embedding export
     def get_vocab_embedding_as_np_array(self, vocab_type: VocabType
                                         ) -> np.ndarray:

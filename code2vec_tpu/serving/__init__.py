@@ -6,9 +6,10 @@ from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
 from code2vec_tpu.serving.extractor_bridge import Extractor, ExtractorPool
 from code2vec_tpu.serving.predict import InteractivePredictor
 
-# ServingEngine / bulk_predict / export_code_vectors are imported from
-# their modules directly (code2vec_tpu.serving.engine / .bulk): they pull
-# in jax + the trainer, which the lightweight REPL pieces above must not.
+# ServingEngine / ServingMesh / bulk_predict / export_code_vectors are
+# imported from their modules directly (code2vec_tpu.serving.engine /
+# .mesh / .frontqueue / .bulk): they pull in jax + the trainer, which
+# the lightweight REPL pieces above must not.
 
 __all__ = ['Extractor', 'ExtractorPool', 'InteractivePredictor',
            'ServingError', 'EngineClosed', 'EngineOverloaded',

@@ -50,6 +50,15 @@ Instrumented with standalone telemetry instruments (``stats()``) that
 mirror into the process-global registry when telemetry is enabled
 (``serving/*`` in telemetry/catalog.py; OBSERVABILITY.md).
 
+One engine is one replica: a fleet of them serves behind ONE shared
+front queue as a ``ServingMesh`` (serving/mesh.py; SERVING.md "Serving
+mesh") — the engine then runs in **external-dispatch mode**
+(``external_dispatch=True``): no private queue or dispatcher thread,
+the mesh's replica puller feeds ``dispatch_external()`` directly, and
+every registry mirror below is replica-labeled
+(``serving/...{replica=rN}``) so coexisting replicas never collide in
+the process-global registry.
+
 Typical use::
 
     engine = model.serving_engine()          # warm-compiles the ladder
@@ -105,6 +114,17 @@ _OVERLOAD_EXIT = 0.25
 #: no throughput signal
 _SERVICE_WINDOW_S = 2.0
 _SERVICE_MIN_SPAN_S = 0.05
+
+#: Serializes the ASYNC device enqueue of predict programs across
+#: coexisting engines (mesh replicas share one device mesh in-process).
+#: Two threads interleaving their per-device enqueues of
+#: collective-bearing SPMD programs can cross the programs' rendezvous
+#: and deadlock the backend (observed: two replicas' AllGathers wedged
+#: on the 8-device CPU test mesh).  Holding the lock only for the
+#: enqueue imposes a consistent per-device program order; the
+#: executions themselves still pipeline (per-device streams run them
+#: in order), so the serialized section is microseconds, not step time.
+_DISPATCH_ENQUEUE_LOCK = threading.Lock()
 
 
 # --------------------------------------------------------------- ladder
@@ -302,6 +322,110 @@ class _Request:
             self.future.set_exception(exc)
 
 
+def bound_rejects(admitted: int, rows: int,
+                  bound: Optional[int]) -> bool:
+    """The admission bound's pile-up rule, shared by the engine's
+    ``_admit`` and the mesh's ``FrontQueue.admit``: the bound rejects
+    request PILE-UP, not request size — a single request larger than
+    the whole bound (the oversize-splitting contract) is admitted
+    alone on an idle queue; its own size then bounds the queue, and
+    everything behind it sheds until it drains."""
+    if bound is None or admitted + rows <= bound:
+        return False
+    return rows <= bound or admitted > 0
+
+
+def overload_tier(admitted: int, rows: int, bound: Optional[int],
+                  level: int, tier: str,
+                  warm_tiers: Sequence[str]) -> Tuple[int, str]:
+    """One hysteresis step of the degradation ladder, shared by engine
+    and mesh admission: returns ``(new_level, effective_tier)``.  The
+    wide enter/exit gap makes the ladder respond to SUSTAINED overload
+    instead of flapping on bursts; a downgrade never lands on a cold
+    program (``warm_tiers``)."""
+    if bound is not None:
+        fill = (admitted + rows) / bound
+        if fill >= _OVERLOAD_ENTER_2:
+            level = 2
+        elif fill >= _OVERLOAD_ENTER_1:
+            level = max(level, 1)
+        elif fill < _OVERLOAD_EXIT:
+            level = 0
+    effective = _DEGRADE_LADDER.get(level, {}).get(tier, tier)
+    if effective != tier and effective not in warm_tiers:
+        effective = tier
+    return level, effective
+
+
+def note_service_window(window: collections.deque, window_rows: int,
+                        rate: float, rows: int,
+                        oldest_enqueue: Optional[float]
+                        ) -> Tuple[int, float]:
+    """One completion's update of the sliding served-rows/s window —
+    the drain-estimate math shared by ``ServingEngine._note_service``
+    (one replica) and ``ServingMesh`` (every replica's completions →
+    the fleet rate).  Mutates ``window`` in place and returns the new
+    ``(window_rows, rate)``; the caller holds its own lock.  See
+    ``_note_service`` for why throughput-over-a-window (not sojourn,
+    not inter-completion gaps) is the right estimator."""
+    now = time.perf_counter()
+    window.append((now, rows))
+    window_rows += rows
+    horizon = now - _SERVICE_WINDOW_S
+    while len(window) > 1 and window[0][0] < horizon:
+        _t, evicted = window.popleft()
+        window_rows -= evicted
+    anchor_t, anchor_rows = window[0]
+    span = now - anchor_t
+    if span >= _SERVICE_MIN_SPAN_S:
+        # the anchor's own rows completed AT the span's start — they
+        # represent work done before it and are excluded
+        rate = (window_rows - anchor_rows) / span
+    elif rate <= 0 and oldest_enqueue is not None:
+        # seed from batch sojourn until the window spans a measurable
+        # interval — biased low, so a shed too many, never a deadline
+        # promised and missed
+        rate = rows / max(1e-6, now - oldest_enqueue)
+    return window_rows, rate
+
+
+def tokenize_and_chunk(reader: PathContextReader,
+                       lines: Sequence[str], tier: str, future: Future,
+                       deadline_s: Optional[float], trace,
+                       t_tokenize0: float,
+                       max_bucket: int) -> List['_Request']:
+    """Caller-thread tokenize + oversize chunking, shared by
+    ``ServingEngine.submit`` and ``ServingMesh.submit``: one request at
+    or under the top bucket stays whole; larger ones split into
+    ``_Request`` chunks re-joined in order through an ``_Aggregate``
+    (chunk spans nest each chunk's phases under the shared trace)."""
+    batch = reader.process_input_rows(lines)
+    if trace is not None:
+        trace.span_at('serving.tokenize', t_tokenize0,
+                      time.perf_counter())
+    n = int(batch.label.shape[0])
+    if n <= max_bucket:
+        return [_Request(batch, tier, future=future,
+                         deadline_s=deadline_s, trace=trace)]
+    n_chunks = -(-n // max_bucket)
+    aggregate = _Aggregate(future, n_chunks, trace=trace)
+    requests = []
+    for i in range(n_chunks):
+        chunk = PathContextReader._take_rows(
+            batch, slice(i * max_bucket, (i + 1) * max_bucket))
+        chunk_span = None
+        if trace is not None:
+            chunk_span = trace.span(
+                'serving.chunk',
+                attrs={'chunk': i, 'of': n_chunks,
+                       'rows': int(chunk.label.shape[0])})
+        requests.append(_Request(
+            chunk, tier, aggregate=aggregate, chunk_idx=i,
+            deadline_s=deadline_s, trace=trace,
+            span_parent=chunk_span))
+    return requests
+
+
 class _Rollover:
     """One in-flight canaried param rollover: the candidate params plus
     the canary tallies. All fields are mutated under the engine's
@@ -364,8 +488,26 @@ class ServingEngine:
                  params_step: Optional[int] = None,
                  tracer: Optional[tracing_lib.Tracer] = None,
                  tracing_sample_rate: Optional[float] = None,
+                 replica_id: Optional[str] = None,
+                 external_dispatch: bool = False,
+                 on_batch_done=None,
                  log=None):
         self.config = config
+        # mesh-replica identity (serving/mesh.py): labels this engine's
+        # registry mirrors so N coexisting replicas never double-count a
+        # counter or overwrite each other's gauges, and stamps the
+        # dispatch spans for per-replica latency attribution
+        self.replica_id = replica_id
+        # external-dispatch mode: the engine compiles/dispatches/decodes
+        # but owns NO queue — a ServingMesh dispatcher feeds it through
+        # dispatch_external(); submit()/follow_checkpoints() are the
+        # mesh's job and refuse here
+        self._external = bool(external_dispatch)
+        # completion hook (mesh replica table): called from the decode
+        # worker as (engine, rows, taken, ok) once a dispatched batch
+        # delivered (or typed-failed) — drives the mesh's in-flight
+        # window, fleet drain estimate, and dispatch-share gauges
+        self._on_batch_done = on_batch_done
         self.trainer = trainer
         self.params = params
         self.decode_table = decode_table
@@ -427,6 +569,15 @@ class ServingEngine:
         self._param_source = param_source
         workers = (decode_workers if decode_workers is not None
                    else config.SERVING_DECODE_WORKERS)
+        # the registry mirror for every emission site below: the plain
+        # process-global registry for a standalone engine, a replica-
+        # labeled view of it (serving/x_total{replica=rN}) for a mesh
+        # replica — telemetry/catalog.py "Instance labels"
+        if replica_id is not None:
+            self._mirror = tele_core.ScopedRegistry(
+                tele_core.registry(), 'replica', replica_id)
+        else:
+            self._mirror = tele_core.registry()
         # standalone instruments: stats()/benchmarks read them without
         # enabling the process-global telemetry layer; emission sites
         # below mirror into the registry when telemetry is on
@@ -485,6 +636,10 @@ class ServingEngine:
         # instrumented site below reduces to one `is not None` check.
         rate = (tracing_sample_rate if tracing_sample_rate is not None
                 else config.tracing_sample_rate)
+        # an INJECTED tracer belongs to its injector (a mesh shares one
+        # across every replica; a bench reads it after the run): only a
+        # tracer this engine constructed is closed by engine.close()
+        self._owns_tracer = tracer is None
         if tracer is not None:
             self._tracer: Optional[tracing_lib.Tracer] = tracer
         elif rate > 0:
@@ -518,11 +673,17 @@ class ServingEngine:
         self._follow_stop = threading.Event()
         self._decode_pool = ThreadPoolExecutor(
             max_workers=max(1, workers),
-            thread_name_prefix='serving-decode')
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, daemon=True,
-            name='serving-dispatch')
-        self._dispatcher.start()
+            thread_name_prefix='serving-decode'
+            + ('' if replica_id is None else '-%s' % replica_id))
+        if self._external:
+            # a mesh replica owns no queue: the mesh's replica puller
+            # is the dispatcher (serving/mesh.py)
+            self._dispatcher: Optional[threading.Thread] = None
+        else:
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name='serving-dispatch')
+            self._dispatcher.start()
 
     # ---------------------------------------------------------- warmup
     def _warm_batches(self, bucket: int):
@@ -617,7 +778,7 @@ class ServingEngine:
                 raise
             warm_s = time.perf_counter() - t0
             if tele_core.enabled():
-                reg = tele_core.registry()
+                reg = self._mirror
                 reg.gauge('serving/warmup_s').set(warm_s)
                 reg.gauge('serving/programs_warm').set(programs)
             self.log('serving: warmed %d programs (buckets %s x tiers %s, '
@@ -632,7 +793,7 @@ class ServingEngine:
         """Reject one submission at admission (typed, nothing enqueued)."""
         self.shed_total.inc()
         if tele_core.enabled():
-            tele_core.registry().counter('serving/shed_total').inc()
+            self._mirror.counter('serving/shed_total').inc()
         raise EngineOverloaded(
             'request shed at admission (%s): %d rows, %d rows queued, '
             'bound %s — retry against another replica or back off'
@@ -655,49 +816,33 @@ class ServingEngine:
                 self._shed_locked(rows, 'reject_all drill')
             admitted = self._admitted_rows_locked()
             bound = self.queue_bound
-            if bound is not None and admitted + rows > bound:
-                # the bound rejects request PILE-UP, not request size: a
-                # single request larger than the whole bound (submit's
-                # oversize-splitting contract) is admitted alone on an
-                # idle queue — its own size then bounds the queue, and
-                # everything behind it is shed until it drains
-                if rows <= bound or admitted > 0:
-                    self._shed_locked(rows, 'queue bound')
+            if bound_rejects(admitted, rows, bound):
+                self._shed_locked(rows, 'queue bound')
             if deadline_s is not None and self._service_rows_per_s > 0:
                 drain_s = (admitted + rows) / self._service_rows_per_s
                 if drain_s > deadline_s:
                     self._shed_locked(
                         rows, 'drain estimate %.0fms > deadline %.0fms'
                         % (1e3 * drain_s, 1e3 * deadline_s))
-            if bound is not None:
-                fill = (admitted + rows) / bound
-                level = self._overload_level
-                if fill >= _OVERLOAD_ENTER_2:
-                    level = 2
-                elif fill >= _OVERLOAD_ENTER_1:
-                    level = max(level, 1)
-                elif fill < _OVERLOAD_EXIT:
-                    level = 0
-                if level != self._overload_level:
-                    self._overload_level = level
-                    self.overload_level_gauge.set(level)
-                    if tele_core.enabled():
-                        tele_core.registry().gauge(
-                            'serving/overload_level').set(level)
-            effective = _DEGRADE_LADDER.get(
-                self._overload_level, {}).get(tier, tier)
-            if effective != tier and effective not in self.tiers:
-                effective = tier  # never downgrade onto a cold program
+            level, effective = overload_tier(
+                admitted, rows, bound, self._overload_level, tier,
+                self.tiers)
+            if level != self._overload_level:
+                self._overload_level = level
+                self.overload_level_gauge.set(level)
+                if tele_core.enabled():
+                    self._mirror.gauge(
+                        'serving/overload_level').set(level)
             if effective != tier:
                 self.degraded_total.inc()
                 if tele_core.enabled():
-                    tele_core.registry().counter(
+                    self._mirror.counter(
                         'serving/degraded_total').inc()
             self._reserved_rows += rows
             self._peak_rows = max(self._peak_rows,
                                   self._admitted_rows_locked())
             if tele_core.enabled():
-                tele_core.registry().gauge(
+                self._mirror.gauge(
                     'serving/queue_peak_rows').set(self._peak_rows)
         return effective
 
@@ -714,6 +859,10 @@ class ServingEngine:
         this request (0 = none): past it the request is shed at
         admission or expired in the queue with a typed error, never
         dispatched."""
+        if self._external:
+            raise RuntimeError(
+                'this engine is a mesh replica (external dispatch); '
+                'submit through its ServingMesh (serving/mesh.py)')
         if tier not in self.tiers:
             raise ValueError('tier %r is not warmed on this engine '
                              '(tiers=%s)' % (tier, list(self.tiers)))
@@ -735,7 +884,7 @@ class ServingEngine:
             deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
         self.requests_total.inc()
         if tele_core.enabled():
-            tele_core.registry().counter('serving/requests_total').inc()
+            self._mirror.counter('serving/requests_total').inc()
         trace = None
         if self._tracer is not None:
             trace = self._tracer.begin(
@@ -766,31 +915,9 @@ class ServingEngine:
                             attrs={'requested': requested_tier,
                                    'effective': tier})
         try:
-            batch = self.reader.process_input_rows(lines)
-            if trace is not None:
-                trace.span_at('serving.tokenize', t_admit1,
-                              time.perf_counter())
-            max_bucket = self.buckets[-1]
-            if n <= max_bucket:
-                requests = [_Request(batch, tier, future=future,
-                                     deadline_s=deadline_s, trace=trace)]
-            else:
-                n_chunks = -(-n // max_bucket)
-                aggregate = _Aggregate(future, n_chunks, trace=trace)
-                requests = []
-                for i in range(n_chunks):
-                    chunk = PathContextReader._take_rows(
-                        batch, slice(i * max_bucket, (i + 1) * max_bucket))
-                    chunk_span = None
-                    if trace is not None:
-                        chunk_span = trace.span(
-                            'serving.chunk',
-                            attrs={'chunk': i, 'of': n_chunks,
-                                   'rows': int(chunk.label.shape[0])})
-                    requests.append(_Request(
-                        chunk, tier, aggregate=aggregate, chunk_idx=i,
-                        deadline_s=deadline_s, trace=trace,
-                        span_parent=chunk_span))
+            requests = tokenize_and_chunk(
+                self.reader, lines, tier, future, deadline_s, trace,
+                t_admit1, self.buckets[-1])
         except BaseException as exc:
             with self._cond:
                 self._reserved_rows -= n
@@ -1013,6 +1140,20 @@ class ServingEngine:
                      % (step, n_canary, floor))
         return handle
 
+    def adopt_params(self, params, step: Optional[int] = None) -> None:
+        """Atomically swap the serving params with NO canary and NO
+        ledger registration: the fleet-swap leg of a coordinated mesh
+        rollover (serving/mesh.py), where the canary replica already
+        validated this exact param set against live traffic and the
+        mesh owns the ONE ledger entry for the shared arrays —
+        per-replica re-registration of the same pytree would N-count
+        it. Refuses while a rollover is in flight on this replica."""
+        with self._cond:
+            self._check_rollover_clear_locked()
+            self.params = params
+            if step is not None:
+                self._params_step = step
+
     def _mem_swap_in(self, params, step: Optional[int]) -> None:
         """Ledger bookkeeping for a concluded swap: the candidate entry
         (if any) retires and the engine's serving entry re-registers
@@ -1041,7 +1182,7 @@ class ServingEngine:
         if agreement is not None:
             self.rollover_agreement.set(agreement)
         if tele_core.enabled():
-            reg = tele_core.registry()
+            reg = self._mirror
             reg.counter('serving/rollover_total' if swapped
                         else 'serving/rollover_rollbacks_total').inc()
             if agreement is not None:
@@ -1108,6 +1249,14 @@ class ServingEngine:
         """Poll the checkpoint store for a newer retained step and roll
         it in through the canary (``--serve-follow-checkpoints``).
         Requires the engine's param source; idempotent."""
+        if self._external:
+            # the fleet must roll as ONE unit: N replica pollers racing
+            # independent canaries is exactly the mode the mesh's
+            # coordinated rollover exists to replace
+            raise RuntimeError(
+                'this engine is a mesh replica; --serve-follow-'
+                'checkpoints runs at the mesh '
+                '(ServingMesh.follow_checkpoints, serving/mesh.py)')
         if self._param_source is None:
             raise RuntimeError('follow_checkpoints needs a param source '
                                '(build the engine via '
@@ -1166,7 +1315,7 @@ class ServingEngine:
         depth = sum(len(q) for q in self._queues.values())
         self.queue_depth.set(depth)
         if tele_core.enabled():
-            tele_core.registry().gauge('serving/queue_depth').set(depth)
+            self._mirror.gauge('serving/queue_depth').set(depth)
 
     # ------------------------------------------------------ dispatcher
     def _dispatch_loop(self) -> None:
@@ -1242,7 +1391,7 @@ class ServingEngine:
             for request in expired:
                 self.expired_total.inc()
                 if tele_core.enabled():
-                    tele_core.registry().counter(
+                    self._mirror.counter(
                         'serving/expired_total').inc()
                 request.fail(DeadlineExceeded(
                     'request expired after %.0fms in queue (SLO '
@@ -1260,6 +1409,22 @@ class ServingEngine:
                     memory_lib.ledger().note_oom(exc, 'serving.dispatch')
                     for request in taken:
                         request.fail(exc)
+
+    def dispatch_external(self, tier: str, taken: List[_Request],
+                          rows: int) -> None:
+        """Mesh-replica dispatch hook (serving/mesh.py): ship one
+        coalesced micro-batch the mesh's shared front queue popped.
+        Same failure contract as the internal dispatcher — an exception
+        fails every member request typed and dumps OOM forensics — but
+        it also RE-RAISES so the caller's replica breaker can count the
+        failure and weight this replica out of dispatch."""
+        try:
+            self._dispatch_batch(tier, taken, rows)
+        except BaseException as exc:
+            memory_lib.ledger().note_oom(exc, 'serving.dispatch')
+            for request in taken:
+                request.fail(exc)
+            raise
 
     def _pack_padded(self, padded: Batch, bucket: int) -> Tuple[tuple, int]:
         """Pad-complete plane batch -> packed wire arrays on a capacity
@@ -1328,29 +1493,40 @@ class ServingEngine:
                 False, 'canary timed out after %.0fs'
                 % self.canary_timeout_s))
         # async dispatch: returns with device futures; the decode pool
-        # blocks on them, the dispatcher goes back to coalescing
-        if self._tracer is not None:
-            # bridge into the profiler timeline (OBSERVABILITY.md): the
-            # dispatch shows up as a named host lane next to the
-            # trainer's StepTraceAnnotation scopes in captured traces
-            import jax
-            with jax.profiler.TraceAnnotation('serving/dispatch'):
+        # blocks on them, the dispatcher goes back to coalescing.  The
+        # enqueue itself is serialized across engines (mesh replicas):
+        # see _DISPATCH_ENQUEUE_LOCK
+        with _DISPATCH_ENQUEUE_LOCK:
+            if self._tracer is not None:
+                # bridge into the profiler timeline (OBSERVABILITY.md):
+                # the dispatch shows up as a named host lane next to the
+                # trainer's StepTraceAnnotation scopes in captured traces
+                import jax
+                with jax.profiler.TraceAnnotation('serving/dispatch'):
+                    out = self.trainer.predict_step_placed(params, arrays,
+                                                           tier=tier)
+            else:
                 out = self.trainer.predict_step_placed(params, arrays,
                                                        tier=tier)
-        else:
-            out = self.trainer.predict_step_placed(params, arrays,
-                                                   tier=tier)
-        shadow_out = None
-        if rollover is not None and tier != 'vectors':
-            # canary shadow: same arrays, same shapes/shardings — the
-            # warm program is reused, so a live rollover never compiles
-            # (predict programs are never donated: re-feeding `arrays`
-            # is safe)
-            shadow_out = self.trainer.predict_step_placed(
-                rollover.params, arrays, tier=tier)
+            shadow_out = None
+            if rollover is not None and tier != 'vectors':
+                # canary shadow: same arrays, same shapes/shardings —
+                # the warm program is reused, so a live rollover never
+                # compiles (predict programs are never donated:
+                # re-feeding `arrays` is safe)
+                shadow_out = self.trainer.predict_step_placed(
+                    rollover.params, arrays, tier=tier)
         t_disp = time.perf_counter()
         if traced:
             t_head = min(request.t_enqueue for request in taken)
+            # the pack span carries the dispatch attribution the latency
+            # report keys on: bucket, effective tier, and — on a mesh —
+            # WHICH replica served the batch (scripts/latency_report.py
+            # per-replica columns)
+            pack_attrs = {'bucket': bucket, 'capacity': capacity,
+                          'batch_rows': rows, 'tier': tier}
+            if self.replica_id is not None:
+                pack_attrs['replica'] = self.replica_id
             for request in traced:
                 tr, parent = request.trace, request.span_parent
                 tr.span_at('serving.coalesce', t_head, t0, parent=parent,
@@ -1361,8 +1537,7 @@ class ServingEngine:
                                parent=parent,
                                attrs={'fault': 'slow_dispatch'})
                 tr.span_at('serving.pack', t_stall, t_pack, parent=parent,
-                           attrs={'bucket': bucket, 'capacity': capacity,
-                                  'batch_rows': rows, 'tier': tier})
+                           attrs=pack_attrs)
                 tr.span_at('serving.h2d', t_pack, t_h2d, parent=parent)
                 tr.span_at('serving.dispatch', t_h2d, t_disp,
                            parent=parent,
@@ -1375,7 +1550,7 @@ class ServingEngine:
                               'capacity': capacity,
                               'requests': len(taken)}
         if tele_core.enabled():
-            reg = tele_core.registry()
+            reg = self._mirror
             reg.timer('serving/dispatch_ms').record(dispatch_s)
             reg.counter('serving/batches_total').inc()
             reg.gauge('serving/batch_fill_rate').set(rows / bucket)
@@ -1401,7 +1576,7 @@ class ServingEngine:
             decode_s = time.perf_counter() - t0
             self.decode_timer.record(decode_s)
             if tele_core.enabled():
-                tele_core.registry().timer(
+                self._mirror.timer(
                     'serving/decode_ms').record(decode_s)
             t_fetch = t0 + fetch_s
             t_decode = t0 + decode_s
@@ -1435,18 +1610,28 @@ class ServingEngine:
                 latency = now - request.t_enqueue
                 self.latency.record(latency)
                 if tele_core.enabled():
-                    tele_core.registry().timer(
+                    self._mirror.timer(
                         'serving/latency_ms').record(latency)
                 if request.trace is not None:
                     request.trace.end(deliver_span)
                     request.finish_trace()
             self._note_service(n_rows, taken)
+            if self._on_batch_done is not None:
+                # mesh replica-table hook: in-flight window release,
+                # fleet drain estimate, dispatch-share accounting
+                self._on_batch_done(self, n_rows, taken, True)
         except BaseException as exc:
             # async dispatches surface device OOM at this fetch
             # boundary — same forensics as the dispatch side
             memory_lib.ledger().note_oom(exc, 'serving.decode')
             for request in taken:
                 request.fail(exc)
+            if self._on_batch_done is not None:
+                try:
+                    self._on_batch_done(
+                        self, sum(r.rows for r in taken), taken, False)
+                except Exception:
+                    pass  # the failure path must stay failure-proof
             return
         if shadow_out is not None:
             # canary tally AFTER the callers got their answers: the
@@ -1484,25 +1669,12 @@ class ServingEngine:
         interval (first batch, or right after an idle gap evicted it)
         the estimate seeds from batch sojourn — biased low, so a shed
         too many, never a deadline promised and missed."""
-        now = time.perf_counter()
+        oldest = min(request.t_enqueue for request in taken)
         with self._lock:
-            window = self._service_window
-            window.append((now, rows))
-            self._service_window_rows += rows
-            horizon = now - _SERVICE_WINDOW_S
-            while len(window) > 1 and window[0][0] < horizon:
-                _t, evicted = window.popleft()
-                self._service_window_rows -= evicted
-            anchor_t, anchor_rows = window[0]
-            span = now - anchor_t
-            if span >= _SERVICE_MIN_SPAN_S:
-                # the anchor's own rows completed AT the span's start —
-                # they represent work done before it and are excluded
-                self._service_rows_per_s = (
-                    (self._service_window_rows - anchor_rows) / span)
-            elif self._service_rows_per_s <= 0:
-                oldest = min(request.t_enqueue for request in taken)
-                self._service_rows_per_s = rows / max(1e-6, now - oldest)
+            self._service_window_rows, self._service_rows_per_s = \
+                note_service_window(
+                    self._service_window, self._service_window_rows,
+                    self._service_rows_per_s, rows, oldest)
 
     # -------------------------------------------------------- lifecycle
     def stats(self) -> Dict[str, object]:
@@ -1512,6 +1684,7 @@ class ServingEngine:
             peak_rows = self._peak_rows
             params_step = self._params_step
         return {
+            'replica': self.replica_id,
             'requests_total': self.requests_total.snapshot(),
             'batches_total': self.batches_total.snapshot(),
             'queue_depth': self.queue_depth.snapshot(),
@@ -1566,7 +1739,8 @@ class ServingEngine:
         follow = self._follow_thread
         if follow is not None:
             follow.join()
-        self._dispatcher.join()
+        if self._dispatcher is not None:
+            self._dispatcher.join()
         self._decode_pool.shutdown(wait=True)
         # retire this engine's ledger entries: the params it swapped in
         # and an armed candidate (release is no-op-safe, so racing the
@@ -1576,10 +1750,14 @@ class ServingEngine:
         led = memory_lib.ledger()
         led.release('params', self._mem_prefix + '/serving')
         led.release('params', self._mem_prefix + '/candidate')
-        if self._tracer is not None:
+        if self._tracer is not None and self._owns_tracer:
             # dispatcher + decode pool have drained: every in-flight
             # trace is already finished (delivered or typed-failed), so
-            # the close dump is complete, never truncated
+            # the close dump is complete, never truncated.  An injected
+            # tracer is NOT closed: its owner (the mesh sharing it
+            # across replicas, a bench reading it afterwards) decides
+            # when the fleet is actually done — a retiring replica must
+            # not end the whole fleet's flight recorder
             self._tracer.close()
 
     def __enter__(self) -> 'ServingEngine':

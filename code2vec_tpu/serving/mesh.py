@@ -1,0 +1,1348 @@
+"""Serving mesh: N ``ServingEngine`` replicas behind ONE shared front
+queue, with continuous cross-tier batching, replica-aware admission,
+and coordinated canaried rollover (SERVING.md "Serving mesh").
+
+The single-engine story (PRs 4/7/8/9) ends at one replica: "heavy
+traffic from millions of users" (ROADMAP north star) needs a FLEET —
+the Ads-serving stack's shape (PAPERS.md, arxiv 2501.10546): many model
+servers behind shared queues, params refreshed continuously under live
+traffic.  This module is that shape for code2vec:
+
+- **One shared front queue** (``serving/frontqueue.py``).  Admission —
+  bound, deadline-vs-drain, degradation ladder — moves up to the fleet:
+  the drain estimate is the fleet service rate (the mesh's sliding
+  window over every replica's completions — numerically the sum of
+  per-replica served-rows/s), and shedding/expiry are typed at the
+  shared queue, so one slow replica never wedges its share of traffic.
+- **Replica pullers = continuous cross-tier batching.**  Each replica
+  runs one puller thread that claims work from the shared queue the
+  moment the replica has a free in-flight slot: the puller picks the
+  tier whose head waited longest and keeps folding newly-arriving
+  compatible requests into the still-gathering micro-batch up to the
+  coalescing deadline (the Ragged Paged Attention
+  insert-into-the-in-flight-batch idea at request granularity), then
+  packs onto the smallest covering (bucket x capacity-rung x tier)
+  warm program of ITS engine.  Predict tiers and ``submit_neighbors``
+  vectors traffic ride the same dispatch stream.
+- **Replica-aware weighting.**  The replica table tracks per-replica
+  in-flight windows, a dispatch circuit breaker (K consecutive dispatch
+  failures open it; half-open probes one batch after the cooldown), and
+  retirement — a breaker-open or retired replica simply stops pulling,
+  and the queue redirects to its siblings instead of wedging.  A
+  replica canarying a rollover pulls with a halved in-flight window
+  (it still needs live traffic to conclude the canary; its shadow cost
+  is off-latency by the engine's contract).
+- **Coordinated rollover.**  ``load_params(step|path|pytree)`` canaries
+  on ONE replica (reusing the engine's shadow-scoring machinery), then
+  fleet-swaps the SAME validated params onto every other replica on
+  agreement (``engine.adopt_params`` — pointer swap, zero compiles,
+  one ledger entry), or rolls the canary back and leaves every replica
+  serving the old params.  ``follow_checkpoints`` moves up here too:
+  the fleet rolls as a unit instead of N pollers racing.
+
+**Replica modes.**  ``MESH_REPLICAS`` in-process replica threads by
+default (``MESH_REPLICA_MODE='thread'``): every replica is a
+``ServingEngine`` in external-dispatch mode over the model's trainer,
+so warm programs are shared through the trainer's jit caches and
+replica 2..N warm for free.  ``'process'`` runs each replica as a
+spawned worker process hosting its own model + engine, speaking the
+same dispatch wire (tokenized ``Batch`` out, decoded results back) over
+a pipe — the shape multi-host serving needs, so going distributed is a
+config change, not a rewrite.  Process replicas restore params from the
+model's checkpoint path (pytrees don't cross processes; checkpoint refs
+do — which is also why process-mode rollover takes step/path sources
+only).
+
+Measured gate: ``benchmarks/bench_mesh.py`` (open-loop load at fixed
+offered rate; p99 / shed rate / per-replica fill at 1/2/4 replicas).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.serving import engine as engine_lib
+from code2vec_tpu.serving.engine import (ServingEngine, _Request,
+                                         _resolve)
+from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+                                         EngineOverloaded)
+from code2vec_tpu.serving.frontqueue import FrontQueue
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry import tracing as tracing_lib
+from code2vec_tpu.telemetry.core import Counter, Gauge
+from code2vec_tpu.training.trainer import PREDICT_TIERS
+
+#: replica dispatch-breaker states (mirrors the extractor breaker's
+#: numbering: serving/breaker_state semantics)
+_BREAKER_CLOSED = 0
+_BREAKER_HALF_OPEN = 1
+_BREAKER_OPEN = 2
+
+
+class _ReplicaSlot:
+    """One row of the mesh replica table: transport + health + the
+    dispatch accounting the weighting decisions read.  All mutable
+    fields are guarded by the MESH's ``_cond`` lock (the replica's
+    puller, the decode-completion hook, rollover, and retirement all
+    touch them)."""
+
+    __slots__ = ('rid', 'transport', 'thread', 'retired', 'inflight',
+                 'rows_dispatched', 'batches', 'breaker_fails',
+                 'breaker_state', 'breaker_open_until', 'canarying')
+
+    def __init__(self, rid: str, transport):
+        self.rid = rid
+        self.transport = transport
+        self.thread: Optional[threading.Thread] = None
+        self.retired = False
+        self.inflight = 0
+        self.rows_dispatched = 0
+        self.batches = 0
+        self.breaker_fails = 0
+        self.breaker_state = _BREAKER_CLOSED
+        self.breaker_open_until = 0.0
+        self.canarying = False
+
+
+class _ThreadReplica:
+    """In-process replica transport: a ``ServingEngine`` in
+    external-dispatch mode, called directly."""
+
+    mode = 'thread'
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+
+    def dispatch(self, tier: str, taken: List[_Request],
+                 rows: int) -> None:
+        self.engine.dispatch_external(tier, taken, rows)
+
+    def wait_ready(self) -> None:
+        pass  # in-process: constructed ready
+
+    def warmup(self) -> None:
+        self.engine.warmup()
+
+    def load_params(self, source, canary_batches: int,
+                    min_agreement: float) -> Future:
+        return self.engine.load_params(source,
+                                       canary_batches=canary_batches,
+                                       min_agreement=min_agreement)
+
+    def adopt(self, params, source, step: Optional[int]) -> None:
+        # in-process fleet swap: the canary replica's validated pytree
+        # IS the candidate — pointer swap, no restore, no new ledger
+        # entry (the arrays are shared across replicas)
+        self.engine.adopt_params(params, step=step)
+
+    def stats(self) -> Dict[str, object]:
+        return self.engine.stats()
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+class _ProcessReplica:
+    """Process replica transport: a spawned worker hosting its own
+    model + engine, fed tokenized ``Batch`` payloads over a pipe and
+    returning decoded results — the same wire a multi-host mesh would
+    speak, so scaling out is a config change.
+
+    The parent-side receiver thread resolves in-flight dispatches and
+    feeds the mesh's completion hook; the worker serves dispatches
+    sequentially (its engine still decodes on its own pool)."""
+
+    mode = 'process'
+
+    # the pending map and the send side of the pipe are shared by the
+    # puller, the receiver thread, and control calls (lock-discipline
+    # rule, ANALYSIS.md):
+    # graftlint: guard _ProcessReplica._pending,_control,_seq by _lock
+    def __init__(self, rid: str, config_overrides: Dict[str, object],
+                 on_batch_done, log, on_worker_dead=None,
+                 start_timeout_s: float = 600.0):
+        import multiprocessing
+        self.rid = rid
+        self.log = log
+        self._on_batch_done = on_batch_done
+        self._on_worker_dead = on_worker_dead
+        self._start_timeout_s = start_timeout_s
+        ctx = multiprocessing.get_context('spawn')
+        self._conn, child = ctx.Pipe()
+        self._proc = ctx.Process(
+            target=_replica_worker_main,
+            args=(rid, config_overrides, child), daemon=True)
+        # spawn only: the worker's cold start (model build + warmup) is
+        # the expensive part, and N replicas must pay it CONCURRENTLY —
+        # the mesh constructs every transport first, then wait_ready()s
+        # each, so fleet startup is ~one worker's wall clock, not N of
+        # them
+        self._proc.start()
+        child.close()
+        self._lock = threading.Lock()
+        self._pending: Dict[int, Tuple[List[_Request], int]] = {}
+        self._seq = 0
+        self._control: Dict[int, Future] = {}
+        self._receiver: Optional[threading.Thread] = None
+
+    def wait_ready(self) -> None:
+        """Block until the worker reported ready, then start the
+        receiver.  Must run before the first dispatch/control call."""
+        if self._receiver is not None:
+            return
+        if not self._conn.poll(self._start_timeout_s):
+            self._proc.terminate()
+            raise RuntimeError(
+                'mesh replica %s worker did not come up within %.0fs'
+                % (self.rid, self._start_timeout_s))
+        try:
+            msg = self._conn.recv()
+        except (EOFError, OSError) as exc:
+            # worker died before it could even report its failure
+            self._proc.terminate()
+            raise RuntimeError(
+                'mesh replica %s worker exited during startup (%r) — '
+                'check the worker log; process replicas need a '
+                'checkpointed model with a retained step'
+                % (self.rid, exc))
+        if msg[0] == 'failed':
+            self._proc.terminate()
+            raise RuntimeError('mesh replica %s worker failed to '
+                               'start: %s' % (self.rid, msg[1]))
+        if msg[0] != 'ready':
+            self._proc.terminate()
+            raise RuntimeError('mesh replica %s worker failed to start: '
+                               '%r' % (self.rid, msg))
+        self._receiver = threading.Thread(target=self._recv_loop,
+                                          daemon=True,
+                                          name='mesh-recv-%s' % self.rid)
+        self._receiver.start()
+
+    def _control_call(self, kind: str, *payload,
+                      timeout: Optional[float] = 600.0):
+        future: Future = Future()
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self._control[seq] = future
+            self._conn.send((kind, seq) + payload)
+        return future.result(timeout)
+
+    def dispatch(self, tier: str, taken: List[_Request],
+                 rows: int) -> None:
+        batches = [request.batch for request in taken]
+        try:
+            with self._lock:
+                seq = self._seq
+                self._seq += 1
+                self._pending[seq] = (taken, rows)
+                self._conn.send(('dispatch', seq, tier, batches))
+        except BaseException as exc:
+            with self._lock:
+                self._pending.pop(seq, None)
+            # same contract as engine.dispatch_external: the member
+            # requests FAIL TYPED here (the puller's breaker handler
+            # assumes it), then the error propagates for breaker
+            # accounting — a dead worker pipe must never leave caller
+            # futures hanging
+            failure = EngineClosed(
+                'mesh replica %s wire send failed: %r' % (self.rid, exc))
+            for request in taken:
+                request.fail(failure)
+            raise
+        # the worker pops its queue-wait here, not in an engine this
+        # process can see: close the span at hand-off so queue time is
+        # attributed, not smeared into the trace tail
+        now = time.perf_counter()
+        for request in taken:
+            if request.queue_span is not None:
+                request.trace.end(request.queue_span, now)
+                request.queue_span = None
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                # worker died: every in-flight dispatch fails typed
+                with self._lock:
+                    pending = list(self._pending.items())
+                    self._pending.clear()
+                    control = list(self._control.items())
+                    self._control.clear()
+                exc = EngineClosed(
+                    'mesh replica %s worker exited with %d dispatch(es) '
+                    'in flight' % (self.rid, len(pending)))
+                for _seq, (taken, rows) in pending:
+                    for request in taken:
+                        request.fail(exc)
+                    self._on_batch_done(self, rows, taken, False)
+                for _seq, future in control:
+                    if not future.done():
+                        future.set_exception(exc)
+                if self._on_worker_dead is not None:
+                    # the worker can never come back (no respawn yet —
+                    # ROADMAP item 2): the mesh retires the slot, so
+                    # the breaker's half-open probe doesn't sacrifice
+                    # one real micro-batch every cooldown forever
+                    try:
+                        self._on_worker_dead(self)
+                    except Exception:
+                        pass
+                return
+            kind, seq = msg[0], msg[1]
+            if kind in ('result', 'error'):
+                with self._lock:
+                    entry = self._pending.pop(seq, None)
+                    ctrl = self._control.pop(seq, None)
+                if entry is not None:
+                    taken, rows = entry
+                    if kind == 'result':
+                        for request, results in zip(taken, msg[2]):
+                            request.deliver(results)
+                            request.finish_trace()
+                        self._on_batch_done(self, rows, taken, True)
+                    else:
+                        for request in taken:
+                            request.fail(msg[2])
+                        self._on_batch_done(self, rows, taken, False)
+                elif ctrl is not None:
+                    if kind == 'result':
+                        _resolve(ctrl, msg[2])
+                    elif not ctrl.done():
+                        ctrl.set_exception(msg[2])
+            elif kind == 'closed':
+                with self._lock:
+                    ctrl = self._control.pop(seq, None)
+                if ctrl is not None:
+                    _resolve(ctrl, None)
+                return
+
+    def warmup(self) -> None:
+        pass  # the worker warms before it reports ready
+
+    def load_params(self, source, canary_batches: int,
+                    min_agreement: float) -> Future:
+        """Arm a canaried rollover IN the worker; the returned future
+        resolves with the report (a parent-side waiter polls — the
+        canary concludes on the worker's live dispatch traffic)."""
+        if not isinstance(source, (int, str)) or isinstance(source, bool):
+            raise RuntimeError(
+                'process-mode replicas roll over from checkpoint refs '
+                '(step int or model path), not param pytrees — pytrees '
+                'do not cross process (or host) boundaries')
+        self._control_call('load_params', source, canary_batches,
+                           min_agreement)
+        handle: Future = Future()
+
+        def wait() -> None:
+            try:
+                while True:
+                    report = self._control_call('poll_rollover')
+                    if report is not None:
+                        _resolve(handle, report)
+                        return
+                    time.sleep(0.05)
+            except BaseException as exc:
+                if not handle.done():
+                    handle.set_exception(exc)
+
+        threading.Thread(target=wait, daemon=True,
+                         name='mesh-canary-%s' % self.rid).start()
+        return handle
+
+    def adopt(self, params, source, step: Optional[int]) -> None:
+        # cross-process fleet swap ships the checkpoint REF: the worker
+        # restores it against its own abstract targets (canary already
+        # validated the content on live traffic; canary_batches=0 swaps
+        # without re-canarying)
+        del params  # unused: pytrees do not cross the process wire
+        self._control_call('load_params', source, 0, 0.0)
+        while self._control_call('poll_rollover') is None:
+            time.sleep(0.02)
+
+    def stats(self) -> Dict[str, object]:
+        return self._control_call('stats')
+
+    def close(self) -> None:
+        if self._receiver is None:
+            # never became ready (a sibling's startup failed): nothing
+            # to hand-shake with — just reap the worker
+            self._proc.terminate()
+            self._proc.join(timeout=30.0)
+            self._conn.close()
+            return
+        try:
+            self._control_call('close', timeout=60.0)
+        except BaseException:
+            pass  # a dead worker's pipe refuses the handshake: reap it
+        if self._receiver is not threading.current_thread():
+            # the worker-dead path closes from the receiver itself
+            self._receiver.join(timeout=30.0)
+        self._proc.join(timeout=60.0)
+        if self._proc.is_alive():
+            self._proc.terminate()
+        self._conn.close()
+
+
+def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
+                         conn) -> None:
+    """Process-replica worker entry point (spawned): build the model
+    from the shipped config, host one external-dispatch engine, serve
+    the pipe."""
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    try:
+        config = Config(**config_overrides)
+        model = Code2VecModel(config)
+        engine = ServingEngine(
+            config, model.trainer, model.params, model.vocabs,
+            decode_table=model._target_index_to_word,
+            tiers=config.serving_warm_tiers,
+            param_source=model._serving_param_source(),
+            replica_id=rid, external_dispatch=True, log=config.log)
+        engine.warmup()
+    except BaseException as exc:
+        # the parent must learn WHY this replica died, not just see an
+        # EOF on the wire (a missing retained step, a model-build
+        # failure, ...)
+        try:
+            conn.send(('failed', repr(exc)))
+        except BaseException:
+            pass
+        raise
+    rollover: Dict[str, object] = {'handle': None}
+    conn.send(('ready', None))
+    try:
+        while True:
+            msg = conn.recv()
+            kind, seq = msg[0], msg[1]
+            try:
+                if kind == 'dispatch':
+                    tier, batches = msg[2], msg[3]
+                    requests = [_Request(batch, tier, future=Future())
+                                for batch in batches]
+                    rows = sum(request.rows for request in requests)
+                    engine.dispatch_external(tier, requests, rows)
+                    results = [request.future.result(timeout=600)
+                               for request in requests]
+                    conn.send(('result', seq, results))
+                elif kind == 'load_params':
+                    source, n_canary, floor = msg[2], msg[3], msg[4]
+                    rollover['handle'] = engine.load_params(
+                        source, canary_batches=n_canary,
+                        min_agreement=floor)
+                    conn.send(('result', seq, True))
+                elif kind == 'poll_rollover':
+                    handle = rollover['handle']
+                    if handle is not None and handle.done():
+                        rollover['handle'] = None
+                        conn.send(('result', seq, handle.result()))
+                    else:
+                        conn.send(('result', seq, None))
+                elif kind == 'stats':
+                    conn.send(('result', seq, engine.stats()))
+                elif kind == 'close':
+                    engine.close()
+                    conn.send(('closed', seq))
+                    return
+                else:
+                    raise RuntimeError('unknown mesh wire message %r'
+                                       % (kind,))
+            except BaseException as exc:
+                try:
+                    conn.send(('error', seq, exc))
+                except BaseException:
+                    conn.send(('error', seq,
+                               RuntimeError(repr(exc))))
+    finally:
+        engine.close()
+
+
+# ----------------------------------------------------------------- mesh
+class ServingMesh:
+    """N serving replicas, one shared front queue.  Build via
+    ``Code2VecModel.serving_mesh()``; the API mirrors the single
+    engine's (``submit`` / ``predict`` / ``submit_neighbors`` /
+    ``load_params`` / ``follow_checkpoints`` / ``close``)."""
+
+    # the replica table, fleet service window, rollover slot and close
+    # flags are shared by submitters, N pullers, decode-completion
+    # hooks, and control calls (lock-discipline rule, ANALYSIS.md);
+    # _cond wraps _lock:
+    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s by _lock|_cond
+    def __init__(self, model, replicas: Optional[int] = None,
+                 tiers: Optional[Sequence[str]] = None,
+                 mode: Optional[str] = None,
+                 max_delay_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None,
+                 queue_bound: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_secs: Optional[float] = None,
+                 canary_batches: Optional[int] = None,
+                 canary_agreement: Optional[float] = None,
+                 params_step: Optional[int] = None,
+                 tracer: Optional[tracing_lib.Tracer] = None,
+                 tracing_sample_rate: Optional[float] = None,
+                 log=None):
+        config = model.config
+        self.config = config
+        self.log = log if log is not None else config.log
+        n = int(replicas if replicas is not None else config.MESH_REPLICAS)
+        if n < 1:
+            raise ValueError('a mesh needs >= 1 replica, got %d' % n)
+        self.mode = mode if mode is not None else config.MESH_REPLICA_MODE
+        if self.mode not in ('thread', 'process'):
+            raise ValueError("MESH_REPLICA_MODE must be 'thread' or "
+                             "'process', got %r" % (self.mode,))
+        tiers = tuple(tiers if tiers is not None
+                      else config.serving_warm_tiers)
+        for tier in tiers:
+            if tier not in PREDICT_TIERS:
+                raise ValueError('unknown tier %r; expected a subset of '
+                                 '%s' % (tier, PREDICT_TIERS))
+        self.tiers = tiers
+        self.max_delay_s = (max_delay_ms if max_delay_ms is not None
+                            else config.SERVING_MAX_DELAY_MS) / 1e3
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else config.SERVING_DEADLINE_MS)
+        self.deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
+        self.max_inflight = max(1, int(
+            max_inflight if max_inflight is not None
+            else config.MESH_MAX_INFLIGHT))
+        self.breaker_threshold = max(1, int(
+            breaker_threshold if breaker_threshold is not None
+            else config.MESH_BREAKER_THRESHOLD))
+        self.breaker_cooldown_s = float(
+            breaker_cooldown_secs if breaker_cooldown_secs is not None
+            else config.MESH_BREAKER_COOLDOWN_SECS)
+        self.canary_batches = (canary_batches
+                               if canary_batches is not None
+                               else config.SERVING_CANARY_BATCHES)
+        self.canary_agreement = (canary_agreement
+                                 if canary_agreement is not None
+                                 else config.SERVING_CANARY_AGREEMENT)
+        # submit-side tokenizer + ladder geometry (identical to every
+        # replica's: same config, same mesh data axis — which is what
+        # makes admitted results bit-identical to a single engine's)
+        self._reader = PathContextReader(model.vocabs, config,
+                                         EstimatorAction.Predict)
+        self.data_axis = model.mesh.shape[mesh_lib.DATA_AXIS]
+        self.buckets = engine_lib.batch_ladder(
+            config.serving_batch_buckets, self.data_axis)
+        bound = (queue_bound if queue_bound is not None
+                 else config.MESH_QUEUE_BOUND)
+        # auto bound scales WITH the fleet: every replica adds its share
+        # of absorbable backlog
+        self.queue_bound: Optional[int] = (
+            None if bound < 0 else
+            n * 8 * self.buckets[-1] if bound == 0 else bound)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._drain = False
+        self._rollover: Optional[Dict[str, object]] = None
+        self._rows_total = 0
+        # fleet service window: same estimator the engine runs, fed by
+        # EVERY replica's completions — the fleet-wide drain rate
+        self._service_rows_per_s = 0.0
+        self._service_window: collections.deque = collections.deque()
+        self._service_window_rows = 0
+        if params_step is not None:
+            self._params_step: Optional[int] = params_step
+        elif model.state is not None:
+            self._params_step = int(model.state.step)
+        else:
+            self._params_step = None
+        self._param_source = model._serving_param_source()
+        self._follow_thread: Optional[threading.Thread] = None
+        self._follow_stop = threading.Event()
+        # instruments (mesh-level; per-replica series ride the engines'
+        # replica-labeled mirrors)
+        self.requests_total = Counter('mesh/requests_total')
+        self.rollover_total = Counter('mesh/rollover_total')
+        self.rollover_rollbacks_total = Counter(
+            'mesh/rollover_rollbacks_total')
+        self.breaker_open_total = Counter(
+            'mesh/replica_breaker_open_total')
+        self.replicas_gauge = Gauge('mesh/replicas')
+        self.serving_gauge = Gauge('mesh/replicas_serving')
+        # tracing: ONE tracer shared with every thread-mode replica, so
+        # the flight recorder and span log see the whole fleet
+        rate = (tracing_sample_rate if tracing_sample_rate is not None
+                else config.tracing_sample_rate)
+        # same ownership rule as the engine: an injected tracer is the
+        # caller's to close
+        self._owns_tracer = tracer is None
+        if tracer is not None:
+            self._tracer: Optional[tracing_lib.Tracer] = tracer
+        elif rate > 0:
+            out_dir = None
+            if getattr(config, 'TELEMETRY_DIR', None) or \
+                    config.is_saving or config.is_loading:
+                from code2vec_tpu.telemetry.stepwatch import telemetry_dir
+                out_dir = telemetry_dir(config)
+            self._tracer = tracing_lib.Tracer(
+                out_dir, sample_rate=rate,
+                slow_ms=config.TRACING_SLOW_MS,
+                flight_traces=config.TRACING_FLIGHT_TRACES,
+                log=self.log)
+        else:
+            self._tracer = None
+        self._queue = FrontQueue(tiers, self.queue_bound,
+                                 fleet_rate=self._fleet_rate,
+                                 log=self.log)
+        self._index = None
+        self._aux_pool = ThreadPoolExecutor(max_workers=2,
+                                            thread_name_prefix='mesh-aux')
+        # ---- replica table ----
+        self._replicas: List[_ReplicaSlot] = []
+        try:
+            for i in range(n):
+                rid = 'r%d' % i
+                if self.mode == 'thread':
+                    engine = ServingEngine(
+                        config, model.trainer, model.params, model.vocabs,
+                        decode_table=model._target_index_to_word,
+                        tiers=tiers,
+                        deadline_ms=0.0, queue_bound=-1,
+                        canary_batches=self.canary_batches,
+                        canary_agreement=self.canary_agreement,
+                        param_source=self._param_source,
+                        params_step=self._params_step,
+                        tracer=self._tracer,
+                        tracing_sample_rate=(0.0 if self._tracer is None
+                                             else None),
+                        replica_id=rid, external_dispatch=True,
+                        on_batch_done=self._on_batch_done,
+                        log=self.log)
+                    transport = _ThreadReplica(engine)
+                else:
+                    transport = _ProcessReplica(
+                        rid, self._process_config_overrides(model),
+                        on_batch_done=self._on_process_batch_done,
+                        on_worker_dead=self._on_worker_dead,
+                        log=self.log)
+                self._replicas.append(_ReplicaSlot(rid, transport))
+            for slot in self._replicas:
+                # process workers spawned above cold-start in parallel;
+                # this pass just collects their 'ready' handshakes
+                slot.transport.wait_ready()
+        except BaseException:
+            self._queue.close()
+            for slot in self._replicas:
+                try:
+                    slot.transport.close()
+                except BaseException:
+                    pass
+            self._aux_pool.shutdown(wait=False)
+            raise
+        self.replicas_gauge.set(n)
+        if tele_core.enabled():
+            tele_core.registry().gauge('mesh/replicas').set(n)
+        self._set_serving_gauge_locked_free()
+        for slot in self._replicas:
+            slot.thread = threading.Thread(
+                target=self._pull_loop, args=(slot,), daemon=True,
+                name='mesh-pull-%s' % slot.rid)
+            slot.thread.start()
+
+    # ------------------------------------------------- process plumbing
+    def _process_config_overrides(self, model) -> Dict[str, object]:
+        """The config a process replica rebuilds its model from: the
+        parent's fields, pointed at the parent's checkpoint path
+        (pytrees don't cross processes; params come from the store)."""
+        import dataclasses
+        config = model.config
+        load_path = (config.MODEL_LOAD_PATH if config.is_loading
+                     else config.MODEL_SAVE_PATH
+                     if config.is_saving else None)
+        if load_path is None:
+            raise RuntimeError(
+                "MESH_REPLICA_MODE='process' needs a checkpointed model "
+                '(a --save or --load path with at least one retained '
+                'step): worker processes restore params from the store, '
+                'they cannot share the parent\'s arrays')
+        overrides = {}
+        for field in dataclasses.fields(type(config)):
+            value = getattr(config, field.name, None)
+            if isinstance(value, (bool, int, float, str, type(None))):
+                overrides[field.name] = value
+        overrides['MODEL_LOAD_PATH'] = load_path
+        overrides['MODEL_SAVE_PATH'] = ''
+        overrides['TRAIN_DATA_PATH_PREFIX'] = ''
+        overrides['SERVE_FOLLOW_CHECKPOINTS_SECS'] = 0.0
+        # the worker warms the MESH's resolved tiers, not whatever the
+        # parent's SERVING_WARM_TIERS default says — a tier the caller
+        # added (submit_neighbors' 'vectors') must be warm in every
+        # replica, or its first dispatch compiles on the serving path
+        overrides['SERVING_WARM_TIERS'] = ','.join(self.tiers)
+        return overrides
+
+    # ----------------------------------------------------- fleet rate
+    def _fleet_rate(self) -> float:
+        with self._lock:
+            return self._service_rows_per_s
+
+    def _note_service_locked(self, rows: int,
+                             taken: List[_Request]) -> None:
+        """The engine's windowed throughput estimator
+        (engine.note_service_window), fed by EVERY replica's
+        completions: the window sum over its span IS the fleet-wide
+        served-rows/s the shared admission divides deadlines by."""
+        oldest = (min(request.t_enqueue for request in taken)
+                  if taken else None)
+        self._service_window_rows, self._service_rows_per_s = \
+            engine_lib.note_service_window(
+                self._service_window, self._service_window_rows,
+                self._service_rows_per_s, rows, oldest)
+
+    # ------------------------------------------------ replica weighting
+    def _slot_cap_locked(self, slot: _ReplicaSlot) -> int:
+        """In-flight window of one replica — the dispatch weight.  A
+        canarying replica is halved (still pulling: the canary needs
+        live traffic), a half-open breaker probes ONE batch."""
+        if slot.breaker_state == _BREAKER_HALF_OPEN:
+            return 1
+        if slot.canarying:
+            return max(1, self.max_inflight // 2)
+        return self.max_inflight
+
+    def _slot_ready_locked(self, slot: _ReplicaSlot) -> str:
+        """'ready' | 'wait' | 'exit' for one puller iteration."""
+        if slot.retired:
+            return 'exit'
+        if self._closed and not self._drain:
+            return 'exit'
+        if slot.breaker_state == _BREAKER_OPEN:
+            if time.perf_counter() >= slot.breaker_open_until:
+                slot.breaker_state = _BREAKER_HALF_OPEN
+                self.log('mesh: replica %s breaker half-open (probing '
+                         'one batch)' % slot.rid)
+            else:
+                return 'wait'
+        if slot.inflight >= self._slot_cap_locked(slot):
+            return 'wait'
+        return 'ready'
+
+    def _slot_alive(self, slot: _ReplicaSlot) -> bool:
+        """The queue-side claim check a puller passes to
+        ``pop_coalesced``: a replica that retired or tripped its breaker
+        while waiting must leave WITHOUT taking work."""
+        with self._lock:
+            return not (slot.retired
+                        or slot.breaker_state == _BREAKER_OPEN
+                        or (self._closed and not self._drain))
+
+    def _set_serving_gauge_locked_free(self) -> None:
+        # reads immutable-ish counts outside the lock on purpose: the
+        # gauge is advisory, and both call paths immediately follow a
+        # locked mutation
+        serving = sum(1 for slot in self._replicas
+                      if not slot.retired
+                      and slot.breaker_state != _BREAKER_OPEN)
+        self.serving_gauge.set(serving)
+        if tele_core.enabled():
+            tele_core.registry().gauge(
+                'mesh/replicas_serving').set(serving)
+
+    # -------------------------------------------------------- pull loop
+    def _pull_loop(self, slot: _ReplicaSlot) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    state = self._slot_ready_locked(slot)
+                    if state == 'exit':
+                        return
+                    if state == 'ready':
+                        break
+                    # bounded wait: breaker cooldowns expire on the
+                    # clock, not on a notification
+                    self._cond.wait(0.05)
+            popped = self._queue.pop_coalesced(
+                self.buckets[-1], self.max_delay_s,
+                alive=lambda: self._slot_alive(slot))
+            if popped is None:
+                # depth read BEFORE taking the mesh lock: pop_coalesced
+                # holds the queue lock while it calls back into the
+                # mesh's alive() (queue->mesh order), so the mesh lock
+                # must never wait on the queue lock (AB-BA deadlock); a
+                # stale depth just loops once more
+                depth = self._queue.depth_rows()
+                with self._lock:
+                    if slot.retired or (self._closed and not self._drain):
+                        return
+                    if self._closed and depth == 0:
+                        return
+                continue
+            tier, taken, rows, expired = popped
+            for request in expired:
+                request.fail(DeadlineExceeded(
+                    'request expired after %.0fms in the mesh queue '
+                    '(SLO deadline %.0fms)'
+                    % (1e3 * (time.perf_counter() - request.t_enqueue),
+                       1e3 * (request.t_deadline - request.t_enqueue))))
+            if not taken:
+                continue  # a sibling drained the tier during coalesce
+            with self._cond:
+                slot.inflight += 1
+                probing = slot.breaker_state == _BREAKER_HALF_OPEN
+            try:
+                slot.transport.dispatch(tier, taken, rows)
+            except BaseException as exc:
+                # dispatch_external already failed the member requests
+                # typed; here the BREAKER accounts the replica failure
+                self._dispatch_failed(slot, rows, probing, exc)
+                continue
+            if self.mode == 'process':
+                continue  # completion arrives via the receiver thread
+            # thread transport: the engine's decode worker fires
+            # _on_batch_done; nothing more to do here
+
+    def _dispatch_failed(self, slot: _ReplicaSlot, rows: int,
+                         probing: bool, exc: BaseException) -> None:
+        del rows, probing
+        with self._cond:
+            slot.inflight -= 1
+            self._breaker_failure_locked(slot)
+            self._cond.notify_all()
+        self._queue.kick()
+        self.log('mesh: replica %s dispatch failed (%s): %d consecutive'
+                 % (slot.rid, exc, slot.breaker_fails))
+
+    def _breaker_failure_locked(self, slot: _ReplicaSlot) -> None:
+        slot.breaker_fails += 1
+        if slot.breaker_state == _BREAKER_HALF_OPEN or \
+                slot.breaker_fails >= self.breaker_threshold:
+            if slot.breaker_state != _BREAKER_OPEN:
+                self.breaker_open_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'mesh/replica_breaker_open_total').inc()
+                self.log('mesh: replica %s dispatch breaker OPEN for '
+                         '%.0fs (%d consecutive failures); queue '
+                         'redirects to the remaining replicas'
+                         % (slot.rid, self.breaker_cooldown_s,
+                            slot.breaker_fails))
+            slot.breaker_state = _BREAKER_OPEN
+            slot.breaker_open_until = (time.perf_counter()
+                                       + self.breaker_cooldown_s)
+        self._set_serving_gauge_locked_free()
+
+    def _on_batch_done(self, engine, rows: int, taken: List[_Request],
+                       ok: bool) -> None:
+        """Thread-mode completion hook (runs on the replica engine's
+        decode worker)."""
+        slot = next(s for s in self._replicas
+                    if isinstance(s.transport, _ThreadReplica)
+                    and s.transport.engine is engine)
+        self._complete(slot, rows, taken, ok)
+
+    def _on_process_batch_done(self, transport, rows: int,
+                               taken: List[_Request], ok: bool) -> None:
+        slot = next(s for s in self._replicas
+                    if s.transport is transport)
+        self._complete(slot, rows, taken, ok)
+
+    def _on_worker_dead(self, transport) -> None:
+        """A process replica's worker exited (EOF on the wire): it can
+        never serve again, so retire the slot — otherwise the breaker's
+        half-open probe would sacrifice one real micro-batch every
+        cooldown, forever, to a corpse."""
+        with self._cond:
+            slot = next((s for s in self._replicas
+                         if s.transport is transport), None)
+            if slot is None or slot.retired:
+                return
+            slot.retired = True
+            self._cond.notify_all()
+        self._set_serving_gauge_locked_free()
+        self._queue.kick()
+        self.log('mesh: replica %s worker died; replica retired '
+                 '(queue redirects to the remaining replicas)'
+                 % slot.rid)
+        try:
+            transport.close()  # reap the corpse (skips the dead pipe)
+        except Exception:
+            pass
+
+    def _complete(self, slot: _ReplicaSlot, rows: int,
+                  taken: List[_Request], ok: bool) -> None:
+        with self._cond:
+            slot.inflight -= 1
+            if ok:
+                slot.breaker_fails = 0
+                if slot.breaker_state != _BREAKER_CLOSED:
+                    slot.breaker_state = _BREAKER_CLOSED
+                    self.log('mesh: replica %s breaker closed (probe '
+                             'succeeded)' % slot.rid)
+                    self._set_serving_gauge_locked_free()
+                slot.rows_dispatched += rows
+                slot.batches += 1
+                self._rows_total += rows
+                self._note_service_locked(rows, taken)
+                if tele_core.enabled() and self._rows_total > 0:
+                    # per-replica dispatch share: replica-labeled series
+                    # under one catalog family
+                    from code2vec_tpu.telemetry import catalog
+                    tele_core.registry().gauge(catalog.labeled(
+                        'mesh/dispatch_share', 'replica',
+                        slot.rid)).set(
+                            slot.rows_dispatched / self._rows_total)
+            else:
+                self._breaker_failure_locked(slot)
+            self._cond.notify_all()
+        self._queue.kick()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, context_lines: Sequence[str], tier: str = 'topk',
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one prediction request on the SHARED front queue;
+        whichever free replica claims it serves it.  Same contract as
+        ``ServingEngine.submit`` (typed sheds, oversize split, Future
+        of one result per line)."""
+        if tier not in self.tiers:
+            raise ValueError('tier %r is not warmed on this mesh '
+                             '(tiers=%s)' % (tier, list(self.tiers)))
+        # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked inside FrontQueue.enqueue
+        if self._closed:
+            raise EngineClosed('ServingMesh is closed')
+        lines = list(context_lines)
+        future: Future = Future()
+        if not lines:
+            future.set_result([])
+            return future
+        n = len(lines)
+        if deadline_ms is None:
+            deadline_s = self.deadline_s
+        else:
+            deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
+        self.requests_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter('mesh/requests_total').inc()
+        trace = None
+        if self._tracer is not None:
+            trace = self._tracer.begin(
+                'serving.request',
+                attrs={'tier': tier, 'rows': n, 'mesh': True,
+                       'deadline_ms': (1e3 * deadline_s
+                                       if deadline_s else None)})
+        requested_tier = tier
+        t_admit0 = time.perf_counter()
+        try:
+            tier = self._queue.admit(n, tier, deadline_s)
+        except EngineOverloaded as exc:
+            if trace is not None:
+                trace.event('serving.shed', attrs={'reason': str(exc)})
+                trace.finish(status='shed')
+                self._tracer.note_shed()
+            raise
+        except EngineClosed as exc:
+            if trace is not None:
+                trace.event('serving.closed', attrs={'reason': str(exc)})
+                trace.finish(status='closed')
+            raise
+        t_admit1 = time.perf_counter()
+        if trace is not None:
+            trace.span_at('serving.admission', t_admit0, t_admit1)
+            if tier != requested_tier:
+                trace.event('serving.degraded',
+                            attrs={'requested': requested_tier,
+                                   'effective': tier})
+        try:
+            requests = engine_lib.tokenize_and_chunk(
+                self._reader, lines, tier, future, deadline_s, trace,
+                t_admit1, self.buckets[-1])
+        except BaseException as exc:
+            self._queue.release_reservation(n)
+            if trace is not None:
+                trace.finish(status='error', reason=repr(exc))
+            raise
+        for request in requests:
+            if request.trace is not None:
+                request.queue_span = request.trace.span(
+                    'serving.queue_wait', parent=request.span_parent,
+                    t0=request.t_enqueue)
+        try:
+            self._queue.enqueue(tier, requests, n)
+        except EngineClosed:
+            if trace is not None:
+                trace.event('serving.closed',
+                            attrs={'reason': 'ServingMesh is closed'})
+                trace.finish(status='closed')
+            raise
+        return future
+
+    def predict(self, context_lines: Sequence[str], tier: str = 'topk',
+                timeout: Optional[float] = None) -> list:
+        """Synchronous ``submit().result()`` convenience."""
+        return self.submit(context_lines, tier).result(timeout)
+
+    # -------------------------------------------------------- neighbors
+    def attach_index(self, index) -> 'ServingMesh':
+        """Arm ``submit_neighbors``: neighbor queries ride the shared
+        dispatch stream's 'vectors' tier, then the attached index (one
+        index serves the whole fleet — it is device-resident once)."""
+        if 'vectors' not in self.tiers:
+            raise ValueError(
+                "submit_neighbors needs the 'vectors' tier warmed on "
+                'this mesh (tiers=%s)' % list(self.tiers))
+        self._index = index
+        return self
+
+    def submit_neighbors(self, context_or_vectors,
+                         k: Optional[int] = None) -> Future:
+        """Mesh analogue of ``ServingEngine.submit_neighbors``: context
+        lines ride the micro-batched 'vectors' tier ACROSS the fleet,
+        the resulting code vectors feed the shared index."""
+        index = self._index
+        if index is None:
+            raise RuntimeError('no index attached — call '
+                               'attach_index(load_index(...)) first')
+        k = k if k is not None else self.config.INDEX_NEIGHBORS_K
+        from code2vec_tpu.index.service import neighbors_from_search
+        outer: Future = Future()
+        if isinstance(context_or_vectors, np.ndarray):
+            vectors = np.atleast_2d(context_or_vectors)
+
+            def lookup():
+                try:
+                    values, indices = index.search(vectors, k)
+                    _resolve(outer, neighbors_from_search(
+                        values, indices, index.labels))
+                except BaseException as exc:
+                    if not outer.done():
+                        outer.set_exception(exc)
+            self._aux_pool.submit(lookup)
+            return outer
+        inner = self.submit(context_or_vectors, tier='vectors')
+
+        def chain(done: Future) -> None:
+            try:
+                results = done.result()
+                if not results:
+                    _resolve(outer, [])
+                    return
+                vectors = np.stack([r.code_vector for r in results])
+                values, indices = index.search(vectors, k)
+                _resolve(outer, neighbors_from_search(
+                    values, indices, index.labels))
+            except BaseException as exc:
+                if not outer.done():
+                    outer.set_exception(exc)
+        inner.add_done_callback(chain)
+        return outer
+
+    # --------------------------------------------------------- rollover
+    def load_params(self, source, canary_batches: Optional[int] = None,
+                    min_agreement: Optional[float] = None) -> Future:
+        """Coordinated fleet rollover: canary on ONE replica (the
+        engine's shadow-scoring machinery — zero new compiles), then on
+        agreement fleet-swap the validated params onto every other
+        replica atomically; on disagreement roll the canary back and
+        leave EVERY replica serving the old params.  Returns a Future
+        of the fleet report."""
+        n_canary = (canary_batches if canary_batches is not None
+                    else self.canary_batches)
+        floor = (min_agreement if min_agreement is not None
+                 else self.canary_agreement)
+        handle: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise EngineClosed('ServingMesh is closed')
+            if self._rollover is not None:
+                raise RuntimeError(
+                    'a fleet rollover is already in flight (replica %s); '
+                    'await its handle first'
+                    % self._rollover['replica'].rid)
+            canary_slot = next(
+                (slot for slot in self._replicas
+                 if not slot.retired
+                 and slot.breaker_state != _BREAKER_OPEN), None)
+            if canary_slot is None:
+                raise RuntimeError('no serving replica available to '
+                                   'canary the rollover on')
+            self._rollover = {'replica': canary_slot, 'handle': handle}
+            canary_slot.canarying = True
+        step = source if isinstance(source, int) and \
+            not isinstance(source, bool) else None
+        try:
+            canary_handle = canary_slot.transport.load_params(
+                source, n_canary, floor)
+        except BaseException:
+            with self._cond:
+                self._rollover = None
+                canary_slot.canarying = False
+            raise
+        self.log('mesh: rollover armed — canarying on replica %s '
+                 '(%d batches, agreement floor %.2f)'
+                 % (canary_slot.rid, n_canary, floor))
+
+        def conclude(done: Future) -> None:
+            swapped = 0
+            try:
+                report = done.result()
+            except BaseException as exc:
+                self._finish_rollover(canary_slot)
+                if not handle.done():
+                    handle.set_exception(exc)
+                return
+            if report.get('swapped'):
+                resolved_step = (report.get('step')
+                                 if report.get('step') is not None
+                                 else step)
+                params = getattr(
+                    getattr(canary_slot.transport, 'engine', None),
+                    'params', None)
+                try:
+                    for slot in self._replicas:
+                        if slot is canary_slot or slot.retired:
+                            continue
+                        slot.transport.adopt(params, source,
+                                             resolved_step)
+                        swapped += 1
+                except BaseException as exc:
+                    # a sibling failed its adopt mid-fleet-swap (its
+                    # worker died, its engine closed): the rollover
+                    # machinery must still CONCLUDE — a swallowed
+                    # done-callback exception would leave _rollover set
+                    # forever, wedging every later load_params and the
+                    # follow poller.  The canary (and any sibling that
+                    # already adopted) serves the new params; the
+                    # failed sibling is the breaker/retirement path's
+                    # problem; the caller sees the partial swap typed.
+                    self._finish_rollover(canary_slot)
+                    self.log('mesh: fleet swap FAILED on a sibling '
+                             'after the canary passed (%r); %d of %d '
+                             'siblings adopted'
+                             % (exc, swapped,
+                                sum(1 for s in self._replicas
+                                    if s is not canary_slot
+                                    and not s.retired)))
+                    if not handle.done():
+                        handle.set_exception(exc)
+                    return
+                with self._cond:
+                    self._params_step = (resolved_step
+                                         if resolved_step is not None
+                                         else self._params_step)
+                self.rollover_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'mesh/rollover_total').inc()
+                self.log('mesh: fleet rollover SWAPPED (step %s): '
+                         'canary agreement %.3f on replica %s, %d '
+                         'sibling(s) adopted'
+                         % (resolved_step, report.get('agreement') or 0,
+                            canary_slot.rid, swapped))
+            else:
+                self.rollover_rollbacks_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'mesh/rollover_rollbacks_total').inc()
+                if self._tracer is not None:
+                    self._tracer.dump_flight('rollover_rollback')
+                self.log('mesh: fleet rollover ROLLED BACK on the '
+                         'canary replica %s (%s); every replica keeps '
+                         'the old params'
+                         % (canary_slot.rid, report.get('reason')))
+            self._finish_rollover(canary_slot)
+            fleet_report = dict(report)
+            fleet_report['canary_replica'] = canary_slot.rid
+            fleet_report['replicas_swapped'] = (
+                swapped + 1 if report.get('swapped') else 0)
+            _resolve(handle, fleet_report)
+
+        canary_handle.add_done_callback(conclude)
+        return handle
+
+    def _finish_rollover(self, canary_slot: _ReplicaSlot) -> None:
+        with self._cond:
+            canary_slot.canarying = False
+            self._rollover = None
+            self._cond.notify_all()
+        self._queue.kick()
+
+    def follow_checkpoints(self, poll_secs: Optional[float] = None
+                           ) -> 'ServingMesh':
+        """Fleet-level ``--serve-follow-checkpoints``: ONE poller rolls
+        newer retained steps through the coordinated canary, so the
+        fleet moves as a unit instead of N pollers racing."""
+        if self._param_source is None:
+            raise RuntimeError('follow_checkpoints needs a checkpointed '
+                               'model (build the mesh via '
+                               'model.serving_mesh())')
+        poll = (poll_secs if poll_secs is not None
+                else self.config.SERVE_FOLLOW_CHECKPOINTS_SECS)
+        if poll <= 0:
+            raise ValueError('follow_checkpoints needs poll_secs > 0 '
+                             '(got %r)' % poll)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed('ServingMesh is closed')
+            if self._follow_thread is not None:
+                return self
+            self._follow_thread = threading.Thread(
+                target=self._follow_loop, args=(poll,), daemon=True,
+                name='mesh-follow')
+            self._follow_thread.start()
+        return self
+
+    def _follow_loop(self, poll_secs: float) -> None:
+        attempted: Optional[int] = None
+        while not self._follow_stop.wait(poll_secs):
+            try:
+                newest = self._param_source.newest_step()
+                with self._cond:
+                    if self._closed:
+                        return
+                    busy = self._rollover is not None
+                    current = self._params_step
+                if newest is None or busy:
+                    continue
+                if attempted is not None and newest <= attempted:
+                    continue  # don't hot-loop a rolled-back step
+                if current is not None and newest <= current:
+                    continue
+                self.log('mesh: follow-checkpoints found step %d; '
+                         'starting coordinated rollover' % newest)
+                self.load_params(newest)
+                attempted = newest
+            except EngineClosed:
+                return
+            except Exception as exc:  # poller must survive blips
+                self.log('mesh: follow-checkpoints poll failed: %s'
+                         % exc)
+
+    # -------------------------------------------------------- lifecycle
+    def warmup(self) -> 'ServingMesh':
+        """Warm every replica's (bucket x capacity x tier) ladder.
+        Thread-mode replicas share the trainer's jit caches, so replica
+        2..N warm at cache-hit speed; the fleet compiles each program
+        once."""
+        for slot in self._replicas:
+            slot.transport.warmup()
+        return self
+
+    def retire(self, replica_id: str, timeout: float = 120.0) -> None:
+        """Drain one replica out of the fleet: it stops pulling, its
+        in-flight batches deliver, its engine closes; the shared queue
+        redirects to the remaining replicas throughout."""
+        with self._cond:
+            slot = next((s for s in self._replicas
+                         if s.rid == replica_id), None)
+            if slot is None:
+                raise ValueError('no replica %r in this mesh (%s)'
+                                 % (replica_id,
+                                    [s.rid for s in self._replicas]))
+            if slot.retired:
+                return
+            slot.retired = True
+            self._cond.notify_all()
+        self._queue.kick()
+        if slot.thread is not None:
+            slot.thread.join(timeout)
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while slot.inflight > 0:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.1))
+        slot.transport.close()
+        self._set_serving_gauge_locked_free()
+        self.log('mesh: replica %s retired (served %d rows in %d '
+                 'batches)' % (slot.rid, slot.rows_dispatched,
+                               slot.batches))
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            rows_total = self._rows_total
+            replicas = [{
+                'replica': slot.rid,
+                'retired': slot.retired,
+                'breaker_state': slot.breaker_state,
+                'inflight': slot.inflight,
+                'batches': slot.batches,
+                'rows_dispatched': slot.rows_dispatched,
+                'dispatch_share': (slot.rows_dispatched / rows_total
+                                   if rows_total else 0.0),
+            } for slot in self._replicas]
+            params_step = self._params_step
+            fleet_rate = self._service_rows_per_s
+        out = {
+            'replicas': replicas,
+            'mode': self.mode,
+            'requests_total': self.requests_total.snapshot(),
+            'rows_dispatched': rows_total,
+            'fleet_rows_per_s': fleet_rate,
+            'params_step': params_step,
+            'rollover_total': self.rollover_total.snapshot(),
+            'rollover_rollbacks_total':
+                self.rollover_rollbacks_total.snapshot(),
+            'replica_breaker_open_total':
+                self.breaker_open_total.snapshot(),
+            'tracing': (self._tracer.stats()
+                        if self._tracer is not None else None),
+        }
+        out.update(self._queue.stats())
+        return out
+
+    def replica_stats(self) -> List[Dict[str, object]]:
+        """Per-replica engine stats (fill rate, latency timers, ...) —
+        the per-replica device-fill column of bench_mesh.py."""
+        return [slot.transport.stats() for slot in self._replicas]
+
+    def close(self, drain: bool = False) -> None:
+        """Stop the fleet.  Fail-fast (default): still-queued requests
+        fail typed ``EngineClosed``; in-flight micro-batches deliver.
+        ``drain=True`` serves everything admitted first.  Idempotent."""
+        with self._cond:
+            already = self._closed
+            if not already:
+                self._closed = True
+                self._drain = drain
+            rollover = self._rollover
+            self._rollover = None
+            self._cond.notify_all()
+        self._follow_stop.set()
+        self._queue.close(drain)
+        if not drain:
+            for request in self._queue.abandon():
+                request.fail(EngineClosed(
+                    'ServingMesh closed with the request still queued '
+                    '(close(drain=True) serves the queue first)'))
+        if rollover is not None:
+            handle = rollover['handle']
+            if isinstance(handle, Future) and not handle.done():
+                try:
+                    handle.set_exception(EngineClosed(
+                        'ServingMesh closed mid-rollover'))
+                except Exception:
+                    pass
+        follow = self._follow_thread
+        if follow is not None:
+            follow.join()
+        for slot in self._replicas:
+            if slot.thread is not None:
+                slot.thread.join()
+        for slot in self._replicas:
+            if not slot.retired:
+                slot.transport.close()
+        self._aux_pool.shutdown(wait=True)
+        if self._tracer is not None and self._owns_tracer:
+            self._tracer.close()
+
+    def __enter__(self) -> 'ServingMesh':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -16,10 +16,20 @@ Naming: ``<subsystem>/<metric>[_<unit>]``.  Units in names: ``_ms``
 (milliseconds), ``_s`` (seconds), ``_total`` (monotonic counts),
 ``_per_sec`` (rates).  Prometheus names are derived as
 ``code2vec_<name with / -> _>``.
+
+**Instance labels.** A metric emitted by one of N coexisting instances
+(serving-mesh replicas) carries a label suffix: ``serving/shed_total
+{replica=r1}`` (``labeled`` / ``label_suffix`` build it;
+``core.ScopedRegistry`` applies it transparently at the emission site).
+The CATALOG keys stay label-free — ``base_name`` strips the suffix, and
+the schema lint, the Prometheus exporter, and OBSERVABILITY.md all
+resolve a labeled series to its base entry (Prometheus renders the
+label natively: ``code2vec_serving_shed_total{replica="r1"}``).
 """
 from __future__ import annotations
 
-from typing import Dict
+import re
+from typing import Dict, Optional, Tuple
 
 COUNTER = 'counter'
 GAUGE = 'gauge'
@@ -143,6 +153,45 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'serving/extractor_retries_total': _m(COUNTER, 'retries', 'Extractor '
                                           'pool calls retried after a '
                                           'crash-class failure.'),
+    # ---- serving mesh (code2vec_tpu/serving/mesh.py, SERVING.md) ----
+    'mesh/requests_total': _m(COUNTER, 'requests', 'Requests submitted '
+                              'to the serving mesh front queue.'),
+    'mesh/queue_depth': _m(GAUGE, 'requests', 'Requests waiting in the '
+                           'shared mesh front queue (all tiers).'),
+    'mesh/queue_rows': _m(GAUGE, 'rows', 'Rows admitted to the shared '
+                          'front queue (the admission-bound basis).'),
+    'mesh/shed_total': _m(COUNTER, 'requests', 'Requests shed at mesh '
+                          'admission (all reasons).'),
+    'mesh/shed_bound_total': _m(COUNTER, 'requests', 'Mesh sheds caused '
+                                'by the shared queue bound.'),
+    'mesh/shed_deadline_total': _m(COUNTER, 'requests', 'Mesh sheds '
+                                   'caused by the fleet drain estimate '
+                                   'exceeding the request deadline.'),
+    'mesh/expired_total': _m(COUNTER, 'requests', 'Admitted mesh '
+                             'requests expired past their SLO deadline '
+                             'in the shared queue (never dispatched).'),
+    'mesh/degraded_total': _m(COUNTER, 'requests', 'Mesh requests '
+                              'admitted at a downgraded tier by the '
+                              'shared-queue overload ladder.'),
+    'mesh/replicas': _m(GAUGE, 'replicas', 'Replicas registered in the '
+                        'mesh replica table.'),
+    'mesh/replicas_serving': _m(GAUGE, 'replicas', 'Replicas currently '
+                                'weighted INTO dispatch (not breaker-'
+                                'open, not retired, not closed).'),
+    'mesh/dispatch_share': _m(GAUGE, 'fraction', 'Per-replica share of '
+                              'all rows the mesh has dispatched '
+                              '(replica-labeled series).'),
+    'mesh/replica_breaker_open_total': _m(COUNTER, 'trips', 'Replica '
+                                          'dispatch-breaker open '
+                                          'transitions (consecutive '
+                                          'dispatch failures).'),
+    'mesh/rollover_total': _m(COUNTER, 'rollovers', 'Coordinated fleet '
+                              'rollovers: canary passed on one replica, '
+                              'every replica swapped.'),
+    'mesh/rollover_rollbacks_total': _m(COUNTER, 'rollovers',
+                                        'Coordinated rollovers rolled '
+                                        'back by the canary replica '
+                                        '(fleet kept the old params).'),
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
                         'build.'),
@@ -247,6 +296,44 @@ CATALOG: Dict[str, Dict[str, str]] = {
 # the lint accepts either emission form for any cataloged name.
 
 
+#: instance-label suffix: one {key=value} trailer on a catalog name
+_LABEL_RE = re.compile(r'^(?P<base>[^{]+)\{(?P<key>\w+)=(?P<val>[^}]*)\}$')
+
+
+def label_suffix(key: str, value: str) -> str:
+    """The ``{key=value}`` trailer a labeled series appends to its
+    catalog name (``core.ScopedRegistry`` applies it)."""
+    return '{%s=%s}' % (key, value)
+
+
+def labeled(name: str, key: str, value: str) -> str:
+    """``('serving/shed_total', 'replica', 'r1')`` ->
+    ``'serving/shed_total{replica=r1}'``."""
+    return name + label_suffix(key, value)
+
+
+def base_name(name: str) -> str:
+    """Catalog key for a possibly-labeled metric name (the schema lint
+    and the exporters resolve labeled series through this)."""
+    match = _LABEL_RE.match(name)
+    return match.group('base') if match else name
+
+
+def split_label(name: str) -> Tuple[str, Optional[Tuple[str, str]]]:
+    """``'m{replica=r1}'`` -> ``('m', ('replica', 'r1'))``;
+    label-free names return ``(name, None)``."""
+    match = _LABEL_RE.match(name)
+    if match is None:
+        return name, None
+    return match.group('base'), (match.group('key'), match.group('val'))
+
+
 def prometheus_name(name: str) -> str:
-    """Catalog name -> Prometheus metric name."""
-    return 'code2vec_' + name.replace('/', '_').replace('.', '_')
+    """Catalog name -> Prometheus metric name (labels render as
+    Prometheus labels: ``m{replica=r1}`` ->
+    ``code2vec_m{replica="r1"}``)."""
+    base, label = split_label(name)
+    prom = 'code2vec_' + base.replace('/', '_').replace('.', '_')
+    if label is not None:
+        prom += '{%s="%s"}' % label
+    return prom

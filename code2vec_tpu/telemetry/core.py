@@ -23,8 +23,8 @@ import threading
 import time
 from typing import Deque, Dict, Iterator, Optional, Tuple
 
-__all__ = ['Counter', 'Gauge', 'Timer', 'Registry', 'registry', 'reset',
-           'enable', 'disable', 'enabled']
+__all__ = ['Counter', 'Gauge', 'Timer', 'Registry', 'ScopedRegistry',
+           'registry', 'reset', 'enable', 'disable', 'enabled']
 
 # Module-global enablement. One bool read is the entire disabled-path
 # cost at instrumented call sites.
@@ -236,6 +236,37 @@ class Registry:
         what it touches)."""
         with self._lock:
             self._instruments.clear()
+
+
+class ScopedRegistry:
+    """Registry view that stamps every metric name with an instance
+    label before it reaches the underlying registry: ``counter('serving/
+    shed_total')`` on a ``ScopedRegistry(reg, 'replica', 'r1')`` creates
+    ``serving/shed_total{replica=r1}``.
+
+    This is how N coexisting serving-engine replicas mirror their
+    instruments into the ONE process-global registry without
+    double-counting each other's counters or overwriting each other's
+    gauges (catalog.labeled / catalog.base_name define the name format;
+    the schema lint and the Prometheus exporter resolve labeled names
+    back to their catalog entry).  Stateless — safe to share across
+    threads like the registry it wraps."""
+
+    __slots__ = ('_registry', '_suffix')
+
+    def __init__(self, registry: 'Registry', key: str, value: str):
+        from code2vec_tpu.telemetry import catalog
+        self._registry = registry
+        self._suffix = catalog.label_suffix(key, value)
+
+    def counter(self, name: str) -> Counter:
+        return self._registry.counter(name + self._suffix)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._registry.gauge(name + self._suffix)
+
+    def timer(self, name: str, window: int = 512) -> Timer:
+        return self._registry.timer(name + self._suffix, window=window)
 
 
 _REGISTRY = Registry()

@@ -68,28 +68,59 @@ class PrometheusExporter:
         self.path = os.path.join(logdir, filename)
 
     def flush(self, registry: Registry, step: int) -> None:
-        out = []
+        # grouped by FAMILY, emitted one family at a time: expfmt
+        # requires all samples of a metric family in one contiguous
+        # group under a single HELP/TYPE header.  Replica-labeled
+        # series make that nontrivial for Timers — the full-name sort
+        # interleaves r0's five stat families with r1's — so samples
+        # are collected per family first, then written family-by-family
+        # (strict parsers drop the whole file on a split family).
+        families: Dict[str, dict] = {}
+
+        def fam(prom: str, mtype: Optional[str],
+                help_text: Optional[str] = None) -> list:
+            entry = families.get(prom)
+            if entry is None:
+                entry = families[prom] = {'type': mtype,
+                                          'help': help_text,
+                                          'samples': []}
+            return entry['samples']
+
         for name, inst in registry.items():
-            prom = catalog.prometheus_name(name)
-            meta = catalog.CATALOG.get(name)
-            if meta is not None:
-                out.append('# HELP %s %s' % (prom, meta['help']))
+            # instance-labeled series (replica-scoped serving metrics):
+            # headers carry the label-FREE family name, the sample line
+            # carries the label — the expfmt contract
+            base, label = catalog.split_label(name)
+            prom = catalog.prometheus_name(base)
+            labels = '' if label is None else '{%s="%s"}' % label
+            meta = catalog.CATALOG.get(base)
+            help_text = meta['help'] if meta is not None else None
             if isinstance(inst, Counter):
-                out.append('# TYPE %s counter' % prom)
-                out.append('%s %d' % (prom, inst.snapshot()))
+                fam(prom, 'counter', help_text).append(
+                    '%s%s %d' % (prom, labels, inst.snapshot()))
             elif isinstance(inst, Gauge):
-                out.append('# TYPE %s gauge' % prom)
-                out.append('%s %.17g' % (prom, inst.snapshot()))
+                fam(prom, 'gauge', help_text).append(
+                    '%s%s %.17g' % (prom, labels, inst.snapshot()))
             elif isinstance(inst, Timer):
                 # per-stat gauge families, NOT a 'summary': the summary
                 # exposition requires {quantile=...} + _sum series, and
                 # strict expfmt parsers drop the whole file on violation
                 stats = inst.snapshot()
+                if help_text is not None:
+                    fam(prom, None, help_text)  # HELP-only family line
                 for stat in ('mean_ms', 'p50_ms', 'p95_ms', 'max_ms'):
-                    out.append('# TYPE %s_%s gauge' % (prom, stat))
-                    out.append('%s_%s %.17g' % (prom, stat, stats[stat]))
-                out.append('# TYPE %s_count counter' % prom)
-                out.append('%s_count %d' % (prom, stats['count']))
+                    fam('%s_%s' % (prom, stat), 'gauge').append(
+                        '%s_%s%s %.17g'
+                        % (prom, stat, labels, stats[stat]))
+                fam('%s_count' % prom, 'counter').append(
+                    '%s_count%s %d' % (prom, labels, stats['count']))
+        out = []
+        for prom, entry in families.items():  # first-seen (name) order
+            if entry['help'] is not None:
+                out.append('# HELP %s %s' % (prom, entry['help']))
+            if entry['type'] is not None:
+                out.append('# TYPE %s %s' % (prom, entry['type']))
+            out.extend(entry['samples'])
         tmp = self.path + '.tmp'
         with open(tmp, 'w') as f:
             f.write('\n'.join(out) + '\n')

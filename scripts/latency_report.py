@@ -4,13 +4,18 @@ Reads the flat span records of ``spans.jsonl`` (or any
 ``flight_<event>.jsonl`` flight-recorder dump — header lines are
 skipped) and reports:
 
-- **phase x bucket x tier breakdown**: p50/p95/p99 (nearest-rank) and
-  count per span name, keyed by the trace's output tier and the batch
-  bucket it dispatched on;
+- **phase x bucket x tier x replica breakdown**: p50/p95/p99
+  (nearest-rank) and count per span name, keyed by the trace's output
+  tier, the batch bucket it dispatched on, and — for serving-mesh
+  traffic — WHICH replica served it (the ``replica`` attribute the
+  mesh dispatcher stamps on the pack span; '-' for single-engine
+  traffic);
 - **queue-wait vs device-time decomposition**: where end-to-end latency
   actually went (the micro-batcher's direct tuning signal:
-  queue-dominated -> lower SERVING_MAX_DELAY_MS / raise buckets;
-  device-dominated -> the model is the bottleneck);
+  queue-dominated -> lower SERVING_MAX_DELAY_MS / raise buckets /
+  add replicas; device-dominated -> the model is the bottleneck), as a
+  FLEET view plus a per-replica x tier table — the "which replica is
+  slow" question under a mesh is read straight off it;
 - **terminal statuses**: how many traces ended ok / shed / expired /
   closed / error — shed storms and deadline expiries show up here;
 - **top-K slowest traces** as full indented span trees, for the "why is
@@ -92,31 +97,35 @@ def percentile(sorted_ms: List[float], q: float) -> float:
     return sorted_ms[idx]
 
 
-def trace_key(entry: dict) -> Tuple[str, str]:
-    """(tier, bucket) attribution for one trace: tier from the root
-    attrs, bucket from the pack span that dispatched it ('-' for traces
-    that never reached a dispatch: shed/expired/closed)."""
+def trace_key(entry: dict) -> Tuple[str, str, str]:
+    """(tier, bucket, replica) attribution for one trace: tier from the
+    root attrs, bucket + replica from the pack span that dispatched it
+    ('-' for traces that never reached a dispatch — shed/expired/closed
+    — and '-' replica for single-engine traffic)."""
     root = entry['root'] or {}
     tier = str((root.get('attrs') or {}).get('tier', '-'))
     bucket = '-'
+    replica = '-'
     for rec in entry['spans']:
         if rec['name'] == 'serving.pack':
-            bucket = str((rec.get('attrs') or {}).get('bucket', '-'))
+            attrs = rec.get('attrs') or {}
+            bucket = str(attrs.get('bucket', '-'))
             # the pack span also carries the EFFECTIVE tier (post-
-            # degradation); prefer it when present
-            tier = str((rec.get('attrs') or {}).get('tier', tier))
+            # degradation) and, on a mesh, the serving replica
+            tier = str(attrs.get('tier', tier))
+            replica = str(attrs.get('replica', '-'))
             break
-    return tier, bucket
+    return tier, bucket, replica
 
 
 def phase_rows(traces: Dict[str, dict]
-               ) -> Dict[Tuple[str, str, str], List[float]]:
-    """(phase, tier, bucket) -> ascending list of durations (ms)."""
-    rows: Dict[Tuple[str, str, str], List[float]] = {}
+               ) -> Dict[Tuple[str, str, str, str], List[float]]:
+    """(phase, tier, bucket, replica) -> ascending durations (ms)."""
+    rows: Dict[Tuple[str, str, str, str], List[float]] = {}
     for entry in traces.values():
-        tier, bucket = trace_key(entry)
+        tier, bucket, replica = trace_key(entry)
         for rec in entry['spans']:
-            rows.setdefault((rec['name'], tier, bucket),
+            rows.setdefault((rec['name'], tier, bucket, replica),
                             []).append(float(rec.get('dur_ms', 0.0)))
     for durs in rows.values():
         durs.sort()
@@ -163,6 +172,33 @@ def decomposition(traces: Dict[str, dict]) -> Dict[str, List[float]]:
     return out
 
 
+def replica_decomposition(traces: Dict[str, dict]
+                          ) -> Dict[Tuple[str, str],
+                                    Dict[str, List[float]]]:
+    """(replica, tier) -> {end_to_end, queue_wait, device} (ms,
+    ascending) over delivered traces — the per-replica column of the
+    fleet decomposition (mesh traffic stamps the replica on the pack
+    span; single-engine traffic lands under replica '-')."""
+    out: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
+    for entry in traces.values():
+        root = entry['root']
+        if root is None or root.get('status') not in (None, 'ok'):
+            continue
+        tier, _bucket, replica = trace_key(entry)
+        parts = out.setdefault((replica, tier),
+                               {'end_to_end': [], 'queue_wait': [],
+                                'device': []})
+        parts['end_to_end'].append(float(root.get('dur_ms', 0.0)))
+        parts['queue_wait'].append(
+            _union_ms(entry['spans'], 'serving.queue_wait'))
+        parts['device'].append(
+            _union_ms(entry['spans'], 'serving.device_execute'))
+    for parts in out.values():
+        for values in parts.values():
+            values.sort()
+    return out
+
+
 def status_counts(traces: Dict[str, dict]) -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for entry in traces.values():
@@ -206,7 +242,7 @@ def to_perfetto(traces: Dict[str, dict]) -> List[dict]:
                  for rec in entry['spans']), default=0.0)
     events = []
     for lane, (trace_id, entry) in enumerate(sorted(traces.items()), 1):
-        tier, bucket = trace_key(entry)
+        tier, bucket, replica = trace_key(entry)
         for rec in entry['spans']:
             attrs = dict(rec.get('attrs') or {})
             attrs['trace'] = trace_id
@@ -214,7 +250,8 @@ def to_perfetto(traces: Dict[str, dict]) -> List[dict]:
                 attrs['status'] = rec['status']
             events.append({
                 'name': rec['name'],
-                'cat': 'tier:%s,bucket:%s' % (tier, bucket),
+                'cat': 'tier:%s,bucket:%s,replica:%s'
+                       % (tier, bucket, replica),
                 'ph': 'X',
                 'ts': (rec['t0'] - t_min) * 1e6,
                 'dur': max(0.0, (rec['t1'] - rec['t0']) * 1e6),
@@ -252,14 +289,19 @@ def main(argv=None) -> int:
     rows = phase_rows(traces)
     statuses = status_counts(traces)
     decomp = decomposition(traces)
+    per_replica = replica_decomposition(traces)
+    # the per-replica table earns its ink only when a mesh actually
+    # stamped replica ids (single-engine logs land entirely under '-')
+    meshy = any(replica != '-' for replica, _tier in per_replica)
 
     if args.json:
         print(json.dumps({'measure': 'trace_statuses', 'value': statuses,
                           'traces': len(traces)}))
-        for (phase, tier, bucket), durs in sorted(rows.items()):
+        for (phase, tier, bucket, replica), durs in sorted(rows.items()):
             print(json.dumps({
                 'measure': 'phase_latency_ms', 'phase': phase,
-                'tier': tier, 'bucket': bucket, 'count': len(durs),
+                'tier': tier, 'bucket': bucket, 'replica': replica,
+                'count': len(durs),
                 'p50': round(percentile(durs, 0.50), 3),
                 'p95': round(percentile(durs, 0.95), 3),
                 'p99': round(percentile(durs, 0.99), 3),
@@ -273,28 +315,54 @@ def main(argv=None) -> int:
                 'p50': round(percentile(values, 0.50), 3),
                 'p99': round(percentile(values, 0.99), 3),
             }))
+        for (replica, tier), parts in sorted(per_replica.items()):
+            for part, values in sorted(parts.items()):
+                print(json.dumps({
+                    'measure': 'replica_decomposition_ms',
+                    'replica': replica, 'tier': tier, 'part': part,
+                    'count': len(values),
+                    'p50': round(percentile(values, 0.50), 3),
+                    'p99': round(percentile(values, 0.99), 3),
+                }))
     else:
         print('== %d trace(s) from %s' % (len(traces), args.spans))
         print('statuses: ' + ', '.join('%s=%d' % kv
                                        for kv in sorted(statuses.items())))
         print()
-        print('%-26s %-10s %-7s %6s %9s %9s %9s'
-              % ('phase', 'tier', 'bucket', 'count', 'p50_ms',
-                 'p95_ms', 'p99_ms'))
-        for (phase, tier, bucket), durs in sorted(rows.items()):
-            print('%-26s %-10s %-7s %6d %9.2f %9.2f %9.2f'
-                  % (phase, tier, bucket, len(durs),
+        print('%-26s %-10s %-7s %-7s %6s %9s %9s %9s'
+              % ('phase', 'tier', 'bucket', 'replica', 'count',
+                 'p50_ms', 'p95_ms', 'p99_ms'))
+        for (phase, tier, bucket, replica), durs in sorted(rows.items()):
+            print('%-26s %-10s %-7s %-7s %6d %9.2f %9.2f %9.2f'
+                  % (phase, tier, bucket, replica, len(durs),
                      percentile(durs, 0.50), percentile(durs, 0.95),
                      percentile(durs, 0.99)))
         if decomp['end_to_end']:
             print()
-            print('decomposition over %d delivered trace(s):'
+            print('fleet decomposition over %d delivered trace(s):'
                   % len(decomp['end_to_end']))
             for part in ('end_to_end', 'queue_wait', 'device', 'other'):
                 values = decomp[part]
                 print('  %-12s p50 %9.2fms  p99 %9.2fms'
                       % (part, percentile(values, 0.50),
                          percentile(values, 0.99)))
+        if meshy:
+            print()
+            print('per-replica decomposition (queue-wait vs device):')
+            print('  %-7s %-10s %6s %9s %9s %9s %9s %9s %9s'
+                  % ('replica', 'tier', 'count', 'queue_p50',
+                     'queue_p99', 'dev_p50', 'dev_p99', 'e2e_p50',
+                     'e2e_p99'))
+            for (replica, tier), parts in sorted(per_replica.items()):
+                print('  %-7s %-10s %6d %9.2f %9.2f %9.2f %9.2f '
+                      '%9.2f %9.2f'
+                      % (replica, tier, len(parts['end_to_end']),
+                         percentile(parts['queue_wait'], 0.50),
+                         percentile(parts['queue_wait'], 0.99),
+                         percentile(parts['device'], 0.50),
+                         percentile(parts['device'], 0.99),
+                         percentile(parts['end_to_end'], 0.50),
+                         percentile(parts['end_to_end'], 0.99)))
         if args.top > 0:
             slowest = sorted(
                 (entry for entry in traces.values()

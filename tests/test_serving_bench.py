@@ -202,16 +202,16 @@ def test_span_log_reports_p50_p99_per_phase(model, tmp_path):
     traces = latency_report.group_traces(records)
     assert len(traces) == len(requests)
     rows = latency_report.phase_rows(traces)
-    phases = {phase for (phase, _tier, _bucket) in rows}
+    phases = {phase for (phase, _tier, _bucket, _replica) in rows}
     assert {'serving.request', 'serving.queue_wait', 'serving.pack',
             'serving.device_execute', 'serving.decode',
             'serving.deliver'} <= phases, phases
     # per-phase percentiles are well-formed and cover every request
-    for (phase, tier, _bucket), durs in rows.items():
+    for (phase, tier, _bucket, _replica), durs in rows.items():
         assert tier == 'topk'
         p50 = latency_report.percentile(durs, 0.50)
         p99 = latency_report.percentile(durs, 0.99)
         assert 0.0 <= p50 <= p99, (phase, p50, p99)
-    request_rows = [durs for (phase, _t, _b), durs in rows.items()
+    request_rows = [durs for (phase, _t, _b, _r), durs in rows.items()
                     if phase == 'serving.request']
     assert sum(len(durs) for durs in request_rows) == len(requests)

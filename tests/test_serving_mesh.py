@@ -1,0 +1,558 @@
+"""Serving mesh drills (serving/mesh.py + serving/frontqueue.py,
+ISSUE 13): shared-queue admission parity vs a single engine (admitted
+results bit-identical), continuous cross-tier batching with ZERO
+post-warmup compiles, replica-labeled metrics without registry
+collisions, a breaker-tripped replica weighted out WITHOUT wedging the
+queue, coordinated canary -> fleet-swap / rollback, replica retirement
+drain, the fleet-level overload drill through the existing fault
+grammar's serving points, and the process-per-replica wire."""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.resilience import faults
+from code2vec_tpu.serving import frontqueue as frontqueue_lib
+from code2vec_tpu.serving import mesh as mesh_lib
+from code2vec_tpu.serving.engine import _Request
+from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+                                         EngineOverloaded)
+from tests.test_train_overfit import make_dataset
+
+PREDICT_LINES = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+    'run|c tokc0,pC,tokc1 tokc2,pA,tokc0 tokc1,pB,tokc2',
+]
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plan():
+    faults.configure('')
+    yield
+    faults.configure('')
+
+
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('serving_mesh'))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,16')
+    return Code2VecModel(config)
+
+
+def _wait_until(predicate, timeout=15.0, what='condition'):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return
+        time.sleep(0.002)
+    raise AssertionError('timed out waiting for %s' % what)
+
+
+def _fake_request(rows: int, deadline_s=None) -> _Request:
+    batch = types.SimpleNamespace(label=np.zeros((rows,), np.int32))
+    from concurrent.futures import Future
+    return _Request(batch, 'topk', future=Future(), deadline_s=deadline_s)
+
+
+# ------------------------------------------------------ FrontQueue units
+def test_frontqueue_bound_sheds_typed_by_reason():
+    queue = frontqueue_lib.FrontQueue(('topk',), bound=8,
+                                      fleet_rate=lambda: 0.0)
+    assert queue.admit(4, 'topk', None) == 'topk'
+    queue.enqueue('topk', [_fake_request(4)], 4)  # reservation -> queued
+    with pytest.raises(EngineOverloaded, match='queue bound'):
+        queue.admit(8, 'topk', None)
+    assert queue.shed_total.snapshot() == 1
+    assert queue.shed_bound_total.snapshot() == 1
+    # the fleet drain estimate sheds undeliverable deadlines, typed
+    queue2 = frontqueue_lib.FrontQueue(('topk',), bound=1024,
+                                       fleet_rate=lambda: 1.0)
+    with pytest.raises(EngineOverloaded, match='fleet drain estimate'):
+        queue2.admit(100, 'topk', deadline_s=0.1)
+    assert queue2.shed_deadline_total.snapshot() == 1
+    # no deadline -> no drain check
+    assert queue2.admit(100, 'topk', None) == 'topk'
+
+
+def test_frontqueue_oversize_admitted_alone_then_bounds():
+    """Pile-up, not size: one request larger than the whole bound is
+    admitted on an idle queue; everything behind it sheds."""
+    queue = frontqueue_lib.FrontQueue(('topk',), bound=4,
+                                      fleet_rate=lambda: 0.0)
+    assert queue.admit(10, 'topk', None) == 'topk'
+    with pytest.raises(EngineOverloaded):
+        queue.admit(1, 'topk', None)
+
+
+def test_frontqueue_degrades_under_shared_fill():
+    queue = frontqueue_lib.FrontQueue(('topk', 'full'), bound=8,
+                                      fleet_rate=lambda: 0.0)
+    queue.admit(6, 'topk', None)  # 6/8 reserved: level 2 at next admit
+    assert queue.admit(1, 'full', None) == 'topk'
+    assert queue.degraded_total.snapshot() == 1
+    # never degrade onto a cold program: 'attention' tier not warmed,
+    # so level 1 would keep 'full' as-is — exercised via warmed set
+    queue2 = frontqueue_lib.FrontQueue(('full',), bound=8,
+                                       fleet_rate=lambda: 0.0)
+    queue2.admit(6, 'full', None)
+    assert queue2.admit(1, 'full', None) == 'full'
+    assert queue2.degraded_total.snapshot() == 0
+
+
+def test_frontqueue_pop_coalesces_inserts_and_expires():
+    queue = frontqueue_lib.FrontQueue(('topk',), bound=None,
+                                      fleet_rate=lambda: 0.0)
+    first = _fake_request(2)
+    queue.admit(2, 'topk', None)
+    queue.enqueue('topk', [first], 2)
+
+    # a late arrival inside the coalescing window is folded into the
+    # still-gathering micro-batch (continuous insert)
+    late = _fake_request(3)
+
+    def arrive_late():
+        time.sleep(0.05)
+        queue.admit(3, 'topk', None)
+        queue.enqueue('topk', [late], 3)
+
+    threading.Thread(target=arrive_late).start()
+    tier, taken, rows, expired = queue.pop_coalesced(
+        16, max_delay_s=0.4, alive=lambda: True)
+    assert tier == 'topk' and rows == 5 and not expired
+    assert taken == [first, late]
+
+    # an already-deadlined queued request expires at pop, never taken
+    dead = _fake_request(1, deadline_s=0.01)
+    queue.admit(1, 'topk', None)
+    queue.enqueue('topk', [dead], 1)
+    time.sleep(0.05)
+    _tier, taken, rows, expired = queue.pop_coalesced(
+        16, max_delay_s=0.0, alive=lambda: True)
+    assert expired == [dead] and not taken and rows == 0
+    assert queue.expired_total.snapshot() == 1
+
+    # a dead replica leaves without taking work
+    queue.admit(1, 'topk', None)
+    queue.enqueue('topk', [_fake_request(1)], 1)
+    assert queue.pop_coalesced(16, 0.0, alive=lambda: False) is None
+    assert queue.depth_rows() == 1
+
+
+# ------------------------------------------------------- admission parity
+def test_mesh_matches_single_engine_bit_identical(model):
+    """Shared-queue admission parity: results served THROUGH the mesh
+    are bit-identical to the single engine's (same tokenizer, same
+    bucket/capacity selection, same warm programs)."""
+    with model.serving_engine(tiers=('topk',),
+                              max_delay_ms=0.0) as engine:
+        single = [engine.predict([line], tier='topk', timeout=60)[0]
+                  for line in PREDICT_LINES]
+    with model.serving_mesh(replicas=2, tiers=('topk',),
+                            max_delay_ms=0.0) as mesh:
+        meshed = [mesh.predict([line], tier='topk', timeout=60)[0]
+                  for line in PREDICT_LINES]
+        # oversize split still holds through the shared queue
+        lines = [PREDICT_LINES[i % 3] for i in range(20)]
+        wide = mesh.predict(lines, tier='topk', timeout=60)
+    for m, s in zip(meshed, single):
+        assert m.original_name == s.original_name
+        assert m.topk_predicted_words == s.topk_predicted_words
+        np.testing.assert_array_equal(m.topk_predicted_words_scores,
+                                      s.topk_predicted_words_scores)
+    assert len(wide) == 20
+    direct = model.predict(lines)
+    for w, d in zip(wide, direct):
+        assert w.topk_predicted_words == d.topk_predicted_words
+
+
+# -------------------------------------- mixed tiers, compiles, metrics
+class _FakeIndex:
+    labels = np.array(['m0', 'm1'], dtype=object)
+
+    def search(self, vectors, k):
+        n = vectors.shape[0]
+        return (np.zeros((n, k), np.float32),
+                np.zeros((n, k), np.int64))
+
+
+def test_mesh_mixed_tier_stream_zero_compiles_and_labeled_metrics(model):
+    """Acceptance: one dispatch stream serves predict tiers AND
+    submit_neighbors with ZERO post-warmup compiles; coexisting
+    replicas mirror their instruments replica-LABELED, so the registry
+    neither double-counts counters nor overwrites gauges."""
+    from code2vec_tpu.telemetry import core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+    core.reset()
+    core.enable()
+    mesh = model.serving_mesh(
+        replicas=2, tiers=('topk', 'attention', 'vectors'),
+        max_delay_ms=1.0)
+    try:
+        assert install_compile_listener()
+        compiles = core.registry().counter('jit/compiles_total')
+        mesh.attach_index(_FakeIndex())
+        warm = compiles.value
+        futures = []
+        for i in range(24):
+            kind = ('topk', 'attention', 'neighbors')[i % 3]
+            lines = [PREDICT_LINES[i % 3]]
+            if kind == 'neighbors':
+                futures.append(mesh.submit_neighbors(lines))
+            else:
+                futures.append(mesh.submit(lines, tier=kind))
+        for future in futures:
+            assert future.result(timeout=60)
+        assert compiles.value - warm == 0, (
+            '%d compiles during mixed-tier mesh serving'
+            % (compiles.value - warm))
+        # both replicas served the one stream
+        stats = mesh.stats()
+        assert all(r['batches'] > 0 for r in stats['replicas'])
+        # replica-labeled mirrors: one series per replica, base name
+        # absent (no unlabeled collision for the per-engine counters)
+        reg = core.registry()
+        for rid in ('r0', 'r1'):
+            labeled = reg.get('serving/batches_total{replica=%s}' % rid)
+            assert labeled is not None and labeled.snapshot() > 0
+        assert reg.get('serving/batches_total') is None
+        total = sum(
+            reg.get('serving/batches_total{replica=%s}' % rid).snapshot()
+            for rid in ('r0', 'r1'))
+        assert total == sum(r['batches'] for r in stats['replicas'])
+        # fleet-level mesh metrics ride unlabeled
+        assert reg.get('mesh/requests_total').snapshot() == 24
+    finally:
+        mesh.close()
+        core.disable()
+        core.reset()
+
+
+# ------------------------------------------------------- replica breaker
+def test_breaker_trips_replica_out_without_queue_wedge(model):
+    """K consecutive dispatch failures open one replica's breaker; the
+    shared queue redirects to its sibling (no wedge, no lost work
+    beyond the failed dispatches), and the half-open probe closes the
+    breaker once dispatch heals."""
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0, breaker_threshold=2,
+                              breaker_cooldown_secs=60.0)
+    try:
+        slot0 = mesh._replicas[0]
+        engine0 = slot0.transport.engine
+        real_dispatch = engine0.dispatch_external
+
+        def boom(tier, taken, rows):
+            exc = RuntimeError('injected replica-0 dispatch failure')
+            for request in taken:
+                request.fail(exc)
+            raise exc
+
+        engine0.dispatch_external = boom
+        # feed singles until r0 has failed enough claims to trip; r1
+        # keeps serving its share throughout
+        sacrificed = 0
+        deadline = time.perf_counter() + 20.0
+        while slot0.breaker_state != mesh_lib._BREAKER_OPEN:
+            assert time.perf_counter() < deadline, \
+                'breaker never tripped'
+            future = mesh.submit([PREDICT_LINES[0]], tier='topk')
+            try:
+                future.result(timeout=60)
+            except RuntimeError:
+                sacrificed += 1
+        assert sacrificed >= 2  # the threshold's consecutive failures
+        assert mesh.stats()['replica_breaker_open_total'] >= 1
+        # weighted out: traffic flows entirely through r1, queue never
+        # wedges
+        before = slot0.batches
+        results = [mesh.predict([PREDICT_LINES[i % 3]], tier='topk',
+                                timeout=60)
+                   for i in range(10)]
+        assert all(r[0].topk_predicted_words for r in results)
+        assert slot0.batches == before
+        # heal + force the cooldown over: the half-open probe batch
+        # closes the breaker and r0 serves again
+        engine0.dispatch_external = real_dispatch
+        with mesh._lock:
+            slot0.breaker_open_until = time.perf_counter() - 1.0
+        _wait_until(
+            lambda: (mesh.predict([PREDICT_LINES[0]], tier='topk',
+                                  timeout=60) and
+                     slot0.breaker_state == mesh_lib._BREAKER_CLOSED),
+            timeout=20.0, what='half-open probe to close the breaker')
+        assert slot0.batches > before
+    finally:
+        mesh.close()
+
+
+# -------------------------------------------------- coordinated rollover
+def test_coordinated_rollover_fleet_swap_and_rollback_zero_compiles(model):
+    """Acceptance: canary on ONE replica, fleet swap on agreement with
+    zero post-warmup compiles; a failed canary rolls back and leaves
+    EVERY replica serving the old params."""
+    import jax
+    from code2vec_tpu.telemetry import core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+    core.reset()
+    core.enable()
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0)
+    try:
+        assert install_compile_listener()
+        compiles = core.registry().counter('jit/compiles_total')
+        same = jax.tree_util.tree_map(lambda leaf: leaf, model.params)
+        broken = jax.tree_util.tree_map(lambda leaf: -leaf, model.params)
+        jax.block_until_ready(broken)
+        mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        warm = compiles.value
+
+        # ---- canary PASS on one replica -> every replica swaps
+        handle = mesh.load_params(same, canary_batches=2,
+                                  min_agreement=0.9)
+        canary_rid = mesh._rollover['replica'].rid
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        report = handle.result(timeout=60)
+        assert report['swapped'] is True
+        assert report['canary_replica'] == canary_rid
+        assert report['replicas_swapped'] == 2
+        for slot in mesh._replicas:
+            assert slot.transport.engine.params is same, slot.rid
+
+        # ---- canary FAIL -> rollback, every replica keeps old params
+        handle = mesh.load_params(broken, canary_batches=2,
+                                  min_agreement=0.9)
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        report = handle.result(timeout=60)
+        assert report['swapped'] is False
+        assert report['replicas_swapped'] == 0
+        for slot in mesh._replicas:
+            assert slot.transport.engine.params is same, slot.rid
+        stats = mesh.stats()
+        assert stats['rollover_total'] == 1
+        assert stats['rollover_rollbacks_total'] == 1
+        assert compiles.value - warm == 0, (
+            '%d XLA compiles during coordinated rollover'
+            % (compiles.value - warm))
+        # the fleet concluded: a fresh rollover arms cleanly
+        assert mesh.load_params(
+            same, canary_batches=0).result(60)['swapped'] is True
+    finally:
+        mesh.close()
+        core.disable()
+        core.reset()
+
+
+def test_rollover_guards(model):
+    import jax
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0)
+    same = jax.tree_util.tree_map(lambda leaf: leaf, model.params)
+    try:
+        armed = mesh.load_params(same, canary_batches=50)
+        with pytest.raises(RuntimeError, match='already in flight'):
+            mesh.load_params(same, canary_batches=1)
+        # a mesh-replica engine refuses direct submit/follow: the mesh
+        # owns admission and the fleet rollover
+        engine0 = mesh._replicas[0].transport.engine
+        with pytest.raises(RuntimeError, match='mesh replica'):
+            engine0.submit(PREDICT_LINES, tier='topk')
+        with pytest.raises(RuntimeError, match='mesh replica'):
+            engine0.follow_checkpoints(poll_secs=1.0)
+    finally:
+        mesh.close()
+    assert isinstance(armed.exception(timeout=10), EngineClosed)
+    with pytest.raises(EngineClosed):
+        mesh.load_params(same, canary_batches=0)
+
+
+# ------------------------------------------------------ retirement drain
+def test_replica_retirement_drains_and_queue_redirects(model):
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0)
+    try:
+        inflight = [mesh.submit([PREDICT_LINES[i % 3]], tier='topk')
+                    for i in range(12)]
+        mesh.retire('r0')
+        for future in inflight:
+            assert future.result(timeout=60)
+        # the retired replica's engine is closed; the queue redirects
+        retired = mesh._replicas[0]
+        assert retired.retired and not retired.thread.is_alive()
+        before = mesh._replicas[1].batches
+        results = [mesh.predict([line], tier='topk', timeout=60)
+                   for line in PREDICT_LINES]
+        assert all(r[0].topk_predicted_words for r in results)
+        assert mesh._replicas[1].batches > before
+        assert mesh._replicas[0].batches + mesh._replicas[1].batches \
+            >= len(results)
+        assert mesh.stats()['replicas'][0]['retired'] is True
+        mesh.retire('r0')  # idempotent
+        with pytest.raises(ValueError, match='no replica'):
+            mesh.retire('r9')
+    finally:
+        mesh.close()
+
+
+# ---------------------------------------------------- fleet overload drill
+def test_fleet_overload_drill_typed_shed_and_expiry(model):
+    """The ISSUE 13 overload drill through the existing fault grammar's
+    serving points, at FLEET level: reject_all sheds typed at the
+    SHARED queue; slow_dispatch stalls both replicas so deadlined work
+    expires typed in the shared queue; admitted work still returns
+    results identical to the unloaded path."""
+    line = PREDICT_LINES[0]
+    unloaded = model.predict([line])[0]
+    # ---- reject_all fires at mesh admission
+    with model.serving_mesh(replicas=2, tiers=('topk',),
+                            max_delay_ms=0.0, queue_bound=64) as mesh:
+        faults.configure('reject_all@req=0..1')
+        for _ in range(2):
+            with pytest.raises(EngineOverloaded):
+                mesh.submit([line], tier='topk')
+        faults.configure('')
+        (result,) = mesh.predict([line], tier='topk', timeout=60)
+        assert result.topk_predicted_words == \
+            unloaded.topk_predicted_words
+        assert mesh.stats()['shed_total'] == 2
+
+    # ---- slow_dispatch + bounded shared queue: expiry and shed typed
+    # max_inflight=1: a replica is BUSY for the whole >=250ms stall of
+    # its one claimed batch, so the deadlined requests below stay
+    # queued past their SLO deterministically
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0, queue_bound=8,
+                              max_inflight=1)
+    try:
+        faults.configure('slow_dispatch@req=0..255')
+        # plug BOTH replicas, one at a time (two queued plugs would
+        # coalesce into ONE replica's batch): each claims one stalled
+        # batch and is busy for the whole >=250ms stall
+        plugs = []
+        for _ in range(2):
+            plugs.append(mesh.submit([line], tier='topk'))
+            _wait_until(lambda: mesh._queue.depth_rows() == 0,
+                        what='a replica to claim the plug batch')
+        _wait_until(lambda: all(s.inflight >= 1
+                                for s in mesh._replicas),
+                    what='both replicas to hold a stalled batch')
+        # deadlined requests queue behind >=250ms stalls with a 50ms
+        # SLO: they must expire typed at pop, never dispatch
+        doomed = [mesh.submit([line], tier='topk', deadline_ms=50.0)
+                  for _ in range(4)]
+        # open-loop burst past the bound: typed sheds
+        shed = 0
+        admitted = []
+        for _ in range(12):
+            try:
+                admitted.append(mesh.submit([line], tier='topk'))
+            except EngineOverloaded:
+                shed += 1
+        assert shed > 0
+        assert mesh._queue.peak_rows() <= 8
+        for future in doomed:
+            assert isinstance(future.exception(timeout=60),
+                              DeadlineExceeded)
+        faults.configure('')
+        for future in admitted + plugs:
+            (result,) = future.result(timeout=60)
+            assert result.original_name == unloaded.original_name
+            assert result.topk_predicted_words == \
+                unloaded.topk_predicted_words
+            np.testing.assert_array_equal(
+                result.topk_predicted_words_scores,
+                unloaded.topk_predicted_words_scores)
+        stats = mesh.stats()
+        assert stats['shed_total'] == shed
+        assert stats['expired_total'] == 4
+    finally:
+        faults.configure('')
+        mesh.close()
+
+
+# -------------------------------------------------------- close semantics
+def test_mesh_close_failfast_and_drain(model):
+    line = PREDICT_LINES[0]
+    # fail-fast: queued work fails typed, in-flight still delivers
+    mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                              max_delay_ms=0.0)
+    faults.configure('slow_dispatch@req=0..63')
+    plug = mesh.submit([line], tier='topk')
+    _wait_until(lambda: mesh._queue.depth_rows() == 0,
+                what='puller to claim the plug')
+    queued = [mesh.submit([line], tier='topk') for _ in range(3)]
+    mesh.close()
+    faults.configure('')
+    assert plug.result(timeout=60)[0].topk_predicted_words
+    for future in queued:
+        assert isinstance(future.exception(timeout=10), EngineClosed)
+    with pytest.raises(EngineClosed):
+        mesh.submit([line], tier='topk')
+
+    # drain: everything admitted is served first
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=10_000.0)
+    futures = [mesh.submit([ln], tier='topk') for ln in PREDICT_LINES]
+    mesh.close(drain=True)
+    for future, ln in zip(futures, PREDICT_LINES):
+        (result,) = future.result(timeout=60)
+        assert result.topk_predicted_words == \
+            model.predict([ln])[0].topk_predicted_words
+    assert not any(s.thread.is_alive() for s in mesh._replicas)
+
+
+# -------------------------------------------------- process-replica wire
+def test_process_replica_mode_serves_and_rolls(tmp_path_factory):
+    """One spawned worker process per replica on the same dispatch
+    wire: results match the parent's model, stats cross the pipe, and
+    a fleet rollover ships the checkpoint REF (worker restores from
+    the store)."""
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('mesh_proc'))
+    save_path = str(tmp_path_factory.mktemp('mesh_proc_model') / 'model')
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), MODEL_SAVE_PATH=save_path,
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1,
+        SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8', SERVING_WARM_TIERS='topk')
+    model = Code2VecModel(config)
+    model.save(state=model.state, epoch=0, wait=True)  # step 0
+    direct = model.predict(PREDICT_LINES)
+    mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                              mode='process', max_delay_ms=0.0)
+    try:
+        served = mesh.predict(PREDICT_LINES, tier='topk', timeout=120)
+        for s, d in zip(served, direct):
+            assert s.original_name == d.original_name
+            assert s.topk_predicted_words == d.topk_predicted_words
+        stats = mesh.stats()
+        assert stats['mode'] == 'process'
+        assert stats['replicas'][0]['batches'] >= 1
+        per_replica = mesh.replica_stats()
+        assert per_replica[0]['replica'] == 'r0'
+        # rollover by checkpoint ref across the wire (no canary: the
+        # deterministic restore-and-swap leg)
+        report = mesh.load_params(0, canary_batches=0).result(timeout=120)
+        assert report['swapped'] is True
+        # pytrees do not cross the wire: typed refusal
+        with pytest.raises(RuntimeError, match='checkpoint refs'):
+            mesh.load_params(model.params, canary_batches=0)
+    finally:
+        mesh.close()
+        model.close_stores()

@@ -167,6 +167,39 @@ def test_prometheus_textfile(tmp_path):
     assert not (tmp_path / 'metrics.prom.tmp').exists()  # atomic rename
 
 
+def test_prometheus_replica_labels_grouped_per_family(tmp_path):
+    """Replica-labeled series (mesh replicas, catalog 'Instance
+    labels'): one HELP/TYPE header per FAMILY with the labeled samples
+    contiguous under it — strict expfmt parsers drop the whole file on
+    a repeated header or a split family (the full-name sort would
+    otherwise interleave r0's timer stat families with r1's)."""
+    from code2vec_tpu.telemetry.core import ScopedRegistry
+    reg = core.registry()
+    for rid in ('r0', 'r1'):
+        scoped = ScopedRegistry(reg, 'replica', rid)
+        scoped.counter('serving/shed_total').inc(2)
+        scoped.timer('serving/dispatch_ms').record(0.002)
+    PrometheusExporter(str(tmp_path)).flush(reg, step=1)
+    lines = (tmp_path / 'metrics.prom').read_text().splitlines()
+    assert 'code2vec_serving_shed_total{replica="r0"} 2' in lines
+    assert 'code2vec_serving_shed_total{replica="r1"} 2' in lines
+    # headers once per family; labeled samples directly follow theirs
+    for family in ('code2vec_serving_shed_total',
+                   'code2vec_serving_dispatch_ms_mean_ms',
+                   'code2vec_serving_dispatch_ms_count'):
+        types = [i for i, line in enumerate(lines)
+                 if line == '# TYPE %s %s'
+                 % (family, 'counter' if family.endswith(('total',
+                                                          'count'))
+                    else 'gauge')]
+        assert len(types) == 1, (family, lines)
+        samples = [i for i, line in enumerate(lines)
+                   if line.startswith(family + '{')]
+        assert len(samples) == 2, (family, lines)
+        # contiguous group right under the single header
+        assert samples == [types[0] + 1, types[0] + 2], (family, lines)
+
+
 def test_console_exporter_rate_limited():
     lines = []
     exporter = ConsoleExporter(lines.append, min_interval_s=3600.0)

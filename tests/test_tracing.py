@@ -238,9 +238,11 @@ def _synth_records():
 def test_latency_report_tables_and_decomposition():
     traces = latency_report.group_traces(_synth_records())
     rows = latency_report.phase_rows(traces)
-    assert rows[('serving.request', 'topk', '8')] == [100.0]
+    # replica '-' = single-engine traffic (a mesh stamps its replica id
+    # on the pack span, scripts/latency_report.py per-replica columns)
+    assert rows[('serving.request', 'topk', '8', '-')] == [100.0]
     # shed trace never dispatched: bucket '-'
-    assert rows[('serving.shed', 'full', '-')] == [0.0]
+    assert rows[('serving.shed', 'full', '-', '-')] == [0.0]
     decomp = latency_report.decomposition(traces)
     assert decomp['end_to_end'] == [100.0]
     assert decomp['queue_wait'] == [pytest.approx(40.0)]
@@ -451,6 +453,11 @@ def test_overload_drill_reconstructs_every_request(model, tmp_path):
     finally:
         faults.configure('')
         engine.close()
+        # an INJECTED tracer is the injector's to close (a mesh shares
+        # one across replicas — a retiring replica must not end the
+        # fleet's flight recorder); this test owns it, so the close
+        # dump happens here
+        tracer.close()
         core.disable()
         core.reset()
     plug2.result(timeout=60)  # in-flight batch still delivered
