@@ -138,6 +138,12 @@ probe_or_record "after serving" || exit 3
 # the mixed predict + submit_neighbors stream
 run_stage mesh 900 python benchmarks/bench_mesh.py
 probe_or_record "after mesh" || exit 3
+# mesh chaos soak (ISSUE 14): paced load + periodic kill_worker/
+# drop_heartbeat faults against socket-mode workers — zero lost
+# admitted requests, zero post-warmup parent compiles, bounded p99
+# while the supervisor keeps restoring capacity
+run_stage mesh_soak 600 python scripts/mesh_soak.py --mode socket
+probe_or_record "after mesh_soak" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline
 run_stage index 900 python benchmarks/bench_index.py

@@ -477,11 +477,37 @@ class CheckpointStore:
         self._write_metadata()
 
     # ------------------------------------------------------------- restore
+    @staticmethod
+    def _disk_steps(directory: str) -> set:
+        """Committed step directories on disk (orbax commits by rename,
+        so in-flight tmp dirs carry a suffix and never match)."""
+        try:
+            return {int(name) for name in os.listdir(directory)
+                    if name.isdigit()}
+        except OSError:
+            return set()
+
     def _restore_candidates(self) -> list:
         """Every retained (manager, step) across the epoch and snapshot
         managers, NEWEST step first — the corruption-fallback order.
         Keys are global steps (older checkpoints were keyed by epoch —
-        restore handles either, the stored state carries both numbers)."""
+        restore handles either, the stored state carries both numbers).
+
+        Cross-process freshness: an orbax manager caches its step list
+        at open, so a step saved by ANOTHER process afterwards (a
+        serving worker following a live trainer's store, a mesh worker
+        asked to adopt a step the parent just wrote) would be invisible
+        forever.  When the directory holds a committed step the cached
+        list doesn't know, the managers are reopened to resync."""
+        for directory, manager in (
+                (self.entire_dir, self._manager),
+                (self.snapshot_dir, self._snapshot_manager)):
+            if manager is None or not os.path.isdir(directory):
+                continue
+            known = {int(step) for step in manager.all_steps()}
+            if not self._disk_steps(directory) <= known:
+                self.close()  # reopen lazily with the fresh step list
+                break
         candidates = []
         if os.path.isdir(self.entire_dir):
             for step in self.manager().all_steps():

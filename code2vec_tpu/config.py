@@ -425,10 +425,35 @@ class Config:
     MESH_BREAKER_COOLDOWN_SECS: float = 10.0
     # Replica placement: 'thread' = in-process engine replicas sharing
     # the trainer's warm programs; 'process' = one spawned worker
-    # process per replica speaking the same dispatch wire over a pipe
-    # (requires a checkpointed model — workers restore params from the
-    # store). SERVING.md "Serving mesh".
+    # process per replica speaking the framed dispatch wire over a
+    # pipe; 'socket' = the same wire over TCP (workers dial the mesh
+    # listener — replicas can live on other machines). Worker modes
+    # require a checkpointed model (workers restore params from the
+    # store). SERVING.md "Serving mesh" / "Multi-host mesh".
     MESH_REPLICA_MODE: str = 'thread'
+    # ---- mesh self-healing (SERVING.md "Multi-host mesh") ----
+    # Worker heartbeat period in seconds (liveness DISTINCT from
+    # dispatch health: a hung or partitioned worker with nothing in
+    # flight is invisible to the breaker; its missing beats are not).
+    # 0 disables the liveness monitor. Worker modes only.
+    MESH_HEARTBEAT_SECS: float = 2.0
+    # Consecutive heartbeat intervals a worker may miss before the
+    # mesh marks it dead typed, kills it, and redispatches its
+    # in-flight batches.
+    MESH_HEARTBEAT_MISSES: int = 3
+    # Supervised-restart budget: how many restarts one replica may
+    # spend inside MESH_RESTART_WINDOW_SECS before it retires
+    # PERMANENTLY (a flapping worker must not restart-storm). 0 =
+    # never restart (first death retires).
+    MESH_RESTART_LIMIT: int = 3
+    MESH_RESTART_WINDOW_SECS: float = 300.0
+    # First-restart backoff in seconds; doubles per attempt inside the
+    # window (capped at 30s).
+    MESH_RESTART_BACKOFF_SECS: float = 0.5
+    # Bind address of the socket-mode mesh listener. 127.0.0.1 keeps
+    # spawned-local workers loopback-only; a routable address lets
+    # workers on other machines dial in.
+    MESH_SOCKET_HOST: str = '127.0.0.1'
     # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
     # Per-invocation extractor timeout (--extractor-timeout): a wedged
     # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
@@ -737,11 +762,16 @@ class Config:
                                  'bucket, -1 = unbounded; SERVING.md)')
         parser.add_argument('--mesh-replica-mode',
                             dest='mesh_replica_mode',
-                            choices=['thread', 'process'], default=None,
+                            choices=['thread', 'process', 'socket'],
+                            default=None,
                             help='replica placement: in-process engine '
-                                 'threads (shared warm programs) or one '
+                                 'threads (shared warm programs), one '
                                  'worker process per replica on the '
-                                 'same dispatch wire (SERVING.md)')
+                                 'framed dispatch wire over a pipe, or '
+                                 'the same wire over TCP — workers '
+                                 'dial the mesh listener, so replicas '
+                                 'can live on other machines '
+                                 '(SERVING.md "Multi-host mesh")')
         parser.add_argument('--serve-follow-checkpoints',
                             dest='serve_follow_checkpoints', type=float,
                             default=None, metavar='SECS',
@@ -1213,9 +1243,25 @@ class Config:
         if self.MESH_BREAKER_COOLDOWN_SECS < 0:
             raise ValueError('config.MESH_BREAKER_COOLDOWN_SECS must '
                              'be >= 0.')
-        if self.MESH_REPLICA_MODE not in ('thread', 'process'):
-            raise ValueError("config.MESH_REPLICA_MODE must be 'thread' "
-                             "or 'process'.")
+        if self.MESH_REPLICA_MODE not in ('thread', 'process', 'socket'):
+            raise ValueError("config.MESH_REPLICA_MODE must be 'thread', "
+                             "'process' or 'socket'.")
+        if self.MESH_HEARTBEAT_SECS < 0:
+            raise ValueError('config.MESH_HEARTBEAT_SECS must be >= 0 '
+                             '(0 disables the liveness monitor).')
+        if self.MESH_HEARTBEAT_MISSES < 1:
+            raise ValueError('config.MESH_HEARTBEAT_MISSES must be '
+                             '>= 1.')
+        if self.MESH_RESTART_LIMIT < 0:
+            raise ValueError('config.MESH_RESTART_LIMIT must be >= 0 '
+                             '(0 = never restart).')
+        if self.MESH_RESTART_WINDOW_SECS <= 0:
+            raise ValueError('config.MESH_RESTART_WINDOW_SECS must be '
+                             '> 0 (the restart budget is window-'
+                             'scoped).')
+        if self.MESH_RESTART_BACKOFF_SECS < 0:
+            raise ValueError('config.MESH_RESTART_BACKOFF_SECS must be '
+                             '>= 0.')
         if self.SERVING_CANARY_BATCHES < 0:
             raise ValueError('config.SERVING_CANARY_BATCHES must be >= 0 '
                              '(0 = swap without canary).')

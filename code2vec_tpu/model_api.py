@@ -835,7 +835,10 @@ class Code2VecModel:
         coordinated canaried rollover (serving/mesh.py, SERVING.md
         "Serving mesh").  With ``--serve-follow-checkpoints`` the MESH
         polls the checkpoint store and rolls the whole fleet as a unit
-        — replica engines never run their own pollers."""
+        — replica engines never run their own pollers.  Worker modes
+        (``MESH_REPLICA_MODE='process'|'socket'``) self-heal: heartbeat
+        liveness, crash-safe redispatch, and supervised restart
+        (SERVING.md "Multi-host mesh")."""
         from code2vec_tpu.serving.mesh import ServingMesh
         mesh = ServingMesh(self, replicas=replicas, tiers=tiers,
                            **overrides)

@@ -78,6 +78,21 @@ FAULT_POINTS: Dict[str, str] = {
     'reject_all': 'serving/engine.py admission: the triggering submit '
                   'calls are shed with EngineOverloaded regardless of '
                   'queue state (exercises client fail-fast handling).',
+    'kill_worker': 'serving/mesh.py worker serve loop: SIGKILL this '
+                   'replica worker process as the triggering dispatch '
+                   'arrives — mid-batch, so the parent holds it in '
+                   'flight (exercises crash-safe redispatch and '
+                   'supervised restart).',
+    'drop_heartbeat': 'serving/mesh.py worker heartbeat thread: the '
+                      'triggering heartbeat(s) are silently skipped, '
+                      'the drilled shape of a hung-but-connected '
+                      'worker (exercises the liveness monitor, which '
+                      'the dispatch breaker cannot replace).',
+    'partition': 'serving/mesh.py parent receiver: the triggering '
+                 'frame(s) from the worker are dropped as if the '
+                 'network partitioned — results AND heartbeats vanish '
+                 'while both endpoints stay up (exercises liveness '
+                 'detection and redispatch of the blackholed batch).',
 }
 
 #: how long a fired ``hang_input`` blocks.  Long enough that only a

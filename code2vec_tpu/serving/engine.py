@@ -258,7 +258,7 @@ class _Request:
 
     __slots__ = ('batch', 'rows', 'tier', 'future', 'aggregate',
                  'chunk_idx', 't_enqueue', 't_deadline', 'trace',
-                 'span_parent', 'queue_span')
+                 'span_parent', 'queue_span', 'redispatched', 'exclude')
 
     def __init__(self, batch: Batch, tier: str,
                  future: Optional[Future] = None,
@@ -281,6 +281,11 @@ class _Request:
         # absolute expiry instant on the t_enqueue clock; None = no SLO
         self.t_deadline = (self.t_enqueue + deadline_s
                            if deadline_s else None)
+        # crash-safe redispatch state (serving/mesh.py): a batch that
+        # dies with its worker re-admits its members ONCE at the queue
+        # front, excluding the dead replica incarnation
+        self.redispatched = False
+        self.exclude = None
 
     def deliver(self, results: list) -> None:
         if self.aggregate is not None:

@@ -42,6 +42,24 @@ class DeadlineExceeded(ServingError):
     client has already given up on."""
 
 
+class ReplicaDead(ServingError):
+    """The mesh replica holding this request died (worker exit, wire
+    corruption, or heartbeat-declared liveness failure) and the request
+    could not be served anywhere else: it had already been redispatched
+    once after a previous crash, or no serving replica remains.  A
+    first crash is invisible to callers — the mesh re-admits the batch
+    members at the queue front and a sibling (or the supervised
+    restart) serves them."""
+
+
+class WireError(ServingError):
+    """A mesh transport frame failed validation — bad magic, truncated
+    body, or CRC mismatch (the on-wire shape of a worker dying mid-
+    write, or of stream corruption).  The replica behind the wire is
+    failed typed and its stream abandoned; one bad frame never poisons
+    the parent's receiver into misparsing every later frame."""
+
+
 class ExtractorError(ValueError):
     """Base of the extractor bridge's typed failures (a ``ValueError``
     so the REPL's recoverable-error contract holds)."""

@@ -185,7 +185,8 @@ class FrontQueue:
                 sum(self._pending_rows.values()))
 
     def pop_coalesced(self, max_rows: int, max_delay_s: float,
-                      alive: Callable[[], bool]
+                      alive: Callable[[], bool],
+                      claim=None
                       ) -> Optional[Tuple[str, List[_Request], int,
                                           List[_Request]]]:
         """One replica puller's claim on the shared queue.
@@ -199,7 +200,14 @@ class FrontQueue:
         fail typed.  Returns ``None`` when the queue is closed and
         drained, or when ``alive()`` goes false (breaker-tripped /
         retired replicas leave WITHOUT taking work — the queue never
-        wedges on a dead replica)."""
+        wedges on a dead replica).
+
+        ``claim`` identifies the puller's replica INCARNATION: a
+        redispatched request excludes the incarnation that crashed with
+        it (``_Request.exclude``), so a half-dead replica whose death
+        hasn't been noticed yet can never re-claim its own crashed
+        batch — skipped members stay at the queue front for a
+        sibling (or the supervised restart, a NEW incarnation)."""
         with self._cond:
             while True:
                 if not alive():
@@ -225,6 +233,7 @@ class FrontQueue:
                 return None
             taken: List[_Request] = []
             expired: List[_Request] = []
+            skipped: List[_Request] = []
             rows = 0
             now = time.perf_counter()
             queue = self._queues[tier]
@@ -235,8 +244,14 @@ class FrontQueue:
                     expired.append(request)
                     self._pending_rows[tier] -= request.rows
                     continue
+                if claim is not None and request.exclude is claim:
+                    skipped.append(request)
+                    continue
                 taken.append(request)
                 rows += request.rows
+            if skipped:
+                # excluded members keep their place at the front
+                queue.extendleft(reversed(skipped))
             self._pending_rows[tier] -= rows
             self._set_depth_locked()
         for request in expired:
@@ -247,6 +262,27 @@ class FrontQueue:
 
     def _any_queued_locked(self) -> bool:
         return any(self._queues[t] for t in PREDICT_TIERS)
+
+    def requeue_front(self, tier: str,
+                      requests: List[_Request]) -> bool:
+        """Crash-safe redispatch support (serving/mesh.py): re-admit
+        the members of a batch that died WITH its worker at the FRONT
+        of their tier queue, original order and deadlines intact —
+        already-expired members still shed typed at the next pop.  The
+        mesh enforces once-only via ``_Request.redispatched``; no new
+        admission check runs (the rows were already admitted and are
+        re-entering, not piling on).  Returns ``False`` when the queue
+        is closed fail-fast — the caller fails the requests typed
+        instead of queueing work nobody will drain."""
+        with self._cond:
+            if self._closed and not self._drain:
+                return False
+            self._queues[tier].extendleft(reversed(requests))
+            for request in requests:
+                self._pending_rows[tier] += request.rows
+            self._set_depth_locked()
+            self._cond.notify_all()
+        return True
 
     # ------------------------------------------------------- lifecycle
     def kick(self) -> None:

@@ -46,15 +46,45 @@ default (``MESH_REPLICA_MODE='thread'``): every replica is a
 so warm programs are shared through the trainer's jit caches and
 replica 2..N warm for free.  ``'process'`` runs each replica as a
 spawned worker process hosting its own model + engine, speaking the
-same dispatch wire (tokenized ``Batch`` out, decoded results back) over
-a pipe — the shape multi-host serving needs, so going distributed is a
-config change, not a rewrite.  Process replicas restore params from the
-model's checkpoint path (pytrees don't cross processes; checkpoint refs
-do — which is also why process-mode rollover takes step/path sources
-only).
+framed dispatch wire (serving/transport.py: tokenized ``Batch`` out,
+decoded results back, every message length-prefixed + CRC-checked)
+over a pipe; ``'socket'`` carries the IDENTICAL protocol over TCP — the
+mesh opens a listener, each worker dials in with a rid/proto handshake
+and reports its restored params step, so replicas can live on other
+machines.  Worker replicas restore params from the model's checkpoint
+path (pytrees don't cross processes; checkpoint refs do — which is
+also why worker-mode rollover takes step/path sources only).
 
-Measured gate: ``benchmarks/bench_mesh.py`` (open-loop load at fixed
-offered rate; p99 / shed rate / per-replica fill at 1/2/4 replicas).
+**Self-healing (SERVING.md "Multi-host mesh").**  Replica death is a
+non-event, not an operator page:
+
+- **Liveness distinct from dispatch health.**  Workers heartbeat every
+  ``MESH_HEARTBEAT_SECS`` (the in-flight count rides along); a
+  worker that misses more than ``MESH_HEARTBEAT_MISSES`` intervals is
+  marked dead typed — catching the hung or network-partitioned worker
+  the dispatch breaker cannot see because nothing is in flight.
+- **Crash-safe redispatch.**  Requests popped into a batch that dies
+  with its worker are re-admitted ONCE at the FRONT of the shared
+  queue with the dead incarnation excluded and their deadlines intact
+  (already-expired members still shed typed at pop), so a crash costs
+  latency, not answers; a second crash fails them typed
+  (``ReplicaDead``).  The redispatched request's trace carries both
+  attempts (``serving.redispatch`` event + a second queue_wait span).
+- **Supervised restart.**  A mesh supervisor thread restarts a dead
+  locally-spawned worker with exponential backoff under a window-
+  scoped budget (``MESH_RESTART_LIMIT`` per
+  ``MESH_RESTART_WINDOW_SECS`` — a flapping worker retires permanently
+  instead of storming).  The restarted worker cold-starts from the
+  checkpoint store, is re-adopted onto the fleet's CURRENT params step
+  (including a rollover that happened while it was down) before its
+  puller touches the queue, and capacity returns without operator
+  action.
+
+Measured gates: ``benchmarks/bench_mesh.py`` (open-loop load at fixed
+offered rate; p99 / shed rate / per-replica fill at 1/2/4 replicas)
+and ``scripts/mesh_soak.py`` (chaos soak: paced load + periodic
+``kill_worker``/``drop_heartbeat`` faults; zero lost admitted
+requests, zero post-warmup compiles, bounded p99).
 """
 from __future__ import annotations
 
@@ -68,11 +98,14 @@ import numpy as np
 
 from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
 from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving import engine as engine_lib
+from code2vec_tpu.serving import transport as transport_lib
 from code2vec_tpu.serving.engine import (ServingEngine, _Request,
                                          _resolve)
 from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
-                                         EngineOverloaded)
+                                         EngineOverloaded, ReplicaDead,
+                                         WireError)
 from code2vec_tpu.serving.frontqueue import FrontQueue
 from code2vec_tpu.telemetry import core as tele_core
 from code2vec_tpu.telemetry import tracing as tracing_lib
@@ -90,12 +123,20 @@ class _ReplicaSlot:
     """One row of the mesh replica table: transport + health + the
     dispatch accounting the weighting decisions read.  All mutable
     fields are guarded by the MESH's ``_cond`` lock (the replica's
-    puller, the decode-completion hook, rollover, and retirement all
-    touch them)."""
+    puller, the decode-completion hook, liveness monitor, supervisor,
+    rollover, and retirement all touch them).
+
+    ``dead`` is the liveness verdict (worker exited, wire corrupted,
+    or heartbeats missed): a dead slot stops pulling and waits for the
+    supervisor, which either restarts it (``transport`` is replaced —
+    the OLD transport object doubles as the incarnation token crash-
+    safe redispatch excludes) or retires it permanently once the
+    window-scoped restart budget is spent."""
 
     __slots__ = ('rid', 'transport', 'thread', 'retired', 'inflight',
                  'rows_dispatched', 'batches', 'breaker_fails',
-                 'breaker_state', 'breaker_open_until', 'canarying')
+                 'breaker_state', 'breaker_open_until', 'canarying',
+                 'dead', 'restarting', 'restart_times', 'restarts')
 
     def __init__(self, rid: str, transport):
         self.rid = rid
@@ -109,6 +150,10 @@ class _ReplicaSlot:
         self.breaker_state = _BREAKER_CLOSED
         self.breaker_open_until = 0.0
         self.canarying = False
+        self.dead = False
+        self.restarting = False
+        self.restart_times: collections.deque = collections.deque()
+        self.restarts = 0
 
 
 class _ThreadReplica:
@@ -149,43 +194,70 @@ class _ThreadReplica:
         self.engine.close()
 
 
-class _ProcessReplica:
-    """Process replica transport: a spawned worker hosting its own
-    model + engine, fed tokenized ``Batch`` payloads over a pipe and
-    returning decoded results — the same wire a multi-host mesh would
-    speak, so scaling out is a config change.
+class _WorkerReplica:
+    """Worker replica transport: a spawned process hosting its own
+    model + engine, fed tokenized ``Batch`` payloads over the framed
+    wire (serving/transport.py) and returning decoded results.  The
+    carrier is a pipe (``mode='process'``) or TCP (``mode='socket'`` —
+    the worker dials the mesh listener and introduces itself, the
+    shape that lets replicas live on other machines).
 
     The parent-side receiver thread resolves in-flight dispatches and
     feeds the mesh's completion hook; the worker serves dispatches
-    sequentially (its engine still decodes on its own pool)."""
+    sequentially (its engine still decodes on its own pool) and
+    heartbeats on its own thread, so a dispatch-busy worker still
+    proves liveness.  A worker death — EOF, a corrupt frame, or a
+    liveness kill — is reported ONCE through ``on_worker_dead`` with
+    the in-flight batches attached, so the mesh can redispatch them
+    instead of failing callers."""
 
-    mode = 'process'
-
-    # the pending map and the send side of the pipe are shared by the
-    # puller, the receiver thread, and control calls (lock-discipline
-    # rule, ANALYSIS.md):
-    # graftlint: guard _ProcessReplica._pending,_control,_seq by _lock
-    def __init__(self, rid: str, config_overrides: Dict[str, object],
+    # the pending map and the send side of the wire are shared by the
+    # puller, the receiver thread, the heartbeat monitor, and control
+    # calls (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard _WorkerReplica._pending,_control,_seq by _lock
+    def __init__(self, rid: str, mode: str,
+                 config_overrides: Dict[str, object],
                  on_batch_done, log, on_worker_dead=None,
+                 listener: Optional[transport_lib.SocketListener] = None,
                  start_timeout_s: float = 600.0):
         import multiprocessing
         self.rid = rid
+        self.mode = mode
         self.log = log
         self._on_batch_done = on_batch_done
         self._on_worker_dead = on_worker_dead
         self._start_timeout_s = start_timeout_s
+        self._listener = listener
+        self._cancel = threading.Event()
+        #: stamped by the receiver on every frame (heartbeats included);
+        #: the mesh liveness monitor reads it
+        self.last_heartbeat = time.perf_counter()
+        #: the worker's last self-reported {'inflight'} (surfaced as
+        #: ``worker_reported_inflight`` in mesh.stats())
+        self.heartbeat_info: Dict[str, object] = {}
+        #: the ready handshake's {'params_step', 'capabilities'}
+        self.ready_info: Dict[str, object] = {}
         ctx = multiprocessing.get_context('spawn')
-        self._conn, child = ctx.Pipe()
-        self._proc = ctx.Process(
-            target=_replica_worker_main,
-            args=(rid, config_overrides, child), daemon=True)
-        # spawn only: the worker's cold start (model build + warmup) is
-        # the expensive part, and N replicas must pay it CONCURRENTLY —
-        # the mesh constructs every transport first, then wait_ready()s
-        # each, so fleet startup is ~one worker's wall clock, not N of
-        # them
-        self._proc.start()
-        child.close()
+        if mode == 'socket':
+            address = listener.address
+            self._channel = None  # claimed from the listener at ready
+            self._proc = ctx.Process(
+                target=_replica_worker_main,
+                args=(rid, config_overrides, None, address), daemon=True)
+            self._proc.start()
+        else:
+            self._conn, child = ctx.Pipe()
+            self._proc = ctx.Process(
+                target=_replica_worker_main,
+                args=(rid, config_overrides, child, None), daemon=True)
+            # spawn only: the worker's cold start (model build + warmup)
+            # is the expensive part, and N replicas must pay it
+            # CONCURRENTLY — the mesh constructs every transport first,
+            # then wait_ready()s each, so fleet startup is ~one worker's
+            # wall clock, not N of them
+            self._proc.start()
+            child.close()
+            self._channel = transport_lib.PipeTransport(self._conn)
         self._lock = threading.Lock()
         self._pending: Dict[int, Tuple[List[_Request], int]] = {}
         self._seq = 0
@@ -194,32 +266,57 @@ class _ProcessReplica:
 
     def wait_ready(self) -> None:
         """Block until the worker reported ready, then start the
-        receiver.  Must run before the first dispatch/control call."""
+        receiver.  Must run before the first dispatch/control call.
+        Interruptible via ``cancel()`` (a mesh closing mid-restart must
+        not wait out a worker cold start)."""
         if self._receiver is not None:
             return
-        if not self._conn.poll(self._start_timeout_s):
-            self._proc.terminate()
-            raise RuntimeError(
-                'mesh replica %s worker did not come up within %.0fs'
-                % (self.rid, self._start_timeout_s))
+        deadline = time.perf_counter() + self._start_timeout_s
+        if self._channel is None:
+            # socket mode: the worker dials in; claim its validated
+            # hello from the listener, pinned to THIS incarnation's
+            # pid (a reaped predecessor's late hello must not be
+            # handed to the restart)
+            try:
+                self._channel, _hello = self._listener.claim(
+                    self.rid, self._start_timeout_s, cancel=self._cancel,
+                    pid=self._proc.pid)
+            except BaseException as exc:
+                self.reap()
+                raise RuntimeError(
+                    'mesh replica %s worker never dialed in: %r'
+                    % (self.rid, exc))
+        while not self._channel.poll(0.25):
+            if self._cancel.is_set():
+                self.reap()
+                raise RuntimeError('mesh replica %s startup cancelled '
+                                   '(mesh closing)' % self.rid)
+            if time.perf_counter() >= deadline:
+                self.reap()
+                raise RuntimeError(
+                    'mesh replica %s worker did not come up within %.0fs'
+                    % (self.rid, self._start_timeout_s))
         try:
-            msg = self._conn.recv()
-        except (EOFError, OSError) as exc:
+            msg = self._channel.recv()
+        except (EOFError, OSError, WireError) as exc:
             # worker died before it could even report its failure
-            self._proc.terminate()
+            self.reap()
             raise RuntimeError(
                 'mesh replica %s worker exited during startup (%r) — '
-                'check the worker log; process replicas need a '
+                'check the worker log; worker replicas need a '
                 'checkpointed model with a retained step'
                 % (self.rid, exc))
         if msg[0] == 'failed':
-            self._proc.terminate()
+            self.reap()
             raise RuntimeError('mesh replica %s worker failed to '
                                'start: %s' % (self.rid, msg[1]))
         if msg[0] != 'ready':
-            self._proc.terminate()
+            self.reap()
             raise RuntimeError('mesh replica %s worker failed to start: '
                                '%r' % (self.rid, msg))
+        self.ready_info = msg[1] if len(msg) > 1 and \
+            isinstance(msg[1], dict) else {}
+        self.last_heartbeat = time.perf_counter()
         self._receiver = threading.Thread(target=self._recv_loop,
                                           daemon=True,
                                           name='mesh-recv-%s' % self.rid)
@@ -232,30 +329,42 @@ class _ProcessReplica:
             seq = self._seq
             self._seq += 1
             self._control[seq] = future
-            self._conn.send((kind, seq) + payload)
+            self._channel.send((kind, seq) + payload)
         return future.result(timeout)
 
     def dispatch(self, tier: str, taken: List[_Request],
                  rows: int) -> None:
         batches = [request.batch for request in taken]
+        seq = None
         try:
             with self._lock:
                 seq = self._seq
                 self._seq += 1
                 self._pending[seq] = (taken, rows)
-                self._conn.send(('dispatch', seq, tier, batches))
+                self._channel.send(('dispatch', seq, tier, batches))
         except BaseException as exc:
-            with self._lock:
-                self._pending.pop(seq, None)
-            # same contract as engine.dispatch_external: the member
-            # requests FAIL TYPED here (the puller's breaker handler
-            # assumes it), then the error propagates for breaker
-            # accounting — a dead worker pipe must never leave caller
-            # futures hanging
-            failure = EngineClosed(
-                'mesh replica %s wire send failed: %r' % (self.rid, exc))
-            for request in taken:
-                request.fail(failure)
+            entry = None
+            if seq is not None:
+                with self._lock:
+                    entry = self._pending.pop(seq, None)
+            # a dead wire at send time is a worker death with this batch
+            # in flight: hand the members to the mesh's crash-safe
+            # redispatch (first crash re-admits them at the queue front;
+            # a second fails them typed), then re-raise so the puller's
+            # breaker accounts the replica failure.  The receiver's EOF
+            # path may race this — whoever pops the pending entry owns
+            # the requests, so they are handled exactly once.
+            if entry is not None and self._on_worker_dead is not None:
+                try:
+                    self._on_worker_dead(
+                        self, [entry],
+                        WireError('mesh replica %s wire send failed: %r'
+                                  % (self.rid, exc)))
+                except Exception:
+                    for request in entry[0]:
+                        request.fail(EngineClosed(
+                            'mesh replica %s wire send failed: %r'
+                            % (self.rid, exc)))
             raise
         # the worker pops its queue-wait here, not in an engine this
         # process can see: close the span at hand-off so queue time is
@@ -269,35 +378,48 @@ class _ProcessReplica:
     def _recv_loop(self) -> None:
         while True:
             try:
-                msg = self._conn.recv()
-            except (EOFError, OSError):
-                # worker died: every in-flight dispatch fails typed
+                msg = self._channel.recv()
+            except (EOFError, OSError, WireError) as exc:
+                # worker died (EOF) or its stream is poisoned (a partial
+                # frame from a mid-write death fails TYPED instead of
+                # misparsing every later frame): drain the in-flight
+                # state once and report the death upward — the mesh
+                # redispatches the batches and the supervisor restarts
+                # the worker
                 with self._lock:
-                    pending = list(self._pending.items())
+                    pending = list(self._pending.values())
                     self._pending.clear()
-                    control = list(self._control.items())
+                    control = list(self._control.values())
                     self._control.clear()
-                exc = EngineClosed(
-                    'mesh replica %s worker exited with %d dispatch(es) '
-                    'in flight' % (self.rid, len(pending)))
-                for _seq, (taken, rows) in pending:
-                    for request in taken:
-                        request.fail(exc)
-                    self._on_batch_done(self, rows, taken, False)
-                for _seq, future in control:
+                dead = ReplicaDead(
+                    'mesh replica %s worker died (%r) with %d '
+                    'dispatch(es) in flight'
+                    % (self.rid, exc, len(pending)))
+                for future in control:
                     if not future.done():
-                        future.set_exception(exc)
+                        future.set_exception(dead)
                 if self._on_worker_dead is not None:
-                    # the worker can never come back (no respawn yet —
-                    # ROADMAP item 2): the mesh retires the slot, so
-                    # the breaker's half-open probe doesn't sacrifice
-                    # one real micro-batch every cooldown forever
                     try:
-                        self._on_worker_dead(self)
+                        self._on_worker_dead(self, pending, dead)
                     except Exception:
-                        pass
+                        for taken, _rows in pending:
+                            for request in taken:
+                                request.fail(dead)
+                else:
+                    for taken, _rows in pending:
+                        for request in taken:
+                            request.fail(dead)
                 return
+            # a partitioned network loses frames while both endpoints
+            # stay up: results AND heartbeats vanish, so the liveness
+            # monitor (not the breaker) is what notices
+            if faults.maybe_fire('partition'):
+                continue
+            self.last_heartbeat = time.perf_counter()
             kind, seq = msg[0], msg[1]
+            if kind == 'heartbeat':
+                self.heartbeat_info = msg[2]
+                continue
             if kind in ('result', 'error'):
                 with self._lock:
                     entry = self._pending.pop(seq, None)
@@ -325,6 +447,39 @@ class _ProcessReplica:
                     _resolve(ctrl, None)
                 return
 
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def cancel(self) -> None:
+        """Abort a wait_ready in flight (mesh closing mid-restart)."""
+        self._cancel.set()
+        self.kill()
+
+    def kill(self) -> None:
+        """Hard-stop a hung or partitioned worker: SIGKILL + close the
+        channel so the blocked receiver unblocks with EOF and the death
+        path runs there exactly once."""
+        try:
+            if self._proc is not None and self._proc.is_alive():
+                self._proc.kill()
+        except Exception:
+            pass
+        try:
+            if self._channel is not None:
+                self._channel.close()
+        except Exception:
+            pass
+
+    def reap(self) -> None:
+        """Terminate + join a worker that is already dead or being
+        abandoned, without the graceful close handshake."""
+        self.kill()
+        try:
+            self._proc.join(timeout=30.0)
+        except Exception:
+            pass
+
     def warmup(self) -> None:
         pass  # the worker warms before it reports ready
 
@@ -335,7 +490,7 @@ class _ProcessReplica:
         canary concludes on the worker's live dispatch traffic)."""
         if not isinstance(source, (int, str)) or isinstance(source, bool):
             raise RuntimeError(
-                'process-mode replicas roll over from checkpoint refs '
+                'worker-mode replicas roll over from checkpoint refs '
                 '(step int or model path), not param pytrees — pytrees '
                 'do not cross process (or host) boundaries')
         self._control_call('load_params', source, canary_batches,
@@ -373,32 +528,47 @@ class _ProcessReplica:
 
     def close(self) -> None:
         if self._receiver is None:
-            # never became ready (a sibling's startup failed): nothing
-            # to hand-shake with — just reap the worker
-            self._proc.terminate()
-            self._proc.join(timeout=30.0)
-            self._conn.close()
+            # never became ready (a sibling's startup failed, or a
+            # cancelled restart): nothing to hand-shake with — just
+            # reap the worker
+            self.reap()
             return
         try:
             self._control_call('close', timeout=60.0)
         except BaseException:
-            pass  # a dead worker's pipe refuses the handshake: reap it
+            pass  # a dead worker's wire refuses the handshake: reap it
         if self._receiver is not threading.current_thread():
             # the worker-dead path closes from the receiver itself
             self._receiver.join(timeout=30.0)
         self._proc.join(timeout=60.0)
         if self._proc.is_alive():
             self._proc.terminate()
-        self._conn.close()
+        if self._channel is not None:
+            self._channel.close()
 
 
 def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
-                         conn) -> None:
-    """Process-replica worker entry point (spawned): build the model
-    from the shipped config, host one external-dispatch engine, serve
-    the pipe."""
+                         conn, address) -> None:
+    """Worker replica entry point (spawned): build the model from the
+    shipped config, host one external-dispatch engine, serve the
+    framed wire — a pipe connection (``conn``) in process mode, or a
+    TCP dial to the mesh listener (``address``) in socket mode.  The
+    protocol is identical either way."""
+    import os
+    import signal
     from code2vec_tpu.config import Config
     from code2vec_tpu.model_api import Code2VecModel
+    if conn is not None:
+        channel = transport_lib.PipeTransport(conn)
+    else:
+        channel = transport_lib.dial(address, rid, os.getpid())
+    # the heartbeat thread and the serve loop share the send side
+    send_lock = threading.Lock()
+
+    def send(message) -> None:
+        with send_lock:
+            channel.send(message)
+
     try:
         config = Config(**config_overrides)
         model = Code2VecModel(config)
@@ -414,55 +584,91 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
         # EOF on the wire (a missing retained step, a model-build
         # failure, ...)
         try:
-            conn.send(('failed', repr(exc)))
+            send(('failed', repr(exc)))
         except BaseException:
             pass
         raise
     rollover: Dict[str, object] = {'handle': None}
-    conn.send(('ready', None))
+    inflight = [0]
+    stop_beats = threading.Event()
+
+    def beat_loop() -> None:
+        """Liveness, decoupled from dispatch: a dispatch-busy worker
+        still beats; a hung or drilled one goes silent and the mesh
+        liveness monitor — not the breaker — declares it dead."""
+        period = float(config.MESH_HEARTBEAT_SECS)
+        if period <= 0:
+            return
+        while not stop_beats.wait(period):
+            if faults.maybe_fire('drop_heartbeat'):
+                continue  # the drilled shape of a hung worker
+            try:
+                send(('heartbeat', -1, {'inflight': inflight[0]}))
+            except BaseException:
+                return  # wire gone: the serve loop is exiting too
+
+    engine_stats = engine.stats()
+    send(('ready', {
+        'params_step': engine_stats.get('params_step'),
+        'capabilities': {'tiers': list(config.serving_warm_tiers),
+                         'wire': config.BATCH_WIRE_FORMAT,
+                         'proto': transport_lib.WIRE_PROTO},
+    }))
+    beats = threading.Thread(target=beat_loop, daemon=True,
+                             name='mesh-beat-%s' % rid)
+    beats.start()
     try:
         while True:
-            msg = conn.recv()
+            msg = channel.recv()
             kind, seq = msg[0], msg[1]
             try:
                 if kind == 'dispatch':
+                    if faults.maybe_fire('kill_worker'):
+                        # mid-batch SIGKILL: the parent has this
+                        # dispatch in _pending, so the drill exercises
+                        # exactly the crash-safe redispatch path
+                        os.kill(os.getpid(), signal.SIGKILL)
                     tier, batches = msg[2], msg[3]
                     requests = [_Request(batch, tier, future=Future())
                                 for batch in batches]
                     rows = sum(request.rows for request in requests)
-                    engine.dispatch_external(tier, requests, rows)
-                    results = [request.future.result(timeout=600)
-                               for request in requests]
-                    conn.send(('result', seq, results))
+                    inflight[0] += 1
+                    try:
+                        engine.dispatch_external(tier, requests, rows)
+                        results = [request.future.result(timeout=600)
+                                   for request in requests]
+                    finally:
+                        inflight[0] -= 1
+                    send(('result', seq, results))
                 elif kind == 'load_params':
                     source, n_canary, floor = msg[2], msg[3], msg[4]
                     rollover['handle'] = engine.load_params(
                         source, canary_batches=n_canary,
                         min_agreement=floor)
-                    conn.send(('result', seq, True))
+                    send(('result', seq, True))
                 elif kind == 'poll_rollover':
                     handle = rollover['handle']
                     if handle is not None and handle.done():
                         rollover['handle'] = None
-                        conn.send(('result', seq, handle.result()))
+                        send(('result', seq, handle.result()))
                     else:
-                        conn.send(('result', seq, None))
+                        send(('result', seq, None))
                 elif kind == 'stats':
-                    conn.send(('result', seq, engine.stats()))
+                    send(('result', seq, engine.stats()))
                 elif kind == 'close':
                     engine.close()
-                    conn.send(('closed', seq))
+                    send(('closed', seq))
                     return
                 else:
                     raise RuntimeError('unknown mesh wire message %r'
                                        % (kind,))
             except BaseException as exc:
                 try:
-                    conn.send(('error', seq, exc))
+                    send(('error', seq, exc))
                 except BaseException:
-                    conn.send(('error', seq,
-                               RuntimeError(repr(exc))))
+                    send(('error', seq, RuntimeError(repr(exc))))
     finally:
+        stop_beats.set()
         engine.close()
 
 
@@ -473,11 +679,12 @@ class ServingMesh:
     engine's (``submit`` / ``predict`` / ``submit_neighbors`` /
     ``load_params`` / ``follow_checkpoints`` / ``close``)."""
 
-    # the replica table, fleet service window, rollover slot and close
-    # flags are shared by submitters, N pullers, decode-completion
-    # hooks, and control calls (lock-discipline rule, ANALYSIS.md);
-    # _cond wraps _lock:
-    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s by _lock|_cond
+    # the replica table, fleet service window, rollover slot, restart
+    # hand-off and close flags are shared by submitters, N pullers,
+    # decode-completion hooks, the supervisor, the liveness monitor,
+    # and control calls (lock-discipline rule, ANALYSIS.md); _cond
+    # wraps _lock:
+    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s,_restart_pending by _lock|_cond
     def __init__(self, model, replicas: Optional[int] = None,
                  tiers: Optional[Sequence[str]] = None,
                  mode: Optional[str] = None,
@@ -490,6 +697,11 @@ class ServingMesh:
                  canary_batches: Optional[int] = None,
                  canary_agreement: Optional[float] = None,
                  params_step: Optional[int] = None,
+                 heartbeat_secs: Optional[float] = None,
+                 heartbeat_misses: Optional[int] = None,
+                 restart_limit: Optional[int] = None,
+                 restart_window_secs: Optional[float] = None,
+                 restart_backoff_secs: Optional[float] = None,
                  tracer: Optional[tracing_lib.Tracer] = None,
                  tracing_sample_rate: Optional[float] = None,
                  log=None):
@@ -500,9 +712,26 @@ class ServingMesh:
         if n < 1:
             raise ValueError('a mesh needs >= 1 replica, got %d' % n)
         self.mode = mode if mode is not None else config.MESH_REPLICA_MODE
-        if self.mode not in ('thread', 'process'):
-            raise ValueError("MESH_REPLICA_MODE must be 'thread' or "
-                             "'process', got %r" % (self.mode,))
+        if self.mode not in ('thread', 'process', 'socket'):
+            raise ValueError("MESH_REPLICA_MODE must be 'thread', "
+                             "'process' or 'socket', got %r"
+                             % (self.mode,))
+        # ---- self-healing knobs (SERVING.md "Multi-host mesh") ----
+        self.heartbeat_secs = float(
+            heartbeat_secs if heartbeat_secs is not None
+            else config.MESH_HEARTBEAT_SECS)
+        self.heartbeat_misses = max(1, int(
+            heartbeat_misses if heartbeat_misses is not None
+            else config.MESH_HEARTBEAT_MISSES))
+        self.restart_limit = max(0, int(
+            restart_limit if restart_limit is not None
+            else config.MESH_RESTART_LIMIT))
+        self.restart_window_s = float(
+            restart_window_secs if restart_window_secs is not None
+            else config.MESH_RESTART_WINDOW_SECS)
+        self.restart_backoff_s = float(
+            restart_backoff_secs if restart_backoff_secs is not None
+            else config.MESH_RESTART_BACKOFF_SECS)
         tiers = tuple(tiers if tiers is not None
                       else config.serving_warm_tiers)
         for tier in tiers:
@@ -565,6 +794,16 @@ class ServingMesh:
         self._param_source = model._serving_param_source()
         self._follow_thread: Optional[threading.Thread] = None
         self._follow_stop = threading.Event()
+        # self-healing state: the close event interrupts supervisor
+        # backoffs; _restart_pending is the transport a restart is
+        # readying (close() cancels it so fail-fast close never waits
+        # out — or leaks — a worker cold start)
+        self._close_event = threading.Event()
+        self._restart_pending: Optional[_WorkerReplica] = None
+        self._supervisor: Optional[threading.Thread] = None
+        self._liveness_thread: Optional[threading.Thread] = None
+        self._listener: Optional[transport_lib.SocketListener] = None
+        self._model_config_overrides: Optional[Dict[str, object]] = None
         # instruments (mesh-level; per-replica series ride the engines'
         # replica-labeled mirrors)
         self.requests_total = Counter('mesh/requests_total')
@@ -575,6 +814,11 @@ class ServingMesh:
             'mesh/replica_breaker_open_total')
         self.replicas_gauge = Gauge('mesh/replicas')
         self.serving_gauge = Gauge('mesh/replicas_serving')
+        self.live_gauge = Gauge('mesh/replicas_live')
+        self.restarts_total = Counter('mesh/restarts_total')
+        self.redispatched_total = Counter('mesh/redispatched_total')
+        self.heartbeat_misses_total = Counter(
+            'mesh/heartbeat_misses_total')
         # tracing: ONE tracer shared with every thread-mode replica, so
         # the flight recorder and span log see the whole fleet
         rate = (tracing_sample_rate if tracing_sample_rate is not None
@@ -606,6 +850,17 @@ class ServingMesh:
         # ---- replica table ----
         self._replicas: List[_ReplicaSlot] = []
         try:
+            if self.mode == 'socket':
+                # workers dial in: the listener must be up before the
+                # first spawn.  MESH_SOCKET_HOST is the bind address —
+                # 127.0.0.1 keeps spawned-local workers loopback-only;
+                # a routable address lets workers on other machines
+                # dial the same wire.
+                self._listener = transport_lib.SocketListener(
+                    config.MESH_SOCKET_HOST)
+            if self.mode != 'thread':
+                self._model_config_overrides = \
+                    self._process_config_overrides(model)
             for i in range(n):
                 rid = 'r%d' % i
                 if self.mode == 'thread':
@@ -626,11 +881,7 @@ class ServingMesh:
                         log=self.log)
                     transport = _ThreadReplica(engine)
                 else:
-                    transport = _ProcessReplica(
-                        rid, self._process_config_overrides(model),
-                        on_batch_done=self._on_process_batch_done,
-                        on_worker_dead=self._on_worker_dead,
-                        log=self.log)
+                    transport = self._spawn_worker(rid)
                 self._replicas.append(_ReplicaSlot(rid, transport))
             for slot in self._replicas:
                 # process workers spawned above cold-start in parallel;
@@ -643,17 +894,43 @@ class ServingMesh:
                     slot.transport.close()
                 except BaseException:
                     pass
+            if self._listener is not None:
+                self._listener.close()
             self._aux_pool.shutdown(wait=False)
             raise
         self.replicas_gauge.set(n)
         if tele_core.enabled():
             tele_core.registry().gauge('mesh/replicas').set(n)
         self._set_serving_gauge_locked_free()
+        self._set_live_gauge_locked_free()
         for slot in self._replicas:
             slot.thread = threading.Thread(
-                target=self._pull_loop, args=(slot,), daemon=True,
-                name='mesh-pull-%s' % slot.rid)
+                target=self._pull_loop, args=(slot, slot.transport),
+                daemon=True, name='mesh-pull-%s' % slot.rid)
             slot.thread.start()
+        if self.mode != 'thread':
+            # the self-healing layer: supervisor restarts dead workers
+            # under the window-scoped budget; the liveness monitor
+            # detects hung/partitioned workers the breaker cannot see
+            self._supervisor = threading.Thread(
+                target=self._supervise_loop, daemon=True,
+                name='mesh-supervisor')
+            self._supervisor.start()
+            if self.heartbeat_secs > 0:
+                self._liveness_thread = threading.Thread(
+                    target=self._liveness_loop, daemon=True,
+                    name='mesh-liveness')
+                self._liveness_thread.start()
+
+    def _spawn_worker(self, rid: str) -> '_WorkerReplica':
+        """One worker transport (initial fleet build AND supervised
+        restart): the worker cold-starts from the checkpoint store and
+        reports ready over the framed wire."""
+        return _WorkerReplica(
+            rid, self.mode, self._model_config_overrides,
+            on_batch_done=self._on_worker_batch_done,
+            on_worker_dead=self._on_worker_dead,
+            listener=self._listener, log=self.log)
 
     # ------------------------------------------------- process plumbing
     def _process_config_overrides(self, model) -> Dict[str, object]:
@@ -667,10 +944,10 @@ class ServingMesh:
                      if config.is_saving else None)
         if load_path is None:
             raise RuntimeError(
-                "MESH_REPLICA_MODE='process' needs a checkpointed model "
+                "MESH_REPLICA_MODE='%s' needs a checkpointed model "
                 '(a --save or --load path with at least one retained '
                 'step): worker processes restore params from the store, '
-                'they cannot share the parent\'s arrays')
+                'they cannot share the parent\'s arrays' % self.mode)
         overrides = {}
         for field in dataclasses.fields(type(config)):
             value = getattr(config, field.name, None)
@@ -680,6 +957,12 @@ class ServingMesh:
         overrides['MODEL_SAVE_PATH'] = ''
         overrides['TRAIN_DATA_PATH_PREFIX'] = ''
         overrides['SERVE_FOLLOW_CHECKPOINTS_SECS'] = 0.0
+        # the worker beats at the MESH's resolved period, not whatever
+        # the config default says — a constructor override that only
+        # reached the liveness monitor would make a healthy worker
+        # look dead (monitor dividing by a shorter period than the
+        # worker beats at) and grind the restart budget down
+        overrides['MESH_HEARTBEAT_SECS'] = self.heartbeat_secs
         # the worker warms the MESH's resolved tiers, not whatever the
         # parent's SERVING_WARM_TIERS default says — a tier the caller
         # added (submit_neighbors' 'vectors') must be warm in every
@@ -716,10 +999,11 @@ class ServingMesh:
             return max(1, self.max_inflight // 2)
         return self.max_inflight
 
-    def _slot_ready_locked(self, slot: _ReplicaSlot) -> str:
+    def _slot_ready_locked(self, slot: _ReplicaSlot,
+                           transport) -> str:
         """'ready' | 'wait' | 'exit' for one puller iteration."""
-        if slot.retired:
-            return 'exit'
+        if slot.retired or slot.dead or slot.transport is not transport:
+            return 'exit'  # dead/replaced incarnation: its puller dies
         if self._closed and not self._drain:
             return 'exit'
         if slot.breaker_state == _BREAKER_OPEN:
@@ -733,12 +1017,14 @@ class ServingMesh:
             return 'wait'
         return 'ready'
 
-    def _slot_alive(self, slot: _ReplicaSlot) -> bool:
+    def _slot_alive(self, slot: _ReplicaSlot, transport) -> bool:
         """The queue-side claim check a puller passes to
-        ``pop_coalesced``: a replica that retired or tripped its breaker
-        while waiting must leave WITHOUT taking work."""
+        ``pop_coalesced``: a replica that retired, died, was replaced,
+        or tripped its breaker while waiting must leave WITHOUT taking
+        work."""
         with self._lock:
-            return not (slot.retired
+            return not (slot.retired or slot.dead
+                        or slot.transport is not transport
                         or slot.breaker_state == _BREAKER_OPEN
                         or (self._closed and not self._drain))
 
@@ -747,19 +1033,34 @@ class ServingMesh:
         # gauge is advisory, and both call paths immediately follow a
         # locked mutation
         serving = sum(1 for slot in self._replicas
-                      if not slot.retired
+                      if not slot.retired and not slot.dead
                       and slot.breaker_state != _BREAKER_OPEN)
         self.serving_gauge.set(serving)
         if tele_core.enabled():
             tele_core.registry().gauge(
                 'mesh/replicas_serving').set(serving)
 
+    def _set_live_gauge_locked_free(self) -> None:
+        # the liveness verdict, distinct from dispatch health: a
+        # breaker-open replica is still LIVE (its worker heartbeats),
+        # a dead one is not.  Thread replicas share this process's
+        # liveness by construction.
+        live = sum(1 for slot in self._replicas
+                   if not slot.retired and not slot.dead)
+        self.live_gauge.set(live)
+        if tele_core.enabled():
+            tele_core.registry().gauge('mesh/replicas_live').set(live)
+
     # -------------------------------------------------------- pull loop
-    def _pull_loop(self, slot: _ReplicaSlot) -> None:
+    def _pull_loop(self, slot: _ReplicaSlot, transport) -> None:
+        # `transport` pins this puller to ONE incarnation: after a
+        # supervised restart the slot carries a fresh transport and a
+        # fresh puller — a straggler from the dead incarnation exits
+        # instead of dispatching onto a wire it no longer owns
         while True:
             with self._cond:
                 while True:
-                    state = self._slot_ready_locked(slot)
+                    state = self._slot_ready_locked(slot, transport)
                     if state == 'exit':
                         return
                     if state == 'ready':
@@ -769,7 +1070,8 @@ class ServingMesh:
                     self._cond.wait(0.05)
             popped = self._queue.pop_coalesced(
                 self.buckets[-1], self.max_delay_s,
-                alive=lambda: self._slot_alive(slot))
+                alive=lambda: self._slot_alive(slot, transport),
+                claim=transport)
             if popped is None:
                 # depth read BEFORE taking the mesh lock: pop_coalesced
                 # holds the queue lock while it calls back into the
@@ -778,7 +1080,9 @@ class ServingMesh:
                 # stale depth just loops once more
                 depth = self._queue.depth_rows()
                 with self._lock:
-                    if slot.retired or (self._closed and not self._drain):
+                    if slot.retired or slot.dead or \
+                            slot.transport is not transport or \
+                            (self._closed and not self._drain):
                         return
                     if self._closed and depth == 0:
                         return
@@ -796,22 +1100,24 @@ class ServingMesh:
                 slot.inflight += 1
                 probing = slot.breaker_state == _BREAKER_HALF_OPEN
             try:
-                slot.transport.dispatch(tier, taken, rows)
+                transport.dispatch(tier, taken, rows)
             except BaseException as exc:
-                # dispatch_external already failed the member requests
-                # typed; here the BREAKER accounts the replica failure
+                # the member requests are already handled (thread mode:
+                # dispatch_external failed them typed; worker mode: the
+                # wire-send failure routed them through crash-safe
+                # redispatch); here the BREAKER accounts the replica
+                # failure
                 self._dispatch_failed(slot, rows, probing, exc)
                 continue
-            if self.mode == 'process':
-                continue  # completion arrives via the receiver thread
-            # thread transport: the engine's decode worker fires
-            # _on_batch_done; nothing more to do here
+            # completion: thread transport via the engine's decode
+            # worker (_on_batch_done), worker transports via their
+            # receiver thread — nothing more to do here either way
 
     def _dispatch_failed(self, slot: _ReplicaSlot, rows: int,
                          probing: bool, exc: BaseException) -> None:
         del rows, probing
         with self._cond:
-            slot.inflight -= 1
+            slot.inflight = max(0, slot.inflight - 1)
             self._breaker_failure_locked(slot)
             self._cond.notify_all()
         self._queue.kick()
@@ -846,38 +1152,284 @@ class ServingMesh:
                     and s.transport.engine is engine)
         self._complete(slot, rows, taken, ok)
 
-    def _on_process_batch_done(self, transport, rows: int,
-                               taken: List[_Request], ok: bool) -> None:
-        slot = next(s for s in self._replicas
-                    if s.transport is transport)
+    def _on_worker_batch_done(self, transport, rows: int,
+                              taken: List[_Request], ok: bool) -> None:
+        slot = next((s for s in self._replicas
+                     if s.transport is transport), None)
+        if slot is None:
+            return  # a stale completion from a replaced incarnation
         self._complete(slot, rows, taken, ok)
 
-    def _on_worker_dead(self, transport) -> None:
-        """A process replica's worker exited (EOF on the wire): it can
-        never serve again, so retire the slot — otherwise the breaker's
-        half-open probe would sacrifice one real micro-batch every
-        cooldown, forever, to a corpse."""
+    # ------------------------------------------------------ self-healing
+    def _on_worker_dead(self, transport,
+                        pending: List[Tuple[List[_Request], int]],
+                        reason: BaseException) -> None:
+        """A worker replica died — EOF, a corrupt frame, a wire-send
+        failure, or a liveness kill.  Mark the slot dead TYPED (the
+        supervisor restarts it under the budget; the breaker's
+        half-open probe never sacrifices a real micro-batch to a
+        corpse), then crash-safe-redispatch the batches that died with
+        it: members are re-admitted ONCE at the front of the shared
+        queue with this incarnation excluded and their deadlines
+        intact, so the crash costs latency, not answers."""
         with self._cond:
             slot = next((s for s in self._replicas
                          if s.transport is transport), None)
-            if slot is None or slot.retired:
-                return
-            slot.retired = True
-            self._cond.notify_all()
+            if slot is not None and not slot.retired and not slot.dead:
+                slot.dead = True
+                slot.inflight = 0
+                self._cond.notify_all()  # puller exits, supervisor wakes
+        requeued = failed = 0
+        for taken, _rows in pending:
+            got = self._redispatch_batch(transport, slot, taken, reason)
+            requeued += got
+            failed += len(taken) - got
         self._set_serving_gauge_locked_free()
+        self._set_live_gauge_locked_free()
         self._queue.kick()
-        self.log('mesh: replica %s worker died; replica retired '
-                 '(queue redirects to the remaining replicas)'
-                 % slot.rid)
+        self.log('mesh: replica %s worker DEAD (%s): %d request(s) '
+                 'redispatched to the front of the queue, %d failed '
+                 'typed; supervisor will restart it within the budget'
+                 % (slot.rid if slot is not None else '?', reason,
+                    requeued, failed))
         try:
-            transport.close()  # reap the corpse (skips the dead pipe)
+            transport.reap()  # the corpse: SIGKILL + join, no handshake
         except Exception:
             pass
+
+    def _redispatch_batch(self, token, slot: Optional[_ReplicaSlot],
+                          taken: List[_Request],
+                          reason: BaseException) -> int:
+        """Re-admit the members of one crashed batch at the FRONT of
+        their tier queue (once per request — a second crash fails them
+        typed ``ReplicaDead``).  Returns how many were re-admitted."""
+        survivors: List[_Request] = []
+        for request in taken:
+            if request.trace is not None and \
+                    request.queue_span is not None:
+                # the wire-send-failure path reaches here with the
+                # FIRST queue_wait span still open (the hand-off close
+                # only runs after a successful send): end it so the
+                # redispatch attempt's span doesn't orphan it
+                request.trace.end(request.queue_span)
+                request.queue_span = None
+            if request.redispatched:
+                request.fail(ReplicaDead(
+                    'request lost its replica twice (%s); failing '
+                    'typed instead of bouncing forever' % reason))
+                continue
+            request.redispatched = True
+            request.exclude = token
+            if request.trace is not None:
+                # the trace shows BOTH attempts: the first queue_wait/
+                # dispatch, this event, then a second queue_wait
+                request.trace.event(
+                    'serving.redispatch', parent=request.span_parent,
+                    attrs={'replica': slot.rid if slot else '?',
+                           'reason': str(reason)})
+                request.queue_span = request.trace.span(
+                    'serving.queue_wait', parent=request.span_parent)
+            survivors.append(request)
+        if not survivors:
+            return 0
+        if not self._queue.requeue_front(survivors[0].tier, survivors):
+            # mesh closed fail-fast between death and redispatch
+            for request in survivors:
+                request.fail(EngineClosed(
+                    'ServingMesh closed before the crashed batch could '
+                    'be redispatched'))
+            return 0
+        self.redispatched_total.inc(len(survivors))
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'mesh/redispatched_total').inc(len(survivors))
+        return len(survivors)
+
+    def _liveness_loop(self) -> None:
+        """Heartbeat monitor: liveness DISTINCT from dispatch health.
+        A hung or partitioned worker with nothing in flight looks
+        healthy to the breaker (no dispatch fails); its missing
+        heartbeats are what betray it.  Past the miss budget the
+        replica is killed — the receiver's EOF then runs the one death
+        path (redispatch + supervised restart)."""
+        period = self.heartbeat_secs
+        while not self._close_event.wait(period):
+            now = time.perf_counter()
+            with self._cond:
+                watched = [(s, s.transport) for s in self._replicas
+                           if not s.retired and not s.dead
+                           and not s.restarting
+                           and isinstance(s.transport, _WorkerReplica)]
+            for slot, transport in watched:
+                missed = (now - transport.last_heartbeat) / period
+                if missed < 1.0:
+                    continue
+                self.heartbeat_misses_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'mesh/heartbeat_misses_total').inc()
+                if missed > self.heartbeat_misses:
+                    self.log('mesh: replica %s missed %d heartbeats '
+                             '(budget %d) — hung or partitioned; '
+                             'marking dead and killing the worker'
+                             % (slot.rid, int(missed),
+                                self.heartbeat_misses))
+                    # the kill forces the receiver's EOF: death
+                    # handling (redispatch + supervisor) runs there
+                    # exactly once
+                    transport.kill()
+
+    def _supervise_loop(self) -> None:
+        """Supervised restart: a dead locally-spawned worker comes back
+        on its own — exponential backoff, a window-scoped restart
+        budget (a flapping worker retires permanently instead of
+        storming), cold start from the checkpoint store, then
+        re-adoption onto the fleet's CURRENT params step before its
+        puller touches the queue."""
+        while True:
+            retire = False
+            with self._cond:
+                slot = None
+                while slot is None:
+                    if self._closed:
+                        return
+                    slot = next((s for s in self._replicas
+                                 if s.dead and not s.retired
+                                 and not s.restarting), None)
+                    if slot is None:
+                        self._cond.wait(0.2)
+                now = time.perf_counter()
+                while slot.restart_times and \
+                        now - slot.restart_times[0] > self.restart_window_s:
+                    slot.restart_times.popleft()
+                if len(slot.restart_times) >= self.restart_limit:
+                    slot.retired = True
+                    retire = True
+                else:
+                    slot.restarting = True
+                    slot.restart_times.append(now)
+                attempt = len(slot.restart_times)
+                self._cond.notify_all()
+            if retire:
+                self.log('mesh: replica %s spent its restart budget '
+                         '(%d in %.0fs) — retiring permanently; the '
+                         'queue serves through the remaining replicas'
+                         % (slot.rid, self.restart_limit,
+                            self.restart_window_s))
+                self._set_serving_gauge_locked_free()
+                self._set_live_gauge_locked_free()
+                self._fail_queue_if_fleet_empty()
+                continue
+            backoff = self.restart_backoff_s * (2 ** (attempt - 1))
+            if backoff > 0 and self._close_event.wait(min(backoff, 30.0)):
+                with self._cond:
+                    slot.restarting = False
+                return
+            self.log('mesh: restarting replica %s (attempt %d in '
+                     'window, backoff %.2fs)'
+                     % (slot.rid, attempt, backoff))
+            transport = None
+            try:
+                transport = self._spawn_worker(slot.rid)
+                with self._lock:
+                    self._restart_pending = transport
+                if self._close_event.is_set():
+                    # close() may have read _restart_pending before the
+                    # assignment above: cancel ourselves so the cold
+                    # start is never leaked
+                    transport.cancel()
+                transport.wait_ready()
+                # the worker cold-started from the checkpoint store;
+                # re-adopt it onto the fleet's CURRENT step — which may
+                # have rolled while it was down — BEFORE it pulls.  An
+                # in-flight rollover concludes first, so the step read
+                # here is the one the fleet actually settled on.
+                with self._cond:
+                    while self._rollover is not None and \
+                            not self._closed:
+                        self._cond.wait(0.1)
+                    fleet_step = self._params_step
+                worker_step = transport.ready_info.get('params_step')
+                if fleet_step is not None and worker_step != fleet_step:
+                    self.log('mesh: replica %s rejoined at step %s; '
+                             're-adopting the fleet\'s current step %d'
+                             % (slot.rid, worker_step, fleet_step))
+                    transport.adopt(None, fleet_step, fleet_step)
+            except BaseException as exc:
+                with self._lock:
+                    self._restart_pending = None
+                if transport is not None:
+                    try:
+                        transport.reap()
+                    except Exception:
+                        pass
+                with self._cond:
+                    slot.restarting = False  # still dead: retry/budget
+                if self._close_event.is_set():
+                    return
+                self.log('mesh: replica %s restart failed (%r); '
+                         'retrying under the budget' % (slot.rid, exc))
+                continue
+            with self._cond:
+                self._restart_pending = None
+                if self._closed:
+                    closed = True
+                else:
+                    closed = False
+                    slot.transport = transport
+                    slot.dead = False
+                    slot.restarting = False
+                    slot.inflight = 0
+                    slot.breaker_fails = 0
+                    slot.breaker_state = _BREAKER_CLOSED
+                    slot.restarts += 1
+                    slot.thread = threading.Thread(
+                        target=self._pull_loop, args=(slot, transport),
+                        daemon=True, name='mesh-pull-%s' % slot.rid)
+                    slot.thread.start()
+                    self._cond.notify_all()
+            if closed:
+                transport.close()
+                return
+            self.restarts_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter('mesh/restarts_total').inc()
+            self._set_serving_gauge_locked_free()
+            self._set_live_gauge_locked_free()
+            self._queue.kick()
+            self.log('mesh: replica %s restarted and rejoined the '
+                     'fleet (serving step %s)'
+                     % (slot.rid,
+                        transport.ready_info.get('params_step')
+                        if fleet_step is None else fleet_step))
+
+    def _fail_queue_if_fleet_empty(self) -> None:
+        """Every replica permanently retired: admitted work can never
+        be served — close the queue and fail it typed instead of
+        hanging.  Closing (not just abandoning) also covers the racing
+        submitter that passed submit's unlocked all-retired check
+        before the last retirement landed: its enqueue re-checks the
+        queue's closed flag and raises typed, so nothing can ever
+        strand in a queue with zero pullers."""
+        with self._cond:
+            if not all(s.retired for s in self._replicas):
+                return
+            self._closed = True  # no replica can ever serve again
+            self._cond.notify_all()
+        self.log('mesh: NO serving replicas remain; failing the queue '
+                 'typed')
+        self._queue.close()
+        for request in self._queue.abandon():
+            request.fail(ReplicaDead(
+                'every mesh replica has retired; the queue cannot '
+                'drain'))
 
     def _complete(self, slot: _ReplicaSlot, rows: int,
                   taken: List[_Request], ok: bool) -> None:
         with self._cond:
-            slot.inflight -= 1
+            # clamp: a partitioned worker's late delivery can land
+            # after its death handler already zeroed the window
+            slot.inflight = max(0, slot.inflight - 1)
             if ok:
                 slot.breaker_fails = 0
                 if slot.breaker_state != _BREAKER_CLOSED:
@@ -912,6 +1464,15 @@ class ServingMesh:
         if tier not in self.tiers:
             raise ValueError('tier %r is not warmed on this mesh '
                              '(tiers=%s)' % (tier, list(self.tiers)))
+        # retirement is monotonic, so this unlocked scan can only be
+        # conservatively stale: once every replica has permanently
+        # retired, admitting more work would hang it forever (checked
+        # before the generic closed flag — the fleet-empty path sets
+        # both, and the specific reason is the useful one)
+        if all(slot.retired for slot in self._replicas):
+            raise EngineClosed(
+                'every mesh replica has retired (restart budgets '
+                'spent); the mesh cannot serve')
         # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked inside FrontQueue.enqueue
         if self._closed:
             raise EngineClosed('ServingMesh is closed')
@@ -1065,7 +1626,8 @@ class ServingMesh:
                     % self._rollover['replica'].rid)
             canary_slot = next(
                 (slot for slot in self._replicas
-                 if not slot.retired
+                 if not slot.retired and not slot.dead
+                 and not slot.restarting
                  and slot.breaker_state != _BREAKER_OPEN), None)
             if canary_slot is None:
                 raise RuntimeError('no serving replica available to '
@@ -1104,7 +1666,11 @@ class ServingMesh:
                     'params', None)
                 try:
                     for slot in self._replicas:
-                        if slot is canary_slot or slot.retired:
+                        if slot is canary_slot or slot.retired or \
+                                slot.dead or slot.restarting:
+                            # a dead/restarting sibling re-adopts the
+                            # fleet's current step when it rejoins (the
+                            # supervisor's re-adoption leg)
                             continue
                         slot.transport.adopt(params, source,
                                              resolved_step)
@@ -1246,6 +1812,7 @@ class ServingMesh:
             if slot.retired:
                 return
             slot.retired = True
+            was_dead = slot.dead
             self._cond.notify_all()
         self._queue.kick()
         if slot.thread is not None:
@@ -1257,8 +1824,10 @@ class ServingMesh:
                 if remaining <= 0:
                     break
                 self._cond.wait(min(remaining, 0.1))
-        slot.transport.close()
+        if not was_dead:
+            slot.transport.close()  # a dead worker was already reaped
         self._set_serving_gauge_locked_free()
+        self._set_live_gauge_locked_free()
         self.log('mesh: replica %s retired (served %d rows in %d '
                  'batches)' % (slot.rid, slot.rows_dispatched,
                                slot.batches))
@@ -1269,8 +1838,14 @@ class ServingMesh:
             replicas = [{
                 'replica': slot.rid,
                 'retired': slot.retired,
+                'dead': slot.dead,
+                'restarts': slot.restarts,
                 'breaker_state': slot.breaker_state,
                 'inflight': slot.inflight,
+                'worker_reported_inflight': (
+                    slot.transport.heartbeat_info.get('inflight')
+                    if isinstance(slot.transport, _WorkerReplica)
+                    else None),
                 'batches': slot.batches,
                 'rows_dispatched': slot.rows_dispatched,
                 'dispatch_share': (slot.rows_dispatched / rows_total
@@ -1290,6 +1865,11 @@ class ServingMesh:
                 self.rollover_rollbacks_total.snapshot(),
             'replica_breaker_open_total':
                 self.breaker_open_total.snapshot(),
+            'restarts_total': self.restarts_total.snapshot(),
+            'redispatched_total': self.redispatched_total.snapshot(),
+            'heartbeat_misses_total':
+                self.heartbeat_misses_total.snapshot(),
+            'replicas_live': self.live_gauge.snapshot(),
             'tracing': (self._tracer.stats()
                         if self._tracer is not None else None),
         }
@@ -1298,13 +1878,28 @@ class ServingMesh:
 
     def replica_stats(self) -> List[Dict[str, object]]:
         """Per-replica engine stats (fill rate, latency timers, ...) —
-        the per-replica device-fill column of bench_mesh.py."""
-        return [slot.transport.stats() for slot in self._replicas]
+        the per-replica device-fill column of bench_mesh.py.  A dead or
+        retired replica has no wire to query: its row says so instead
+        of hanging on a corpse."""
+        out = []
+        for slot in self._replicas:
+            if slot.dead or slot.retired:
+                out.append({'replica': slot.rid, 'dead': slot.dead,
+                            'retired': slot.retired})
+            else:
+                out.append(slot.transport.stats())
+        return out
 
     def close(self, drain: bool = False) -> None:
         """Stop the fleet.  Fail-fast (default): still-queued requests
         fail typed ``EngineClosed``; in-flight micro-batches deliver.
-        ``drain=True`` serves everything admitted first.  Idempotent."""
+        ``drain=True`` serves everything admitted first.  Idempotent.
+
+        The self-healing machinery is reaped, not leaked: the
+        supervisor and liveness threads are joined, a restart in flight
+        is cancelled (its half-built worker terminated — never adopted
+        into a closed fleet, never double-restarted), and the socket
+        listener closes so no late-dialing worker is left accepted."""
         with self._cond:
             already = self._closed
             if not already:
@@ -1312,8 +1907,14 @@ class ServingMesh:
                 self._drain = drain
             rollover = self._rollover
             self._rollover = None
+            restart_pending = self._restart_pending
             self._cond.notify_all()
         self._follow_stop.set()
+        self._close_event.set()
+        if restart_pending is not None:
+            # interrupt a supervisor blocked in wait_ready: the worker
+            # cold start must not outlive (or be leaked by) the mesh
+            restart_pending.cancel()
         self._queue.close(drain)
         if not drain:
             for request in self._queue.abandon():
@@ -1331,12 +1932,18 @@ class ServingMesh:
         follow = self._follow_thread
         if follow is not None:
             follow.join()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=60.0)
+        if self._liveness_thread is not None:
+            self._liveness_thread.join(timeout=60.0)
         for slot in self._replicas:
             if slot.thread is not None:
                 slot.thread.join()
         for slot in self._replicas:
-            if not slot.retired:
-                slot.transport.close()
+            if not slot.retired and not slot.dead:
+                slot.transport.close()  # dead workers were reaped
+        if self._listener is not None:
+            self._listener.close()
         self._aux_pool.shutdown(wait=True)
         if self._tracer is not None and self._owns_tracer:
             self._tracer.close()

@@ -1,0 +1,331 @@
+"""Mesh replica transports: one framed wire, two carriers (SERVING.md
+"Multi-host mesh").
+
+PR 13's process replicas spoke raw ``multiprocessing`` pickle over a
+pipe: host-local by construction, and a worker dying mid-write could
+leave a partial object that wedged or misparsed the parent's receiver.
+This module factors the wire into a transport abstraction the mesh and
+the worker both speak, with two properties the self-healing layer
+needs:
+
+- **One frame format, checksummed.**  Every message — dispatch,
+  result, control, heartbeat — crosses as a length-prefixed frame::
+
+      MAGIC(2) | length(4, big-endian) | crc32(4, big-endian) | payload
+
+  where ``payload`` is the pickled message tuple.  ``decode_frame``
+  validates magic, length, and CRC and raises a typed ``WireError`` on
+  any mismatch, so a partial or corrupted frame fails the REPLICA
+  typed instead of poisoning the stream (the parent treats it exactly
+  like a worker death: redispatch + supervised restart).
+- **Pipe and TCP carriers, identical protocol.**  ``PipeTransport``
+  wraps the spawn pipe (``send_bytes``/``recv_bytes`` keep message
+  boundaries; the frame adds integrity).  ``SocketTransport`` carries
+  the same frames over TCP, so a replica worker can live on another
+  machine: the mesh opens a ``SocketListener``, each worker DIALS IN
+  and introduces itself with a ``hello`` frame (rid + wire protocol
+  version + pid), then reports ``('ready', {params_step,
+  capabilities})`` / ``('failed', reason)`` after its cold start — the
+  same two-phase startup the pipe mode uses, so
+  ``MESH_REPLICA_MODE=process|socket`` is a carrier choice, not a
+  protocol fork.
+
+Dependency-free above the serving errors; importable without jax (the
+mesh's worker entry point imports the heavy stack, not this module).
+"""
+from __future__ import annotations
+
+import pickle
+import select
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, Optional, Tuple
+
+from code2vec_tpu.serving.errors import WireError
+
+#: wire protocol version carried in the socket ``hello`` frame — a
+#: parent refuses a worker speaking a different framing/message set
+#: instead of misparsing it
+WIRE_PROTO = 1
+
+_MAGIC = b'c2'
+# header layout: MAGIC (2 bytes) + length (4) + crc32 (4) = 10 bytes
+_HEADER_LEN = 10
+_LEN_CRC = struct.Struct('>II')
+
+#: sanity bound on one frame: a corrupted length field must fail fast,
+#: not allocate gigabytes.  Generous vs real traffic (a 1024-row packed
+#: dispatch is ~MBs).
+MAX_FRAME_BYTES = 1 << 30
+
+
+def encode_frame(message) -> bytes:
+    """Message tuple -> one framed byte string (pickle payload with a
+    length + CRC32 header)."""
+    payload = pickle.dumps(message)
+    return (_MAGIC + _LEN_CRC.pack(len(payload),
+                                   zlib.crc32(payload) & 0xFFFFFFFF)
+            + payload)
+
+
+def decode_frame(data: bytes):
+    """One complete framed byte string -> message.  Raises ``WireError``
+    on bad magic, truncation, trailing bytes, or CRC mismatch — the
+    typed shape of a worker dying mid-write."""
+    if len(data) < _HEADER_LEN:
+        raise WireError('truncated frame: %d bytes < %d-byte header'
+                        % (len(data), _HEADER_LEN))
+    if data[:2] != _MAGIC:
+        raise WireError('bad frame magic %r (stream corrupt or peer '
+                        'speaks a different protocol)' % data[:2])
+    length, crc = _LEN_CRC.unpack_from(data, 2)
+    if length > MAX_FRAME_BYTES:
+        raise WireError('frame length %d exceeds the %d-byte bound '
+                        '(corrupted header)' % (length, MAX_FRAME_BYTES))
+    payload = data[_HEADER_LEN:]
+    if len(payload) != length:
+        raise WireError('truncated frame: %d payload bytes, header '
+                        'promised %d' % (len(payload), length))
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise WireError('frame CRC mismatch (worker died mid-write or '
+                        'stream corrupt)')
+    try:
+        return pickle.loads(payload)
+    except Exception as exc:
+        raise WireError('frame payload failed to unpickle: %r' % exc)
+
+
+class PipeTransport:
+    """Framed messages over a ``multiprocessing`` connection.  The
+    pipe keeps message boundaries; the frame adds the integrity check
+    that turns a mid-write death into a typed ``WireError`` instead of
+    a garbage object."""
+
+    def __init__(self, conn):
+        self._conn = conn
+
+    def send(self, message) -> None:
+        self._conn.send_bytes(encode_frame(message))
+
+    def recv(self):
+        """Blocking receive of one message.  Raises ``EOFError`` /
+        ``OSError`` on a closed pipe, ``WireError`` on a bad frame."""
+        return decode_frame(self._conn.recv_bytes())
+
+    def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        return self._conn.poll(timeout)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class SocketTransport:
+    """Framed messages over a connected TCP socket — the multi-host
+    carrier.  ``recv`` reassembles exactly one frame from the byte
+    stream (header first, then the promised payload); a short read
+    inside a frame is a typed ``WireError``, a clean close at a frame
+    boundary is ``EOFError`` (a worker death between messages)."""
+
+    def __init__(self, sock: socket.socket):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # not TCP (a unix socketpair in tests): no Nagle
+        sock.settimeout(None)
+        self._sock = sock
+
+    def send(self, message) -> None:
+        self._sock.sendall(encode_frame(message))
+
+    def _read_exact(self, n: int, mid_frame: bool) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._sock.recv(min(remaining, 1 << 20))
+            if not chunk:
+                if chunks or mid_frame:
+                    raise WireError(
+                        'socket closed mid-frame (%d of %d bytes read)'
+                        % (n - remaining, n))
+                raise EOFError('socket closed')
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b''.join(chunks)
+
+    def recv(self):
+        header = self._read_exact(_HEADER_LEN, mid_frame=False)
+        if header[:2] != _MAGIC:
+            raise WireError('bad frame magic %r' % header[:2])
+        length, _crc = _LEN_CRC.unpack_from(header, 2)
+        if length > MAX_FRAME_BYTES:
+            raise WireError('frame length %d exceeds the %d-byte bound'
+                            % (length, MAX_FRAME_BYTES))
+        return decode_frame(header + self._read_exact(length,
+                                                      mid_frame=True))
+
+    def poll(self, timeout: Optional[float] = 0.0) -> bool:
+        try:
+            ready, _w, _x = select.select([self._sock], [], [], timeout)
+        except (OSError, ValueError):
+            return True  # closed socket: recv will raise the real error
+        return bool(ready)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketListener:
+    """The mesh's accept side of socket mode: workers dial in, send a
+    ``hello`` frame, and are claimed BY RID — so N workers can cold-
+    start concurrently and connect in any order, and a worker on
+    another machine only needs the (host, port) pair."""
+
+    # the accept thread fills _by_rid while wait_ready callers claim
+    # from it and close() tears it down (lock-discipline rule,
+    # ANALYSIS.md); _cond wraps _lock, so holding either alias guards
+    # the fields:
+    # graftlint: guard SocketListener._by_rid,_closed by _lock|_cond
+    def __init__(self, host: str = '127.0.0.1'):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self._sock.settimeout(0.25)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._by_rid: Dict[str, Tuple[SocketTransport, dict]] = {}
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name='mesh-listen')
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us
+            try:
+                conn.settimeout(30.0)
+                transport = SocketTransport(conn)
+                hello = transport.recv()
+                conn.settimeout(None)
+                if hello[0] != 'hello' or hello[2] != WIRE_PROTO:
+                    raise WireError(
+                        'bad worker hello %r (wire proto %d expected)'
+                        % (hello[:3], WIRE_PROTO))
+            except (WireError, EOFError, OSError, socket.timeout,
+                    IndexError, TypeError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            with self._cond:
+                if self._closed:
+                    transport.close()
+                    return
+                self._by_rid[hello[1]] = (transport,
+                                          {'pid': hello[3]})
+                self._cond.notify_all()
+
+    def claim(self, rid: str, timeout: float,
+              cancel: Optional[threading.Event] = None,
+              pid: Optional[int] = None
+              ) -> Tuple[SocketTransport, dict]:
+        """Block until the worker named ``rid`` has dialed in (its
+        hello validated), then hand its transport over.  ``cancel``
+        aborts the wait early (mesh close during a supervised
+        restart).
+
+        ``pid`` pins the claim to ONE worker incarnation: a reaped
+        predecessor's late-arriving hello (same rid, dead process) is
+        dropped instead of handed to the restart — claiming a corpse's
+        socket would fail the attempt AND burn a restart-budget slot
+        while the healthy new worker sits unclaimed."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            stale = None
+            with self._cond:
+                entry = self._by_rid.get(rid)
+                if entry is not None and pid is not None and \
+                        entry[1].get('pid') != pid:
+                    stale = self._by_rid.pop(rid)
+                    entry = None
+                if entry is not None:
+                    return self._by_rid.pop(rid)
+                if self._closed:
+                    raise EOFError('mesh socket listener closed while '
+                                   'waiting for replica %s' % rid)
+                if cancel is not None and cancel.is_set():
+                    raise RuntimeError('wait for replica %s cancelled '
+                                       '(mesh closing)' % rid)
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        'replica %s worker did not dial in within %.0fs'
+                        % (rid, timeout))
+                self._cond.wait(min(remaining, 0.25))
+            if stale is not None:
+                stale[0].close()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            unclaimed = list(self._by_rid.values())
+            self._by_rid.clear()
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=10.0)
+        for transport, _info in unclaimed:
+            transport.close()
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+
+def dial(address: Tuple[str, int], rid: str, pid: int,
+         timeout: float = 30.0, attempts: int = 3) -> SocketTransport:
+    """Worker side of socket mode: connect to the mesh listener and
+    introduce this replica (``hello`` carries rid + wire protocol +
+    pid; ``ready``/``failed`` with params-step and capabilities follow
+    after the cold start)."""
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            sock = socket.create_connection(tuple(address),
+                                            timeout=timeout)
+            transport = SocketTransport(sock)
+            transport.send(('hello', rid, WIRE_PROTO, pid))
+            return transport
+        except OSError as exc:
+            last = exc
+            time.sleep(0.2 * (2 ** attempt))
+    raise RuntimeError('replica %s could not dial the mesh listener at '
+                       '%s: %r' % (rid, address, last))

@@ -192,6 +192,25 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                         'Coordinated rollovers rolled '
                                         'back by the canary replica '
                                         '(fleet kept the old params).'),
+    'mesh/replicas_live': _m(GAUGE, 'replicas', 'Replicas currently '
+                             'LIVE by the heartbeat verdict (not dead, '
+                             'not retired) — distinct from dispatch '
+                             'health: a breaker-open replica still '
+                             'counts, a hung one does not.'),
+    'mesh/restarts_total': _m(COUNTER, 'restarts', 'Supervised worker '
+                              'restarts that rejoined the fleet '
+                              '(re-adopted onto the current params '
+                              'step before pulling).'),
+    'mesh/redispatched_total': _m(COUNTER, 'requests', 'Requests '
+                                  're-admitted at the queue FRONT '
+                                  'after their batch died with its '
+                                  'worker (once per request; a second '
+                                  'crash fails typed).'),
+    'mesh/heartbeat_misses_total': _m(COUNTER, 'intervals', 'Heartbeat '
+                                      'intervals worker replicas were '
+                                      'observed past due (budget '
+                                      'MESH_HEARTBEAT_MISSES marks the '
+                                      'replica dead).'),
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
                         'build.'),

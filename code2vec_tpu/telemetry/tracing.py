@@ -100,6 +100,12 @@ SPAN_CATALOG: Dict[str, str] = {
                     'ordered rows back into the caller future.',
     'serving.canary_shadow': 'One shadow-scored canary micro-batch '
                              '(attrs: step, rows, agreement tally).',
+    'serving.redispatch': 'The request\'s batch died with its mesh '
+                          'replica: re-admitted ONCE at the queue '
+                          'front with the dead incarnation excluded '
+                          '(attrs: replica, reason); a second '
+                          'queue_wait span follows, so the trace '
+                          'shows both attempts.',
     'extractor.call': 'One ExtractorPool call (attrs: attempt count, '
                       'breaker state, outcome).',
 }
@@ -109,6 +115,7 @@ SPAN_CATALOG: Dict[str, str] = {
 TAIL_SPANS = frozenset((
     'serving.shed', 'serving.expired', 'serving.degraded',
     'serving.closed', 'serving.chunk', 'serving.stall',
+    'serving.redispatch',
 ))
 
 #: flight-recorder dump debounce: repeated same-event dumps inside this

@@ -1,0 +1,215 @@
+"""Chaos soak for the self-healing serving mesh (SERVING.md
+"Multi-host mesh").
+
+A paced open-loop generator drives a worker-mode mesh while the fault
+grammar periodically kills its workers: every worker incarnation is
+armed with ``kill_worker`` (SIGKILL at its K-th dispatch, mid-batch)
+and ``drop_heartbeat`` (goes silent after its B-th beat, the
+hung-worker shape) — each supervised restart re-arms the plan in the
+fresh process, so the faults fire PERIODICALLY for the whole soak.
+The assertions are the self-healing contract:
+
+- **zero lost admitted requests** — every submitted future resolves
+  with results or a TYPED serving error; a hung future or an untyped
+  exception fails the soak (crash-safe redispatch + supervised restart
+  mean a crash costs latency, not answers);
+- **zero post-warmup compiles in the parent** — healing never escapes
+  the warm path on the serving side of the wire (worker cold starts
+  compile in their OWN processes, off the parent's counter);
+- **bounded p99** — restart latency is visible but bounded
+  (``--p99-bound-ms``).
+
+Prints one JSON line per metric (``mesh_soak_*``); exit 1 on any
+violation.  ``BENCH_SMOKE=1`` shrinks shapes and duration for the
+tier-1 smoke (tests/test_bench_smoke.py); the slow-marked full run and
+``capture_all.sh`` (stage ``mesh_soak``) use the real durations.
+
+Usage: python scripts/mesh_soak.py [--secs S] [--replicas N]
+       [--mode process|socket] [--kill-every K] [--drop-beat-at B]
+       [--interval-ms MS] [--p99-bound-ms MS]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from code2vec_tpu import benchlib  # noqa: E402
+
+
+def main() -> int:
+    benchlib.honor_env_platforms()
+    smoke = benchlib.smoke_requested()
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--secs', type=float,
+                        default=10.0 if smoke else 45.0,
+                        help='paced-load duration')
+    parser.add_argument('--replicas', type=int, default=2)
+    parser.add_argument('--mode', default='process',
+                        choices=['process', 'socket'])
+    parser.add_argument('--kill-every', type=int,
+                        default=6 if smoke else 25,
+                        help='kill_worker fires at each incarnation\'s '
+                             'K-th dispatch (mid-batch SIGKILL)')
+    parser.add_argument('--drop-beat-at', type=int,
+                        default=14 if smoke else 60,
+                        help='drop_heartbeat window start: the '
+                             'incarnation goes silent from its B-th '
+                             'beat (liveness kill)')
+    parser.add_argument('--interval-ms', type=float,
+                        default=80.0 if smoke else 50.0,
+                        help='pacing between submits')
+    parser.add_argument('--p99-bound-ms', type=float, default=30000.0,
+                        help='bounded-p99 assertion over delivered '
+                             'requests (restart latency included)')
+    parser.add_argument('--rows', type=int, default=200 if smoke else 1000)
+    parser.add_argument('--contexts', type=int, default=6 if smoke else 50)
+    parser.add_argument('--tokens', type=int, default=500 if smoke else 5000)
+    parser.add_argument('--paths', type=int, default=500 if smoke else 8000)
+    parser.add_argument('--labels', type=int, default=100 if smoke else 1000)
+    args = parser.parse_args()
+
+    from benchmarks.bench_serving import synthesize_dataset
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.serving.errors import ServingError
+    from code2vec_tpu.telemetry import core as tele_core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+
+    workdir = tempfile.mkdtemp(prefix='c2v_meshsoak_')
+    prefix = os.path.join(workdir, 'synth')
+    lines = synthesize_dataset(prefix, args.rows, args.contexts,
+                               args.tokens, args.paths, args.labels)
+    # every restarted worker re-arms this plan in its fresh process, so
+    # the faults fire once per INCARNATION — periodic chaos by
+    # construction
+    fault_spec = ('kill_worker@dispatch=%d,drop_heartbeat@beat=%d..%d'
+                  % (args.kill_every, args.drop_beat_at,
+                     args.drop_beat_at + 9999))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=prefix,
+        MODEL_SAVE_PATH=os.path.join(workdir, 'model'),
+        DL_FRAMEWORK='jax', VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        MAX_CONTEXTS=args.contexts, SERVING_BATCH_BUCKETS='8,32',
+        SERVING_WARM_TIERS='topk', FAULT_INJECT=fault_spec,
+        MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=2,
+        MESH_RESTART_BACKOFF_SECS=0.1,
+        MESH_RESTART_LIMIT=10_000,  # the soak must keep healing
+        MESH_RESTART_WINDOW_SECS=3600.0)
+    model = Code2VecModel(config)
+    model.save(state=model.state, epoch=0, wait=True)
+
+    tele_core.enable()
+    install_compile_listener()
+    compiles = tele_core.registry().counter('jit/compiles_total')
+
+    def emit(record):
+        if smoke:
+            record['smoke'] = True
+        print(json.dumps(record), flush=True)
+
+    mesh = model.serving_mesh(replicas=args.replicas, tiers=('topk',),
+                              mode=args.mode, max_delay_ms=1.0)
+    violations = []
+    try:
+        # warm the whole serving path once, then pin the compile mark
+        mesh.predict([lines[0]], tier='topk', timeout=300)
+        warm = compiles.value
+        rng = np.random.default_rng(11)
+        futures = []
+        stamps = []
+        t0 = time.perf_counter()
+        deadline = t0 + args.secs
+        while time.perf_counter() < deadline:
+            request_lines = [lines[rng.integers(len(lines))]
+                             for _ in range(int(rng.integers(1, 4)))]
+            try:
+                futures.append(mesh.submit(request_lines, tier='topk'))
+                stamps.append(time.perf_counter())
+            except ServingError:
+                futures.append(None)  # typed shed at admission: fine
+                stamps.append(time.perf_counter())
+            time.sleep(args.interval_ms / 1e3)
+        # drain: every admitted future must RESOLVE — results or typed
+        from concurrent.futures import TimeoutError as FutureTimeout
+        ok = shed = typed = lost = untyped = 0
+        latencies = []
+        for t_submit, future in zip(stamps, futures):
+            if future is None:
+                shed += 1
+                continue
+            try:
+                results = future.result(timeout=180)
+            except ServingError:
+                typed += 1  # expired/shed/replica-dead: typed, not lost
+            except FutureTimeout:
+                # a future that never resolved inside the generous
+                # drain window is LOST — the exact hang this soak
+                # exists to catch
+                lost += 1
+                violations.append('hung future (never resolved)')
+            except Exception as exc:
+                untyped += 1
+                violations.append('untyped failure: %r' % exc)
+            else:
+                assert results
+                ok += 1
+                latencies.append(time.perf_counter() - t_submit)
+        postwarm = compiles.value - warm
+        wall = time.perf_counter() - t0
+        stats = mesh.stats()
+    finally:
+        mesh.close()
+        model.close_stores()
+
+    lat_ms = np.asarray(sorted(latencies)) * 1e3
+    p50 = float(np.percentile(lat_ms, 50)) if len(lat_ms) else None
+    p99 = float(np.percentile(lat_ms, 99)) if len(lat_ms) else None
+    total = len(futures)
+    if ok == 0:
+        violations.append('no request ever completed')
+    if postwarm != 0:
+        violations.append('%d post-warmup parent compiles' % postwarm)
+    if p99 is not None and p99 > args.p99_bound_ms:
+        violations.append('p99 %.0fms > bound %.0fms'
+                          % (p99, args.p99_bound_ms))
+    if stats['restarts_total'] < 1:
+        violations.append('no supervised restart fired — the chaos '
+                          'never bit (raise --secs or lower '
+                          '--kill-every)')
+
+    emit({'metric': 'mesh_soak_requests', 'value': total, 'ok': ok,
+          'shed_at_admission': shed, 'typed_failures': typed,
+          'untyped_failures': untyped, 'lost': lost,
+          'wall_s': round(wall, 2), 'mode': args.mode,
+          'replicas': args.replicas, 'fault_spec': fault_spec})
+    emit({'metric': 'mesh_soak_lost_requests', 'value': lost + untyped})
+    emit({'metric': 'mesh_soak_p99_ms',
+          'value': round(p99, 1) if p99 is not None else None,
+          'p50_ms': round(p50, 1) if p50 is not None else None,
+          'bound_ms': args.p99_bound_ms})
+    emit({'metric': 'mesh_soak_restarts',
+          'value': stats['restarts_total'],
+          'redispatched': stats['redispatched_total'],
+          'heartbeat_misses': stats['heartbeat_misses_total'],
+          'replica_breaker_open_total':
+              stats['replica_breaker_open_total']})
+    emit({'metric': 'mesh_soak_postwarm_compiles', 'value': postwarm})
+    if violations:
+        emit({'metric': 'mesh_soak_violations', 'value': len(violations),
+              'detail': violations})
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -199,6 +201,53 @@ def test_bench_mesh_smoke_fixed_offered_load():
         # actually run in parallel; a 1-core container records the
         # ratio but cannot gate on it (nothing scales on one core)
         assert scaling['value'] >= 1.8, scaling
+
+
+def _run_mesh_soak(extra_args=(), timeout=600, smoke=True):
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO)
+    if smoke:
+        env['BENCH_SMOKE'] = '1'
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'mesh_soak.py'),
+         *extra_args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    records = [json.loads(line)
+               for line in proc.stdout.splitlines() if line.strip()]
+    return proc, {r['metric']: r for r in records}
+
+
+def test_mesh_soak_smoke_self_heals_without_losing_requests():
+    """ISSUE 14: the chaos soak must survive import/config rot AND its
+    assertions must hold on the smoke shapes — paced load while the
+    fault grammar periodically SIGKILLs worker replicas: zero lost
+    admitted requests (every future resolves, results or typed), at
+    least one supervised restart actually fired, zero post-warmup
+    compiles in the parent, and a bounded p99."""
+    proc, by_metric = _run_mesh_soak()
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    assert all(r.get('smoke') for r in by_metric.values())
+    summary = by_metric['mesh_soak_requests']
+    assert summary['value'] > 0 and summary['ok'] > 0
+    assert summary['lost'] == 0 and summary['untyped_failures'] == 0
+    assert by_metric['mesh_soak_lost_requests']['value'] == 0
+    restarts = by_metric['mesh_soak_restarts']
+    assert restarts['value'] >= 1, restarts  # the chaos actually bit
+    assert restarts['redispatched'] >= 0
+    p99 = by_metric['mesh_soak_p99_ms']
+    assert p99['value'] is not None and p99['value'] <= p99['bound_ms']
+    assert by_metric['mesh_soak_postwarm_compiles']['value'] == 0
+
+
+@pytest.mark.slow
+def test_mesh_soak_full_run():
+    """The full-duration chaos soak (capture_all.sh stage mesh_soak):
+    same contract, real durations, socket transport."""
+    proc, by_metric = _run_mesh_soak(
+        extra_args=['--mode', 'socket'], timeout=900, smoke=False)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    assert by_metric['mesh_soak_lost_requests']['value'] == 0
+    assert by_metric['mesh_soak_restarts']['value'] >= 1
+    assert by_metric['mesh_soak_postwarm_compiles']['value'] == 0
 
 
 def test_bench_index_smoke_meets_acceptance():

@@ -1,0 +1,151 @@
+"""Mesh transport units (serving/transport.py, ISSUE 14): the framed
+wire's integrity contract — a worker dying mid-write (partial frame) or
+stream corruption fails TYPED (``WireError``) instead of poisoning the
+receiver — over both carriers (pipe and TCP), plus the socket listener's
+rid-keyed handshake."""
+import multiprocessing
+import socket
+import threading
+
+import pytest
+
+from code2vec_tpu.serving import transport as transport_lib
+from code2vec_tpu.serving.errors import WireError
+
+
+# ------------------------------------------------------------- framing
+def test_frame_roundtrip():
+    message = ('dispatch', 7, 'topk', [b'payload', {'k': 1}])
+    assert transport_lib.decode_frame(
+        transport_lib.encode_frame(message)) == message
+
+
+def test_truncated_frame_fails_typed():
+    frame = transport_lib.encode_frame(('result', 3, ['x'] * 100))
+    # a mid-write death can cut anywhere: header, or inside the payload
+    for cut in (1, 5, transport_lib._HEADER_LEN + 3, len(frame) - 1):
+        with pytest.raises(WireError, match='truncated'):
+            transport_lib.decode_frame(frame[:cut])
+
+
+def test_corrupted_frame_fails_typed():
+    frame = bytearray(transport_lib.encode_frame(('result', 3, 'data')))
+    frame[-1] ^= 0xFF  # payload bit-flip: CRC catches it
+    with pytest.raises(WireError, match='CRC'):
+        transport_lib.decode_frame(bytes(frame))
+    with pytest.raises(WireError, match='magic'):
+        transport_lib.decode_frame(b'XX' + bytes(frame)[2:])
+
+
+def test_absurd_length_header_fails_fast():
+    import struct
+    header = (b'c2' + struct.pack('>II', 1 << 40 & 0xFFFFFFFF, 0))
+    # craft a length just over the bound without allocating it
+    bad = b'c2' + struct.pack(
+        '>II', transport_lib.MAX_FRAME_BYTES + 1, 0)
+    with pytest.raises(WireError, match='bound'):
+        transport_lib.decode_frame(bad + b'x')
+    del header
+
+
+# ---------------------------------------------------------------- pipe
+def test_pipe_transport_roundtrip_and_poison():
+    parent, child = multiprocessing.Pipe()
+    a = transport_lib.PipeTransport(parent)
+    b = transport_lib.PipeTransport(child)
+    a.send(('heartbeat', -1, {'inflight': 0}))
+    assert b.poll(1.0)
+    assert b.recv() == ('heartbeat', -1, {'inflight': 0})
+    # raw garbage on the same pipe — the receiver fails TYPED, it does
+    # not unpickle an attacker-shaped or half-written object
+    child.send_bytes(b'not a frame at all')
+    with pytest.raises(WireError):
+        a.recv()
+    # a partial frame (sender died mid-write of a large message)
+    frame = transport_lib.encode_frame(('result', 0, list(range(1000))))
+    child.send_bytes(frame[:len(frame) // 2])
+    with pytest.raises(WireError, match='truncated'):
+        a.recv()
+    child.close()
+    with pytest.raises((EOFError, OSError)):
+        a.recv()
+    a.close()
+
+
+# -------------------------------------------------------------- socket
+def test_socket_transport_roundtrip_partial_and_eof():
+    left, right = socket.socketpair()
+    a = transport_lib.SocketTransport(left)
+    b = transport_lib.SocketTransport(right)
+    big = ('dispatch', 1, 'topk', ['x' * 4096] * 16)
+    a.send(big)
+    a.send(('stats', 2))
+    assert b.recv() == big  # reassembled across stream reads
+    assert b.recv() == ('stats', 2)
+    # partial frame then close: the worker died mid-write — typed
+    frame = transport_lib.encode_frame(('result', 9, 'tail'))
+    left.sendall(frame[:len(frame) - 3])
+    left.close()
+    with pytest.raises(WireError, match='mid-frame'):
+        b.recv()
+    # clean close at a frame boundary is a plain EOF (worker exit)
+    left2, right2 = socket.socketpair()
+    c = transport_lib.SocketTransport(left2)
+    d = transport_lib.SocketTransport(right2)
+    c.close()
+    with pytest.raises(EOFError):
+        d.recv()
+    b.close()
+    d.close()
+
+
+def test_socket_listener_claims_by_rid_and_validates_hello():
+    listener = transport_lib.SocketListener('127.0.0.1')
+    try:
+        # dial out of order: r1 first, then r0 — claims are rid-keyed
+        t1 = transport_lib.dial(listener.address, 'r1', pid=111)
+        t0 = transport_lib.dial(listener.address, 'r0', pid=100)
+        got0, hello0 = listener.claim('r0', timeout=10.0)
+        got1, hello1 = listener.claim('r1', timeout=10.0)
+        assert hello0['pid'] == 100 and hello1['pid'] == 111
+        t0.send(('ready', {'params_step': 5}))
+        assert got0.recv() == ('ready', {'params_step': 5})
+        got1.send(('close', 0))
+        assert t1.recv() == ('close', 0)
+        # a peer speaking the wrong protocol version is dropped, never
+        # claimable
+        bad = socket.create_connection(listener.address, timeout=5.0)
+        transport_lib.SocketTransport(bad).send(
+            ('hello', 'rX', transport_lib.WIRE_PROTO + 1, 1))
+        with pytest.raises(TimeoutError):
+            listener.claim('rX', timeout=0.8)
+        for transport in (t0, t1, got0, got1):
+            transport.close()
+        bad.close()
+    finally:
+        listener.close()
+    assert listener.closed
+    # close() reaped the accept thread; a late dial is refused
+    with pytest.raises(RuntimeError):
+        transport_lib.dial(listener.address, 'r9', pid=9,
+                           timeout=0.5, attempts=1)
+
+
+def test_listener_claim_cancellable():
+    listener = transport_lib.SocketListener('127.0.0.1')
+    cancel = threading.Event()
+    result = {}
+
+    def wait():
+        try:
+            listener.claim('r0', timeout=30.0, cancel=cancel)
+        except BaseException as exc:
+            result['exc'] = exc
+
+    thread = threading.Thread(target=wait)
+    thread.start()
+    cancel.set()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    assert 'cancelled' in str(result['exc'])
+    listener.close()

@@ -1,11 +1,18 @@
 """Serving mesh drills (serving/mesh.py + serving/frontqueue.py,
-ISSUE 13): shared-queue admission parity vs a single engine (admitted
-results bit-identical), continuous cross-tier batching with ZERO
-post-warmup compiles, replica-labeled metrics without registry
+ISSUEs 13 + 14): shared-queue admission parity vs a single engine
+(admitted results bit-identical), continuous cross-tier batching with
+ZERO post-warmup compiles, replica-labeled metrics without registry
 collisions, a breaker-tripped replica weighted out WITHOUT wedging the
 queue, coordinated canary -> fleet-swap / rollback, replica retirement
-drain, the fleet-level overload drill through the existing fault
-grammar's serving points, and the process-per-replica wire."""
+drain, the fleet-level overload drill through the fault grammar's
+serving points, the process/socket worker wire, and the self-healing
+drills: SIGKILL mid-batch -> crash-safe redispatch + supervised restart
++ rejoin at the fleet's rolled-to step, heartbeat-miss liveness on a
+hung or partitioned worker, and the restart budget retiring a flapping
+replica typed."""
+import contextlib
+import os
+import signal
 import threading
 import time
 import types
@@ -146,6 +153,48 @@ def test_frontqueue_pop_coalesces_inserts_and_expires():
     queue.enqueue('topk', [_fake_request(1)], 1)
     assert queue.pop_coalesced(16, 0.0, alive=lambda: False) is None
     assert queue.depth_rows() == 1
+
+
+def test_frontqueue_requeue_front_order_exclusion_and_closed():
+    """Crash-safe redispatch mechanics: a crashed batch's members go
+    back to the FRONT in their original order with deadlines intact;
+    the dead incarnation cannot re-claim them (exclusion by claim
+    token); a fail-fast-closed queue refuses so the caller fails them
+    typed."""
+    queue = frontqueue_lib.FrontQueue(('topk',), bound=None,
+                                      fleet_rate=lambda: 0.0)
+    waiting = _fake_request(1)
+    queue.admit(1, 'topk', None)
+    queue.enqueue('topk', [waiting], 1)
+    dead_token = object()
+    crashed = [_fake_request(2), _fake_request(3)]
+    for request in crashed:
+        request.redispatched = True
+        request.exclude = dead_token
+    assert queue.requeue_front('topk', crashed) is True
+    assert queue.depth_rows() == 6
+    # the dead incarnation skips its own crashed members — they stay
+    # at the front for a sibling
+    tier, taken, rows, expired = queue.pop_coalesced(
+        16, 0.0, alive=lambda: True, claim=dead_token)
+    assert taken == [waiting] and rows == 1 and not expired
+    assert queue.depth_rows() == 5
+    # a DIFFERENT incarnation (sibling or supervised restart) takes
+    # them, in their original order, from the front
+    _t, taken, rows, _e = queue.pop_coalesced(
+        16, 0.0, alive=lambda: True, claim=object())
+    assert taken == crashed and rows == 5
+    # an already-expired member still sheds typed at pop, deadline
+    # intact through the requeue
+    expired_member = _fake_request(1, deadline_s=0.01)
+    time.sleep(0.05)
+    assert queue.requeue_front('topk', [expired_member]) is True
+    _t, taken, _r, expired = queue.pop_coalesced(
+        16, 0.0, alive=lambda: True)
+    assert expired == [expired_member] and not taken
+    # fail-fast close refuses the requeue
+    queue.close()
+    assert queue.requeue_front('topk', [_fake_request(1)]) is False
 
 
 # ------------------------------------------------------- admission parity
@@ -556,3 +605,217 @@ def test_process_replica_mode_serves_and_rolls(tmp_path_factory):
     finally:
         mesh.close()
         model.close_stores()
+
+
+# ---------------------------------------------------- self-healing (14)
+@contextlib.contextmanager
+def _cfg(model, **fields):
+    """Temporarily override config fields (worker processes rebuild
+    their Config from the live fields via the mesh's overrides)."""
+    old = {name: getattr(model.config, name) for name in fields}
+    for name, value in fields.items():
+        setattr(model.config, name, value)
+    try:
+        yield
+    finally:
+        for name, value in old.items():
+            setattr(model.config, name, value)
+
+
+def _checkpointed_model(tmp_path_factory, tag):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('mesh_%s' % tag))
+    save_path = str(tmp_path_factory.mktemp('mesh_%s_model' % tag)
+                    / 'model')
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), MODEL_SAVE_PATH=save_path,
+        DL_FRAMEWORK='jax', COMPUTE_DTYPE='float32', MAX_CONTEXTS=6,
+        TRAIN_BATCH_SIZE=16, TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1,
+        SHUFFLE_BUFFER_SIZE=64, VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8', SERVING_WARM_TIERS='topk')
+    model = Code2VecModel(config)
+    model.save(state=model.state, epoch=0, wait=True)  # step 0
+    return model
+
+
+@pytest.fixture(scope='module')
+def proc_model(tmp_path_factory):
+    model = _checkpointed_model(tmp_path_factory, 'heal')
+    yield model
+    model.close_stores()
+
+
+def _assert_healing_threads_reaped(mesh):
+    """ISSUE 14 small fix: close() must reap the supervisor, liveness
+    monitor, and socket listener (threads AND sockets)."""
+    if mesh._supervisor is not None:
+        assert not mesh._supervisor.is_alive()
+    if mesh._liveness_thread is not None:
+        assert not mesh._liveness_thread.is_alive()
+    if mesh._listener is not None:
+        assert mesh._listener.closed
+
+
+def test_socket_kill_drill_redispatch_restart_rejoin(tmp_path_factory):
+    """The ISSUE 14 acceptance drill, on the TCP transport: SIGKILL a
+    worker replica mid-batch -> every admitted request still completes
+    (crash-safe redispatch onto the sibling, zero hung futures), the
+    supervisor restores fleet capacity without operator action, and the
+    restarted worker rejoins at the params step the fleet rolled to
+    WHILE it was down — all with zero post-warmup compiles in the
+    parent (telemetry compile counter)."""
+    import jax.numpy as jnp
+    from code2vec_tpu.telemetry import core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+    model = _checkpointed_model(tmp_path_factory, 'kill')
+    core.reset()
+    core.enable()
+    mesh = None
+    try:
+        assert install_compile_listener()
+        compiles = core.registry().counter('jit/compiles_total')
+        # worker-side slow_dispatch holds every worker batch >=250ms so
+        # the SIGKILL deterministically lands MID-batch
+        with _cfg(model, FAULT_INJECT='slow_dispatch@req=0..63',
+                  MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=4,
+                  MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5):
+            mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                                      mode='socket', max_delay_ms=0.0)
+        unloaded = {line: model.predict([line])[0]
+                    for line in PREDICT_LINES}
+        (first,) = mesh.predict([PREDICT_LINES[0]], tier='topk',
+                                timeout=120)
+        assert first.topk_predicted_words == \
+            unloaded[PREDICT_LINES[0]].topk_predicted_words
+        warm = compiles.value
+        slot0 = mesh._replicas[0]
+        # 10 x 3-row requests = 30 rows over 8-row buckets: several
+        # micro-batches are in flight at once, so BOTH replicas hold
+        # batches when the SIGKILL lands (one claim cannot hoover the
+        # whole queue)
+        batches_lines = [[PREDICT_LINES[(i + j) % 3] for j in range(3)]
+                         for i in range(10)]
+        admitted = [mesh.submit(lines, tier='topk')
+                    for lines in batches_lines]
+        _wait_until(lambda: slot0.inflight >= 1, timeout=30.0,
+                    what='r0 to hold an in-flight batch')
+        os.kill(slot0.transport.pid, signal.SIGKILL)
+        # zero hung futures, zero lost admitted requests: everything
+        # completes on the sibling (or the restarted worker)
+        for lines, future in zip(batches_lines, admitted):
+            results = future.result(timeout=120)
+            assert len(results) == len(lines)
+            for line, result in zip(lines, results):
+                assert result.topk_predicted_words == \
+                    unloaded[line].topk_predicted_words
+        _wait_until(lambda: slot0.dead or slot0.restarts >= 1,
+                    timeout=30.0, what='the death verdict on r0')
+        stats = mesh.stats()
+        assert stats['redispatched_total'] >= 1
+        # roll the fleet WHILE r0 is down (or restarting): the sibling
+        # swaps; r0 must rejoin at the rolled-to step, not its
+        # cold-start one
+        newer = model.state._replace(step=jnp.asarray(7, jnp.int32))
+        model.save(state=newer, epoch=0, wait=True)
+        report = mesh.load_params(7, canary_batches=0).result(timeout=120)
+        assert report['swapped'] is True
+        _wait_until(lambda: mesh.stats()['restarts_total'] >= 1
+                    and not mesh._replicas[0].dead,
+                    timeout=120.0, what='supervised restart of r0')
+        # capacity is restored: r0 pulls again, serving step 7
+        before = slot0.batches
+        deadline = time.perf_counter() + 60.0
+        while slot0.batches == before:
+            assert time.perf_counter() < deadline, \
+                'restarted r0 never served'
+            mesh.predict([PREDICT_LINES[0]], tier='topk', timeout=120)
+        per_replica = {s.get('replica'): s for s in mesh.replica_stats()}
+        assert per_replica['r0'].get('params_step') == 7, per_replica['r0']
+        assert mesh.stats()['params_step'] == 7
+        assert mesh.stats()['replicas_live'] == 2
+        assert compiles.value - warm == 0, (
+            '%d parent-side compiles during the kill drill'
+            % (compiles.value - warm))
+    finally:
+        if mesh is not None:
+            mesh.close()
+            _assert_healing_threads_reaped(mesh)
+        model.close_stores()
+        core.disable()
+        core.reset()
+
+
+def test_heartbeat_miss_restarts_then_budget_retires_typed(proc_model):
+    """Liveness distinct from dispatch health: a worker that stays
+    connected but stops heartbeating (drop_heartbeat drill — nothing in
+    flight, so the breaker sees NOTHING) is declared dead and
+    restarted; when the restarted worker flaps the same way, the
+    window-scoped restart budget retires the replica permanently and
+    the mesh refuses new work typed instead of hanging it."""
+    model = proc_model
+    with _cfg(model, FAULT_INJECT='drop_heartbeat@beat=2..9999',
+              MESH_HEARTBEAT_SECS=0.2, MESH_HEARTBEAT_MISSES=2,
+              MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=1,
+              MESH_RESTART_WINDOW_SECS=300.0):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='process', max_delay_ms=0.0)
+        try:
+            # the worker serves fine — it is connected and healthy,
+            # only its liveness signal is gone
+            assert mesh.predict([PREDICT_LINES[0]], tier='topk',
+                                timeout=120)[0].topk_predicted_words
+            _wait_until(lambda: mesh.stats()['restarts_total'] >= 1,
+                        timeout=90.0,
+                        what='liveness kill + supervised restart')
+            assert mesh.stats()['heartbeat_misses_total'] >= 1
+            # the restarted worker flaps identically -> budget (1 per
+            # window) is spent -> permanent retirement, typed refusal
+            _wait_until(lambda: mesh._replicas[0].retired, timeout=90.0,
+                        what='restart budget to retire the replica')
+            with pytest.raises(EngineClosed, match='retired'):
+                mesh.submit([PREDICT_LINES[0]], tier='topk')
+            stats = mesh.stats()
+            assert stats['replicas'][0]['retired'] is True
+            assert stats['replicas_live'] == 0
+        finally:
+            mesh.close()
+            _assert_healing_threads_reaped(mesh)
+
+
+def test_partition_liveness_detects_and_redispatches(proc_model):
+    """A network partition (parent-side frames blackholed while both
+    endpoints stay up) is invisible to the dispatch breaker; the
+    heartbeat monitor catches it, the blackholed in-flight batch is
+    redispatched, and the answer still arrives once the supervised
+    restart rejoins — a partition costs latency, not answers."""
+    model = proc_model
+    with _cfg(model, MESH_HEARTBEAT_SECS=0.2, MESH_HEARTBEAT_MISSES=2,
+              MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5,
+              MESH_RESTART_WINDOW_SECS=300.0):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='process', max_delay_ms=0.0)
+        try:
+            unloaded = model.predict([PREDICT_LINES[1]])[0]
+            assert mesh.predict([PREDICT_LINES[1]], tier='topk',
+                                timeout=120)[0].topk_predicted_words
+            # blackhole every frame the parent receives: the worker
+            # keeps computing and beating into the void
+            faults.configure('partition@frame=0..99999')
+            doomed = mesh.submit([PREDICT_LINES[1]], tier='topk')
+            _wait_until(lambda: mesh._replicas[0].dead
+                        or mesh.stats()['restarts_total'] >= 1,
+                        timeout=90.0,
+                        what='liveness to declare the partition')
+            assert mesh.stats()['heartbeat_misses_total'] >= 1
+            # partition heals; the restarted incarnation's frames pass
+            faults.configure('')
+            (result,) = doomed.result(timeout=120)
+            assert result.topk_predicted_words == \
+                unloaded.topk_predicted_words
+            _wait_until(lambda: mesh.stats()['restarts_total'] >= 1,
+                        timeout=120.0, what='restart after partition')
+            assert mesh.stats()['redispatched_total'] >= 1
+        finally:
+            faults.configure('')
+            mesh.close()
+            _assert_healing_threads_reaped(mesh)
