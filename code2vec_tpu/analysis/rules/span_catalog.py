@@ -8,6 +8,15 @@ cataloged span must be documented in OBSERVABILITY.md, and — like the
 fault-point rule — every cataloged span must be WIRED at some call
 site: a stale catalog entry would document a phase the span log can
 never contain, the drift this lint exists to close.
+
+Remote-origin spans (ISSUE 15): a span recorded in a WORKER process
+and grafted into the parent trace by ``Trace.adopt_spans`` has no
+local emission site by construction.  ``tracing.REMOTE_ORIGIN_SPANS``
+declares those names; the rule treats a declared name as wired through
+the adoption path, while still requiring it to be cataloged and
+documented — and a declared name that is NOT in the catalog is itself
+a finding (an adopted span the log can contain but the catalog
+denies).
 """
 from __future__ import annotations
 
@@ -59,7 +68,8 @@ class SpanCatalogRule(Rule):
 
     def run(self, tree: SourceTree) -> List[Finding]:
         try:
-            from code2vec_tpu.telemetry.tracing import SPAN_CATALOG
+            from code2vec_tpu.telemetry.tracing import (
+                REMOTE_ORIGIN_SPANS, SPAN_CATALOG)
         except ImportError:
             return [self.finding(
                 CATALOG_FILE, 0, 'span catalog is not importable')]
@@ -85,7 +95,17 @@ class SpanCatalogRule(Rule):
                 DOC_NAME, 0,
                 'OBSERVABILITY.md is missing (the span catalog must be '
                 'documented)'))
+        for name in sorted(REMOTE_ORIGIN_SPANS - set(SPAN_CATALOG)):
+            findings.append(self.finding(
+                CATALOG_FILE, 0,
+                'remote-origin span %r (REMOTE_ORIGIN_SPANS) is not in '
+                'SPAN_CATALOG — adopt_spans can graft it into the span '
+                'log, so the catalog must admit it' % name))
+        # remote-origin spans are wired through the adoption path: a
+        # worker records them and the mesh receiver grafts them, so no
+        # local literal site is required
         wired = {name for _rel, _lineno, name in sites}
+        wired |= REMOTE_ORIGIN_SPANS
         for name in sorted(set(SPAN_CATALOG) - wired):
             findings.append(self.finding(
                 CATALOG_FILE, 0,

@@ -454,6 +454,30 @@ class Config:
     # spawned-local workers loopback-only; a routable address lets
     # workers on other machines dial in.
     MESH_SOCKET_HOST: str = '127.0.0.1'
+    # ---- fleet observability (OBSERVABILITY.md "Fleet observability") ----
+    # Worker telemetry backhaul: -1 = auto (workers enable telemetry
+    # iff the parent process had it enabled at spawn, so the fleet
+    # export is one decision), 1 = force on, 0 = off. With it on,
+    # each heartbeat ships the worker's registry snapshot + memory-
+    # ledger rollup for the replica-labeled fleet merge.
+    MESH_TELEMETRY_BACKHAUL: int = -1
+    # ---- SLO burn-rate monitor (serving/slo.py, SERVING.md) ----
+    # Availability SLO target for the serving mesh (e.g. 0.99: sheds,
+    # expiries, and failures burn the 1% error budget). 0 disables the
+    # availability leg.
+    SERVING_SLO_AVAILABILITY: float = 0.0
+    # p99 latency SLO target in ms: delivered requests slower than
+    # this burn the fixed 1% latency budget. 0 disables the latency
+    # leg.
+    SERVING_SLO_P99_MS: float = 0.0
+    # Multiwindow burn-rate alerting: an alert needs the budget burn
+    # rate over BOTH windows above SERVING_SLO_BURN_THRESHOLD (burn
+    # 1.0 = spending budget exactly as fast as the SLO allows). The
+    # fast window sets detection latency; the slow window keeps blips
+    # from paging.
+    SERVING_SLO_FAST_WINDOW_SECS: float = 60.0
+    SERVING_SLO_SLOW_WINDOW_SECS: float = 600.0
+    SERVING_SLO_BURN_THRESHOLD: float = 10.0
     # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
     # Per-invocation extractor timeout (--extractor-timeout): a wedged
     # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
@@ -1262,6 +1286,29 @@ class Config:
         if self.MESH_RESTART_BACKOFF_SECS < 0:
             raise ValueError('config.MESH_RESTART_BACKOFF_SECS must be '
                              '>= 0.')
+        if self.MESH_TELEMETRY_BACKHAUL not in (-1, 0, 1):
+            raise ValueError('config.MESH_TELEMETRY_BACKHAUL must be '
+                             '-1 (auto), 0 (off) or 1 (on).')
+        if not 0.0 <= self.SERVING_SLO_AVAILABILITY < 1.0:
+            raise ValueError('config.SERVING_SLO_AVAILABILITY must be '
+                             'in [0, 1) (0 disables; 1.0 would leave '
+                             'no error budget to burn).')
+        if self.SERVING_SLO_P99_MS < 0:
+            raise ValueError('config.SERVING_SLO_P99_MS must be >= 0 '
+                             '(0 disables the latency leg).')
+        if self.SERVING_SLO_FAST_WINDOW_SECS <= 0 or \
+                self.SERVING_SLO_SLOW_WINDOW_SECS <= 0:
+            raise ValueError('config.SERVING_SLO_*_WINDOW_SECS must be '
+                             '> 0.')
+        if self.SERVING_SLO_FAST_WINDOW_SECS > \
+                self.SERVING_SLO_SLOW_WINDOW_SECS:
+            raise ValueError('config.SERVING_SLO_FAST_WINDOW_SECS must '
+                             'not exceed SERVING_SLO_SLOW_WINDOW_SECS '
+                             '(the fast window detects, the slow one '
+                             'confirms).')
+        if self.SERVING_SLO_BURN_THRESHOLD <= 0:
+            raise ValueError('config.SERVING_SLO_BURN_THRESHOLD must '
+                             'be > 0.')
         if self.SERVING_CANARY_BATCHES < 0:
             raise ValueError('config.SERVING_CANARY_BATCHES must be >= 0 '
                              '(0 = swap without canary).')

@@ -83,6 +83,16 @@ FAULT_POINTS: Dict[str, str] = {
                    'arrives — mid-batch, so the parent holds it in '
                    'flight (exercises crash-safe redispatch and '
                    'supervised restart).',
+    'kill_worker_after_execute': 'serving/mesh.py worker serve loop: '
+                                 'SIGKILL this replica worker AFTER '
+                                 'the triggering dispatch executed on '
+                                 'device (its finished spans ship on '
+                                 'a heartbeat first) but BEFORE the '
+                                 'result frame — the crash shape where '
+                                 'device work was done and lost, so a '
+                                 'redispatched request\'s stitched '
+                                 'trace must show BOTH incarnations\' '
+                                 'device-execute spans.',
     'drop_heartbeat': 'serving/mesh.py worker heartbeat thread: the '
                       'triggering heartbeat(s) are silently skipped, '
                       'the drilled shape of a hung-but-connected '

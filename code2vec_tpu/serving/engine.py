@@ -660,6 +660,11 @@ class ServingEngine:
                 out_dir, sample_rate=rate,
                 slow_ms=config.TRACING_SLOW_MS,
                 flight_traces=config.TRACING_FLIGHT_TRACES,
+                # a worker-mode mesh replica shares the parent's
+                # telemetry dir: namespace its flight dumps
+                # (flight_<event>_r<N>.jsonl) so two processes never
+                # clobber one postmortem file
+                instance=replica_id,
                 log=self.log)
         else:
             self._tracer = None

@@ -80,11 +80,24 @@ non-event, not an operator page:
   puller touches the queue, and capacity returns without operator
   action.
 
+**Fleet observability (OBSERVABILITY.md "Fleet observability").**  A
+worker replica's spans, metrics, and HBM ledger live in its own
+process; the wire carries them home: dispatch frames ship per-member
+trace contexts and workers backhaul finished span records (result
+frames + heartbeats) for ``adopt_spans`` stitching under a
+per-incarnation clock-offset estimate; heartbeats are the typed
+schema-versioned ``transport.Heartbeat`` carrying the worker's
+registry snapshot + ledger rollup for the replica-labeled fleet merge;
+and ``serving/slo.py`` watches the fleet completion stream against
+``SERVING_SLO_*`` burn-rate targets, alarming into the flight
+recorder.
+
 Measured gates: ``benchmarks/bench_mesh.py`` (open-loop load at fixed
 offered rate; p99 / shed rate / per-replica fill at 1/2/4 replicas)
 and ``scripts/mesh_soak.py`` (chaos soak: paced load + periodic
 ``kill_worker``/``drop_heartbeat`` faults; zero lost admitted
-requests, zero post-warmup compiles, bounded p99).
+requests, zero post-warmup compiles, bounded p99, zero unstitched
+trace trees).
 """
 from __future__ import annotations
 
@@ -100,6 +113,7 @@ from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving import engine as engine_lib
+from code2vec_tpu.serving import slo as slo_lib
 from code2vec_tpu.serving import transport as transport_lib
 from code2vec_tpu.serving.engine import (ServingEngine, _Request,
                                          _resolve)
@@ -218,6 +232,7 @@ class _WorkerReplica:
     def __init__(self, rid: str, mode: str,
                  config_overrides: Dict[str, object],
                  on_batch_done, log, on_worker_dead=None,
+                 on_telemetry=None, on_spans=None,
                  listener: Optional[transport_lib.SocketListener] = None,
                  start_timeout_s: float = 600.0):
         import multiprocessing
@@ -226,6 +241,11 @@ class _WorkerReplica:
         self.log = log
         self._on_batch_done = on_batch_done
         self._on_worker_dead = on_worker_dead
+        #: fleet-merge hook: (transport, registry snapshot, ledger
+        #: rollup) per heartbeat — the mesh labels and merges
+        self._on_telemetry = on_telemetry
+        #: stitching accounting hook: (spans adopted, spans dropped)
+        self._on_spans = on_spans
         self._start_timeout_s = start_timeout_s
         self._listener = listener
         self._cancel = threading.Event()
@@ -235,6 +255,18 @@ class _WorkerReplica:
         #: the worker's last self-reported {'inflight'} (surfaced as
         #: ``worker_reported_inflight`` in mesh.stats())
         self.heartbeat_info: Dict[str, object] = {}
+        #: this incarnation's monotonic-clock offset estimate (min-
+        #: filter over the ready handshake + every heartbeat) — remote
+        #: span stamps shift by it at adoption, so cross-host stamps
+        #: order correctly in the stitched tree
+        self.clock = transport_lib.ClockOffset()
+        #: the worker's last memory-ledger rollup ({attributed_bytes,
+        #: budget_bytes, buckets}) — mesh.stats()'s per-worker HBM view
+        self.ledger_info: Dict[str, object] = {}
+        #: receiver-thread-only: last merged counter values, for the
+        #: delta-inc fleet merge (fresh per incarnation, so counters
+        #: accumulate across restarts)
+        self._merge_last: Dict[str, float] = {}
         #: the ready handshake's {'params_step', 'capabilities'}
         self.ready_info: Dict[str, object] = {}
         ctx = multiprocessing.get_context('spawn')
@@ -317,6 +349,10 @@ class _WorkerReplica:
         self.ready_info = msg[1] if len(msg) > 1 and \
             isinstance(msg[1], dict) else {}
         self.last_heartbeat = time.perf_counter()
+        # first clock-offset sample: the ready frame carries the
+        # worker's monotonic stamp (heartbeats refresh it from here on)
+        self.clock.observe(self.ready_info.get('t_mono'),
+                           self.last_heartbeat)
         self._receiver = threading.Thread(target=self._recv_loop,
                                           daemon=True,
                                           name='mesh-recv-%s' % self.rid)
@@ -335,13 +371,23 @@ class _WorkerReplica:
     def dispatch(self, tier: str, taken: List[_Request],
                  rows: int) -> None:
         batches = [request.batch for request in taken]
+        # per-member trace context: the worker runs its engine spans
+        # UNDER the parent's trace and ships them back for stitching
+        # (None for untraced members — the worker records nothing).
+        # Re-parenting happens PARENT-side at adoption (the member's
+        # span_parent object), so the context stays minimal.
+        ctxs = [None if request.trace is None else
+                {'trace_id': request.trace.trace_id,
+                 'sampled': request.trace.sampled}
+                for request in taken]
         seq = None
         try:
             with self._lock:
                 seq = self._seq
                 self._seq += 1
                 self._pending[seq] = (taken, rows)
-                self._channel.send(('dispatch', seq, tier, batches))
+                self._channel.send(('dispatch', seq, tier, batches,
+                                    ctxs))
         except BaseException as exc:
             entry = None
             if seq is not None:
@@ -379,6 +425,19 @@ class _WorkerReplica:
         while True:
             try:
                 msg = self._channel.recv()
+                # a partitioned network loses frames while both
+                # endpoints stay up: results AND heartbeats vanish, so
+                # the liveness monitor (not the breaker) is what
+                # notices
+                if faults.maybe_fire('partition'):
+                    continue
+                if msg[0] == 'heartbeat':
+                    # schema-versioned typed payload: version skew
+                    # between a worker and its mesh fails the replica
+                    # TYPED through the one death path below, instead
+                    # of feeding the telemetry merge a guessed pickle
+                    # shape
+                    transport_lib.check_heartbeat(msg[2])
             except (EOFError, OSError, WireError) as exc:
                 # worker died (EOF) or its stream is poisoned (a partial
                 # frame from a mid-write death fails TYPED instead of
@@ -410,15 +469,25 @@ class _WorkerReplica:
                         for request in taken:
                             request.fail(dead)
                 return
-            # a partitioned network loses frames while both endpoints
-            # stay up: results AND heartbeats vanish, so the liveness
-            # monitor (not the breaker) is what notices
-            if faults.maybe_fire('partition'):
-                continue
             self.last_heartbeat = time.perf_counter()
             kind, seq = msg[0], msg[1]
             if kind == 'heartbeat':
-                self.heartbeat_info = msg[2]
+                beat = msg[2]
+                self.clock.observe(beat.t_mono, self.last_heartbeat)
+                self.heartbeat_info = {'inflight': beat.inflight}
+                if beat.ledger:
+                    self.ledger_info = beat.ledger
+                # spans orphaned from their result frame — finished
+                # late, or about to be orphaned by a crash — ride the
+                # beat and stitch while their dispatch is still pending
+                self._adopt_pending_bundles(beat.spans)
+                if beat.telemetry is not None and \
+                        self._on_telemetry is not None:
+                    try:
+                        self._on_telemetry(self, beat.telemetry,
+                                           beat.ledger)
+                    except Exception:
+                        pass  # the merge must never kill the receiver
                 continue
             if kind in ('result', 'error'):
                 with self._lock:
@@ -427,6 +496,12 @@ class _WorkerReplica:
                 if entry is not None:
                     taken, rows = entry
                     if kind == 'result':
+                        # graft the worker-side span records into the
+                        # live traces BEFORE delivery finishes them —
+                        # a finished trace is already serialized and
+                        # cannot be stitched
+                        self._adopt_member_bundles(
+                            seq, taken, msg[3] if len(msg) > 3 else None)
                         for request, results in zip(taken, msg[2]):
                             request.deliver(results)
                             request.finish_trace()
@@ -446,6 +521,64 @@ class _WorkerReplica:
                 if ctrl is not None:
                     _resolve(ctrl, None)
                 return
+
+    # ------------------------------------------------ trace stitching
+    def _adopt_one(self, request: Optional[_Request],
+                   spans: List[dict]) -> Tuple[int, int]:
+        """Graft one bundle's records into its member's live trace;
+        returns (adopted, dropped)."""
+        if request is None or request.trace is None:
+            return 0, len(spans)
+        adopted = request.trace.adopt_spans(
+            spans, self.clock.offset, parent=request.span_parent)
+        return adopted, len(spans) - adopted
+
+    def _adopt_member_bundles(self, seq: int, taken: List[_Request],
+                              bundles) -> None:
+        """Result-frame stitching: the worker's ``sink.collect(seq)``
+        guarantees every bundle here belongs to THIS dispatch, so
+        bundles align with its members by index (``seq`` double-checks
+        the contract — a mismatch is dropped and counted, never
+        mis-grafted; late bundles from other dispatches only ever
+        travel on heartbeats)."""
+        if not bundles:
+            return
+        adopted = dropped = 0
+        for bundle in bundles:
+            member = bundle.get('member')
+            request = (taken[member]
+                       if bundle.get('seq') == seq
+                       and isinstance(member, int)
+                       and 0 <= member < len(taken) else None)
+            got, lost = self._adopt_one(request,
+                                        bundle.get('spans') or [])
+            adopted += got
+            dropped += lost
+        if (adopted or dropped) and self._on_spans is not None:
+            self._on_spans(adopted, dropped)
+
+    def _adopt_pending_bundles(self, bundles) -> None:
+        """Heartbeat-ridden stitching: each bundle names its dispatch
+        seq; bundles whose dispatch already concluded (their trace is
+        finished and written) are counted dropped, not mis-grafted."""
+        if not bundles:
+            return
+        adopted = dropped = 0
+        for bundle in bundles:
+            with self._lock:
+                entry = self._pending.get(bundle.get('seq'))
+            request = None
+            if entry is not None:
+                member = bundle.get('member')
+                taken = entry[0]
+                if isinstance(member, int) and 0 <= member < len(taken):
+                    request = taken[member]
+            got, lost = self._adopt_one(request,
+                                        bundle.get('spans') or [])
+            adopted += got
+            dropped += lost
+        if (adopted or dropped) and self._on_spans is not None:
+            self._on_spans(adopted, dropped)
 
     @property
     def pid(self) -> Optional[int]:
@@ -547,6 +680,18 @@ class _WorkerReplica:
             self._channel.close()
 
 
+def _worker_ledger_rollup() -> Dict[str, object]:
+    """Compact memory-ledger view for the heartbeat: enough for the
+    mesh's per-worker HBM rollup (budget pressure visible BEFORE the
+    remote worker OOMs), small enough to ride every beat."""
+    from code2vec_tpu.telemetry import memory as memory_lib
+    ledger = memory_lib.ledger()
+    return {'attributed_bytes': ledger.attributed_bytes(),
+            'budget_bytes': ledger.budget_bytes(),
+            'buckets': {bucket: ledger.bucket_bytes(bucket)
+                        for bucket in memory_lib.BUCKETS}}
+
+
 def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
                          conn, address) -> None:
     """Worker replica entry point (spawned): build the model from the
@@ -571,6 +716,14 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
 
     try:
         config = Config(**config_overrides)
+        if config.MESH_TELEMETRY_BACKHAUL == 1:
+            # the parent resolved the backhaul decision at spawn: with
+            # it on, this worker's registry snapshots + ledger rollup
+            # ride every heartbeat into the replica-labeled fleet merge
+            from code2vec_tpu.telemetry.jit_tracker import \
+                install_compile_listener
+            tele_core.enable()
+            install_compile_listener()
         model = Code2VecModel(config)
         engine = ServingEngine(
             config, model.trainer, model.params, model.vocabs,
@@ -591,25 +744,52 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
     rollover: Dict[str, object] = {'handle': None}
     inflight = [0]
     stop_beats = threading.Event()
+    # worker-side half of cross-process stitching: member traces run
+    # under the parent's shipped contexts and their finished span
+    # records backhaul on the result frame (or a heartbeat)
+    sink = tracing_lib.RemoteSpanSink(rid)
 
     def beat_loop() -> None:
         """Liveness, decoupled from dispatch: a dispatch-busy worker
         still beats; a hung or drilled one goes silent and the mesh
-        liveness monitor — not the breaker — declares it dead."""
+        liveness monitor — not the breaker — declares it dead.  The
+        typed payload also carries the observability backhaul: span
+        records not yet shipped on a result frame, the telemetry
+        registry snapshot, and the memory-ledger rollup."""
         period = float(config.MESH_HEARTBEAT_SECS)
         if period <= 0:
             return
         while not stop_beats.wait(period):
             if faults.maybe_fire('drop_heartbeat'):
                 continue  # the drilled shape of a hung worker
+            backhaul = config.MESH_TELEMETRY_BACKHAUL == 1
             try:
-                send(('heartbeat', -1, {'inflight': inflight[0]}))
+                # the whole backhaul honors the off switch: with it
+                # off, beats carry liveness + the clock stamp only
+                telemetry = (tele_core.registry().snapshot()
+                             if backhaul and tele_core.enabled()
+                             else None)
+                ledger = _worker_ledger_rollup() if backhaul else None
+            except Exception:
+                telemetry, ledger = None, None
+            try:
+                send(('heartbeat', -1, transport_lib.Heartbeat(
+                    inflight=inflight[0],
+                    t_mono=time.perf_counter(),
+                    # age-gated: a just-finished bundle belongs to its
+                    # own result frame; one still here after ~a beat
+                    # has missed it (stall or crash-in-progress) and
+                    # ships now
+                    spans=sink.drain(min_age_s=period / 2),
+                    telemetry=telemetry,
+                    ledger=ledger)))
             except BaseException:
                 return  # wire gone: the serve loop is exiting too
 
     engine_stats = engine.stats()
     send(('ready', {
         'params_step': engine_stats.get('params_step'),
+        't_mono': time.perf_counter(),
         'capabilities': {'tiers': list(config.serving_warm_tiers),
                          'wire': config.BATCH_WIRE_FORMAT,
                          'proto': transport_lib.WIRE_PROTO},
@@ -629,8 +809,17 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
                         # exactly the crash-safe redispatch path
                         os.kill(os.getpid(), signal.SIGKILL)
                     tier, batches = msg[2], msg[3]
-                    requests = [_Request(batch, tier, future=Future())
-                                for batch in batches]
+                    ctxs = (msg[4] if len(msg) > 4
+                            else [None] * len(batches))
+                    requests = []
+                    for member, (batch, ctx) in enumerate(
+                            zip(batches, ctxs)):
+                        trace = (sink.begin('serving.remote', ctx, seq,
+                                            member)
+                                 if ctx is not None else None)
+                        requests.append(_Request(batch, tier,
+                                                 future=Future(),
+                                                 trace=trace))
                     rows = sum(request.rows for request in requests)
                     inflight[0] += 1
                     try:
@@ -639,7 +828,24 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
                                    for request in requests]
                     finally:
                         inflight[0] -= 1
-                    send(('result', seq, results))
+                    # member traces finish on the decode threads right
+                    # after the futures resolve; wait them out so the
+                    # result frame carries the full bundle set (a late
+                    # finisher rides the next heartbeat instead)
+                    sink.wait_finished([r.trace for r in requests],
+                                       timeout=5.0)
+                    if faults.maybe_fire('kill_worker_after_execute'):
+                        # die AFTER the device work but BEFORE the
+                        # result frame: the finished spans ride a
+                        # heartbeat (the beat thread drains the sink),
+                        # then the SIGKILL orphans the batch — the
+                        # stitched-trace drill's way of proving a
+                        # redispatched request shows BOTH incarnations'
+                        # device work
+                        time.sleep(max(0.5,
+                                       3 * config.MESH_HEARTBEAT_SECS))
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    send(('result', seq, results, sink.collect(seq)))
                 elif kind == 'load_params':
                     source, n_canary, floor = msg[2], msg[3], msg[4]
                     rollover['handle'] = engine.load_params(
@@ -819,6 +1025,13 @@ class ServingMesh:
         self.redispatched_total = Counter('mesh/redispatched_total')
         self.heartbeat_misses_total = Counter(
             'mesh/heartbeat_misses_total')
+        # fleet observability plane (OBSERVABILITY.md "Fleet
+        # observability"): stitching + backhaul accounting
+        self.adopted_spans_total = Counter('tracing/adopted_spans_total')
+        self.remote_spans_dropped_total = Counter(
+            'tracing/remote_spans_dropped_total')
+        self.worker_snapshots_total = Counter(
+            'mesh/worker_snapshots_total')
         # tracing: ONE tracer shared with every thread-mode replica, so
         # the flight recorder and span log see the whole fleet
         rate = (tracing_sample_rate if tracing_sample_rate is not None
@@ -841,6 +1054,19 @@ class ServingMesh:
                 log=self.log)
         else:
             self._tracer = None
+        # SLO burn-rate monitor (serving/slo.py): availability + p99
+        # targets over the fleet's completion stream, alarming into the
+        # shared flight recorder
+        self._slo: Optional[slo_lib.SloMonitor] = None
+        if config.SERVING_SLO_AVAILABILITY > 0 or \
+                config.SERVING_SLO_P99_MS > 0:
+            self._slo = slo_lib.SloMonitor(
+                availability=config.SERVING_SLO_AVAILABILITY,
+                p99_ms=config.SERVING_SLO_P99_MS,
+                fast_window_s=config.SERVING_SLO_FAST_WINDOW_SECS,
+                slow_window_s=config.SERVING_SLO_SLOW_WINDOW_SECS,
+                burn_threshold=config.SERVING_SLO_BURN_THRESHOLD,
+                tracer=self._tracer, log=self.log)
         self._queue = FrontQueue(tiers, self.queue_bound,
                                  fleet_rate=self._fleet_rate,
                                  log=self.log)
@@ -926,10 +1152,20 @@ class ServingMesh:
         """One worker transport (initial fleet build AND supervised
         restart): the worker cold-starts from the checkpoint store and
         reports ready over the framed wire."""
+        overrides = dict(self._model_config_overrides)
+        if overrides.get('MESH_TELEMETRY_BACKHAUL', -1) == -1:
+            # resolve the backhaul AUTO at SPAWN time, not mesh build:
+            # a telemetry enable after the mesh came up must reach
+            # every later-restarted (or scaled-up) worker, or the
+            # fleet merge silently stays partial
+            overrides['MESH_TELEMETRY_BACKHAUL'] = (
+                1 if tele_core.enabled() else 0)
         return _WorkerReplica(
-            rid, self.mode, self._model_config_overrides,
+            rid, self.mode, overrides,
             on_batch_done=self._on_worker_batch_done,
             on_worker_dead=self._on_worker_dead,
+            on_telemetry=self._on_worker_telemetry,
+            on_spans=self._note_stitched,
             listener=self._listener, log=self.log)
 
     # ------------------------------------------------- process plumbing
@@ -969,6 +1205,64 @@ class ServingMesh:
         # replica, or its first dispatch compiles on the serving path
         overrides['SERVING_WARM_TIERS'] = ','.join(self.tiers)
         return overrides
+
+    # -------------------------------------------- fleet observability
+    def _note_stitched(self, adopted: int, dropped: int) -> None:
+        """Stitching accounting (receiver threads): spans grafted into
+        live traces vs arrived too late to stitch."""
+        if adopted:
+            self.adopted_spans_total.inc(adopted)
+        if dropped:
+            self.remote_spans_dropped_total.inc(dropped)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            if adopted:
+                reg.counter('tracing/adopted_spans_total').inc(adopted)
+            if dropped:
+                reg.counter(
+                    'tracing/remote_spans_dropped_total').inc(dropped)
+
+    def _on_worker_telemetry(self, transport, snapshot,
+                             ledger) -> None:
+        """Fleet merge (one worker heartbeat): label the worker's
+        registry snapshot with its replica id and fold it into THIS
+        process's registry, so the existing JSONL/Prometheus exporters
+        emit ONE fleet export — worker series land exactly where a
+        thread-mode replica's ScopedRegistry mirror would put them.
+        Counters merge by delta (a restarted incarnation resets its
+        own counts; the fleet series keeps accumulating), gauges by
+        last-write, timers as MirrorTimer stat adoptions."""
+        del ledger  # rides transport.ledger_info for stats(); the
+        #             mem/* gauges arrive via the snapshot itself
+        self.worker_snapshots_total.inc()
+        if not tele_core.enabled():
+            return
+        from code2vec_tpu.telemetry import catalog
+        reg = tele_core.registry()
+        reg.counter('mesh/worker_snapshots_total').inc()
+        reg.gauge(catalog.labeled(
+            'mesh/clock_offset_ms', 'replica', transport.rid)).set(
+                transport.clock.offset * 1e3)
+        for name, value in (snapshot or {}).items():
+            base, label = catalog.split_label(name)
+            meta = catalog.CATALOG.get(base)
+            if meta is None:
+                continue  # uncataloged names never enter the export
+            target = (name if label is not None else
+                      catalog.labeled(name, 'replica', transport.rid))
+            if isinstance(value, dict):
+                reg.mirror_timer(target).adopt(value)
+            elif meta['type'] == catalog.COUNTER:
+                last = transport._merge_last.get(target, 0)
+                delta = value if value < last else value - last
+                transport._merge_last[target] = value
+                if delta:
+                    reg.counter(target).inc(int(delta))
+            else:
+                try:
+                    reg.gauge(target).set(float(value))
+                except (TypeError, ValueError):
+                    continue
 
     # ----------------------------------------------------- fleet rate
     def _fleet_rate(self) -> float:
@@ -1254,6 +1548,11 @@ class ServingMesh:
         path (redispatch + supervised restart)."""
         period = self.heartbeat_secs
         while not self._close_event.wait(period):
+            if self._slo is not None:
+                # periodic burn-gauge refresh: exported burns decay
+                # after traffic stops instead of freezing at the last
+                # burst's value
+                self._slo.refresh()
             now = time.perf_counter()
             with self._cond:
                 watched = [(s, s.transport) for s in self._replicas
@@ -1505,6 +1804,8 @@ class ServingMesh:
                 trace.event('serving.shed', attrs={'reason': str(exc)})
                 trace.finish(status='shed')
                 self._tracer.note_shed()
+            if self._slo is not None:
+                self._slo.observe_bad('shed')
             raise
         except EngineClosed as exc:
             if trace is not None:
@@ -1540,6 +1841,27 @@ class ServingMesh:
                             attrs={'reason': 'ServingMesh is closed'})
                 trace.finish(status='closed')
             raise
+        if self._slo is not None:
+            # one SLO event per CALLER-VISIBLE request, observed at its
+            # future — an oversize submit's chunk fan-out must not
+            # inflate the good count, and one failed chunk fails the
+            # whole answer, burning one full budget unit.  Shed-at-
+            # admission is counted at the raise above (the future is
+            # never returned); a close-time EngineClosed flood is
+            # shutdown, not an SLO violation, and stays out.
+            slo, t_admitted = self._slo, t_admit0
+
+            def _slo_observe(done: Future) -> None:
+                try:
+                    exc = done.exception()
+                except BaseException:
+                    return  # caller cancelled: not the server's verdict
+                if exc is None:
+                    slo.observe_good(time.perf_counter() - t_admitted)
+                elif not isinstance(exc, EngineClosed):
+                    slo.observe_bad(type(exc).__name__)
+
+            future.add_done_callback(_slo_observe)
         return future
 
     def predict(self, context_lines: Sequence[str], tier: str = 'topk',
@@ -1846,6 +2168,17 @@ class ServingMesh:
                     slot.transport.heartbeat_info.get('inflight')
                     if isinstance(slot.transport, _WorkerReplica)
                     else None),
+                # per-worker observability backhaul: remote HBM
+                # pressure + the stitching clock, visible without
+                # touching the worker's wire
+                'worker_memory': (
+                    dict(slot.transport.ledger_info) or None
+                    if isinstance(slot.transport, _WorkerReplica)
+                    else None),
+                'clock_offset_ms': (
+                    slot.transport.clock.offset * 1e3
+                    if isinstance(slot.transport, _WorkerReplica)
+                    and slot.transport.clock.samples else None),
                 'batches': slot.batches,
                 'rows_dispatched': slot.rows_dispatched,
                 'dispatch_share': (slot.rows_dispatched / rows_total
@@ -1870,6 +2203,13 @@ class ServingMesh:
             'heartbeat_misses_total':
                 self.heartbeat_misses_total.snapshot(),
             'replicas_live': self.live_gauge.snapshot(),
+            'adopted_spans_total': self.adopted_spans_total.snapshot(),
+            'remote_spans_dropped_total':
+                self.remote_spans_dropped_total.snapshot(),
+            'worker_snapshots_total':
+                self.worker_snapshots_total.snapshot(),
+            'slo': (self._slo.stats()
+                    if self._slo is not None else None),
             'tracing': (self._tracer.stats()
                         if self._tracer is not None else None),
         }

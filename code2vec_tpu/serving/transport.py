@@ -30,11 +30,22 @@ needs:
   ``MESH_REPLICA_MODE=process|socket`` is a carrier choice, not a
   protocol fork.
 
+The observability plane rides this wire too (ISSUE 15,
+OBSERVABILITY.md "Fleet observability"): dispatch frames carry per-
+member trace contexts, result frames and heartbeats carry finished
+worker-side span records back, and the typed ``Heartbeat`` payload
+(schema-versioned — a mismatched payload fails the replica typed, not
+a pickle-shape guessing game) also snapshots the worker's telemetry
+registry and memory-ledger buckets for the fleet merge.
+``ClockOffset`` estimates each worker incarnation's monotonic-clock
+offset so remote span stamps order correctly in the stitched tree.
+
 Dependency-free above the serving errors; importable without jax (the
 mesh's worker entry point imports the heavy stack, not this module).
 """
 from __future__ import annotations
 
+import dataclasses
 import pickle
 import select
 import socket
@@ -42,14 +53,116 @@ import struct
 import threading
 import time
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from code2vec_tpu.serving.errors import WireError
 
 #: wire protocol version carried in the socket ``hello`` frame — a
 #: parent refuses a worker speaking a different framing/message set
-#: instead of misparsing it
-WIRE_PROTO = 1
+#: instead of misparsing it.  v2: dispatch frames carry trace contexts,
+#: result frames carry span-record backhaul, heartbeats are the typed
+#: schema-versioned ``Heartbeat`` payload.
+WIRE_PROTO = 2
+
+#: schema version of the ``Heartbeat`` payload.  Distinct from
+#: WIRE_PROTO (which covers framing + the message set): the heartbeat
+#: payload evolves faster than the wire, and a worker built against a
+#: different payload shape must fail TYPED at the receiver instead of
+#: feeding the telemetry merge garbage.
+HEARTBEAT_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """The worker -> mesh liveness payload (one per
+    ``MESH_HEARTBEAT_SECS``), promoted from the old ad-hoc
+    ``{'inflight': n}`` dict so new riders don't mean another
+    pickle-shape guessing game at the listener:
+
+    - ``inflight``: the worker's self-reported in-flight dispatch count
+      (surfaced as ``worker_reported_inflight`` in ``mesh.stats()``);
+    - ``t_mono``: the worker's ``time.perf_counter()`` at send time —
+      one ``ClockOffset`` sample per beat, so the parent's offset
+      estimate refreshes continuously;
+    - ``spans``: finished worker-side span-record bundles not yet
+      shipped on a result frame (spans orphaned by a crash-in-progress
+      or finished after their result frame went out);
+    - ``telemetry``: the worker's registry snapshot for the fleet
+      merge (None when the worker runs telemetry-off);
+    - ``ledger``: compact memory-ledger rollup ({attributed_bytes,
+      budget_bytes, buckets}) so remote HBM pressure is visible in
+      ``mesh.stats()`` BEFORE the worker OOMs.
+    """
+    schema: int = HEARTBEAT_SCHEMA
+    inflight: int = 0
+    t_mono: float = 0.0
+    spans: List[dict] = dataclasses.field(default_factory=list)
+    telemetry: Optional[Dict[str, object]] = None
+    ledger: Optional[Dict[str, object]] = None
+
+
+def check_heartbeat(payload) -> 'Heartbeat':
+    """Validate one received heartbeat payload; raises ``WireError`` on
+    a non-``Heartbeat`` object or a schema mismatch — the typed shape
+    of version skew between a worker and its mesh."""
+    if not isinstance(payload, Heartbeat):
+        raise WireError('heartbeat payload is %s, not Heartbeat '
+                        '(worker speaks a different payload schema)'
+                        % type(payload).__name__)
+    if payload.schema != HEARTBEAT_SCHEMA:
+        raise WireError('heartbeat schema %r != expected %d (worker '
+                        'built against a different payload version)'
+                        % (payload.schema, HEARTBEAT_SCHEMA))
+    return payload
+
+
+class ClockOffset:
+    """Per-worker-incarnation monotonic-clock offset estimate, so
+    remote span stamps graft into the parent's timeline in the right
+    order (OBSERVABILITY.md "Fleet observability").
+
+    Each one-way sample (a frame stamped ``remote_t`` at send,
+    received at ``local_t``) bounds the true offset from above:
+    ``local_t = remote_t + offset_true + wire_delay`` with
+    ``wire_delay >= 0``, so ``local_t - remote_t >= offset_true``.
+    The estimate keeps the MINIMUM over samples — monotonically
+    nonincreasing, converging to ``offset_true + min_delay`` — and is
+    refreshed on every heartbeat (plus the ready handshake), so clock
+    skew between hosts tightens rather than drifts.  Apply as
+    ``t_parent = t_remote + offset``.
+    """
+
+    # samples arrive on the receiver thread while stitchers read the
+    # estimate (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard ClockOffset._offset,_samples by _lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._offset: Optional[float] = None
+        self._samples = 0
+
+    def observe(self, remote_t: Optional[float],
+                local_t: Optional[float] = None) -> None:
+        """Feed one (remote send stamp, local receive stamp) sample."""
+        if remote_t is None:
+            return
+        if local_t is None:
+            local_t = time.perf_counter()
+        sample = local_t - float(remote_t)
+        with self._lock:
+            self._samples += 1
+            if self._offset is None or sample < self._offset:
+                self._offset = sample
+
+    @property
+    def offset(self) -> float:
+        """Current estimate in seconds (0.0 before any sample)."""
+        with self._lock:
+            return self._offset if self._offset is not None else 0.0
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
 
 _MAGIC = b'c2'
 # header layout: MAGIC (2 bytes) + length (4) + crc32 (4) = 10 bytes

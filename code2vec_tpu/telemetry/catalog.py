@@ -211,6 +211,15 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                       'observed past due (budget '
                                       'MESH_HEARTBEAT_MISSES marks the '
                                       'replica dead).'),
+    'mesh/clock_offset_ms': _m(GAUGE, 'ms', 'Estimated monotonic-clock '
+                               'offset of one worker incarnation vs '
+                               'the mesh (replica-labeled; min-filter '
+                               'over heartbeat samples — remote span '
+                               'stamps shift by this at stitching).'),
+    'mesh/worker_snapshots_total': _m(COUNTER, 'snapshots', 'Worker '
+                                      'telemetry/ledger snapshots '
+                                      'merged replica-labeled into the '
+                                      'fleet registry off heartbeats.'),
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
                         'build.'),
@@ -246,9 +255,49 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                  'tail-retained (shed/expired/degraded/'
                                  'split/closed/slow).'),
     'tracing/flight_dumps_total': _m(COUNTER, 'dumps', 'Flight-recorder '
-                                     'ring dumps (flight_<event>.jsonl: '
-                                     'overload burst, canary rollback, '
-                                     'breaker open, close).'),
+                                     'ring dumps (flight_<event>.jsonl, '
+                                     'replica-namespaced '
+                                     'flight_<event>_r<N>.jsonl in '
+                                     'worker processes: overload burst, '
+                                     'canary rollback, breaker open, '
+                                     'SLO burn, close).'),
+    'tracing/adopted_spans_total': _m(COUNTER, 'spans', 'Remote worker '
+                                      'span records grafted into live '
+                                      'parent traces by adopt_spans '
+                                      '(cross-process stitching).'),
+    'tracing/remote_spans_dropped_total': _m(COUNTER, 'spans', 'Remote '
+                                             'span records that could '
+                                             'not be stitched: their '
+                                             'dispatch was no longer '
+                                             'pending or the trace had '
+                                             'already finished.'),
+    # ---- SLO burn-rate monitor (serving/slo.py, SERVING.md) ----
+    'slo/availability_burn_fast': _m(GAUGE, 'ratio', 'Availability '
+                                     'error-budget burn rate over the '
+                                     'fast window (1.0 = burning '
+                                     'exactly the budget).'),
+    'slo/availability_burn_slow': _m(GAUGE, 'ratio', 'Availability '
+                                     'error-budget burn rate over the '
+                                     'slow window.'),
+    'slo/p99_burn_fast': _m(GAUGE, 'ratio', 'p99-latency error-budget '
+                            'burn rate over the fast window (share of '
+                            'requests slower than SERVING_SLO_P99_MS '
+                            'vs the 1% budget).'),
+    'slo/p99_burn_slow': _m(GAUGE, 'ratio', 'p99-latency error-budget '
+                            'burn rate over the slow window.'),
+    'slo/good_total': _m(COUNTER, 'requests', 'Requests counted good '
+                         'by the SLO monitor (delivered, within the '
+                         'latency target when one is set).'),
+    'slo/bad_total': _m(COUNTER, 'requests', 'Requests counted against '
+                        'the availability budget (shed, expired, '
+                        'failed).'),
+    'slo/slow_total': _m(COUNTER, 'requests', 'Delivered requests '
+                         'slower than SERVING_SLO_P99_MS (counted '
+                         'against the latency budget).'),
+    'slo/alerts_total': _m(COUNTER, 'alerts', 'SLO burn alerts fired '
+                           '(both windows over '
+                           'SERVING_SLO_BURN_THRESHOLD; dumps '
+                           'flight_slo_burn.jsonl).'),
     # ---- device-memory ledger (telemetry/memory.py) ----
     'mem/params_bytes': _m(GAUGE, 'bytes', 'Ledger-attributed device '
                            'bytes held by model parameter sets (one '

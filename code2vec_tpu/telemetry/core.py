@@ -23,8 +23,9 @@ import threading
 import time
 from typing import Deque, Dict, Iterator, Optional, Tuple
 
-__all__ = ['Counter', 'Gauge', 'Timer', 'Registry', 'ScopedRegistry',
-           'registry', 'reset', 'enable', 'disable', 'enabled']
+__all__ = ['Counter', 'Gauge', 'Timer', 'MirrorTimer', 'Registry',
+           'ScopedRegistry', 'registry', 'reset', 'enable', 'disable',
+           'enabled']
 
 # Module-global enablement. One bool read is the entire disabled-path
 # cost at instrumented call sites.
@@ -182,6 +183,37 @@ class Timer:
                 'total_s': total}
 
 
+class MirrorTimer(Timer):
+    """A Timer mirror fed by a REMOTE registry snapshot instead of
+    local ``record`` calls — how a worker replica's timer stats join
+    the parent's fleet export (serving/mesh.py telemetry backhaul,
+    OBSERVABILITY.md "Fleet observability").
+
+    The worker ships its timer's stat dict on each heartbeat;
+    ``adopt`` stores it wholesale and ``snapshot`` replays it, so the
+    JSONL/Prometheus exporters render the remote series exactly like a
+    local one (it IS-A Timer for their isinstance dispatch).  Window
+    semantics stay the worker's — the stats were computed over ITS
+    sample window."""
+
+    __slots__ = ('_stats',)
+
+    def __init__(self, name: str = '', window: int = 512):
+        super().__init__(name, window=window)
+        self._stats: Optional[Dict[str, float]] = None
+
+    def adopt(self, stats: Dict[str, float]) -> None:
+        with self._lock:
+            self._stats = dict(stats)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            stats = self._stats
+        if stats is None:
+            return super().snapshot()
+        return dict(stats)
+
+
 class Registry:
     """Thread-safe name -> instrument map with get-or-create accessors.
 
@@ -217,6 +249,12 @@ class Registry:
 
     def timer(self, name: str, window: int = 512) -> Timer:
         return self._get_or_create(name, Timer, window=window)
+
+    def mirror_timer(self, name: str) -> MirrorTimer:
+        """Get-or-create a remote-fed timer mirror (fleet merge only:
+        the name should be replica-labeled, so it never collides with
+        a locally recorded Timer)."""
+        return self._get_or_create(name, MirrorTimer)
 
     def items(self) -> Iterator[Tuple[str, object]]:
         with self._lock:

@@ -106,9 +106,22 @@ SPAN_CATALOG: Dict[str, str] = {
                           '(attrs: replica, reason); a second '
                           'queue_wait span follows, so the trace '
                           'shows both attempts.',
+    'serving.remote': 'Remote-worker envelope: one dispatched member\'s '
+                      'worker-side execution (receipt to finish), '
+                      'recorded in the worker process and grafted into '
+                      'the parent trace by adopt_spans (attrs: replica, '
+                      'pid).  A redispatched request shows one per '
+                      'incarnation that did device work.',
     'extractor.call': 'One ExtractorPool call (attrs: attempt count, '
                       'breaker state, outcome).',
 }
+
+#: span names that originate in a REMOTE worker process and reach the
+#: parent's span log only through ``Trace.adopt_spans`` (the mesh wire
+#: backhaul) — the ``span-catalog`` lint treats these as wired even
+#: with no local literal emission site, and still requires catalog +
+#: OBSERVABILITY.md coverage
+REMOTE_ORIGIN_SPANS = frozenset(('serving.remote',))
 
 #: span names whose presence marks a trace for tail retention even when
 #: head sampling skipped it
@@ -219,6 +232,45 @@ class Trace:
                 return
             span.t1 = t1
 
+    def adopt_spans(self, records: List[dict], offset_s: float = 0.0,
+                    parent: Optional[Span] = None) -> int:
+        """Graft REMOTE span records (a worker-side trace's serialized
+        spans, shipped back over the mesh wire) into this live trace —
+        the cross-process stitching half of the fleet observability
+        plane (OBSERVABILITY.md "Fleet observability").
+
+        Remote span ids are remapped onto this trace's id sequence (so
+        two incarnations' subtrees can never collide), remote-internal
+        parent links are preserved through the remap, a remote root
+        (parent None) is re-parented under ``parent`` (the member's
+        chunk span, or this trace's root), and every stamp is shifted
+        by ``offset_s`` — the per-worker ``ClockOffset`` estimate that
+        makes cross-host stamps order correctly.  Returns how many
+        spans were adopted; 0 when the trace already finished (its log
+        record is written — late arrivals cannot be stitched and the
+        caller counts them dropped)."""
+        if not records:
+            return 0
+        parent_id = parent.span_id if parent is not None else 0
+        with self._lock:
+            if self._finished:
+                return 0
+            idmap: Dict[int, int] = {}
+            for rec in records:
+                new_id = self._span_seq
+                self._span_seq += 1
+                idmap[rec['span']] = new_id
+                remote_parent = rec.get('parent')
+                self._spans.append(Span(
+                    new_id,
+                    idmap.get(remote_parent, parent_id)
+                    if remote_parent is not None else parent_id,
+                    rec['name'],
+                    float(rec['t0']) + offset_s,
+                    float(rec['t1']) + offset_s,
+                    rec.get('attrs')))
+            return len(records)
+
     def finish(self, status: str = 'ok',
                reason: Optional[str] = None) -> None:
         """Close the trace exactly once: stamp the root end, close any
@@ -255,8 +307,15 @@ class Tracer:
                  shed_burst: int = SHED_BURST,
                  shed_window_s: float = SHED_WINDOW_S,
                  dump_min_interval_s: float = DUMP_MIN_INTERVAL_S,
+                 instance: Optional[str] = None,
                  log=None):
         self.out_dir = out_dir
+        # instance namespaces the flight-recorder dumps
+        # (flight_<event>_<instance>.jsonl): a worker-mode mesh replica
+        # and its parent share one telemetry dir, and two processes
+        # os.replace-ing the SAME flight_<event>.jsonl would clobber
+        # each other's postmortems (latency_report.py globs both forms)
+        self.instance = instance
         self.spans_path = None
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
@@ -381,7 +440,9 @@ class Tracer:
                 return None
             self._last_dump[event] = now
             ring = list(self._ring)
-        path = os.path.join(self.out_dir, 'flight_%s.jsonl' % event)
+        suffix = '' if not self.instance else '_%s' % self.instance
+        path = os.path.join(self.out_dir,
+                            'flight_%s%s.jsonl' % (event, suffix))
         tmp = path + '.tmp'
         with open(tmp, 'w') as f:
             f.write(json.dumps({'flight': event, 'time': time.time(),
@@ -418,3 +479,123 @@ class Tracer:
                 return
             self._closed = True
         self.dump_flight('close', force=True)
+
+
+class RemoteSpanSink:
+    """Worker-side trace sink for cross-process stitching
+    (OBSERVABILITY.md "Fleet observability").
+
+    A worker-mode mesh replica runs the engine's span sites in its own
+    process, where the parent's span log cannot see them.  The worker
+    serve loop ``begin``s one trace per dispatched member UNDER the
+    parent's shipped trace context (trace_id + parent span id), the
+    engine records its phases into it exactly as it would locally, and
+    when the trace finishes this sink serializes the spans into plain
+    record dicts bundled with their (dispatch seq, member index) —
+    nothing is written worker-side.  The serve loop ``collect``s the
+    bundles onto the result frame; anything still in the outbox when a
+    heartbeat fires rides the heartbeat instead (spans that finished
+    after their result frame, or that a crash is about to orphan).
+    The parent grafts them with ``Trace.adopt_spans``.
+
+    The outbox is BOUNDED (``max_bundles``): with heartbeats disabled
+    (``MESH_HEARTBEAT_SECS=0``) nothing sweeps orphans, and error-path
+    bundles never get a result frame — stitching is best-effort
+    observability, so past the cap the oldest bundles drop instead of
+    growing the worker without bound.
+    """
+
+    # traces finish on the worker engine's decode threads while the
+    # serve loop collects and the heartbeat thread drains
+    # (lock-discipline rule, ANALYSIS.md); _cond wraps _lock:
+    # graftlint: guard RemoteSpanSink._outbox,_open,dropped_bundles by _lock|_cond
+    def __init__(self, replica: str, max_bundles: int = 512):
+        self.replica = replica
+        self.max_bundles = max(1, int(max_bundles))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._outbox: List[tuple] = []
+        #: bundles evicted past the cap (never shipped)
+        self.dropped_bundles = 0
+        #: id(trace) -> (seq, member) for traces not yet finished
+        self._open: Dict[int, tuple] = {}
+
+    def begin(self, name: str, ctx: dict, seq: int,
+              member: int) -> Trace:
+        """One member's worker-side trace under the parent's context:
+        the root span (``name``, normally ``serving.remote``) becomes a
+        child of the parent's member span after adoption."""
+        trace = Trace(self, str(ctx.get('trace_id', '?')),
+                      bool(ctx.get('sampled')), name,
+                      time.perf_counter(),
+                      attrs={'replica': self.replica,
+                             'pid': os.getpid()})
+        with self._lock:
+            self._open[id(trace)] = (seq, member)
+        return trace
+
+    def _finish_trace(self, trace: Trace, status: str,
+                      reason: Optional[str], spans: List[Span]) -> None:
+        root = trace.root
+        if reason is not None:
+            root.attrs = dict(root.attrs or ())
+            root.attrs['reason'] = reason
+        records = [span.record(trace.trace_id) for span in spans]
+        with self._cond:
+            seq, member = self._open.pop(id(trace), (None, None))
+            self._outbox.append((time.perf_counter(),
+                                 {'seq': seq, 'member': member,
+                                  'trace': trace.trace_id,
+                                  'status': status, 'spans': records}))
+            overflow = len(self._outbox) - self.max_bundles
+            if overflow > 0:
+                del self._outbox[:overflow]
+                self.dropped_bundles += overflow
+            self._cond.notify_all()
+
+    def wait_finished(self, traces: List[Optional[Trace]],
+                      timeout: float) -> None:
+        """Block (bounded) until every given trace has finished — they
+        finish on the engine's decode threads moments after the member
+        futures resolve, so the result frame almost always carries the
+        full bundle set."""
+        pending = {id(t) for t in traces if t is not None}
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while pending & set(self._open):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return
+                self._cond.wait(min(remaining, 0.05))
+
+    def collect(self, seq: int) -> List[dict]:
+        """Pop the bundles belonging to dispatch ``seq`` — the result
+        frame's piggyback.  Seq-keyed so a concurrently-firing
+        heartbeat can never steal the result frame's bundles out from
+        under the serve loop."""
+        with self._lock:
+            take = [bundle for _born, bundle in self._outbox
+                    if bundle['seq'] == seq]
+            self._outbox = [(born, bundle)
+                            for born, bundle in self._outbox
+                            if bundle['seq'] != seq]
+        return take
+
+    def drain(self, min_age_s: float = 0.0) -> List[dict]:
+        """Pop bundles older than ``min_age_s`` — the heartbeat's
+        orphan sweep.  The age gate leaves a just-finished bundle for
+        its own result frame; a bundle still here after a beat period
+        has evidently missed it (the serve loop is stalled or about to
+        die with the result unsent) and ships now."""
+        if min_age_s <= 0:
+            with self._lock:
+                taken, self._outbox = self._outbox, []
+            return [bundle for _born, bundle in taken]
+        now = time.perf_counter()
+        with self._lock:
+            take = [bundle for born, bundle in self._outbox
+                    if now - born >= min_age_s]
+            self._outbox = [(born, bundle)
+                            for born, bundle in self._outbox
+                            if now - born < min_age_s]
+        return take

@@ -2,7 +2,9 @@
 
 Reads the flat span records of ``spans.jsonl`` (or any
 ``flight_<event>.jsonl`` flight-recorder dump — header lines are
-skipped) and reports:
+skipped; a flight path transparently merges its replica-namespaced
+``flight_<event>_r<N>.jsonl`` siblings, the worker-process form, with
+cross-file deduplication) and reports:
 
 - **phase x bucket x tier x replica breakdown**: p50/p95/p99
   (nearest-rank) and count per span name, keyed by the trace's output
@@ -19,7 +21,14 @@ skipped) and reports:
 - **terminal statuses**: how many traces ended ok / shed / expired /
   closed / error — shed storms and deadline expiries show up here;
 - **top-K slowest traces** as full indented span trees, for the "why is
-  p99 like that" question.
+  p99 like that" question;
+- with ``--fleet``: the cross-process view over STITCHED traces
+  (OBSERVABILITY.md "Fleet observability") — true
+  queue-vs-WIRE-vs-device decomposition per replica for worker-mode
+  mesh traffic (the wire residual is the transport cost no
+  single-process span can show), plus the count of delivered traces
+  whose worker-side spans never stitched (``scripts/mesh_soak.py``
+  asserts that count to zero).
 
 ``--perfetto out.json`` converts the spans to the Chrome trace-event
 format, so serving traces open in the same Perfetto/chrome://tracing
@@ -39,6 +48,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -57,20 +67,68 @@ PHASE_CHAIN = (
 )
 
 
+#: flight-recorder dump filename, with the optional replica-instance
+#: namespace a worker-mode mesh replica writes under
+#: (flight_<event>_r<N>.jsonl — telemetry/tracing.py): the parent and
+#: its workers share one telemetry dir, so a postmortem must read BOTH
+#: forms
+FLIGHT_RE = re.compile(
+    r'^flight_(?P<event>.+?)(?:_(?P<inst>r\d+))?\.jsonl$')
+
+
+def collect_span_paths(path: str) -> List[str]:
+    """Expand one span-log path into every sibling that belongs to the
+    same story: a ``flight_<event>.jsonl`` (or a replica-namespaced
+    ``flight_<event>_r<N>.jsonl``) pulls in every other dump of that
+    event in the directory.  A plain spans.jsonl stays itself."""
+    match = FLIGHT_RE.match(os.path.basename(path))
+    if match is None:
+        return [path]
+    dirname = os.path.dirname(path) or '.'
+    event = match.group('event')
+    paths = {path}
+    try:
+        siblings = sorted(os.listdir(dirname))
+    except OSError:
+        siblings = []
+    for candidate in siblings:
+        sibling = FLIGHT_RE.match(candidate)
+        if sibling is not None and sibling.group('event') == event:
+            paths.add(os.path.join(dirname, candidate))
+    return sorted(paths)
+
+
 def load_spans(path: str) -> List[dict]:
     """Flat span records from a spans.jsonl or flight_<event>.jsonl
-    (flight header lines and garbage lines are skipped)."""
+    (flight header lines and garbage lines are skipped).  Flight paths
+    transparently merge their replica-namespaced siblings; records
+    appearing in several files (a trace in both the span log and a
+    flight ring) are deduplicated."""
     records = []
-    with open(path) as f:
-        for raw in f:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except ValueError:
-                continue
-            if isinstance(rec, dict) and 'name' in rec and 'trace' in rec:
+    seen = set()
+    for one_path in collect_span_paths(path):
+        # only GLOBBED siblings may be absent (raced away); the
+        # caller's own path stays strict — a typo'd path must fail,
+        # not masquerade as an empty span log
+        if one_path != path and not os.path.exists(one_path):
+            continue
+        with open(one_path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue
+                if not (isinstance(rec, dict) and 'name' in rec
+                        and 'trace' in rec):
+                    continue
+                key = (rec['trace'], rec.get('span'), rec['name'],
+                       rec.get('t0'))
+                if key in seen:
+                    continue
+                seen.add(key)
                 records.append(rec)
     return records
 
@@ -199,6 +257,81 @@ def replica_decomposition(traces: Dict[str, dict]
     return out
 
 
+#: the fleet decomposition's wire residual subtracts the parent-side
+#: phases that are NOT queue wait; everything left after queue + the
+#: remote envelope is time on the wire (frame send, kernel buffers,
+#: receiver scheduling)
+_PARENT_PHASES = ('serving.admission', 'serving.tokenize')
+
+
+def fleet_decomposition(traces: Dict[str, dict]
+                        ) -> Dict[Tuple[str, str],
+                                  Dict[str, List[float]]]:
+    """(replica, tier) -> {end_to_end, queue_wait, wire, device,
+    worker_host} (ms, ascending) over delivered traces — the
+    ``--fleet`` view of STITCHED cross-process traces.
+
+    For worker-mode mesh traffic the parent only sees admission,
+    tokenize, and queue wait; the grafted ``serving.remote`` envelope
+    covers the worker's receipt-to-finish, ``serving.device_execute``
+    nests inside it, and the residual between end-to-end and
+    (parent phases + queue + remote) is true WIRE time — the
+    cross-process transport cost no single-process span could show.
+    Thread-mode traces land with wire 0 (there is no wire)."""
+    out: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
+    for entry in traces.values():
+        root = entry['root']
+        if root is None or root.get('status') not in (None, 'ok'):
+            continue
+        tier, _bucket, replica = trace_key(entry)
+        total = float(root.get('dur_ms', 0.0))
+        queue = _union_ms(entry['spans'], 'serving.queue_wait')
+        device = _union_ms(entry['spans'], 'serving.device_execute')
+        remote = _union_ms(entry['spans'], 'serving.remote')
+        if remote > 0:
+            parent = sum(_union_ms(entry['spans'], name)
+                         for name in _PARENT_PHASES)
+            wire = max(0.0, total - queue - remote - parent)
+            worker_host = max(0.0, remote - device)
+        else:
+            wire = 0.0
+            worker_host = 0.0
+        parts = out.setdefault(
+            (replica, tier),
+            {'end_to_end': [], 'queue_wait': [], 'wire': [],
+             'device': [], 'worker_host': []})
+        parts['end_to_end'].append(total)
+        parts['queue_wait'].append(queue)
+        parts['wire'].append(wire)
+        parts['device'].append(device)
+        parts['worker_host'].append(worker_host)
+    for parts in out.values():
+        for values in parts.values():
+            values.sort()
+    return out
+
+
+def unstitched_traces(traces: Dict[str, dict]) -> List[str]:
+    """Delivered traces with NO device-execute attribution — for
+    worker-mode mesh traffic that means the worker-side spans never
+    made it back over the wire (the stitching failure mode
+    ``scripts/mesh_soak.py`` asserts to zero).  Thread-mode and
+    single-engine traces record device_execute locally, so any
+    delivered trace missing it is wire-truncated."""
+    out = []
+    for trace_id, entry in traces.items():
+        root = entry['root']
+        if root is None or root.get('status') not in (None, 'ok'):
+            continue
+        if root.get('name') != 'serving.request':
+            continue  # engine-level singles (canary shadows) have no
+            #           device leg by design
+        if not any(rec['name'] == 'serving.device_execute'
+                   for rec in entry['spans']):
+            out.append(trace_id)
+    return sorted(out)
+
+
 def status_counts(traces: Dict[str, dict]) -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for entry in traces.values():
@@ -270,6 +403,13 @@ def main(argv=None) -> int:
                         help='spans.jsonl or flight_<event>.jsonl path')
     parser.add_argument('--top', type=int, default=5,
                         help='slowest span trees to print (0 = none)')
+    parser.add_argument('--fleet', action='store_true',
+                        help='cross-process fleet view over STITCHED '
+                             'traces: queue-vs-wire-vs-device '
+                             'decomposition per replica, plus the '
+                             'count of delivered traces whose worker-'
+                             'side spans never stitched (wire-'
+                             'truncated)')
     parser.add_argument('--json', action='store_true',
                         help='emit machine-readable JSON lines instead '
                              'of the table')
@@ -324,6 +464,23 @@ def main(argv=None) -> int:
                     'p50': round(percentile(values, 0.50), 3),
                     'p99': round(percentile(values, 0.99), 3),
                 }))
+        if args.fleet:
+            unstitched = unstitched_traces(traces)
+            print(json.dumps({'measure': 'unstitched_traces',
+                              'value': len(unstitched),
+                              'traces': unstitched[:32]}))
+            for (replica, tier), parts in sorted(
+                    fleet_decomposition(traces).items()):
+                for part in ('end_to_end', 'queue_wait', 'wire',
+                             'device', 'worker_host'):
+                    values = parts[part]
+                    print(json.dumps({
+                        'measure': 'fleet_decomposition_ms',
+                        'replica': replica, 'tier': tier, 'part': part,
+                        'count': len(values),
+                        'p50': round(percentile(values, 0.50), 3),
+                        'p99': round(percentile(values, 0.99), 3),
+                    }))
     else:
         print('== %d trace(s) from %s' % (len(traces), args.spans))
         print('statuses: ' + ', '.join('%s=%d' % kv
@@ -363,6 +520,28 @@ def main(argv=None) -> int:
                          percentile(parts['device'], 0.99),
                          percentile(parts['end_to_end'], 0.50),
                          percentile(parts['end_to_end'], 0.99)))
+        if args.fleet:
+            unstitched = unstitched_traces(traces)
+            print()
+            print('fleet view (stitched cross-process traces): %d '
+                  'delivered trace(s) UNSTITCHED (no device-execute '
+                  'attribution — worker spans lost on the wire)'
+                  % len(unstitched))
+            fleet = fleet_decomposition(traces)
+            if fleet:
+                print('  %-7s %-10s %6s %9s %9s %9s %9s %9s'
+                      % ('replica', 'tier', 'count', 'queue_p99',
+                         'wire_p99', 'dev_p99', 'whost_p99',
+                         'e2e_p99'))
+                for (replica, tier), parts in sorted(fleet.items()):
+                    print('  %-7s %-10s %6d %9.2f %9.2f %9.2f %9.2f '
+                          '%9.2f'
+                          % (replica, tier, len(parts['end_to_end']),
+                             percentile(parts['queue_wait'], 0.99),
+                             percentile(parts['wire'], 0.99),
+                             percentile(parts['device'], 0.99),
+                             percentile(parts['worker_host'], 0.99),
+                             percentile(parts['end_to_end'], 0.99)))
         if args.top > 0:
             slowest = sorted(
                 (entry for entry in traces.values()

@@ -17,7 +17,11 @@ The assertions are the self-healing contract:
   the warm path on the serving side of the wire (worker cold starts
   compile in their OWN processes, off the parent's counter);
 - **bounded p99** — restart latency is visible but bounded
-  (``--p99-bound-ms``).
+  (``--p99-bound-ms``);
+- **zero unstitched trace trees** — at ``TRACING_SAMPLE_RATE=1.0``,
+  every delivered request's span tree must carry its worker-side
+  device-execute spans (cross-process stitching, OBSERVABILITY.md
+  "Fleet observability"); a wire-truncated tree fails the soak.
 
 Prints one JSON line per metric (``mesh_soak_*``); exit 1 on any
 violation.  ``BENCH_SMOKE=1`` shrinks shapes and duration for the
@@ -104,7 +108,10 @@ def main() -> int:
         MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=2,
         MESH_RESTART_BACKOFF_SECS=0.1,
         MESH_RESTART_LIMIT=10_000,  # the soak must keep healing
-        MESH_RESTART_WINDOW_SECS=3600.0)
+        MESH_RESTART_WINDOW_SECS=3600.0,
+        # trace EVERY request: the stitching assertion below needs the
+        # full span-tree population, not a sample
+        TRACING_SAMPLE_RATE=1.0)
     model = Code2VecModel(config)
     model.save(state=model.state, epoch=0, wait=True)
 
@@ -186,6 +193,43 @@ def main() -> int:
         violations.append('no supervised restart fired — the chaos '
                           'never bit (raise --secs or lower '
                           '--kill-every)')
+
+    # cross-process stitching contract (OBSERVABILITY.md "Fleet
+    # observability"): ZERO admitted requests may finish with a
+    # wire-truncated trace tree — every delivered trace must carry its
+    # worker-side device-execute spans, grafted by adopt_spans
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from latency_report import (group_traces, load_spans,
+                                unstitched_traces)
+    spans_path = os.path.join(workdir, 'telemetry', 'spans.jsonl')
+    stitched_total = unstitched = None
+    if os.path.exists(spans_path):
+        traces = group_traces(load_spans(spans_path))
+        delivered = [e for e in traces.values()
+                     if e['root'] is not None
+                     and e['root'].get('status') in (None, 'ok')]
+        truncated = unstitched_traces(traces)
+        stitched_total = len(delivered)
+        unstitched = len(truncated)
+        if ok and not delivered:
+            violations.append('requests completed but the span log '
+                              'has no delivered traces (tracing '
+                              'broken?)')
+        if truncated:
+            violations.append(
+                '%d delivered trace(s) finished UNSTITCHED (no '
+                'worker device-execute spans): %s'
+                % (len(truncated), truncated[:8]))
+    elif ok:
+        violations.append('no span log at %s (stitching assertion '
+                          'could not run)' % spans_path)
+    emit({'metric': 'mesh_soak_unstitched_traces', 'value': unstitched,
+          'delivered_traces': stitched_total,
+          'adopted_spans': stats.get('adopted_spans_total'),
+          'remote_spans_dropped':
+              stats.get('remote_spans_dropped_total')})
 
     emit({'metric': 'mesh_soak_requests', 'value': total, 'ok': ok,
           'shed_at_admission': shed, 'typed_failures': typed,

@@ -1,0 +1,614 @@
+"""Fleet observability plane (ISSUE 15): cross-process trace
+stitching over the mesh wire, worker telemetry/ledger backhaul merged
+into ONE fleet export, clock-offset estimation, SLO burn-rate
+alarming, flight-dump namespacing, and the ``latency_report.py
+--fleet`` view — unit-drilled piece by piece, then end-to-end through
+a socket-mode worker kill (the delivered request's stitched tree
+shows BOTH incarnations' device work)."""
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+_SCRIPTS = os.path.join(REPO, 'scripts')
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+import latency_report  # noqa: E402
+
+from code2vec_tpu.config import Config  # noqa: E402
+from code2vec_tpu.resilience import faults  # noqa: E402
+from code2vec_tpu.serving import slo as slo_lib  # noqa: E402
+from code2vec_tpu.serving import transport as transport_lib  # noqa: E402
+from code2vec_tpu.serving.errors import (EngineOverloaded,  # noqa: E402
+                                         WireError)
+from code2vec_tpu.telemetry import core as tele_core  # noqa: E402
+from code2vec_tpu.telemetry import tracing as tracing_lib  # noqa: E402
+from tests.test_train_overfit import make_dataset  # noqa: E402
+
+PREDICT_LINES = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+    'run|c tokc0,pC,tokc1 tokc2,pA,tokc0 tokc1,pB,tokc2',
+]
+
+
+@pytest.fixture(autouse=True)
+def clear_fault_plan():
+    faults.configure('')
+    yield
+    faults.configure('')
+
+
+# --------------------------------------------------- clock offset units
+def test_clock_offset_monotone_under_skewed_clock():
+    """The min-filter estimate only ever tightens (monotonically
+    nonincreasing), and under a skewed remote clock it recovers the
+    true offset up to the smallest observed one-way delay — enough to
+    ORDER cross-host stamps."""
+    true_offset = -123.456  # remote clock runs 123.456s AHEAD
+    rng = np.random.default_rng(3)
+    clock = transport_lib.ClockOffset()
+    assert clock.offset == 0.0 and clock.samples == 0
+    estimates = []
+    delays = []
+    for _ in range(200):
+        delay = float(rng.uniform(0.0005, 0.050))  # wire delay >= 0
+        remote_t = float(rng.uniform(0, 1000))
+        clock.observe(remote_t, remote_t + true_offset + delay)
+        delays.append(delay)
+        estimates.append(clock.offset)
+    # monotone nonincreasing, never below the true offset
+    assert all(b <= a + 1e-12 for a, b in zip(estimates, estimates[1:]))
+    assert clock.offset >= true_offset
+    assert clock.offset - true_offset <= min(delays) + 1e-9
+    # ordering: a remote stamp shifted by the estimate lands within
+    # min-delay of its true parent-clock instant
+    assert abs((500.0 + clock.offset) - (500.0 + true_offset)) \
+        <= min(delays) + 1e-9
+    # None samples are ignored, not crashes
+    clock.observe(None)
+    assert clock.samples == 200
+
+
+# ------------------------------------------------ typed heartbeat units
+def test_heartbeat_schema_validation_typed():
+    good = transport_lib.Heartbeat(inflight=2, t_mono=1.0)
+    assert transport_lib.check_heartbeat(good) is good
+    with pytest.raises(WireError, match='payload schema'):
+        transport_lib.check_heartbeat({'inflight': 2})  # the old shape
+    with pytest.raises(WireError, match='schema'):
+        transport_lib.check_heartbeat(
+            transport_lib.Heartbeat(schema=transport_lib.
+                                    HEARTBEAT_SCHEMA + 1))
+
+
+def test_heartbeat_rides_the_frame_wire():
+    payload = transport_lib.Heartbeat(
+        inflight=1, t_mono=2.5,
+        spans=[{'seq': 0, 'member': 0, 'spans': []}],
+        telemetry={'jit/compiles_total': 4},
+        ledger={'attributed_bytes': 128})
+    back = transport_lib.decode_frame(
+        transport_lib.encode_frame(('heartbeat', -1, payload)))
+    beat = transport_lib.check_heartbeat(back[2])
+    assert beat.t_mono == 2.5
+    assert beat.telemetry == {'jit/compiles_total': 4}
+    assert beat.ledger['attributed_bytes'] == 128
+
+
+# -------------------------------------------------- adopt_spans units
+def _remote_records():
+    return [
+        {'trace': 'x', 'span': 0, 'parent': None,
+         'name': 'serving.remote', 't0': 10.0, 't1': 12.0,
+         'attrs': {'replica': 'r0', 'pid': 111}},
+        {'trace': 'x', 'span': 1, 'parent': 0,
+         'name': 'serving.device_execute', 't0': 10.5, 't1': 11.5},
+        {'trace': 'x', 'span': 2, 'parent': 1,
+         'name': 'serving.fetch', 't0': 11.0, 't1': 11.4},
+    ]
+
+
+def test_adopt_spans_remaps_ids_applies_offset_and_parents():
+    tracer = tracing_lib.Tracer(None, sample_rate=1.0)
+    trace = tracer.begin('serving.request')
+    chunk = trace.span('serving.chunk')
+    assert trace.adopt_spans(_remote_records(), offset_s=-7.0,
+                             parent=chunk) == 3
+    by_name = {s.name: s for s in trace._spans}
+    remote = by_name['serving.remote']
+    dev = by_name['serving.device_execute']
+    fetch = by_name['serving.fetch']
+    # fresh local ids, no collision with the existing spans
+    ids = [s.span_id for s in trace._spans]
+    assert len(ids) == len(set(ids))
+    # the remote root grafts under the member's span; internal links
+    # survive the remap
+    assert remote.parent_id == chunk.span_id
+    assert dev.parent_id == remote.span_id
+    assert fetch.parent_id == dev.span_id
+    # stamps shifted onto the parent clock
+    assert remote.t0 == 3.0 and dev.t1 == 4.5
+    trace.finish()
+    # a finished trace is already serialized: late spans are refused
+    assert trace.adopt_spans(_remote_records()) == 0
+
+
+def test_adopt_spans_two_incarnations_never_collide():
+    tracer = tracing_lib.Tracer(None, sample_rate=1.0)
+    trace = tracer.begin('serving.request')
+    assert trace.adopt_spans(_remote_records(), 0.0) == 3
+    second = _remote_records()
+    second[0]['attrs'] = {'replica': 'r0', 'pid': 222}
+    assert trace.adopt_spans(second, 0.0) == 3
+    remotes = [s for s in trace._spans if s.name == 'serving.remote']
+    assert len(remotes) == 2
+    assert {s.attrs['pid'] for s in remotes} == {111, 222}
+    devs = [s for s in trace._spans
+            if s.name == 'serving.device_execute']
+    assert {d.parent_id for d in devs} == \
+        {r.span_id for r in remotes}
+
+
+# ---------------------------------------------- remote span sink units
+def test_remote_sink_collect_is_seq_keyed_and_drain_age_gated():
+    sink = tracing_lib.RemoteSpanSink('r1')
+    ctx = {'trace_id': 'abc', 'sampled': True}
+    t_a = sink.begin('serving.remote', ctx, seq=4, member=0)
+    t_b = sink.begin('serving.remote', ctx, seq=5, member=0)
+    t_a.span_at('serving.device_execute', 1.0, 2.0)
+    t_a.finish()
+    t_b.finish()
+    sink.wait_finished([t_a, t_b], timeout=2.0)
+    # a concurrent heartbeat with an age gate leaves fresh bundles for
+    # their own result frame
+    assert sink.drain(min_age_s=60.0) == []
+    got = sink.collect(4)
+    assert [b['seq'] for b in got] == [4]
+    names = [r['name'] for r in got[0]['spans']]
+    assert names == ['serving.remote', 'serving.device_execute']
+    assert got[0]['spans'][0]['attrs']['replica'] == 'r1'
+    # the leftover (seq 5) is the orphan sweep's
+    leftovers = sink.drain()
+    assert [b['seq'] for b in leftovers] == [5]
+    assert sink.drain() == []
+
+
+def test_remote_sink_outbox_bounded():
+    """With heartbeats disabled nothing sweeps orphans: the outbox
+    caps (oldest dropped, counted) instead of growing the worker
+    without bound."""
+    sink = tracing_lib.RemoteSpanSink('r1', max_bundles=2)
+    ctx = {'trace_id': 'abc', 'sampled': True}
+    for seq in range(5):
+        sink.begin('serving.remote', ctx, seq=seq, member=0).finish()
+    assert sink.dropped_bundles == 3
+    assert [b['seq'] for b in sink.drain()] == [3, 4]
+
+
+# ------------------------------------------------- SLO monitor units
+def test_slo_monitor_quiet_at_baseline_fires_on_burn_latched(tmp_path):
+    tracer = tracing_lib.Tracer(str(tmp_path), sample_rate=1.0)
+    monitor = slo_lib.SloMonitor(
+        availability=0.99, p99_ms=50.0, fast_window_s=30.0,
+        slow_window_s=60.0, burn_threshold=5.0, min_events=10,
+        tracer=tracer)
+    assert monitor.enabled
+    for _ in range(50):
+        monitor.observe_good(0.005)
+    assert monitor.alerts_total.value == 0  # baseline stays quiet
+    stats = monitor.stats()
+    assert stats['availability_burn_fast'] == 0.0
+    # an injected burn (every request shed) crosses both windows
+    for _ in range(30):
+        monitor.observe_bad('shed')
+    assert monitor.alerts_total.value == 1  # latched: fired ONCE
+    assert monitor.stats()['alerting']['availability'] is True
+    path = os.path.join(str(tmp_path), 'flight_slo_burn.jsonl')
+    assert os.path.exists(path)
+    header = json.loads(open(path).readline())
+    assert header['flight'] == 'slo_burn'
+    # p99 leg: slow deliveries burn the 1% latency budget
+    for _ in range(40):
+        monitor.observe_good(0.500)
+    assert monitor.stats()['alerting']['p99'] is True
+    assert monitor.alerts_total.value == 2
+    assert monitor.slow_total.value == 40
+
+
+def test_slo_burns_evict_at_read_time():
+    """A stats() read long after a burst reports the burn as OVER
+    (windows evict at read time), not the burst-time value forever."""
+    monitor = slo_lib.SloMonitor(
+        availability=0.9, fast_window_s=0.2, slow_window_s=0.3,
+        burn_threshold=2.0, min_events=5)
+    for _ in range(10):
+        monitor.observe_bad('shed')
+    assert monitor.stats()['availability_burn_fast'] > 2.0
+    time.sleep(0.4)  # both windows age out with NO further traffic
+    stale = monitor.stats()
+    assert stale['availability_burn_fast'] == 0.0
+    assert stale['fast_window_events'] == 0
+
+
+def test_slo_monitor_disabled_legs():
+    monitor = slo_lib.SloMonitor()  # no targets: a no-op observer
+    assert not monitor.enabled
+    monitor.observe_good(10.0)
+    monitor.observe_bad('shed')
+    assert monitor.alerts_total.value == 0
+
+
+# ------------------------------------- flight namespacing + report glob
+def test_flight_dumps_namespaced_by_instance_and_globbed(tmp_path):
+    out = str(tmp_path)
+    parent = tracing_lib.Tracer(out, sample_rate=1.0)
+    worker = tracing_lib.Tracer(out, sample_rate=1.0, instance='r1')
+    trace = parent.begin('serving.request', attrs={'tier': 'topk'})
+    trace.span_at('serving.device_execute', 0.0, 1.0)
+    trace.finish()
+    remote = worker.begin('serving.request', attrs={'tier': 'topk'})
+    remote.finish()
+    assert parent.dump_flight('overload', force=True).endswith(
+        'flight_overload.jsonl')
+    assert worker.dump_flight('overload', force=True).endswith(
+        'flight_overload_r1.jsonl')
+    # the two processes never clobber one postmortem file...
+    assert os.path.exists(os.path.join(out, 'flight_overload.jsonl'))
+    assert os.path.exists(
+        os.path.join(out, 'flight_overload_r1.jsonl'))
+    # ...and the report reads BOTH forms from either entry point, with
+    # cross-file dedup
+    for entry in ('flight_overload.jsonl', 'flight_overload_r1.jsonl'):
+        records = latency_report.load_spans(os.path.join(out, entry))
+        traces = latency_report.group_traces(records)
+        assert trace.trace_id in traces
+        assert remote.trace_id in traces
+        assert len(traces[trace.trace_id]['spans']) == 2  # deduped
+    # an underscore-bearing event without an instance stays itself
+    parent.dump_flight('slo_burn', force=True)
+    match = latency_report.FLIGHT_RE.match('flight_slo_burn.jsonl')
+    assert match.group('event') == 'slo_burn'
+    assert match.group('inst') is None
+
+
+# -------------------------------------------- fleet report on synthetic
+def _synthetic_stitched_log(path):
+    """Two delivered traces: one stitched worker-mode (with wire gap),
+    one wire-truncated (no device attribution)."""
+    records = [
+        # stitched: root 0..100ms, queue 5..25, remote 30..90 with
+        # device 40..80 — wire = 100 - 20 - 60 = 20ms
+        {'trace': 'T1', 'span': 0, 'parent': None,
+         'name': 'serving.request', 't0': 0.0, 't1': 0.100,
+         'dur_ms': 100.0, 'status': 'ok', 'sampled': True,
+         'attrs': {'tier': 'topk'}},
+        {'trace': 'T1', 'span': 1, 'parent': 0,
+         'name': 'serving.queue_wait', 't0': 0.005, 't1': 0.025,
+         'dur_ms': 20.0},
+        {'trace': 'T1', 'span': 2, 'parent': 0,
+         'name': 'serving.remote', 't0': 0.030, 't1': 0.090,
+         'dur_ms': 60.0, 'attrs': {'replica': 'r0', 'pid': 7}},
+        {'trace': 'T1', 'span': 3, 'parent': 2,
+         'name': 'serving.pack', 't0': 0.030, 't1': 0.035,
+         'dur_ms': 5.0,
+         'attrs': {'bucket': 8, 'tier': 'topk', 'replica': 'r0'}},
+        {'trace': 'T1', 'span': 4, 'parent': 2,
+         'name': 'serving.device_execute', 't0': 0.040, 't1': 0.080,
+         'dur_ms': 40.0},
+        # truncated: delivered but its worker spans never stitched
+        {'trace': 'T2', 'span': 0, 'parent': None,
+         'name': 'serving.request', 't0': 0.0, 't1': 0.050,
+         'dur_ms': 50.0, 'status': 'ok', 'sampled': True,
+         'attrs': {'tier': 'topk'}},
+        {'trace': 'T2', 'span': 1, 'parent': 0,
+         'name': 'serving.queue_wait', 't0': 0.0, 't1': 0.010,
+         'dur_ms': 10.0},
+        # a shed trace: not delivered, so never "unstitched"
+        {'trace': 'T3', 'span': 0, 'parent': None,
+         'name': 'serving.request', 't0': 0.0, 't1': 0.001,
+         'dur_ms': 1.0, 'status': 'shed', 'sampled': True,
+         'attrs': {'tier': 'topk'}},
+    ]
+    with open(path, 'w') as f:
+        for rec in records:
+            f.write(json.dumps(rec) + '\n')
+
+
+def test_latency_report_fleet_decomposition_and_unstitched(tmp_path,
+                                                           capsys):
+    spans = str(tmp_path / 'spans.jsonl')
+    _synthetic_stitched_log(spans)
+    traces = latency_report.group_traces(
+        latency_report.load_spans(spans))
+    assert latency_report.unstitched_traces(traces) == ['T2']
+    fleet = latency_report.fleet_decomposition(traces)
+    parts = fleet[('r0', 'topk')]
+    assert parts['end_to_end'] == [100.0]
+    assert parts['queue_wait'] == [20.0]
+    assert parts['device'] == [40.0]
+    assert parts['worker_host'] == [20.0]   # remote 60 - device 40
+    assert abs(parts['wire'][0] - 20.0) < 1e-6  # 100 - 20 - 60
+    # the truncated trace has no replica attribution: lands under '-'
+    assert fleet[('-', 'topk')]['wire'] == [0.0]
+    # CLI --fleet --json emits the rows
+    assert latency_report.main(
+        ['--spans', spans, '--fleet', '--json', '--top', '0']) == 0
+    out = capsys.readouterr().out
+    rows = [json.loads(line) for line in out.splitlines()]
+    unstitched = [r for r in rows
+                  if r['measure'] == 'unstitched_traces']
+    assert unstitched[0]['value'] == 1
+    wire_rows = [r for r in rows
+                 if r['measure'] == 'fleet_decomposition_ms'
+                 and r['part'] == 'wire' and r['replica'] == 'r0']
+    assert wire_rows and abs(wire_rows[0]['p50'] - 20.0) < 1e-6
+
+
+# ---------------------------------------------- fleet telemetry merge
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('fleet_obs'))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,16')
+    return Code2VecModel(config)
+
+
+def _fake_worker(rid):
+    clock = transport_lib.ClockOffset()
+    clock.observe(0.0, 0.0015)
+    return types.SimpleNamespace(rid=rid, clock=clock, _merge_last={})
+
+
+def test_worker_telemetry_merges_replica_labeled_no_family_splits(
+        model, tmp_path):
+    """The fleet merge: worker snapshots land replica-labeled in the
+    parent registry, counters accumulate by delta across incarnation
+    resets, and the Prometheus export stays one contiguous group per
+    family (strict expfmt parsers reject split families)."""
+    from code2vec_tpu.telemetry.exporters import PrometheusExporter
+    mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                              mode='thread')
+    tele_core.reset()
+    tele_core.enable()
+    try:
+        timer_stats = {'count': 4, 'mean_ms': 2.0, 'p50_ms': 2.0,
+                       'p95_ms': 3.0, 'max_ms': 3.5, 'last_ms': 1.5,
+                       'total_s': 0.008}
+        snapshot = {
+            'serving/requests_total{replica=r7}': 5,
+            'serving/latency_ms{replica=r7}': timer_stats,
+            'jit/compiles_total': 12,
+            'mem/attributed_bytes': 4096.0,
+            'not/cataloged': 1.0,
+        }
+        w7 = _fake_worker('r7')
+        w8 = _fake_worker('r8')
+        mesh._on_worker_telemetry(w7, snapshot, None)
+        mesh._on_worker_telemetry(w8, {'jit/compiles_total': 3}, None)
+        reg = tele_core.registry()
+        # labeled names keep their label; unlabeled gain the replica's
+        assert reg.get(
+            'serving/requests_total{replica=r7}').snapshot() == 5
+        assert reg.get(
+            'jit/compiles_total{replica=r7}').snapshot() == 12
+        assert reg.get(
+            'jit/compiles_total{replica=r8}').snapshot() == 3
+        assert reg.get(
+            'mem/attributed_bytes{replica=r7}').snapshot() == 4096.0
+        assert reg.get('not/cataloged') is None  # refused the export
+        # the parent's own (unlabeled) counter is untouched
+        assert reg.get('jit/compiles_total') is None
+        # delta merge: monotone growth accumulates, an incarnation
+        # reset (restart) keeps accumulating instead of rewinding
+        mesh._on_worker_telemetry(w7, {'jit/compiles_total': 15}, None)
+        assert reg.get(
+            'jit/compiles_total{replica=r7}').snapshot() == 15
+        w7b = _fake_worker('r7')  # restarted incarnation, counts reset
+        mesh._on_worker_telemetry(w7b, {'jit/compiles_total': 2}, None)
+        assert reg.get(
+            'jit/compiles_total{replica=r7}').snapshot() == 17
+        # clock offset exported per replica
+        assert reg.get(
+            'mesh/clock_offset_ms{replica=r7}').snapshot() > 0
+        # Prometheus export: every family contiguous, replica series
+        # distinct
+        exporter = PrometheusExporter(str(tmp_path))
+        exporter.flush(reg, step=0)
+        text = open(exporter.path).read().splitlines()
+        fam_of = lambda line: line.split('{')[0].split(' ')[0]  # noqa: E731
+        seen, last = {}, None
+        for line in text:
+            if line.startswith('#'):
+                continue
+            fam = fam_of(line)
+            if fam != last and fam in seen:
+                raise AssertionError('family %r split in the fleet '
+                                     'export' % fam)
+            seen[fam] = True
+            last = fam
+        lat = [line for line in text
+               if line.startswith('code2vec_serving_latency_ms_mean_ms')]
+        assert any('replica="r7"' in line for line in lat)
+        assert mesh.stats()['worker_snapshots_total'] == 4
+    finally:
+        mesh.close()
+        tele_core.disable()
+        tele_core.reset()
+
+
+def test_mesh_slo_monitor_fires_on_reject_all_burn(model):
+    """The mesh-integrated burn alarm: an injected reject_all drill
+    sheds every submit, which burns the availability budget and fires
+    the monitor; a healthy stream beforehand stays quiet."""
+    from tests.test_serving_mesh import _cfg
+    with _cfg(model, SERVING_SLO_AVAILABILITY=0.5,
+              SERVING_SLO_FAST_WINDOW_SECS=30.0,
+              SERVING_SLO_SLOW_WINDOW_SECS=60.0,
+              SERVING_SLO_BURN_THRESHOLD=1.5):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='thread', max_delay_ms=0.0)
+    try:
+        assert mesh._slo is not None and mesh._slo.enabled
+        for _ in range(8):
+            mesh.predict([PREDICT_LINES[0]], tier='topk', timeout=120)
+        assert mesh.stats()['slo']['alerts_total'] == 0  # quiet
+        faults.configure('reject_all@req=0..9999')
+        shed = 0
+        for _ in range(40):
+            try:
+                mesh.submit([PREDICT_LINES[0]], tier='topk')
+            except EngineOverloaded:
+                shed += 1
+        assert shed == 40
+        stats = mesh.stats()['slo']
+        assert stats['alerts_total'] >= 1
+        assert stats['alerting']['availability'] is True
+        assert stats['availability_burn_fast'] > 1.5
+        assert stats['bad_total'] == 40
+    finally:
+        faults.configure('')
+        mesh.close()
+
+
+# ------------------------------------- e2e: stitched socket kill drill
+def _wait_until(predicate, timeout=60.0, what='condition'):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError('timed out waiting for %s' % what)
+
+
+def test_socket_stitched_kill_drill_both_incarnations(tmp_path_factory):
+    """The stitching acceptance drill: a socket-mode worker executes a
+    batch on device, its spans ship (heartbeat), then it is SIGKILLed
+    BEFORE the result frame.  The redispatched request is served by the
+    restarted incarnation — and its delivered trace tree contains BOTH
+    incarnations' `serving.remote` envelopes with device-execute spans,
+    phase stamps ordered by the clock-offset estimate.  Along the way:
+    the fleet merge carries the worker's telemetry + ledger, and zero
+    delivered traces finish unstitched."""
+    from tests.test_serving_mesh import _cfg, _checkpointed_model
+    from code2vec_tpu.telemetry.jit_tracker import \
+        install_compile_listener
+    model = _checkpointed_model(tmp_path_factory, 'stitch')
+    tele_core.reset()
+    tele_core.enable()
+    mesh = None
+    try:
+        install_compile_listener()
+        telemetry_dir = os.path.join(
+            os.path.dirname(model.config.MODEL_SAVE_PATH), 'telemetry')
+        with _cfg(model,
+                  # trigger counts are 0-based: fires on the SECOND
+                  # dispatch this incarnation serves
+                  FAULT_INJECT='kill_worker_after_execute@dispatch=1',
+                  TRACING_SAMPLE_RATE=1.0,
+                  MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=8,
+                  MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5):
+            mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                      mode='socket', max_delay_ms=0.0)
+            # dispatch #1: a clean stitched round trip
+            clean = mesh.submit([PREDICT_LINES[0]], tier='topk')
+            assert clean.result(timeout=300)
+            # dispatch #2 fires the fault: executed, spans beat home,
+            # killed before the result frame -> redispatch -> the NEW
+            # incarnation serves it
+            doomed = mesh.submit([PREDICT_LINES[1]], tier='topk')
+            assert doomed.result(timeout=600)
+            _wait_until(lambda: mesh.stats()['restarts_total'] >= 1,
+                        timeout=300.0, what='supervised restart')
+            stats = mesh.stats()
+            assert stats['redispatched_total'] >= 1
+            assert stats['adopted_spans_total'] > 0
+            # worker backhaul surfaced: ledger rollup + clock offset
+            _wait_until(
+                lambda: mesh.stats()['replicas'][0]['worker_memory']
+                is not None, timeout=60.0, what='ledger backhaul')
+            row = mesh.stats()['replicas'][0]
+            assert 'attributed_bytes' in row['worker_memory']
+            assert 'buckets' in row['worker_memory']
+            assert row['clock_offset_ms'] is not None
+            # fleet merge reached the parent registry replica-labeled
+            # (an external-dispatch worker emits dispatch-side series:
+            # batches, never submit-side requests)
+            _wait_until(
+                lambda: tele_core.registry().get(
+                    'serving/batches_total{replica=r0}') is not None,
+                timeout=60.0, what='fleet telemetry merge')
+            assert tele_core.registry().get(
+                'serving/batches_total{replica=r0}').snapshot() >= 1
+        mesh.close()
+        spans_path = os.path.join(telemetry_dir, 'spans.jsonl')
+        traces = latency_report.group_traces(
+            latency_report.load_spans(spans_path))
+        # every delivered trace is stitched
+        delivered = {tid: e for tid, e in traces.items()
+                     if e['root'] is not None
+                     and e['root'].get('status') in (None, 'ok')
+                     and (e['root'].get('attrs') or {}).get('mesh')}
+        assert delivered
+        assert not [tid for tid in
+                    latency_report.unstitched_traces(traces)
+                    if tid in delivered]
+        # the redispatched trace shows BOTH incarnations' device work
+        stitched = None
+        for entry in delivered.values():
+            names = [r['name'] for r in entry['spans']]
+            if names.count('serving.remote') >= 2 and \
+                    'serving.redispatch' in names:
+                stitched = entry
+                break
+        assert stitched is not None, \
+            'no delivered trace carries both incarnations'
+        remotes = [r for r in stitched['spans']
+                   if r['name'] == 'serving.remote']
+        pids = {(r.get('attrs') or {}).get('pid') for r in remotes}
+        assert len(pids) == 2, 'expected two worker incarnations'
+        remote_ids = {r['span'] for r in remotes}
+        devs = [r for r in stitched['spans']
+                if r['name'] == 'serving.device_execute']
+        assert len(devs) >= 2
+        # each remote envelope contains a device-execute child
+        dev_parents = {d['parent'] for d in devs}
+        assert remote_ids <= dev_parents
+        # stitched stamps are ordered: every remote span sits inside
+        # the root's window (clock offset applied), and phase sums
+        # stay within the end-to-end envelope
+        root = stitched['root']
+        for rec in stitched['spans']:
+            assert rec['t0'] >= root['t0'] - 0.05
+            assert rec['t1'] <= root['t1'] + 0.05
+        phase_ms = sum(r['dur_ms'] for r in stitched['spans']
+                       if r['name'] in ('serving.admission',
+                                        'serving.tokenize',
+                                        'serving.queue_wait'))
+        assert phase_ms <= root['dur_ms'] * 1.05 + 5.0
+        # the fleet report decomposes queue / wire / device for r0
+        fleet = latency_report.fleet_decomposition(traces)
+        r0_rows = [key for key in fleet if key[0] == 'r0']
+        assert r0_rows
+        parts = fleet[r0_rows[0]]
+        assert parts['device'] and parts['device'][-1] > 0
+    finally:
+        if mesh is not None:
+            mesh.close()
+        model.close_stores()
+        tele_core.disable()
+        tele_core.reset()
