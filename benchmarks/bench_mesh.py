@@ -88,6 +88,38 @@ def make_profile(lines, n_requests: int, max_lines: int, seed: int = 3):
     return profile
 
 
+def make_zipf_profile(lines, n_requests: int, max_lines: int,
+                      n_templates: int, alpha: float, seed: int = 5,
+                      vec_dim: int = 0, vec_share: float = 0.15):
+    """Zipf-replayed duplicate-heavy traffic: a pool of distinct request
+    templates replayed with probability proportional to 1/rank^alpha —
+    the fleet-traffic shape the memoization tier exists for (hot
+    methods arrive over and over; SERVING.md "Memoization tier").
+    ``vec_share`` of the templates are single-row VECTOR neighbor
+    queries replayed with per-request jitter: near-identical but never
+    byte-identical, so exact dedup cannot catch them — the semantic
+    tier's traffic."""
+    templates = make_profile(lines, n_templates, max_lines, seed=seed)
+    rng = np.random.default_rng(seed)
+    if vec_dim:
+        for t in range(n_templates):
+            if rng.random() < vec_share:
+                base = rng.standard_normal(vec_dim).astype(np.float32)
+                templates[t] = ('neighbors_vec', base)
+    ranks = np.arange(1, n_templates + 1, dtype=np.float64)
+    weights = ranks ** -alpha
+    weights /= weights.sum()
+    picks = rng.choice(n_templates, size=n_requests, p=weights)
+    profile = []
+    for i in picks:
+        kind, payload = templates[int(i)]
+        if kind == 'neighbors_vec':
+            jitter = rng.standard_normal(vec_dim).astype(np.float32)
+            payload = payload + np.float32(1e-4) * jitter
+        profile.append((kind, payload))
+    return profile
+
+
 def run_arm(model, index, profile, replicas: int, offered_rows_per_s: float,
             deadline_ms: float, compiles, generators: int = 4) -> dict:
     """One fixed-offered-load arm against an n-replica mesh.  The
@@ -199,6 +231,138 @@ def run_arm(model, index, profile, replicas: int, offered_rows_per_s: float,
     }
 
 
+def run_memo_arm(model, index, profile, offered_rows_per_s: float,
+                 deadline_ms: float, compiles, memo_bytes: int,
+                 epsilon: float, capacity: float,
+                 generators: int = 4) -> dict:
+    """One Zipf-replay arm: the same paced open-loop driver as
+    ``run_arm``, but latencies split at the SUBMIT boundary — a memo
+    hit comes back already resolved (``future.done()`` on return), so
+    cache-served and live-served p99 are measured separately.  Device
+    work is the mesh's ``rows_dispatched`` (a hit never dispatches);
+    device-seconds-per-1k-requests is the host-side proxy
+    rows_dispatched / one replica's measured capacity."""
+    import threading
+    from code2vec_tpu.serving.errors import (DeadlineExceeded,
+                                             EngineOverloaded)
+    mesh = model.serving_mesh(
+        replicas=1, tiers=('topk', 'attention', 'vectors'),
+        max_delay_ms=2.0, deadline_ms=deadline_ms,
+        memo_cache_bytes=memo_bytes, memo_semantic_epsilon=epsilon)
+    mesh.attach_index(index)
+    warm_compiles = compiles.value if compiles is not None else 0
+    cache_lat: list = []
+    live_lat: list = []
+    vec_lat: list = []
+    lat_lock = threading.Lock()
+    offsets = []
+    cum_rows = 0
+    for kind, payload in profile:
+        offsets.append(cum_rows / offered_rows_per_s)
+        cum_rows += 1 if kind == 'neighbors_vec' else len(payload)
+    shed_counts = [0] * generators
+    expired_counts = [0] * generators
+    futures_per: list = [[] for _ in range(generators)]
+    t0 = time.perf_counter()
+
+    def generator(g: int) -> None:
+        for i in range(g, len(profile), generators):
+            kind, payload = profile[i]
+            target = t0 + offsets[i]
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            t_submit = time.perf_counter()
+            try:
+                if kind in ('neighbors', 'neighbors_vec'):
+                    future = mesh.submit_neighbors(payload)
+                else:
+                    future = mesh.submit(payload, tier=kind)
+            except EngineOverloaded:
+                shed_counts[g] += 1
+                continue
+            if kind == 'neighbors_vec':
+                # vector queries never ride the device (the index is
+                # host-side here) — timed separately; the semantic
+                # tier's effect shows in semantic_hits + vec p99
+                def vstamp(done, t_submit=t_submit):
+                    if done.exception() is None:
+                        with lat_lock:
+                            vec_lat.append(
+                                time.perf_counter() - t_submit)
+                future.add_done_callback(vstamp)
+            elif future.done() and future.exception() is None:
+                # resolved AT submit: served from the memo tier (a
+                # live request cannot complete before submit returns —
+                # it has a device round-trip ahead of it)
+                with lat_lock:
+                    cache_lat.append(time.perf_counter() - t_submit)
+            else:
+                def stamp(done, t_submit=t_submit):
+                    if done.exception() is None:
+                        with lat_lock:
+                            live_lat.append(
+                                time.perf_counter() - t_submit)
+                future.add_done_callback(stamp)
+            futures_per[g].append(future)
+
+    try:
+        threads = [threading.Thread(target=generator, args=(g,))
+                   for g in range(generators)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        for g in range(generators):
+            for future in futures_per[g]:
+                try:
+                    future.result(timeout=600)
+                except DeadlineExceeded:
+                    expired_counts[g] += 1
+                except EngineOverloaded:
+                    shed_counts[g] += 1
+        wall = time.perf_counter() - t0
+        stats = mesh.stats()
+    finally:
+        mesh.close()
+    postwarm = (compiles.value - warm_compiles
+                if compiles is not None else None)
+    memo_stats = stats['memo']
+    total = len(profile)
+    device_rows = stats['rows_dispatched']
+
+    def p99(lat):
+        arr = np.asarray(sorted(lat)) * 1e3
+        return round(float(np.percentile(arr, 99)), 3) if len(arr) \
+            else None
+
+    return {
+        'cache_served': len(cache_lat),
+        'live_served': len(live_lat),
+        'hit_rate': (round(memo_stats['hit_rate'], 3)
+                     if memo_stats else 0.0),
+        'memo_entries': memo_stats['entries'] if memo_stats else 0,
+        'memo_bytes': memo_stats['bytes'] if memo_stats else 0,
+        'semantic_hits': (memo_stats['semantic_hits']
+                          if memo_stats else 0),
+        'semantic_agreement': (memo_stats['semantic']['agreement']
+                               if memo_stats else None),
+        'cache_p99_ms': p99(cache_lat),
+        'live_p99_ms': p99(live_lat),
+        'vec_served': len(vec_lat),
+        'vec_p99_ms': p99(vec_lat),
+        'shed_rate': round(sum(shed_counts) / total, 3),
+        'expired_rate': round(sum(expired_counts) / total, 3),
+        'device_rows_dispatched': device_rows,
+        'device_rows_per_1k_requests':
+            round(device_rows * 1e3 / total, 1),
+        'device_seconds_per_1k_requests':
+            round(device_rows / max(1e-9, capacity) * 1e3 / total, 4),
+        'postwarm_compiles': postwarm,
+        'wall_s': round(wall, 2),
+    }
+
+
 def measure_capacity(model, index, profile, reps: int = 2) -> float:
     """One replica's sustainable rows/s: open-loop firehose (no arrival
     pacing, no deadline) through a 1-replica mesh — delivered rows over
@@ -249,6 +413,29 @@ def main() -> None:
                         default=2000.0,
                         help='per-request SLO deadline under load '
                              '(drives shed/expiry at saturation)')
+    parser.add_argument('--zipf-alpha', type=float, default=0.0,
+                        help='run the memoization-tier comparison '
+                             'instead of the replica-scaling arms: '
+                             'replay a Zipf(alpha)-weighted template '
+                             'pool through memo off / exact / '
+                             'exact+semantic meshes (SERVING.md '
+                             '"Memoization tier")')
+    parser.add_argument('--memo-templates', type=int, default=None,
+                        help='distinct request templates in the Zipf '
+                             'pool (default 48 smoke / 256)')
+    parser.add_argument('--memo-cache-bytes', type=int,
+                        default=64 << 20,
+                        help='exact-tier cache budget for the memo '
+                             'arms')
+    parser.add_argument('--memo-epsilon', type=float, default=0.05,
+                        help='semantic-tier epsilon for the '
+                             'exact+semantic arm')
+    parser.add_argument('--memo-offered-factor', type=float,
+                        default=0.8,
+                        help='memo arms run below one replica\'s '
+                             'capacity (sustainable regime: p99 '
+                             'comparisons are about the cache, not '
+                             'saturation)')
     parser.add_argument('--max-request-lines', type=int,
                         default=4 if smoke else 8)
     parser.add_argument('--rows', type=int, default=200 if smoke else 2000)
@@ -290,6 +477,39 @@ def main() -> None:
     cal_profile = make_profile(lines, 192 if smoke else 512,
                                args.max_request_lines, seed=11)
     capacity = measure_capacity(model, index, cal_profile)
+
+    if args.zipf_alpha > 0:
+        # ---- memoization-tier comparison (stage mesh_memo) ----
+        n_templates = (args.memo_templates if args.memo_templates
+                       else (48 if smoke else 256))
+        offered = args.memo_offered_factor * capacity
+        emit({'metric': 'mesh_memo_capacity_rows_per_sec_1r',
+              'value': round(capacity, 1)})
+        mean_rows = (1 + args.max_request_lines) / 2
+        n_requests = max(64, int(offered * args.secs / mean_rows))
+        profile = make_zipf_profile(lines, n_requests,
+                                    args.max_request_lines,
+                                    n_templates, args.zipf_alpha,
+                                    vec_dim=config.CODE_VECTOR_SIZE)
+        arms = (('off', 0, 0.0),
+                ('exact', args.memo_cache_bytes, 0.0),
+                ('exact+semantic', args.memo_cache_bytes,
+                 args.memo_epsilon))
+        for name, memo_bytes, epsilon in arms:
+            arm = run_memo_arm(model, index, profile, offered,
+                               args.deadline_ms, compiles, memo_bytes,
+                               epsilon, capacity)
+            arm.update({'metric': 'mesh_memo_arm', 'memo': name,
+                        'zipf_alpha': args.zipf_alpha,
+                        'templates': n_templates,
+                        'requests': len(profile),
+                        'offered_rows_per_sec': round(offered, 1),
+                        'host_cores': os.cpu_count()})
+            emit(arm)
+        emit({'metric': 'mesh_peak_hbm_bytes',
+              **benchlib.device_memory_record()})
+        return
+
     offered = args.offered_factor * capacity
     emit({'metric': 'mesh_capacity_rows_per_sec_1r',
           'value': round(capacity, 1)})

@@ -138,6 +138,12 @@ probe_or_record "after serving" || exit 3
 # the mixed predict + submit_neighbors stream
 run_stage mesh 900 python benchmarks/bench_mesh.py
 probe_or_record "after mesh" || exit 3
+# memoization tier (ISSUE 16): Zipf-replayed duplicate-heavy traffic
+# through memo off / exact / exact+semantic — hit rate, cache-served
+# vs live p99, shed rate, device-seconds-per-1k-requests, and the
+# zero-postwarm-compile check with the cache in front of the fleet
+run_stage mesh_memo 900 python benchmarks/bench_mesh.py --zipf-alpha 1.1
+probe_or_record "after mesh_memo" || exit 3
 # mesh chaos soak (ISSUE 14): paced load + periodic kill_worker/
 # drop_heartbeat faults against socket-mode workers — zero lost
 # admitted requests, zero post-warmup parent compiles, bounded p99

@@ -95,7 +95,16 @@ def main() -> None:
                                'replicas', 'offered_rows_per_sec',
                                'p50_ms', 'p99_ms', 'shed_rate',
                                'per_replica_fill', 'dispatch_share',
-                               'postwarm_compiles', 'host_cores')}
+                               'postwarm_compiles', 'host_cores',
+                               # memoization-tier arms (ISSUE 16):
+                               # cache-served vs live p99 keyed by the
+                               # memo arm + Zipf shape, with the
+                               # device-work-saved column the tier is
+                               # judged on
+                               'memo', 'zipf_alpha', 'hit_rate',
+                               'cache_p99_ms', 'live_p99_ms',
+                               'semantic_hits', 'semantic_agreement',
+                               'device_seconds_per_1k_requests')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

@@ -478,6 +478,20 @@ class Config:
     SERVING_SLO_FAST_WINDOW_SECS: float = 60.0
     SERVING_SLO_SLOW_WINDOW_SECS: float = 600.0
     SERVING_SLO_BURN_THRESHOLD: float = 10.0
+    # ---- memoization tier (serving/memo.py, SERVING.md) ----
+    # Exact-tier result cache budget in bytes (--memo-cache-bytes):
+    # repeated requests (keyed on the canonicalized path-context bag,
+    # per tier and per k) are served at mesh admission — before
+    # tokenize, the front queue, and the device. 0 disables the tier.
+    # Entries are generation-keyed: a fleet rollover invalidates the
+    # whole cache atomically.
+    MEMO_CACHE_BYTES: int = 0
+    # Semantic tier epsilon: serve a single-row neighbors query from
+    # the cached result of a prior query whose code vector is within
+    # this cosine distance. 0 (default) keeps the tier OFF — it trades
+    # exactness for hit rate and must be rolled out gated on the
+    # memo/semantic_agreement metric (SERVING.md "Memoization tier").
+    MEMO_SEMANTIC_EPSILON: float = 0.0
     # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
     # Per-invocation extractor timeout (--extractor-timeout): a wedged
     # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
@@ -784,6 +798,14 @@ class Config:
                                  'bound in rows across all replicas '
                                  '(0 = auto: replicas x 8 x top '
                                  'bucket, -1 = unbounded; SERVING.md)')
+        parser.add_argument('--memo-cache-bytes', dest='memo_cache_bytes',
+                            type=int, default=None, metavar='BYTES',
+                            help='exact-tier memoization cache budget '
+                                 'in bytes — repeated requests are '
+                                 'served before the queue and the '
+                                 'device (MEMO_CACHE_BYTES; 0 '
+                                 'disables; SERVING.md "Memoization '
+                                 'tier")')
         parser.add_argument('--mesh-replica-mode',
                             dest='mesh_replica_mode',
                             choices=['thread', 'process', 'socket'],
@@ -987,6 +1009,8 @@ class Config:
             self.MESH_REPLICAS = parsed.mesh_replicas
         if parsed.mesh_queue_bound is not None:
             self.MESH_QUEUE_BOUND = parsed.mesh_queue_bound
+        if parsed.memo_cache_bytes is not None:
+            self.MEMO_CACHE_BYTES = parsed.memo_cache_bytes
         if parsed.mesh_replica_mode:
             self.MESH_REPLICA_MODE = parsed.mesh_replica_mode
         if parsed.serve_follow_checkpoints is not None:
@@ -1259,6 +1283,12 @@ class Config:
         if self.MESH_QUEUE_BOUND < -1:
             raise ValueError('config.MESH_QUEUE_BOUND must be >= -1 '
                              '(0 = auto, -1 = unbounded).')
+        if self.MEMO_CACHE_BYTES < 0:
+            raise ValueError('config.MEMO_CACHE_BYTES must be >= 0 '
+                             '(0 disables the memoization tier).')
+        if not 0.0 <= self.MEMO_SEMANTIC_EPSILON <= 1.0:
+            raise ValueError('config.MEMO_SEMANTIC_EPSILON must be in '
+                             '[0, 1] (0 keeps the semantic tier off).')
         if self.MESH_MAX_INFLIGHT < 1:
             raise ValueError('config.MESH_MAX_INFLIGHT must be >= 1.')
         if self.MESH_BREAKER_THRESHOLD < 1:

@@ -197,6 +197,40 @@ def parse_c2v_line(line: str, max_contexts: int) -> ParsedRow:
     return ParsedRow(label, source_strs, path_strs, target_strs)
 
 
+def canonicalize_contexts(lines: Iterable[str]) -> List[str]:
+    """Canonical form of raw ``label ctx1 ctx2 …`` predict lines — THE
+    definition of request identity (SERVING.md "Memoization tier").
+    Every prediction surface funnels through it: ``process_input_rows``
+    applies it (so ``model.predict``, ``serving/bulk.py``, and both
+    submit paths tokenize identical canonical input), and
+    ``ServingEngine.submit`` / ``ServingMesh.submit`` call it up front
+    so the memoization key (``serving/memo.py``) and the tokenizer can
+    never disagree on what "the same request" is.
+
+    Per line: surrounding/repeated whitespace is stripped, empty
+    context slots dropped, and the contexts sorted lexicographically —
+    a canonical MULTISET of path-contexts (extraction order carries no
+    meaning, and canonicalizing BEFORE tokenize makes every path reduce
+    the attention sum in the same float order).  Duplicate
+    ``src,path,tgt`` triples are KEPT: a repeated context contributes
+    its attention weight twice in the reference model, so the
+    duplicate count is part of request identity — dedup here would
+    silently change scores vs the evaluate-path reader, which never
+    canonicalizes.  Line order across the request is preserved:
+    results are per-line, positional.
+    Idempotent: ``canonicalize_contexts(canonicalize_contexts(x))``
+    equals ``canonicalize_contexts(x)``.
+    """
+    out = []
+    for line in lines:
+        parts = str(line).split()
+        if not parts:
+            out.append('')
+            continue
+        out.append(' '.join([parts[0]] + sorted(parts[1:])))
+    return out
+
+
 class PathContextReader:
     def __init__(self, vocabs: Code2VecVocabs, config: Config,
                  estimator_action: EstimatorAction,
@@ -510,7 +544,11 @@ class PathContextReader:
 
     def process_input_rows(self, input_lines: Iterable[str]) -> Batch:
         """Tokenize raw extractor output lines for prediction — never
-        filtered (reference path_context_reader.py:96-107)."""
+        filtered (reference path_context_reader.py:96-107).  Lines are
+        canonicalized first (``canonicalize_contexts``), so every
+        predict surface — direct, bulk, engine, mesh — tokenizes the
+        SAME canonical context bag and the memo key (serving/memo.py)
+        addresses exactly what was computed."""
         rows = [parse_c2v_line(line, self.config.MAX_CONTEXTS)
-                for line in input_lines]
+                for line in canonicalize_contexts(input_lines)]
         return self.tokenize_rows(rows)

@@ -81,7 +81,8 @@ import numpy as np
 
 from code2vec_tpu.data import packed as packed_lib
 from code2vec_tpu.data.reader import (Batch, EstimatorAction,
-                                      PathContextReader)
+                                      PathContextReader,
+                                      canonicalize_contexts)
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
@@ -879,7 +880,11 @@ class ServingEngine:
         # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked under _cond before enqueue below
         if self._closed:
             raise EngineClosed('ServingEngine is closed')
-        lines = list(context_lines)
+        # ONE definition of request identity across engine + mesh +
+        # memo key (data/reader.py canonicalize_contexts; idempotent —
+        # process_input_rows applies it too, so the tokenizer and any
+        # caller-side key derivation can never disagree)
+        lines = canonicalize_contexts(context_lines)
         future: Future = Future()
         if not lines:
             future.set_result([])

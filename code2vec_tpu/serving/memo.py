@@ -1,0 +1,431 @@
+"""Request memoization tier: serve repeated code traffic from cache
+before it ever touches the queue or the device (SERVING.md
+"Memoization tier").
+
+At fleet scale code traffic is massively duplicated — the same methods
+and near-clones arrive thousands of times, and every duplicate pays
+full tokenize + queue + device cost.  This module is the cache the
+mesh checks at admission, BEFORE ``FrontQueue.admit``: a hit resolves
+the caller's future immediately, costing zero device-seconds and no
+queue slot (the Ads-serving amortization shape, PAPERS.md).
+
+Two tiers:
+
+- **Exact** (``MEMO_CACHE_BYTES > 0``) — a content-addressed result
+  cache keyed by ``request_key``: an order-independent hash over the
+  canonicalized path-context bag (``data.reader.canonicalize_contexts``
+  sorts/dedups the parsed ``(source, path, target)`` triples per line),
+  scoped per tier and per neighbors ``k``.  Bounded LRU with byte
+  accounting registered in the memory ledger (bucket ``memo``,
+  ``kind='host'`` — host bytes, deliberately outside the device
+  live-array reconciliation).
+- **Semantic** (``MEMO_SEMANTIC_EPSILON > 0``; default OFF) — for
+  vectors/neighbors traffic: a neighbor query whose code vector lies
+  within cosine distance epsilon of a cached query's vector is served
+  that cached result (which came from the attached index's lookup on
+  the cached code vector).  Every N-th would-be hit is shadow-sampled
+  instead: the request runs live and the cached top-1 neighbor is
+  compared against the live top-1, exporting
+  ``memo/semantic_agreement`` — the canary machinery's top-1 agreement
+  metric, reused to measure how aggressive epsilon may be (SERVING.md
+  has the agreement-gated rollout runbook).
+
+Correctness contract:
+
+- **Generation-keyed invalidation.**  Every entry records the cache
+  generation at insert.  A concluded fleet rollover bumps the
+  generation (``ServingMesh.load_params`` → ``bump_generation``) which
+  atomically invalidates every pre-swap entry — one O(1) version bump,
+  not a per-entry eviction walk; a rolled-BACK canary never calls it,
+  so the cache stays warm.  An insert whose request was in flight
+  across the swap carries the OLD generation and is refused.
+- **Delivered-good-only inserts.**  The mesh inserts from a
+  done-callback on the caller-visible future, so only results that
+  were actually delivered (after oversize re-join, after crash-safe
+  redispatch) are cached; errors and cancellations insert nothing.
+- **Degraded tiers cannot poison.**  The insert key uses the EFFECTIVE
+  (possibly ladder-degraded) tier, the lookup key the REQUESTED tier —
+  a degraded 'topk' answer is cached as 'topk', never as 'full'.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry import memory as memory_lib
+from code2vec_tpu.telemetry.core import Counter, Gauge
+
+__all__ = ['MemoCache', 'request_key', 'results_nbytes']
+
+#: ledger entry key for the cache's host bytes (bucket ``memo``)
+LEDGER_KEY = 'serving_memo'
+
+#: nominal per-entry bookkeeping overhead charged on top of the
+#: measured result bytes (key digest + OrderedDict slot + entry object)
+ENTRY_OVERHEAD = 128
+
+
+def request_key(canonical_lines: Sequence[str], tier: str,
+                k: Optional[int] = None) -> bytes:
+    """Content address of one request: a hash over the canonicalized
+    path-context bag, scoped per tier and per neighbors ``k``.
+    ``canonical_lines`` MUST already be canonical
+    (``canonicalize_contexts``): the per-line sort of the parsed
+    ``(source, path, target)`` triples is what makes the hash
+    order-independent over each line's context bag.  Line ORDER across
+    the request stays part of the identity — results are positional."""
+    digest = hashlib.sha256()
+    digest.update(('%s|%s' % (tier, k)).encode('utf-8'))
+    for line in canonical_lines:
+        digest.update(b'\x1e')
+        digest.update(line.encode('utf-8', 'surrogatepass'))
+    return digest.digest()
+
+
+def results_nbytes(obj) -> int:
+    """Approximate host bytes of a cached result tree
+    (``ModelPredictionResults`` / ``NeighborResult`` rows: numpy
+    arrays, strings, dicts, tuples).  Metadata and string lengths only
+    — never copies, never touches a device."""
+    total = 0
+    stack = [obj]
+    while stack:
+        item = stack.pop()
+        if isinstance(item, np.ndarray) or isinstance(item, np.generic):
+            total += int(item.nbytes)
+        elif isinstance(item, (str, bytes)):
+            total += len(item)
+        elif isinstance(item, dict):
+            stack.extend(item.keys())
+            stack.extend(item.values())
+        elif isinstance(item, (list, tuple)):
+            stack.extend(item)
+        elif item is None or isinstance(item, (bool, int, float)):
+            total += 8
+        else:
+            total += 64  # opaque object: nominal charge
+    return total
+
+
+class _Entry:
+    __slots__ = ('results', 'nbytes', 'generation')
+
+    def __init__(self, results, nbytes: int, generation: int):
+        self.results = results
+        self.nbytes = nbytes
+        self.generation = generation
+
+
+class _SemRow:
+    """One cached semantic-tier query: the unit query vector and the
+    single-row neighbor result it produced."""
+
+    __slots__ = ('unit', 'result', 'nbytes', 'generation')
+
+    def __init__(self, unit: np.ndarray, result, nbytes: int,
+                 generation: int):
+        self.unit = unit
+        self.result = result
+        self.nbytes = nbytes
+        self.generation = generation
+
+
+class MemoCache:
+    """The mesh's request memoization cache (exact + semantic tiers).
+
+    Thread contract: ``lookup`` runs on submitter threads, ``insert``
+    on decode-completion callbacks, ``bump_generation`` on the rollover
+    conclude callback, ``stats`` on monitors — one lock guards all
+    cache state (lock-discipline rule, ANALYSIS.md):
+    """
+    # graftlint: guard MemoCache._entries,_bytes,_generation,_params_step,_sem,_sem_bytes,_sem_rows_total,_sem_serves,_sem_samples,_sem_agree by _lock
+
+    def __init__(self, capacity_bytes: int,
+                 semantic_epsilon: float = 0.0,
+                 semantic_max_rows: int = 512,
+                 semantic_shadow_every: int = 8,
+                 params_step: Optional[int] = None,
+                 log=None):
+        if capacity_bytes <= 0:
+            raise ValueError('MemoCache needs capacity_bytes > 0 (got '
+                             '%r); a disabled memo tier is no cache, '
+                             'not an empty one' % capacity_bytes)
+        self.capacity_bytes = int(capacity_bytes)
+        self.semantic_epsilon = float(semantic_epsilon)
+        self.semantic_max_rows = max(1, int(semantic_max_rows))
+        self.semantic_shadow_every = max(2, int(semantic_shadow_every))
+        self.log = log if log is not None else (lambda msg: None)
+        self._lock = threading.Lock()
+        self._entries: 'collections.OrderedDict[bytes, _Entry]' = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._generation = 0
+        self._params_step = params_step
+        # semantic tier: per-k row store (a neighbor result is only
+        # reusable at the same k)
+        self._sem: Dict[int, collections.deque] = {}
+        self._sem_bytes = 0
+        self._sem_rows_total = 0
+        self._sem_serves = 0   # candidate hits, for shadow sampling
+        self._sem_samples = 0  # shadow comparisons run
+        self._sem_agree = 0    # ... that agreed on top-1
+        # instruments (catalog family memo/*, OBSERVABILITY.md)
+        self.hits_total = Counter('memo/hits_total')
+        self.misses_total = Counter('memo/misses_total')
+        self.inserts_total = Counter('memo/inserts_total')
+        self.evictions_total = Counter('memo/evictions_total')
+        self.semantic_hits_total = Counter('memo/semantic_hits_total')
+        self.bytes_gauge = Gauge('memo/bytes')
+        self.entries_gauge = Gauge('memo/entries')
+        self.agreement_gauge = Gauge('memo/semantic_agreement')
+        # host-bucket ledger sibling: memo bytes are HOST memory, so
+        # kind='host' keeps them out of the device live-array
+        # reconciliation while still visible in the taxonomy
+        memory_lib.ledger().register('memo', LEDGER_KEY, 0, kind='host')
+
+    # ------------------------------------------------------- exact tier
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def lookup(self, key: bytes):
+        """The cached result list for ``key``, or None.  A hit touches
+        LRU recency; entries from a previous generation never serve
+        (defensive — ``bump_generation`` already cleared them)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.generation != self._generation:
+                self._entries.pop(key, None)
+                self._bytes -= entry.nbytes
+                entry = None
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is None:
+            self.misses_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter('memo/misses_total').inc()
+            return None
+        self.hits_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter('memo/hits_total').inc()
+        return entry.results
+
+    def insert(self, key: bytes, results, generation: int) -> bool:
+        """Insert a delivered-good result under the generation captured
+        at SUBMIT time — a result in flight across a rollover carries
+        the old generation and is refused (stale results can never
+        enter the post-swap cache).  Evicts LRU entries to fit; a
+        result larger than the whole budget is skipped."""
+        nbytes = results_nbytes(results) + len(key) + ENTRY_OVERHEAD
+        if nbytes > self.capacity_bytes:
+            return False
+        evicted = 0
+        with self._lock:
+            if generation != self._generation:
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._bytes + nbytes > self.capacity_bytes \
+                    and self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                evicted += 1
+            self._entries[key] = _Entry(results, nbytes, generation)
+            self._bytes += nbytes
+            total = self._bytes + self._sem_bytes
+            entries = len(self._entries)
+        self.inserts_total.inc()
+        if evicted:
+            self.evictions_total.inc(evicted)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.counter('memo/inserts_total').inc()
+            if evicted:
+                reg.counter('memo/evictions_total').inc(evicted)
+        self._export(total, entries)
+        return True
+
+    # ---------------------------------------------------- semantic tier
+    def semantic_lookup(self, vector, k: int
+                        ) -> Optional[Tuple[object, bool]]:
+        """Nearest cached query within cosine distance epsilon at this
+        ``k``: returns ``(cached_row_result, shadow)`` or None.
+        ``shadow=True`` marks a sampled agreement check — the caller
+        must run the request LIVE and feed both results to
+        ``note_semantic_agreement`` instead of serving the cache."""
+        if self.semantic_epsilon <= 0:
+            return None
+        unit = np.asarray(vector, np.float32).reshape(-1)
+        norm = float(np.linalg.norm(unit))
+        if not np.isfinite(norm) or norm == 0.0:
+            return None
+        unit = unit / norm
+        with self._lock:
+            rows = self._sem.get(int(k))
+            if not rows:
+                return None
+            stacked = np.stack([row.unit for row in rows])
+            sims = stacked @ unit
+            best = int(np.argmax(sims))
+            if 1.0 - float(sims[best]) > self.semantic_epsilon:
+                return None
+            result = rows[best].result
+            self._sem_serves += 1
+            shadow = (self._sem_serves % self.semantic_shadow_every) == 0
+        if not shadow:
+            self.semantic_hits_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'memo/semantic_hits_total').inc()
+        return result, shadow
+
+    def semantic_insert(self, vectors, results, k: int,
+                        generation: int) -> int:
+        """Remember each query row's code vector + its neighbor result
+        for within-epsilon reuse.  FIFO-bounded at
+        ``semantic_max_rows`` across all ``k``.  No-op while the
+        semantic tier is OFF (epsilon == 0) — a disabled tier stores
+        nothing and costs nothing."""
+        if self.semantic_epsilon <= 0:
+            return 0
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        inserted = 0
+        with self._lock:
+            if generation != self._generation:
+                return 0
+            rows = self._sem.setdefault(
+                int(k), collections.deque())
+            for vec, result in zip(vectors, results):
+                norm = float(np.linalg.norm(vec))
+                if not np.isfinite(norm) or norm == 0.0:
+                    continue
+                nbytes = (results_nbytes(result) + int(vec.nbytes)
+                          + ENTRY_OVERHEAD)
+                rows.append(_SemRow(vec / norm, result, nbytes,
+                                    generation))
+                self._sem_bytes += nbytes
+                self._sem_rows_total += 1
+                inserted += 1
+                while self._sem_rows_total > self.semantic_max_rows:
+                    self._evict_sem_row_locked()
+            total = self._bytes + self._sem_bytes
+            entries = len(self._entries)
+        if inserted:
+            self._export(total, entries)
+        return inserted
+
+    def _evict_sem_row_locked(self) -> None:
+        """Drop the oldest semantic row across every k (FIFO)."""
+        for k, rows in self._sem.items():
+            if rows:
+                victim = rows.popleft()
+                self._sem_bytes -= victim.nbytes
+                self._sem_rows_total -= 1
+                if not rows:
+                    del self._sem[k]
+                return
+
+    @staticmethod
+    def _top1(row) -> Optional[object]:
+        labels = getattr(row, 'labels', None)
+        if labels:
+            return labels[0]
+        indices = getattr(row, 'indices', None)
+        if indices is not None and len(indices):
+            return int(indices[0])
+        return None
+
+    def note_semantic_agreement(self, cached_row, live_row) -> None:
+        """One shadow sample concluded: compare the cached top-1
+        neighbor against the live top-1 (the canary machinery's
+        agreement statistic) and export the running agreement rate —
+        the epsilon-aggressiveness dial (SERVING.md runbook)."""
+        cached_top = self._top1(cached_row)
+        live_top = self._top1(live_row)
+        agree = cached_top is not None and cached_top == live_top
+        with self._lock:
+            self._sem_samples += 1
+            self._sem_agree += 1 if agree else 0
+            rate = self._sem_agree / self._sem_samples
+        self.agreement_gauge.set(rate)
+        if tele_core.enabled():
+            tele_core.registry().gauge(
+                'memo/semantic_agreement').set(rate)
+
+    # ------------------------------------------------------ invalidation
+    def bump_generation(self, params_step: Optional[int] = None) -> int:
+        """A fleet rollover SWAPPED: one atomic version bump invalidates
+        every pre-swap entry (exact and semantic) — not a per-entry
+        eviction walk, and not counted as evictions.  A rolled-back
+        canary never calls this, so the cache stays warm.  Returns the
+        new generation."""
+        with self._lock:
+            self._generation += 1
+            self._params_step = (params_step if params_step is not None
+                                 else self._params_step)
+            dropped = len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self._sem.clear()
+            self._sem_bytes = 0
+            self._sem_rows_total = 0
+            generation = self._generation
+        self._export(0, 0)
+        self.log('memo: generation -> %d (params step %s); %d cached '
+                 'entr%s invalidated atomically'
+                 % (generation, params_step, dropped,
+                    'y' if dropped == 1 else 'ies'))
+        return generation
+
+    # --------------------------------------------------------- plumbing
+    def _export(self, total_bytes: int, entries: int) -> None:
+        self.bytes_gauge.set(total_bytes)
+        self.entries_gauge.set(entries)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.gauge('memo/bytes').set(total_bytes)
+            reg.gauge('memo/entries').set(entries)
+        # re-register replaces the previous ledger entry: replacing IS
+        # the release of the previous size (telemetry/memory.py)
+        memory_lib.ledger().register('memo', LEDGER_KEY, total_bytes,
+                                     kind='host')
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            out = {
+                'entries': len(self._entries),
+                'bytes': self._bytes + self._sem_bytes,
+                'capacity_bytes': self.capacity_bytes,
+                'generation': self._generation,
+                'params_step': self._params_step,
+                'semantic': {
+                    'epsilon': self.semantic_epsilon,
+                    'rows': self._sem_rows_total,
+                    'serves': self._sem_serves,
+                    'samples': self._sem_samples,
+                    'agreement': (self._sem_agree / self._sem_samples
+                                  if self._sem_samples else None),
+                },
+            }
+        hits = self.hits_total.snapshot()
+        misses = self.misses_total.snapshot()
+        out.update({
+            'hits': hits,
+            'misses': misses,
+            'hit_rate': hits / (hits + misses) if hits + misses else 0.0,
+            'inserts': self.inserts_total.snapshot(),
+            'evictions': self.evictions_total.snapshot(),
+            'semantic_hits': self.semantic_hits_total.snapshot(),
+        })
+        return out
+
+    def close(self) -> None:
+        """Release the ledger entry (idempotent)."""
+        memory_lib.ledger().release('memo', LEDGER_KEY)

@@ -109,10 +109,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from code2vec_tpu.data.reader import EstimatorAction, PathContextReader
+from code2vec_tpu.data.reader import (EstimatorAction,
+                                      PathContextReader,
+                                      canonicalize_contexts)
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving import engine as engine_lib
+from code2vec_tpu.serving import memo as memo_lib
 from code2vec_tpu.serving import slo as slo_lib
 from code2vec_tpu.serving import transport as transport_lib
 from code2vec_tpu.serving.engine import (ServingEngine, _Request,
@@ -903,6 +906,8 @@ class ServingMesh:
                  canary_batches: Optional[int] = None,
                  canary_agreement: Optional[float] = None,
                  params_step: Optional[int] = None,
+                 memo_cache_bytes: Optional[int] = None,
+                 memo_semantic_epsilon: Optional[float] = None,
                  heartbeat_secs: Optional[float] = None,
                  heartbeat_misses: Optional[int] = None,
                  restart_limit: Optional[int] = None,
@@ -1073,6 +1078,19 @@ class ServingMesh:
         self._index = None
         self._aux_pool = ThreadPoolExecutor(max_workers=2,
                                             thread_name_prefix='mesh-aux')
+        # memoization tier (serving/memo.py, SERVING.md "Memoization
+        # tier"): checked at submit BEFORE tokenize/admit; built once
+        # here and never reassigned, so reads need no lock
+        memo_bytes = int(memo_cache_bytes if memo_cache_bytes is not None
+                         else config.MEMO_CACHE_BYTES)
+        epsilon = float(memo_semantic_epsilon
+                        if memo_semantic_epsilon is not None
+                        else config.MEMO_SEMANTIC_EPSILON)
+        self._memo: Optional[memo_lib.MemoCache] = (
+            memo_lib.MemoCache(memo_bytes, semantic_epsilon=epsilon,
+                               params_step=self._params_step,
+                               log=self.log)
+            if memo_bytes > 0 else None)
         # ---- replica table ----
         self._replicas: List[_ReplicaSlot] = []
         try:
@@ -1775,7 +1793,11 @@ class ServingMesh:
         # graftlint: disable=lock-discipline -- benign racy fast-fail: a close() racing past this read is re-checked inside FrontQueue.enqueue
         if self._closed:
             raise EngineClosed('ServingMesh is closed')
-        lines = list(context_lines)
+        t_submit0 = time.perf_counter()
+        # ONE definition of request identity across engine + mesh +
+        # memo key (data/reader.py canonicalize_contexts; idempotent —
+        # process_input_rows applies it again at tokenize)
+        lines = canonicalize_contexts(context_lines)
         future: Future = Future()
         if not lines:
             future.set_result([])
@@ -1796,6 +1818,34 @@ class ServingMesh:
                        'deadline_ms': (1e3 * deadline_s
                                        if deadline_s else None)})
         requested_tier = tier
+        # memoization tier: content-addressed exact lookup BEFORE
+        # tokenize and FrontQueue.admit — a hit resolves the future
+        # right here, costing zero device-seconds and no queue slot
+        memo = self._memo
+        memo_key = None
+        if memo is not None:
+            memo_key = memo_lib.request_key(lines, tier)
+            # the exact tier STANDS DOWN while a canary is in flight:
+            # duplicate-heavy traffic served from cache would starve
+            # the canary's shadow scorer of batches and the rollover
+            # would never conclude — during a canary every request
+            # runs live (inserts still happen; the generation check
+            # keeps any result in flight across the swap out)
+            rolling = self._rollover is not None  # graftlint: disable=lock-discipline -- benign racy read: a stale None serves one more hit, a stale rollover runs one more request live
+            cached = None if rolling else memo.lookup(memo_key)
+            if cached is not None:
+                if trace is not None:
+                    trace.event('serving.memo_hit',
+                                attrs={'tier': tier, 'rows': n,
+                                       'memo': 'exact'})
+                    trace.finish(status='ok')
+                if self._slo is not None:
+                    self._slo.observe_good(
+                        time.perf_counter() - t_submit0)
+                # shallow list copy: callers may mutate the list they
+                # get back; the result rows themselves are shared
+                future.set_result(list(cached))
+                return future
         t_admit0 = time.perf_counter()
         try:
             tier = self._queue.admit(n, tier, deadline_s)
@@ -1862,6 +1912,24 @@ class ServingMesh:
                     slo.observe_bad(type(exc).__name__)
 
             future.add_done_callback(_slo_observe)
+        if memo is not None:
+            # insert-on-delivery: only a good caller-visible result is
+            # cached (fires after oversize chunk re-join); key on the
+            # EFFECTIVE tier so a degraded-tier answer can never poison
+            # the full-tier key the next caller will look up
+            insert_key = (memo_key if tier == requested_tier
+                          else memo_lib.request_key(lines, tier))
+            generation = memo.generation
+
+            def _memo_insert(done: Future) -> None:
+                try:
+                    exc = done.exception()
+                except BaseException:
+                    return  # caller cancelled: nothing was delivered
+                if exc is None:
+                    memo.insert(insert_key, done.result(), generation)
+
+            future.add_done_callback(_memo_insert)
         return future
 
     def predict(self, context_lines: Sequence[str], tier: str = 'topk',
@@ -1893,20 +1961,73 @@ class ServingMesh:
         k = k if k is not None else self.config.INDEX_NEIGHBORS_K
         from code2vec_tpu.index.service import neighbors_from_search
         outer: Future = Future()
+        memo = self._memo
         if isinstance(context_or_vectors, np.ndarray):
             vectors = np.atleast_2d(context_or_vectors)
+            shadow_row = None
+            if memo is not None and vectors.shape[0] == 1:
+                # semantic tier: serve a within-epsilon single-row query
+                # from a near-identical prior request's cached result
+                sem = memo.semantic_lookup(vectors[0], k)
+                if sem is not None:
+                    sem_row, shadow = sem
+                    if not shadow:
+                        if self._tracer is not None:
+                            trace = self._tracer.begin(
+                                'serving.request',
+                                attrs={'tier': 'neighbors', 'rows': 1,
+                                       'mesh': True})
+                            trace.event('serving.memo_hit',
+                                        attrs={'tier': 'neighbors',
+                                               'rows': 1,
+                                               'memo': 'semantic'})
+                            trace.finish(status='ok')
+                        outer.set_result([sem_row])
+                        return outer
+                    # shadow sample: run live anyway, then score the
+                    # cached row's top-1 agreement against the live one
+                    shadow_row = sem_row
+            sem_gen = memo.generation if memo is not None else None
 
             def lookup():
                 try:
                     values, indices = index.search(vectors, k)
-                    _resolve(outer, neighbors_from_search(
-                        values, indices, index.labels))
+                    results = neighbors_from_search(
+                        values, indices, index.labels)
+                    if memo is not None:
+                        if shadow_row is not None and results:
+                            memo.note_semantic_agreement(
+                                shadow_row, results[0])
+                        memo.semantic_insert(vectors, results, k, sem_gen)
+                    _resolve(outer, results)
                 except BaseException as exc:
                     if not outer.done():
                         outer.set_exception(exc)
             self._aux_pool.submit(lookup)
             return outer
-        inner = self.submit(context_or_vectors, tier='vectors')
+        lines = canonicalize_contexts(context_or_vectors)
+        nkey = None
+        gen = None
+        if memo is not None:
+            # exact tier for line-based neighbor queries: keyed per k so
+            # a k=5 answer can never serve a k=10 ask
+            nkey = memo_lib.request_key(lines, 'neighbors', k=k)
+            cached = memo.lookup(nkey)
+            if cached is not None:
+                if self._tracer is not None:
+                    trace = self._tracer.begin(
+                        'serving.request',
+                        attrs={'tier': 'neighbors', 'rows': len(lines),
+                               'mesh': True})
+                    trace.event('serving.memo_hit',
+                                attrs={'tier': 'neighbors',
+                                       'rows': len(lines),
+                                       'memo': 'exact'})
+                    trace.finish(status='ok')
+                outer.set_result(list(cached))
+                return outer
+            gen = memo.generation
+        inner = self.submit(lines, tier='vectors')
 
         def chain(done: Future) -> None:
             try:
@@ -1916,8 +2037,12 @@ class ServingMesh:
                     return
                 vectors = np.stack([r.code_vector for r in results])
                 values, indices = index.search(vectors, k)
-                _resolve(outer, neighbors_from_search(
-                    values, indices, index.labels))
+                out_results = neighbors_from_search(
+                    values, indices, index.labels)
+                if memo is not None:
+                    memo.insert(nkey, out_results, gen)
+                    memo.semantic_insert(vectors, out_results, k, gen)
+                _resolve(outer, out_results)
             except BaseException as exc:
                 if not outer.done():
                     outer.set_exception(exc)
@@ -2022,6 +2147,14 @@ class ServingMesh:
                     self._params_step = (resolved_step
                                          if resolved_step is not None
                                          else self._params_step)
+                if self._memo is not None:
+                    # UNCONDITIONAL on swap, not keyed to step: a
+                    # pytree-source swap has resolved_step=None and
+                    # must still invalidate every memoized result
+                    # atomically (generation bump, not per-entry
+                    # eviction); a rolled-back canary never reaches
+                    # here, so the cache stays warm on rollback
+                    self._memo.bump_generation(resolved_step)
                 self.rollover_total.inc()
                 if tele_core.enabled():
                     tele_core.registry().counter(
@@ -2210,6 +2343,8 @@ class ServingMesh:
                 self.worker_snapshots_total.snapshot(),
             'slo': (self._slo.stats()
                     if self._slo is not None else None),
+            'memo': (self._memo.stats()
+                     if self._memo is not None else None),
             'tracing': (self._tracer.stats()
                         if self._tracer is not None else None),
         }
@@ -2285,6 +2420,8 @@ class ServingMesh:
         if self._listener is not None:
             self._listener.close()
         self._aux_pool.shutdown(wait=True)
+        if self._memo is not None:
+            self._memo.close()
         if self._tracer is not None and self._owns_tracer:
             self._tracer.close()
 

@@ -220,6 +220,35 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                       'telemetry/ledger snapshots '
                                       'merged replica-labeled into the '
                                       'fleet registry off heartbeats.'),
+    # ---- memoization tier (code2vec_tpu/serving/memo.py, SERVING.md) ----
+    'memo/hits_total': _m(COUNTER, 'requests', 'Requests served from '
+                          'the exact memo tier at mesh admission (zero '
+                          'device-seconds, no queue slot).'),
+    'memo/misses_total': _m(COUNTER, 'requests', 'Memo lookups that '
+                            'missed and went to the live serving '
+                            'path.'),
+    'memo/inserts_total': _m(COUNTER, 'results', 'Delivered-good '
+                             'results inserted into the exact memo '
+                             'tier.'),
+    'memo/evictions_total': _m(COUNTER, 'entries', 'LRU entries evicted '
+                               'under the MEMO_CACHE_BYTES budget '
+                               '(generation bumps invalidate without '
+                               'counting here).'),
+    'memo/bytes': _m(GAUGE, 'bytes', 'Host bytes held by cached memo '
+                     'results (exact + semantic tiers; mirrors the '
+                     'ledger memo bucket).'),
+    'memo/entries': _m(GAUGE, 'entries', 'Entries resident in the exact '
+                       'memo tier.'),
+    'memo/semantic_hits_total': _m(COUNTER, 'requests', 'Neighbor '
+                                   'queries served by the semantic '
+                                   'tier from a within-epsilon cached '
+                                   'query.'),
+    'memo/semantic_agreement': _m(GAUGE, 'fraction', 'Running top-1 '
+                                  'agreement of shadow-sampled '
+                                  'semantic hits vs their live '
+                                  'results — the epsilon-'
+                                  'aggressiveness dial (SERVING.md '
+                                  'rollout runbook).'),
     # ---- embedding index (code2vec_tpu/index/, INDEX.md) ----
     'index/build_s': _m(GAUGE, 's', 'Wall time of the last store / IVF '
                         'build.'),
@@ -314,6 +343,10 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                 'the warm serving compilation ladder '
                                 '(code + temp, AOT memory_analysis; '
                                 'excluded from array reconciliation).'),
+    'mem/memo_bytes': _m(GAUGE, 'bytes', 'Host bytes held by the '
+                         'serving memoization tier (bucket memo, '
+                         'kind=host; excluded from array '
+                         'reconciliation — nothing on a device).'),
     'mem/attributed_bytes': _m(GAUGE, 'bytes', 'Sum of all array-kind '
                                'ledger entries (the reconciliation '
                                'numerator).'),

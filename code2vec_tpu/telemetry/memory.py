@@ -51,6 +51,13 @@ Bucket taxonomy (OBSERVABILITY.md "Device memory ledger"):
                      reported, but excluded from the array
                      reconciliation (an executable is not a
                      ``jax.Array``).
+- ``memo``         — the serving memoization tier's cached results
+                     (``serving/memo.py``) — HOST bytes, the one
+                     host-resident bucket in the taxonomy.
+                     kind='host': reported so the cache budget is
+                     visible next to the device residents it spares,
+                     but excluded from the live-array reconciliation
+                     (nothing here lives on a device).
 
 Everything live on the backend but in no bucket is the residual
 "unattributed" — reconciliation keeps it honest: nothing hides.
@@ -73,7 +80,8 @@ OOM_DUMP_NAME = 'oom_ledger.json'
 
 #: the ledger's bucket taxonomy — registration validates against it so
 #: a typo'd bucket cannot silently fork the accounting
-BUCKETS = ('params', 'opt_state', 'staging', 'index', 'executables')
+BUCKETS = ('params', 'opt_state', 'staging', 'index', 'executables',
+           'memo')
 
 #: bucket -> catalog gauge mirrored into the telemetry registry
 #: (names cataloged in telemetry/catalog.py; OBSERVABILITY.md)
@@ -83,6 +91,7 @@ _BUCKET_GAUGE = {
     'staging': 'mem/staging_bytes',
     'index': 'mem/index_bytes',
     'executables': 'mem/executables_bytes',
+    'memo': 'mem/memo_bytes',
 }
 
 _EVENT_RING = 128

@@ -112,6 +112,12 @@ SPAN_CATALOG: Dict[str, str] = {
                       'the parent trace by adopt_spans (attrs: replica, '
                       'pid).  A redispatched request shows one per '
                       'incarnation that did device work.',
+    'serving.memo_hit': 'Terminal: the request was served from the '
+                        'memoization tier at mesh admission — zero '
+                        'device-seconds, no queue slot (attrs: tier, '
+                        'rows, memo=exact|semantic); '
+                        'latency_report.py --fleet attributes the '
+                        'saved work off these.',
     'extractor.call': 'One ExtractorPool call (attrs: attempt count, '
                       'breaker state, outcome).',
 }

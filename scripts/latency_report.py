@@ -277,13 +277,23 @@ def fleet_decomposition(traces: Dict[str, dict]
     nests inside it, and the residual between end-to-end and
     (parent phases + queue + remote) is true WIRE time — the
     cross-process transport cost no single-process span could show.
-    Thread-mode traces land with wire 0 (there is no wire)."""
+    Thread-mode traces land with wire 0 (there is no wire).
+
+    Requests served from the memoization tier (a ``serving.memo_hit``
+    marker span, SERVING.md "Memoization tier") are split out under
+    replica ``memo``: their end-to-end IS the whole story — zero
+    queue, zero wire, zero device — so the fleet table attributes the
+    saved device work to the cache instead of diluting a replica's
+    column with sub-ms rows."""
     out: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
     for entry in traces.values():
         root = entry['root']
         if root is None or root.get('status') not in (None, 'ok'):
             continue
         tier, _bucket, replica = trace_key(entry)
+        if any(rec['name'] == 'serving.memo_hit'
+               for rec in entry['spans']):
+            replica = 'memo'
         total = float(root.get('dur_ms', 0.0))
         queue = _union_ms(entry['spans'], 'serving.queue_wait')
         device = _union_ms(entry['spans'], 'serving.device_execute')
@@ -326,6 +336,10 @@ def unstitched_traces(traces: Dict[str, dict]) -> List[str]:
         if root.get('name') != 'serving.request':
             continue  # engine-level singles (canary shadows) have no
             #           device leg by design
+        if any(rec['name'] == 'serving.memo_hit'
+               for rec in entry['spans']):
+            continue  # served from the memoization tier: ZERO device
+            #           work is the point, not a truncated wire
         if not any(rec['name'] == 'serving.device_execute'
                    for rec in entry['spans']):
             out.append(trace_id)

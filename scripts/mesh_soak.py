@@ -21,7 +21,14 @@ The assertions are the self-healing contract:
 - **zero unstitched trace trees** — at ``TRACING_SAMPLE_RATE=1.0``,
   every delivered request's span tree must carry its worker-side
   device-execute spans (cross-process stitching, OBSERVABILITY.md
-  "Fleet observability"); a wire-truncated tree fails the soak.
+  "Fleet observability"); a wire-truncated tree fails the soak
+  (memo-hit traces are exempt by design — they never reach a worker);
+- **zero stale memo serves** — the soak runs with the memoization tier
+  ON (``--memo-bytes``) and half the load replaying one hot request;
+  mid-soak fleet rollover drills (``--rollovers``) swap params to a
+  freshly saved step and assert the swap atomically invalidated the
+  cache: zero entries survive, the first post-swap duplicate runs
+  LIVE, and the generation advanced per completed rollover.
 
 Prints one JSON line per metric (``mesh_soak_*``); exit 1 on any
 violation.  ``BENCH_SMOKE=1`` shrinks shapes and duration for the
@@ -30,7 +37,8 @@ tier-1 smoke (tests/test_bench_smoke.py); the slow-marked full run and
 
 Usage: python scripts/mesh_soak.py [--secs S] [--replicas N]
        [--mode process|socket] [--kill-every K] [--drop-beat-at B]
-       [--interval-ms MS] [--p99-bound-ms MS]
+       [--interval-ms MS] [--p99-bound-ms MS] [--memo-bytes B]
+       [--rollovers R]
 """
 from __future__ import annotations
 
@@ -75,6 +83,16 @@ def main() -> int:
     parser.add_argument('--p99-bound-ms', type=float, default=30000.0,
                         help='bounded-p99 assertion over delivered '
                              'requests (restart latency included)')
+    parser.add_argument('--memo-bytes', type=int, default=32 << 20,
+                        help='memoization-tier budget for the soak '
+                             '(default ON: the chaos drills must hold '
+                             'with the cache in front of the fleet; '
+                             '0 disables)')
+    parser.add_argument('--rollovers', type=int, default=2,
+                        help='mid-soak fleet rollover drills: each '
+                             'must atomically invalidate the memo '
+                             'cache (generation bump) with zero stale '
+                             'serves after the swap')
     parser.add_argument('--rows', type=int, default=200 if smoke else 1000)
     parser.add_argument('--contexts', type=int, default=6 if smoke else 50)
     parser.add_argument('--tokens', type=int, default=500 if smoke else 5000)
@@ -125,20 +143,91 @@ def main() -> int:
         print(json.dumps(record), flush=True)
 
     mesh = model.serving_mesh(replicas=args.replicas, tiers=('topk',),
-                              mode=args.mode, max_delay_ms=1.0)
+                              mode=args.mode, max_delay_ms=1.0,
+                              memo_cache_bytes=args.memo_bytes)
+    memo_on = args.memo_bytes > 0
     violations = []
+    rollovers_done = 0
+    drill_retries = 0
     try:
+        import jax.numpy as jnp
+
         # warm the whole serving path once, then pin the compile mark
         mesh.predict([lines[0]], tier='topk', timeout=300)
         warm = compiles.value
         rng = np.random.default_rng(11)
+        # the memo tier's traffic shape: half the load replays one hot
+        # request, so cache hits ride THROUGH the kill/restart chaos
+        hot = [lines[0], lines[1]]
+
+        def rollover_drill(i: int):
+            """Save the current params at a fresh step, roll the fleet
+            to it (restore-and-swap, no canary), then probe the memo
+            stale-serving contract: the swap must atomically invalidate
+            (generation bump) and the first post-swap duplicate must
+            run LIVE.  Returns (ok, error)."""
+            step = 100 + rollovers_done
+            model.save(state=model.state._replace(
+                step=jnp.asarray(step, jnp.int32)), epoch=0, wait=True)
+            probe = [lines[0]]
+            try:
+                mesh.predict(probe, tier='topk', timeout=180)
+                report = mesh.load_params(
+                    step, canary_batches=0).result(timeout=180)
+            except Exception as exc:  # a worker died mid-drill: retry
+                return False, repr(exc)
+            if not report.get('swapped'):
+                return False, 'rollover did not swap: %r' % (report,)
+            if memo_on:
+                memo_stats = mesh.stats()['memo']
+                if memo_stats['entries'] != 0 or memo_stats['bytes']:
+                    violations.append(
+                        'rollover %d left %d memo entries (%d bytes) '
+                        'live after the swap'
+                        % (i, memo_stats['entries'],
+                           memo_stats['bytes']))
+                post = mesh.submit(probe, tier='topk')
+                if post.done():
+                    violations.append(
+                        'STALE: memo served a pre-rollover result '
+                        'after swap %d' % i)
+                try:
+                    post.result(timeout=180)
+                except ServingError:
+                    pass  # typed shed under chaos: the stale check above
+                          # already ran; nothing stale was delivered
+            return True, None
+
         futures = []
         stamps = []
         t0 = time.perf_counter()
         deadline = t0 + args.secs
+        roll_idx = 0
+        roll_times = [t0 + args.secs * (i + 1) / (args.rollovers + 1)
+                      for i in range(args.rollovers)]
         while time.perf_counter() < deadline:
-            request_lines = [lines[rng.integers(len(lines))]
-                             for _ in range(int(rng.integers(1, 4)))]
+            if roll_idx < len(roll_times) and \
+                    time.perf_counter() >= roll_times[roll_idx]:
+                ok_drill, err = rollover_drill(roll_idx)
+                if ok_drill:
+                    rollovers_done += 1
+                    roll_idx += 1
+                else:
+                    drill_retries += 1
+                    print('rollover drill %d retry %d: %s'
+                          % (roll_idx, drill_retries, err),
+                          file=sys.stderr)
+                    roll_times[roll_idx] = time.perf_counter() + 1.0
+                    if drill_retries > 5 * max(1, args.rollovers):
+                        violations.append(
+                            'rollover drill %d kept failing: %s'
+                            % (roll_idx, err))
+                        roll_idx += 1
+            if memo_on and rng.random() < 0.5:
+                request_lines = hot
+            else:
+                request_lines = [lines[rng.integers(len(lines))]
+                                 for _ in range(int(rng.integers(1, 4)))]
             try:
                 futures.append(mesh.submit(request_lines, tier='topk'))
                 stamps.append(time.perf_counter())
@@ -248,6 +337,35 @@ def main() -> int:
           'replica_breaker_open_total':
               stats['replica_breaker_open_total']})
     emit({'metric': 'mesh_soak_postwarm_compiles', 'value': postwarm})
+    if memo_on:
+        # memoization-tier soak contract (SERVING.md "Memoization
+        # tier"): the cache must actually serve under the duplicate-
+        # heavy traffic, and every completed rollover must have
+        # invalidated it (generation bump) — zero stale serves is
+        # asserted inline by each drill's post-swap probe above.
+        memo_stats = stats['memo']
+        if memo_stats['hits'] == 0:
+            violations.append('memo tier never served a hit under the '
+                              'duplicate-heavy soak traffic')
+        if args.rollovers > 0 and rollovers_done == 0:
+            violations.append('no rollover drill ever completed '
+                              '(%d retries)' % drill_retries)
+        # >= not ==: a drill whose handle died AFTER the swap landed
+        # still bumped the generation server-side; under-counting
+        # rollovers must not read as a missed invalidation
+        if memo_stats['generation'] < rollovers_done:
+            violations.append(
+                'memo generation %d < %d completed rollovers — a swap '
+                'concluded without invalidating the cache'
+                % (memo_stats['generation'], rollovers_done))
+        emit({'metric': 'mesh_soak_memo', 'value': memo_stats['hits'],
+              'hit_rate': round(memo_stats['hit_rate'], 3),
+              'entries': memo_stats['entries'],
+              'bytes': memo_stats['bytes'],
+              'evictions': memo_stats['evictions'],
+              'generation': memo_stats['generation'],
+              'rollovers': rollovers_done,
+              'drill_retries': drill_retries})
     if violations:
         emit({'metric': 'mesh_soak_violations', 'value': len(violations),
               'detail': violations})

@@ -236,6 +236,14 @@ def test_mesh_soak_smoke_self_heals_without_losing_requests():
     p99 = by_metric['mesh_soak_p99_ms']
     assert p99['value'] is not None and p99['value'] <= p99['bound_ms']
     assert by_metric['mesh_soak_postwarm_compiles']['value'] == 0
+    # ISSUE 16: the soak runs with the memo tier ON and mid-soak
+    # rollover drills — the cache must serve under chaos, every
+    # completed rollover must have bumped the generation, and zero
+    # stale serves (asserted inline by the soak: rc 0 covers it)
+    memo = by_metric['mesh_soak_memo']
+    assert memo['value'] > 0 and memo['hit_rate'] > 0, memo
+    assert memo['rollovers'] >= 1, memo
+    assert memo['generation'] >= memo['rollovers'], memo
 
 
 @pytest.mark.slow

@@ -1,0 +1,415 @@
+"""Memoization-tier drills (serving/memo.py + the mesh admission wiring,
+ISSUE 16): one shared request-identity definition (canonicalize_contexts)
+across engine/mesh/memo key, exact-tier hits resolved AT SUBMIT with
+memo-vs-live bit identity (including the oversize split/re-join path and
+permuted context order), degraded-tier answers that cannot poison the
+full-tier key, the rollover-invalidation drill (fleet swap -> every
+pre-swap entry misses via ONE generation bump, not per-entry eviction;
+a rolled-back canary leaves the cache warm), the epsilon-gated semantic
+tier with its shadow-sampled top-1 agreement export, and LRU/ledger
+byte accounting."""
+import collections
+
+import numpy as np
+import pytest
+
+from code2vec_tpu.config import Config
+from code2vec_tpu.data.reader import canonicalize_contexts
+from code2vec_tpu.serving import memo as memo_lib
+from code2vec_tpu.telemetry import memory as memory_lib
+from tests.test_train_overfit import make_dataset
+
+PREDICT_LINES = [
+    'get|a toka0,pA,toka1 toka1,pB,toka2',
+    'set|b tokb0,pA,tokb1',
+    'run|c tokc0,pC,tokc1 tokc2,pA,tokc0 tokc1,pB,tokc2',
+]
+
+# same requests, context multisets permuted within each line (plus
+# stray whitespace): identical canonical form, so identical memo keys
+PERMUTED_LINES = [
+    'get|a toka1,pB,toka2 toka0,pA,toka1',
+    'set|b  tokb0,pA,tokb1',
+    'run|c tokc1,pB,tokc2 tokc0,pC,tokc1 tokc2,pA,tokc0',
+]
+
+
+@pytest.fixture(scope='module')
+def model(tmp_path_factory):
+    from code2vec_tpu.model_api import Code2VecModel
+    prefix = make_dataset(tmp_path_factory.mktemp('serving_memo'))
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=str(prefix), DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=6, TRAIN_BATCH_SIZE=16,
+        TEST_BATCH_SIZE=16, NUM_TRAIN_EPOCHS=1, SHUFFLE_BUFFER_SIZE=64,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,16')
+    return Code2VecModel(config)
+
+
+def _assert_rows_identical(a_rows, b_rows):
+    """Bit identity between two result lists (the memo acceptance bar:
+    a cache-served answer is indistinguishable from the live one)."""
+    assert len(a_rows) == len(b_rows)
+    for a, b in zip(a_rows, b_rows):
+        assert a.original_name == b.original_name
+        assert a.topk_predicted_words == b.topk_predicted_words
+        if a.topk_predicted_words_scores is None:
+            assert b.topk_predicted_words_scores is None
+        else:
+            np.testing.assert_array_equal(a.topk_predicted_words_scores,
+                                          b.topk_predicted_words_scores)
+        assert a.attention_per_context == b.attention_per_context
+        if a.code_vector is None:
+            assert b.code_vector is None
+        else:
+            np.testing.assert_array_equal(a.code_vector, b.code_vector)
+
+
+# --------------------------------------------------- canonical identity
+def test_canonicalize_contexts_semantics():
+    # sort each line's context multiset, label kept first; duplicates
+    # are KEPT — a repeated context weights attention twice, so the
+    # count is part of request identity
+    assert canonicalize_contexts(['lab c,p,d a,p,b a,p,b']) == \
+        ['lab a,p,b a,p,b c,p,d']
+    # whitespace runs collapse; blank lines survive positionally
+    assert canonicalize_contexts(['  lab   x,y,z  ', '', 'l2 a,b,c']) == \
+        ['lab x,y,z', '', 'l2 a,b,c']
+    # idempotent: canonical input is a fixed point
+    lines = canonicalize_contexts(PERMUTED_LINES)
+    assert canonicalize_contexts(lines) == lines
+    # line ORDER is preserved — results are positional
+    swapped = canonicalize_contexts([PREDICT_LINES[1], PREDICT_LINES[0]])
+    assert swapped[0].startswith('set|b')
+
+
+def test_request_key_scopes_tier_and_k_and_line_order():
+    canon = canonicalize_contexts(PREDICT_LINES)
+    permuted = canonicalize_contexts(PERMUTED_LINES)
+    assert memo_lib.request_key(canon, 'topk') == \
+        memo_lib.request_key(permuted, 'topk')
+    assert memo_lib.request_key(canon, 'topk') != \
+        memo_lib.request_key(canon, 'full')
+    assert memo_lib.request_key(canon, 'neighbors', k=5) != \
+        memo_lib.request_key(canon, 'neighbors', k=10)
+    reordered = [canon[1], canon[0], canon[2]]
+    assert memo_lib.request_key(canon, 'topk') != \
+        memo_lib.request_key(reordered, 'topk')
+
+
+# ------------------------------------------------------ MemoCache units
+def test_memo_cache_lru_eviction_and_ledger_bytes():
+    cache = memo_lib.MemoCache(4096)
+    try:
+        keys = [memo_lib.request_key(['l%d a,b,c' % i], 'topk')
+                for i in range(8)]
+        row = [{'scores': np.zeros(128, np.float64)}]  # ~1k + overhead
+        for key in keys:
+            assert cache.insert(key, row, cache.generation)
+        stats = cache.stats()
+        assert stats['evictions'] > 0
+        assert stats['bytes'] <= cache.capacity_bytes
+        # the LRU survivor set is the most-recent suffix
+        assert cache.lookup(keys[0]) is None
+        assert cache.lookup(keys[-1]) is not None
+        # ledger: memo bucket carries the cache's host bytes
+        assert memory_lib.ledger().bucket_bytes('memo') == stats['bytes']
+        # a result larger than the whole budget is skipped
+        huge = [{'scores': np.zeros(4096, np.float64)}]
+        assert not cache.insert(keys[0], huge, cache.generation)
+        # an insert carrying a stale generation is refused (a request
+        # in flight across a rollover can never poison the new cache)
+        old_gen = cache.generation
+        cache.bump_generation(3)
+        assert not cache.insert(keys[0], row, old_gen)
+        assert cache.lookup(keys[-1]) is None  # swap invalidated all
+        assert cache.stats()['params_step'] == 3
+    finally:
+        cache.close()
+    assert memory_lib.ledger().bucket_bytes('memo') == 0
+
+
+def test_memo_cache_generation_bump_is_not_eviction():
+    cache = memo_lib.MemoCache(1 << 20)
+    try:
+        key = memo_lib.request_key(['l a,b,c'], 'topk')
+        cache.insert(key, [{'s': np.zeros(8)}], cache.generation)
+        before = cache.stats()
+        assert before['entries'] == 1
+        cache.bump_generation()
+        after = cache.stats()
+        assert after['generation'] == before['generation'] + 1
+        assert after['entries'] == 0 and after['bytes'] == 0
+        # the drill's distinguishing assertion: atomic version bump,
+        # NOT a per-entry eviction walk
+        assert after['evictions'] == before['evictions'] == 0
+        assert cache.lookup(key) is None
+    finally:
+        cache.close()
+
+
+def test_memo_semantic_shadow_sampling_and_agreement():
+    from code2vec_tpu.index.service import neighbors_from_search
+    cache = memo_lib.MemoCache(1 << 20, semantic_epsilon=0.05,
+                               semantic_shadow_every=2)
+    try:
+        vec = np.array([1.0, 0.0, 0.0, 0.0], np.float32)
+        rows = neighbors_from_search(np.array([[0.9, 0.5]]),
+                                     np.array([[2, 0]]),
+                                     ['a', 'b', 'c'])
+        assert cache.semantic_insert(vec[None, :], rows, 10,
+                                     cache.generation) == 1
+        near = vec * 1.001 + np.array([0.0, 1e-3, 0.0, 0.0], np.float32)
+        hit = cache.semantic_lookup(near, 10)
+        assert hit is not None and hit[1] is False  # served
+        hit2 = cache.semantic_lookup(near, 10)
+        assert hit2 is not None and hit2[1] is True  # shadow sample
+        # beyond epsilon, or a different k: no candidate
+        far = np.array([0.0, 1.0, 0.0, 0.0], np.float32)
+        assert cache.semantic_lookup(far, 10) is None
+        assert cache.semantic_lookup(near, 5) is None
+        # shadow agreement export: 1 agree + 1 disagree -> rate 0.5
+        cache.note_semantic_agreement(rows[0], rows[0])
+        other = neighbors_from_search(np.array([[0.8, 0.1]]),
+                                      np.array([[1, 0]]), ['a', 'b', 'c'])
+        cache.note_semantic_agreement(rows[0], other[0])
+        stats = cache.stats()['semantic']
+        assert stats['samples'] == 2
+        assert stats['agreement'] == pytest.approx(0.5)
+        assert cache.agreement_gauge.snapshot() == pytest.approx(0.5)
+    finally:
+        cache.close()
+
+
+def test_memo_semantic_off_by_default_stores_nothing():
+    cache = memo_lib.MemoCache(1 << 20)  # epsilon 0 = tier OFF
+    try:
+        vec = np.ones((1, 4), np.float32)
+        assert cache.semantic_insert(vec, [object()], 10,
+                                     cache.generation) == 0
+        assert cache.semantic_lookup(vec[0], 10) is None
+        assert cache.stats()['semantic']['rows'] == 0
+    finally:
+        cache.close()
+
+
+# ------------------------------------------------- mesh admission wiring
+def test_mesh_exact_hit_at_submit_bit_identical_to_live(model):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'attention'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        live = mesh.predict(PREDICT_LINES, tier='attention', timeout=60)
+        # the duplicate — context order permuted — is served AT SUBMIT:
+        # the future comes back already resolved, before tokenize,
+        # before the queue, before the device
+        handle = mesh.submit(PERMUTED_LINES, tier='attention')
+        assert handle.done()
+        cached = handle.result()
+        _assert_rows_identical(cached, live)
+        # ... and bit-identical to an independent live compute
+        _assert_rows_identical(cached, model.predict(PREDICT_LINES))
+        stats = mesh.stats()['memo']
+        assert stats['hits'] == 1 and stats['entries'] >= 1
+        assert stats['bytes'] > 0
+    finally:
+        mesh.close()
+
+
+def test_mesh_memo_off_by_default(model):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                              max_delay_ms=0.0)
+    try:
+        assert mesh.stats()['memo'] is None
+        mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        handle = mesh.submit(PREDICT_LINES, tier='topk')
+        assert not handle.done() or handle.result()  # went live
+        handle.result(timeout=60)
+    finally:
+        mesh.close()
+
+
+def test_mesh_oversize_split_rejoin_memo_bit_identity(model):
+    """A request wider than the top batch bucket (16) is split into
+    chunks and re-joined; the memo insert fires on the CALLER-VISIBLE
+    future after the join, so the cached answer covers all rows in
+    order."""
+    lines = [PREDICT_LINES[i % 3] for i in range(20)]
+    permuted = [PERMUTED_LINES[i % 3] for i in range(20)]
+    mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        live = mesh.predict(lines, tier='topk', timeout=120)
+        assert len(live) == 20
+        handle = mesh.submit(permuted, tier='topk')
+        assert handle.done()
+        _assert_rows_identical(handle.result(), live)
+        # independent live compute (model.predict serves the full tier:
+        # compare the fields the topk tier produces)
+        for cached, ref in zip(handle.result(), model.predict(lines)):
+            assert cached.topk_predicted_words == ref.topk_predicted_words
+            np.testing.assert_array_equal(
+                cached.topk_predicted_words_scores,
+                ref.topk_predicted_words_scores)
+    finally:
+        mesh.close()
+
+
+def test_mesh_degraded_tier_cannot_poison_full_key(model, monkeypatch):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'full'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        orig_admit = mesh._queue.admit
+
+        def degrading_admit(n, tier, deadline_s):
+            return orig_admit(n, 'topk' if tier == 'full' else tier,
+                              deadline_s)
+
+        monkeypatch.setattr(mesh._queue, 'admit', degrading_admit)
+        degraded = mesh.predict(PREDICT_LINES, tier='full', timeout=60)
+        assert all(not r.attention_per_context for r in degraded)
+        monkeypatch.undo()
+        # the degraded answer was keyed under its EFFECTIVE tier: the
+        # full-tier ask misses and computes live, with attention
+        handle = mesh.submit(PREDICT_LINES, tier='full')
+        assert not handle.done()
+        full = handle.result(timeout=60)
+        assert all(r.attention_per_context for r in full)
+        # ... while a topk ask is a legitimate hit on the degraded row
+        topk_handle = mesh.submit(PREDICT_LINES, tier='topk')
+        assert topk_handle.done()
+        _assert_rows_identical(topk_handle.result(), degraded)
+    finally:
+        mesh.close()
+
+
+# ------------------------------------------------ rollover invalidation
+def test_rollover_invalidation_drill(model):
+    """Fleet swap -> every pre-swap memo entry is a MISS via one atomic
+    generation bump (evictions stay 0); a rolled-BACK canary leaves the
+    cache warm."""
+    import jax
+    mesh = model.serving_mesh(replicas=2, tiers=('topk',),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        same = jax.tree_util.tree_map(lambda leaf: leaf, model.params)
+        broken = jax.tree_util.tree_map(lambda leaf: -leaf, model.params)
+        jax.block_until_ready(broken)
+        mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        warm_hit = mesh.submit(PREDICT_LINES, tier='topk')
+        assert warm_hit.done()
+        gen_before = mesh.stats()['memo']['generation']
+
+        # ---- canaried fleet swap: the CONCLUDE callback must bump
+        handle = mesh.load_params(same, canary_batches=2,
+                                  min_agreement=0.9)
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        assert handle.result(timeout=60)['swapped'] is True
+        stats = mesh.stats()['memo']
+        assert stats['generation'] == gen_before + 1
+        assert stats['entries'] == 0 and stats['bytes'] == 0
+        assert stats['evictions'] == 0  # version bump, not eviction
+        stale = mesh.submit(PREDICT_LINES, tier='topk')
+        assert not stale.done()  # pre-swap entry can never serve
+        stale.result(timeout=60)
+
+        # ---- rolled-back canary: cache stays WARM
+        rewarmed = mesh.submit(PREDICT_LINES, tier='topk')
+        assert rewarmed.done()  # the post-swap compute re-cached it
+        handle = mesh.load_params(broken, canary_batches=2,
+                                  min_agreement=0.9)
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.predict([PREDICT_LINES[0]], tier='topk', timeout=60)
+        assert handle.result(timeout=60)['swapped'] is False
+        stats = mesh.stats()['memo']
+        assert stats['generation'] == gen_before + 1  # unchanged
+        still_warm = mesh.submit(PREDICT_LINES, tier='topk')
+        assert still_warm.done()
+    finally:
+        mesh.close()
+
+
+# ------------------------------------------------------- semantic tier
+class _FakeIndex:
+    """Deterministic stand-in for index/service.py's loaded index."""
+
+    def __init__(self, dim, n=8, seed=0):
+        rng = np.random.default_rng(seed)
+        store = rng.normal(size=(n, dim)).astype(np.float32)
+        self._store = store / np.linalg.norm(store, axis=1,
+                                             keepdims=True)
+        self.labels = ['lab%d' % i for i in range(n)]
+
+    def search(self, vectors, k):
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        sims = vectors @ self._store.T
+        idx = np.argsort(-sims, axis=1)[:, :k]
+        return np.take_along_axis(sims, idx, axis=1), idx
+
+
+def test_mesh_neighbors_exact_and_semantic_tiers(model):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20,
+                              memo_semantic_epsilon=0.05)
+    try:
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        mesh.attach_index(_FakeIndex(dim=vec.shape[0]))
+        # line-path exact tier: keyed per k
+        first = mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        again = mesh.submit_neighbors(list(PERMUTED_LINES), k=4)
+        assert again.done()
+        assert [r.labels for r in again.result()] == \
+            [r.labels for r in first]
+        # keyed per k: the k=6 ask is NOT served from the k=4 entry
+        # (it may still complete synchronously — its inner vectors-tier
+        # submit is itself a legitimate memo hit)
+        hits_before = mesh.stats()['memo']['hits']
+        other_k = mesh.submit_neighbors(PREDICT_LINES, k=6).result(60)
+        assert len(other_k[0].labels) == 6
+        assert mesh.stats()['memo']['hits'] == hits_before + 1  # vectors
+        # ndarray-path semantic tier: a near-identical single-row query
+        # is served from the cached neighbor result; every 8th
+        # candidate hit shadow-samples top-1 agreement instead
+        live = mesh.submit_neighbors(vec, k=4).result(60)
+        serves = 0
+        for i in range(10):
+            near = vec * np.float32(1.0 + 1e-5 * (i + 1))
+            out = mesh.submit_neighbors(near, k=4).result(60)
+            assert out[0].labels == live[0].labels
+        stats = mesh.stats()['memo']
+        assert stats['semantic']['serves'] >= 8
+        assert stats['semantic']['samples'] >= 1  # shadow ran live
+        assert stats['semantic']['agreement'] == pytest.approx(1.0)
+        assert stats['semantic_hits'] >= 1
+    finally:
+        mesh.close()
+
+
+def test_mesh_semantic_tier_defaults_off(model):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        mesh.attach_index(_FakeIndex(dim=vec.shape[0]))
+        mesh.submit_neighbors(vec, k=4).result(60)
+        mesh.submit_neighbors(vec * np.float32(1.00001),
+                              k=4).result(60)
+        stats = mesh.stats()['memo']
+        assert stats['semantic']['epsilon'] == 0.0
+        assert stats['semantic']['rows'] == 0
+        assert stats['semantic']['serves'] == 0
+    finally:
+        mesh.close()
