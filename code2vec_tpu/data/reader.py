@@ -197,7 +197,8 @@ def parse_c2v_line(line: str, max_contexts: int) -> ParsedRow:
     return ParsedRow(label, source_strs, path_strs, target_strs)
 
 
-def canonicalize_contexts(lines: Iterable[str]) -> List[str]:
+def canonicalize_contexts(lines: Iterable[str],
+                          max_contexts: Optional[int] = None) -> List[str]:
     """Canonical form of raw ``label ctx1 ctx2 …`` predict lines — THE
     definition of request identity (SERVING.md "Memoization tier").
     Every prediction surface funnels through it: ``process_input_rows``
@@ -207,27 +208,41 @@ def canonicalize_contexts(lines: Iterable[str]) -> List[str]:
     so the memoization key (``serving/memo.py``) and the tokenizer can
     never disagree on what "the same request" is.
 
-    Per line: surrounding/repeated whitespace is stripped, empty
-    context slots dropped, and the contexts sorted lexicographically —
-    a canonical MULTISET of path-contexts (extraction order carries no
-    meaning, and canonicalizing BEFORE tokenize makes every path reduce
-    the attention sum in the same float order).  Duplicate
-    ``src,path,tgt`` triples are KEPT: a repeated context contributes
-    its attention weight twice in the reference model, so the
-    duplicate count is part of request identity — dedup here would
-    silently change scores vs the evaluate-path reader, which never
-    canonicalizes.  Line order across the request is preserved:
-    results are per-line, positional.
-    Idempotent: ``canonicalize_contexts(canonicalize_contexts(x))``
-    equals ``canonicalize_contexts(x)``.
+    Tokenize-faithful by construction: each line is split exactly as
+    ``parse_c2v_line`` splits it (single-space separators — an empty
+    slot from a doubled space still OCCUPIES a context slot), then
+    truncated to ``max_contexts`` in ORIGINAL extraction order, and
+    only then are the surviving empty slots dropped and the survivors
+    sorted lexicographically — a canonical MULTISET of the exact
+    path-contexts the tokenizer would keep.  Truncating before the
+    sort is load-bearing: sorting first would let a different context
+    subset survive ``MAX_CONTEXTS`` than the evaluate-path reader
+    (which never canonicalizes) keeps, silently changing predictions.
+    For the same reason every serving entry point passes its
+    ``config.MAX_CONTEXTS`` here — the FIRST canonicalization must be
+    the one that truncates.  Dropping empty slots after truncation is
+    tokenize-invariant (they map to PAD and are masked), and sorting
+    makes every path reduce the attention sum in the same float
+    order.  Duplicate ``src,path,tgt`` triples are KEPT: a repeated
+    context contributes its attention weight twice in the reference
+    model, so the duplicate count is part of request identity.  Line
+    order across the request is preserved: results are per-line,
+    positional.
+    Idempotent at fixed ``max_contexts``:
+    ``canonicalize_contexts(canonicalize_contexts(x, m), m)`` equals
+    ``canonicalize_contexts(x, m)`` (a canonical line has no empty
+    slots and at most ``m`` contexts, so the re-truncation is a
+    no-op).
     """
     out = []
     for line in lines:
-        parts = str(line).split()
-        if not parts:
-            out.append('')
-            continue
-        out.append(' '.join([parts[0]] + sorted(parts[1:])))
+        parts = str(line).rstrip('\r\n').split(' ')  # parse_c2v_line split
+        contexts = parts[1:]
+        if max_contexts is not None:
+            # extraction-order truncation, empty slots counted — the
+            # slots parse_c2v_line would fill (and mask) for this line
+            contexts = contexts[:max_contexts]
+        out.append(' '.join([parts[0]] + sorted(c for c in contexts if c)))
     return out
 
 
@@ -550,5 +565,6 @@ class PathContextReader:
         SAME canonical context bag and the memo key (serving/memo.py)
         addresses exactly what was computed."""
         rows = [parse_c2v_line(line, self.config.MAX_CONTEXTS)
-                for line in canonicalize_contexts(input_lines)]
+                for line in canonicalize_contexts(
+                    input_lines, self.config.MAX_CONTEXTS)]
         return self.tokenize_rows(rows)

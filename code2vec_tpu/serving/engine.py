@@ -881,10 +881,13 @@ class ServingEngine:
         if self._closed:
             raise EngineClosed('ServingEngine is closed')
         # ONE definition of request identity across engine + mesh +
-        # memo key (data/reader.py canonicalize_contexts; idempotent —
-        # process_input_rows applies it too, so the tokenizer and any
-        # caller-side key derivation can never disagree)
-        lines = canonicalize_contexts(context_lines)
+        # memo key (data/reader.py canonicalize_contexts; idempotent at
+        # fixed MAX_CONTEXTS — process_input_rows applies it too, so the
+        # tokenizer and any caller-side key derivation can never
+        # disagree).  MAX_CONTEXTS must reach the FIRST call: it
+        # truncates in extraction order before the canonical sort.
+        lines = canonicalize_contexts(context_lines,
+                                      self.config.MAX_CONTEXTS)
         future: Future = Future()
         if not lines:
             future.set_result([])

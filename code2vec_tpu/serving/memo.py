@@ -14,9 +14,10 @@ Two tiers:
 - **Exact** (``MEMO_CACHE_BYTES > 0``) — a content-addressed result
   cache keyed by ``request_key``: an order-independent hash over the
   canonicalized path-context bag (``data.reader.canonicalize_contexts``
-  sorts/dedups the parsed ``(source, path, target)`` triples per line),
-  scoped per tier and per neighbors ``k``.  Bounded LRU with byte
-  accounting registered in the memory ledger (bucket ``memo``,
+  truncates each line to ``MAX_CONTEXTS`` in extraction order, then
+  sorts the surviving ``(source, path, target)`` triples — duplicates
+  kept), scoped per tier and per neighbors ``k``.  Bounded LRU with
+  byte accounting registered in the memory ledger (bucket ``memo``,
   ``kind='host'`` — host bytes, deliberately outside the device
   live-array reconciliation).
 - **Semantic** (``MEMO_SEMANTIC_EPSILON > 0``; default OFF) — for
@@ -46,6 +47,11 @@ Correctness contract:
 - **Degraded tiers cannot poison.**  The insert key uses the EFFECTIVE
   (possibly ladder-degraded) tier, the lookup key the REQUESTED tier —
   a degraded 'topk' answer is cached as 'topk', never as 'full'.
+- **Caller mutation cannot poison.**  ``insert``/``semantic_insert``
+  store a private snapshot (``copy_results``) — the first caller keeps
+  the original and may mutate it freely — and every hit is served a
+  fresh copy, so no two requesters ever share a row or a numpy array
+  with each other or with the cache.
 """
 from __future__ import annotations
 
@@ -60,7 +66,7 @@ from code2vec_tpu.telemetry import core as tele_core
 from code2vec_tpu.telemetry import memory as memory_lib
 from code2vec_tpu.telemetry.core import Counter, Gauge
 
-__all__ = ['MemoCache', 'request_key', 'results_nbytes']
+__all__ = ['MemoCache', 'copy_results', 'request_key', 'results_nbytes']
 
 #: ledger entry key for the cache's host bytes (bucket ``memo``)
 LEDGER_KEY = 'serving_memo'
@@ -110,6 +116,28 @@ def results_nbytes(obj) -> int:
         else:
             total += 64  # opaque object: nominal charge
     return total
+
+
+def copy_results(obj):
+    """Deep-ish copy of a result tree: numpy arrays are copied,
+    containers (lists, dicts, tuples — NamedTuple rows like
+    ``ModelPredictionResults``/``NeighborResult`` included) are
+    rebuilt; immutable leaves (str/bytes/numbers/None) are shared.
+    The cache stores a snapshot at insert and serves a fresh copy per
+    hit, so a caller mutating what it was handed can never poison what
+    every subsequent requester of the same key receives."""
+    if isinstance(obj, np.ndarray):
+        return obj.copy()
+    if isinstance(obj, list):
+        return [copy_results(item) for item in obj]
+    if isinstance(obj, tuple):
+        copied = [copy_results(item) for item in obj]
+        if hasattr(obj, '_fields'):  # NamedTuple: rebuild as its type
+            return type(obj)(*copied)
+        return tuple(copied)
+    if isinstance(obj, dict):
+        return {key: copy_results(value) for key, value in obj.items()}
+    return obj
 
 
 class _Entry:
@@ -195,17 +223,26 @@ class MemoCache:
             return self._generation
 
     def lookup(self, key: bytes):
-        """The cached result list for ``key``, or None.  A hit touches
-        LRU recency; entries from a previous generation never serve
-        (defensive — ``bump_generation`` already cleared them)."""
+        """A fresh copy of the cached result list for ``key``
+        (``copy_results`` — hits never share rows or arrays), or None.
+        A hit touches LRU recency; entries from a previous generation
+        never serve (defensive — ``bump_generation`` already cleared
+        them; an eviction here re-exports the gauges and the ledger so
+        they cannot sit stale until the next insert)."""
+        stale_total = None
+        stale_entries = 0
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None and entry.generation != self._generation:
                 self._entries.pop(key, None)
                 self._bytes -= entry.nbytes
                 entry = None
+                stale_total = self._bytes + self._sem_bytes
+                stale_entries = len(self._entries)
             if entry is not None:
                 self._entries.move_to_end(key)
+        if stale_total is not None:
+            self._export(stale_total, stale_entries)
         if entry is None:
             self.misses_total.inc()
             if tele_core.enabled():
@@ -214,17 +251,22 @@ class MemoCache:
         self.hits_total.inc()
         if tele_core.enabled():
             tele_core.registry().counter('memo/hits_total').inc()
-        return entry.results
+        # outside the lock: the snapshot stored at insert is never
+        # mutated, so the reference read above stays safe to copy
+        return copy_results(entry.results)
 
     def insert(self, key: bytes, results, generation: int) -> bool:
         """Insert a delivered-good result under the generation captured
         at SUBMIT time — a result in flight across a rollover carries
         the old generation and is refused (stale results can never
-        enter the post-swap cache).  Evicts LRU entries to fit; a
-        result larger than the whole budget is skipped."""
+        enter the post-swap cache).  Stores a private snapshot
+        (``copy_results``) — the delivering caller keeps the original.
+        Evicts LRU entries to fit; a result larger than the whole
+        budget is skipped."""
         nbytes = results_nbytes(results) + len(key) + ENTRY_OVERHEAD
         if nbytes > self.capacity_bytes:
             return False
+        results = copy_results(results)
         evicted = 0
         with self._lock:
             if generation != self._generation:
@@ -259,7 +301,9 @@ class MemoCache:
         ``k``: returns ``(cached_row_result, shadow)`` or None.
         ``shadow=True`` marks a sampled agreement check — the caller
         must run the request LIVE and feed both results to
-        ``note_semantic_agreement`` instead of serving the cache."""
+        ``note_semantic_agreement`` instead of serving the cache.  A
+        served row is a fresh copy (``copy_results``); a shadow row is
+        the cached reference, read only for the top-1 comparison."""
         if self.semantic_epsilon <= 0:
             return None
         unit = np.asarray(vector, np.float32).reshape(-1)
@@ -284,6 +328,7 @@ class MemoCache:
             if tele_core.enabled():
                 tele_core.registry().counter(
                     'memo/semantic_hits_total').inc()
+            result = copy_results(result)
         return result, shadow
 
     def semantic_insert(self, vectors, results, k: int,
@@ -308,8 +353,10 @@ class MemoCache:
                     continue
                 nbytes = (results_nbytes(result) + int(vec.nbytes)
                           + ENTRY_OVERHEAD)
-                rows.append(_SemRow(vec / norm, result, nbytes,
-                                    generation))
+                # private snapshot: the delivering caller keeps the
+                # original row (same isolation contract as insert())
+                rows.append(_SemRow(vec / norm, copy_results(result),
+                                    nbytes, generation))
                 self._sem_bytes += nbytes
                 self._sem_rows_total += 1
                 inserted += 1

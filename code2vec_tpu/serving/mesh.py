@@ -1795,9 +1795,12 @@ class ServingMesh:
             raise EngineClosed('ServingMesh is closed')
         t_submit0 = time.perf_counter()
         # ONE definition of request identity across engine + mesh +
-        # memo key (data/reader.py canonicalize_contexts; idempotent —
-        # process_input_rows applies it again at tokenize)
-        lines = canonicalize_contexts(context_lines)
+        # memo key (data/reader.py canonicalize_contexts; idempotent at
+        # fixed MAX_CONTEXTS — process_input_rows applies it again at
+        # tokenize).  MAX_CONTEXTS must reach the FIRST call: it
+        # truncates in extraction order before the canonical sort.
+        lines = canonicalize_contexts(context_lines,
+                                      self.config.MAX_CONTEXTS)
         future: Future = Future()
         if not lines:
             future.set_result([])
@@ -1842,9 +1845,9 @@ class ServingMesh:
                 if self._slo is not None:
                     self._slo.observe_good(
                         time.perf_counter() - t_submit0)
-                # shallow list copy: callers may mutate the list they
-                # get back; the result rows themselves are shared
-                future.set_result(list(cached))
+                # lookup returned a fresh copy (memo_lib.copy_results):
+                # mutating it cannot poison later hits on this key
+                future.set_result(cached)
                 return future
         t_admit0 = time.perf_counter()
         try:
@@ -1960,12 +1963,20 @@ class ServingMesh:
                                'attach_index(load_index(...)) first')
         k = k if k is not None else self.config.INDEX_NEIGHBORS_K
         from code2vec_tpu.index.service import neighbors_from_search
+        t_submit0 = time.perf_counter()
         outer: Future = Future()
         memo = self._memo
+        # BOTH memo tiers stand down while a canary rollover is in
+        # flight, exactly as submit() does: duplicate-heavy neighbors
+        # traffic served from cache would starve the canary's shadow
+        # scorer of batches and the rollover would never conclude
+        # (inserts still happen; the generation check keeps any result
+        # in flight across the swap out)
+        rolling = self._rollover is not None  # graftlint: disable=lock-discipline -- benign racy read: a stale None serves one more hit, a stale rollover runs one more request live
         if isinstance(context_or_vectors, np.ndarray):
             vectors = np.atleast_2d(context_or_vectors)
             shadow_row = None
-            if memo is not None and vectors.shape[0] == 1:
+            if memo is not None and not rolling and vectors.shape[0] == 1:
                 # semantic tier: serve a within-epsilon single-row query
                 # from a near-identical prior request's cached result
                 sem = memo.semantic_lookup(vectors[0], k)
@@ -1982,6 +1993,11 @@ class ServingMesh:
                                                'rows': 1,
                                                'memo': 'semantic'})
                             trace.finish(status='ok')
+                        # cache-served requests stay in the SLO
+                        # good-rate denominator, as in submit()
+                        if self._slo is not None:
+                            self._slo.observe_good(
+                                time.perf_counter() - t_submit0)
                         outer.set_result([sem_row])
                         return outer
                     # shadow sample: run live anyway, then score the
@@ -2005,14 +2021,16 @@ class ServingMesh:
                         outer.set_exception(exc)
             self._aux_pool.submit(lookup)
             return outer
-        lines = canonicalize_contexts(context_or_vectors)
+        lines = canonicalize_contexts(context_or_vectors,
+                                      self.config.MAX_CONTEXTS)
         nkey = None
         gen = None
         if memo is not None:
             # exact tier for line-based neighbor queries: keyed per k so
-            # a k=5 answer can never serve a k=10 ask
+            # a k=5 answer can never serve a k=10 ask; stands down
+            # during a canary like every other memo serve path
             nkey = memo_lib.request_key(lines, 'neighbors', k=k)
-            cached = memo.lookup(nkey)
+            cached = None if rolling else memo.lookup(nkey)
             if cached is not None:
                 if self._tracer is not None:
                     trace = self._tracer.begin(
@@ -2024,7 +2042,12 @@ class ServingMesh:
                                        'rows': len(lines),
                                        'memo': 'exact'})
                     trace.finish(status='ok')
-                outer.set_result(list(cached))
+                # cache-served requests stay in the SLO good-rate
+                # denominator, as in submit()
+                if self._slo is not None:
+                    self._slo.observe_good(
+                        time.perf_counter() - t_submit0)
+                outer.set_result(cached)
                 return outer
             gen = memo.generation
         inner = self.submit(lines, tier='vectors')

@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 
 from code2vec_tpu.config import Config
-from code2vec_tpu.data.reader import canonicalize_contexts
+from code2vec_tpu.data.reader import canonicalize_contexts, parse_c2v_line
 from code2vec_tpu.serving import memo as memo_lib
 from code2vec_tpu.telemetry import memory as memory_lib
 from tests.test_train_overfit import make_dataset
@@ -73,8 +73,10 @@ def test_canonicalize_contexts_semantics():
     # count is part of request identity
     assert canonicalize_contexts(['lab c,p,d a,p,b a,p,b']) == \
         ['lab a,p,b a,p,b c,p,d']
-    # whitespace runs collapse; blank lines survive positionally
-    assert canonicalize_contexts(['  lab   x,y,z  ', '', 'l2 a,b,c']) == \
+    # split matches parse_c2v_line (single-space separators): empty
+    # slots from doubled spaces are dropped; blank lines survive
+    # positionally
+    assert canonicalize_contexts(['lab  x,y,z ', '', 'l2 a,b,c']) == \
         ['lab x,y,z', '', 'l2 a,b,c']
     # idempotent: canonical input is a fixed point
     lines = canonicalize_contexts(PERMUTED_LINES)
@@ -82,6 +84,38 @@ def test_canonicalize_contexts_semantics():
     # line ORDER is preserved — results are positional
     swapped = canonicalize_contexts([PREDICT_LINES[1], PREDICT_LINES[0]])
     assert swapped[0].startswith('set|b')
+
+
+def test_canonicalize_truncates_in_extraction_order():
+    """REVIEW fix: truncation to MAX_CONTEXTS happens in ORIGINAL
+    extraction order, BEFORE the canonical sort — the context subset
+    that survives is exactly the subset the evaluate-path reader
+    (parse_c2v_line, which never canonicalizes) keeps."""
+    line = 'lab c,p,3 a,p,1 b,p,2'
+    # sort-first would keep {a,b}; extraction-order keeps {c,a}
+    assert canonicalize_contexts([line], 2) == ['lab a,p,1 c,p,3']
+    # an empty slot from a doubled space occupies a context slot in
+    # parse_c2v_line, so it must occupy one during truncation here too
+    gapped = 'lab a,p,1  b,p,2'
+    assert canonicalize_contexts([gapped], 2) == ['lab a,p,1']
+    # idempotent at fixed max_contexts
+    once = canonicalize_contexts([line, gapped], 2)
+    assert canonicalize_contexts(once, 2) == once
+    # the canonical line tokenizes to the same label + valid-context
+    # multiset as the raw line, at every truncation width
+    wide = 'l ' + ' '.join('t%d,p,%d' % (i, i) for i in range(10))
+    for raw in (line, gapped, wide):
+        for m in (1, 2, 4, 8):
+            canon = canonicalize_contexts([raw], m)[0]
+            raw_row = parse_c2v_line(raw, m)
+            canon_row = parse_c2v_line(canon, m)
+            assert canon_row.label_str == raw_row.label_str
+
+            def valid_ctxs(row):
+                return sorted(t for t in zip(row.source_strs,
+                                             row.path_strs,
+                                             row.target_strs) if any(t))
+            assert valid_ctxs(canon_row) == valid_ctxs(raw_row)
 
 
 def test_request_key_scopes_tier_and_k_and_line_order():
@@ -145,6 +179,81 @@ def test_memo_cache_generation_bump_is_not_eviction():
         # NOT a per-entry eviction walk
         assert after['evictions'] == before['evictions'] == 0
         assert cache.lookup(key) is None
+    finally:
+        cache.close()
+
+
+def test_memo_stale_generation_eviction_reexports_gauges():
+    """The defensive stale-generation eviction in lookup must re-export
+    memo/bytes, memo/entries and the ledger bucket immediately — not
+    leave them stale until the next insert."""
+    cache = memo_lib.MemoCache(1 << 20)
+    try:
+        key = memo_lib.request_key(['l a,b,c'], 'topk')
+        cache.insert(key, [{'s': np.zeros(64)}], cache.generation)
+        assert cache.bytes_gauge.snapshot() > 0
+        assert memory_lib.ledger().bucket_bytes('memo') > 0
+        # forge the unreachable-in-practice state the branch defends
+        # against: an entry whose generation mismatches the cache's
+        cache._entries[key].generation += 1
+        assert cache.lookup(key) is None
+        assert cache.bytes_gauge.snapshot() == 0
+        assert cache.entries_gauge.snapshot() == 0
+        assert memory_lib.ledger().bucket_bytes('memo') == 0
+        assert cache.stats()['entries'] == 0
+    finally:
+        cache.close()
+
+
+def test_memo_hits_isolated_from_caller_mutation():
+    """Neither the first (delivering) caller nor any hit-served caller
+    can poison the cache by mutating what they were handed: inserts
+    snapshot, hits get fresh copies (copy_results)."""
+    from code2vec_tpu.index.service import NeighborResult
+    cache = memo_lib.MemoCache(1 << 20)
+    try:
+        key = memo_lib.request_key(['l a,b,c'], 'neighbors', k=2)
+        live = [NeighborResult(indices=np.array([2, 0]),
+                               scores=np.array([0.9, 0.5], np.float32),
+                               labels=['c', 'a'])]
+        cache.insert(key, live, cache.generation)
+        # the delivering caller mutates its rows AFTER delivery
+        live[0].scores[:] = -1.0
+        live[0].labels.append('poison')
+        hit = cache.lookup(key)
+        assert type(hit[0]) is NeighborResult  # NamedTuple type kept
+        np.testing.assert_array_equal(
+            hit[0].scores, np.array([0.9, 0.5], np.float32))
+        assert hit[0].labels == ['c', 'a']
+        # a hit-served caller mutates what IT got back
+        hit[0].scores[:] = 7.0
+        hit[0].labels.clear()
+        again = cache.lookup(key)
+        assert again[0] is not hit[0]
+        np.testing.assert_array_equal(
+            again[0].scores, np.array([0.9, 0.5], np.float32))
+        assert again[0].labels == ['c', 'a']
+    finally:
+        cache.close()
+
+
+def test_memo_semantic_serves_isolated_copies():
+    from code2vec_tpu.index.service import neighbors_from_search
+    cache = memo_lib.MemoCache(1 << 20, semantic_epsilon=0.05,
+                               semantic_shadow_every=100)
+    try:
+        vec = np.array([1.0, 0.0, 0.0], np.float32)
+        rows = neighbors_from_search(np.array([[0.9, 0.5]]),
+                                     np.array([[2, 0]]), ['a', 'b', 'c'])
+        cache.semantic_insert(vec[None, :], rows, 4, cache.generation)
+        rows[0].scores[:] = -1.0  # delivering caller mutates after
+        served, shadow = cache.semantic_lookup(vec, 4)
+        assert not shadow
+        np.testing.assert_array_almost_equal(served.scores, [0.9, 0.5])
+        served.scores[:] = 5.0  # hit caller mutates its copy
+        served2, _ = cache.semantic_lookup(vec, 4)
+        assert served2 is not served
+        np.testing.assert_array_almost_equal(served2.scores, [0.9, 0.5])
     finally:
         cache.close()
 
@@ -392,6 +501,73 @@ def test_mesh_neighbors_exact_and_semantic_tiers(model):
         assert stats['semantic']['samples'] >= 1  # shadow ran live
         assert stats['semantic']['agreement'] == pytest.approx(1.0)
         assert stats['semantic_hits'] >= 1
+    finally:
+        mesh.close()
+
+
+class _SloStub:
+    """Records SloMonitor observations (serving/slo.py interface)."""
+
+    def __init__(self):
+        self.good = 0
+        self.bad = 0
+
+    def observe_good(self, latency_s=None):
+        self.good += 1
+
+    def observe_bad(self, reason='failed'):
+        self.bad += 1
+
+    def stats(self):
+        return {'good': self.good, 'bad': self.bad}
+
+
+def test_mesh_neighbors_memo_stands_down_during_canary(model):
+    """REVIEW fix: while a canary rollover is in flight, BOTH
+    submit_neighbors memo tiers (exact nkey + semantic) must run live,
+    like submit() — cache-served duplicates would starve the shadow
+    scorer.  Also: cache-served neighbors requests must stay in the
+    SLO good-rate denominator."""
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20,
+                              memo_semantic_epsilon=0.05)
+    try:
+        slo = _SloStub()
+        mesh._slo = slo
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        mesh.attach_index(_FakeIndex(dim=vec.shape[0]))
+        # warm both tiers
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        mesh.submit_neighbors(vec, k=4).result(60)
+        # duplicates are hits while no rollover is in flight — and each
+        # cache-served request is observed into the SLO good stream
+        good_before = slo.good
+        warm = mesh.submit_neighbors(PREDICT_LINES, k=4)
+        assert warm.done()
+        assert slo.good == good_before + 1
+        near = vec * np.float32(1.00001)
+        sem = mesh.submit_neighbors(near, k=4)
+        assert sem.done()
+        assert slo.good == good_before + 2
+        serves_before = mesh.stats()['memo']['semantic']['serves']
+        hits_before = mesh.stats()['memo']['hits']
+        # arm a fake in-flight rollover: both tiers stand down
+        mesh._rollover = {'replica': None, 'handle': None}
+        try:
+            rolled = mesh.submit_neighbors(PREDICT_LINES, k=4)
+            assert not rolled.done()  # ran live, not cache-served
+            rolled.result(60)
+            sem_rolled = mesh.submit_neighbors(near, k=4)
+            sem_rolled.result(60)
+            stats = mesh.stats()['memo']
+            assert stats['hits'] == hits_before  # exact tier stood down
+            assert stats['semantic']['serves'] == serves_before
+        finally:
+            mesh._rollover = None
+        # rollover concluded: duplicates serve from cache again
+        assert mesh.submit_neighbors(PREDICT_LINES, k=4).done()
     finally:
         mesh.close()
 
