@@ -153,6 +153,11 @@ probe_or_record "after mesh_soak" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline
 run_stage index 900 python benchmarks/bench_index.py
+probe_or_record "after index" || exit 3
+# training goodput plane (ISSUE 17): steady-state MFU, goodput
+# fraction, and badput shares of the real hot loop — the healthy
+# baseline a later goodput regression flips against
+run_stage goodput 900 python benchmarks/bench_goodput.py
 
 # settle the queued >=2% flip verdicts from everything this round (and
 # prior rounds) captured — durable rows in results/flip_verdicts.json.

@@ -104,7 +104,16 @@ def main() -> None:
                                'memo', 'zipf_alpha', 'hit_rate',
                                'cache_p99_ms', 'live_p99_ms',
                                'semantic_hits', 'semantic_agreement',
-                               'device_seconds_per_1k_requests')}
+                               'device_seconds_per_1k_requests',
+                               # goodput plane (ISSUE 17): steady-state
+                               # MFU / goodput fraction / badput shares
+                               # of the real hot loop, the baseline a
+                               # goodput regression flips against
+                               'mfu', 'goodput_fraction',
+                               'badput_compile_pct',
+                               'badput_input_wait_pct',
+                               'arithmetic_intensity',
+                               'steps_per_window')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

@@ -319,6 +319,24 @@ class Config:
     # the run's work completes (--memory-report). Live runs can instead
     # `touch <telemetry_dir>/MEM_NOW` for a snapshot with no restart.
     MEMORY_REPORT: bool = False
+    # ---- training goodput plane (telemetry/goodput.py) ----
+    # Per-device peak FLOP/s used as the MFU denominator (train/mfu =
+    # achieved model FLOP/s over peak x device count). -1 = UNSET: the
+    # DEVICE_PEAK_FLOPS environment variable fills in (the
+    # TELEMETRY_TRACE_AT_STEP convention), else the device-kind table
+    # in telemetry/goodput.py (known TPU generations, a CPU floor),
+    # else a conservative default. Set it explicitly for hardware the
+    # table doesn't know — MFU is only as honest as this denominator.
+    DEVICE_PEAK_FLOPS: float = -1.0
+    # Step-time anomaly watchdog threshold, in robust standard
+    # deviations (MAD-scaled) above the per-shape rolling median. A
+    # sustained regression past it fires goodput/anomalies_total, dumps
+    # flight_step_anomaly.jsonl, and auto-triggers a profiler capture.
+    # 0 disables the watchdog.
+    GOODPUT_ANOMALY_SIGMA: float = 6.0
+    # Minimum seconds between anomaly-triggered profiler captures, so a
+    # persistently degraded run produces one trace, not hundreds.
+    GOODPUT_AUTOCAPTURE_COOLDOWN_SECS: float = 600.0
     # ---- resilience (code2vec_tpu/resilience/, ROBUSTNESS.md) ----
     # Divergence guard: check the windowed losses for NaN/Inf at each
     # log-window sync (zero extra host syncs — the losses come to host
@@ -723,6 +741,14 @@ class Config:
                                  'when global step N is reached (implies '
                                  '--telemetry; live runs can instead touch '
                                  '<telemetry_dir>/TRACE_NOW)')
+        parser.add_argument('--device-peak-flops',
+                            dest='device_peak_flops',
+                            type=float, default=None, metavar='FLOPS',
+                            help='per-device peak FLOP/s used as the '
+                                 'MFU denominator (train/mfu); unset '
+                                 'falls back to the DEVICE_PEAK_FLOPS '
+                                 'env var, then a device-kind table '
+                                 '(telemetry/goodput.py)')
         parser.add_argument('--memory-report', dest='memory_report',
                             action='store_true',
                             help='write a reconciled device-memory '
@@ -979,6 +1005,8 @@ class Config:
             if env_step >= 0:
                 self.TELEMETRY_TRACE_AT_STEP = env_step
                 self.TELEMETRY = True
+        if parsed.device_peak_flops is not None:
+            self.DEVICE_PEAK_FLOPS = parsed.device_peak_flops
         if parsed.memory_report:
             self.MEMORY_REPORT = True
         if parsed.hbm_budget_bytes is not None:
@@ -1250,6 +1278,15 @@ class Config:
         if self.HBM_BUDGET_BYTES < -1:
             raise ValueError('config.HBM_BUDGET_BYTES must be >= -1 '
                              '(-1 = env fallback, 0 = unlimited).')
+        if self.DEVICE_PEAK_FLOPS != -1.0 and self.DEVICE_PEAK_FLOPS <= 0:
+            raise ValueError('config.DEVICE_PEAK_FLOPS must be > 0 '
+                             '(-1 = env/device-table fallback).')
+        if self.GOODPUT_ANOMALY_SIGMA < 0:
+            raise ValueError('config.GOODPUT_ANOMALY_SIGMA must be >= 0 '
+                             '(0 disables the anomaly watchdog).')
+        if self.GOODPUT_AUTOCAPTURE_COOLDOWN_SECS < 0:
+            raise ValueError(
+                'config.GOODPUT_AUTOCAPTURE_COOLDOWN_SECS must be >= 0.')
         if self.BATCH_WIRE_FORMAT not in {'planes', 'packed'}:
             raise ValueError("config.BATCH_WIRE_FORMAT must be in "
                              "{'planes', 'packed'}.")

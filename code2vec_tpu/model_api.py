@@ -31,6 +31,7 @@ from code2vec_tpu.metrics import (SubtokensEvaluationMetric,
                                   decode_topk_batch)
 from code2vec_tpu.models.backends import create_backend
 from code2vec_tpu.parallel import mesh as mesh_lib
+from code2vec_tpu.telemetry import goodput as goodput_lib
 from code2vec_tpu.training.trainer import Trainer, TrainerState
 from code2vec_tpu.vocab import Code2VecVocabs, VocabType
 
@@ -344,7 +345,11 @@ class Code2VecModel:
 
         def _evaluate_and_log(label: str, step: int, params) -> None:
             eval_t0 = time.time()
-            results = self.evaluate(params=params)
+            # typed badput mark for the goodput ledger (no-op when
+            # telemetry is off; absorbed when the trainer's eval-callback
+            # wrap already opened an eval interval)
+            with goodput_lib.interval(goodput_lib.KIND_EVAL):
+                results = self.evaluate(params=params)
             eval_wall = time.time() - eval_t0
             self.eval_history.append({
                 'label': label, 'step': step,
@@ -380,9 +385,12 @@ class Code2VecModel:
                 return
             last_saved_step[0] = step
             # async: the write finalizes in the background while training
-            # continues; train()'s finally drains it
-            self.save(state=state, epoch=last_complete_epoch, wait=False,
-                      snapshot=snapshot)
+            # continues; train()'s finally drains it. The goodput mark
+            # covers the dispatch cost the loop pays (device->host copy),
+            # not the background write.
+            with goodput_lib.interval(goodput_lib.KIND_CHECKPOINT):
+                self.save(state=state, epoch=last_complete_epoch, wait=False,
+                          snapshot=snapshot)
 
         def on_save_interval(epoch: int, batch_num: int,
                              state: TrainerState) -> None:

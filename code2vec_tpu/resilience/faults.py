@@ -70,6 +70,12 @@ FAULT_POINTS: Dict[str, str] = {
                      'SLOW_DISPATCH_SECONDS before dispatching the '
                      'triggering micro-batch (exercises admission '
                      'control: queue bound, shedding, deadline expiry).',
+    'slow_step': 'training/trainer.py hot loop: sleep SLOW_STEP_SECONDS '
+                 'inside the triggering train step(s) — a sustained '
+                 'per-step stall shaped like a degraded input stage or '
+                 'a throttled device (exercises the step-time anomaly '
+                 'watchdog and its profiler auto-capture; use a '
+                 'lo..hi window for the sustained shape it detects).',
     'extractor_crash': 'serving/extractor_bridge.py pool call: the '
                        'triggering extractor invocation raises '
                        'ExtractorCrash as if the subprocess died '
@@ -115,6 +121,12 @@ HANG_SECONDS = 600.0
 #: queue bound, short enough that a windowed drill stays inside test
 #: budgets.
 SLOW_DISPATCH_SECONDS = 0.25
+
+#: how long a fired ``slow_step`` stalls one hot-loop train step.
+#: Far past any smoke-model step's median + GOODPUT_ANOMALY_SIGMA
+#: robust deviations, so a windowed drill deterministically trips the
+#: anomaly watchdog, while a 3-step sustain window costs <0.5s.
+SLOW_STEP_SECONDS = 0.12
 
 
 def parse_spec(spec: str) -> Dict[str, object]:

@@ -68,6 +68,21 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'train/epoch_wall_time_s': _m(GAUGE, 's', 'Wall time of the last '
                                   "epoch's training loop (includes interval "
                                   'evals; excludes epoch-end eval/save).'),
+    # ---- MFU / roofline (telemetry/goodput.py) ----
+    'train/mfu': _m(GAUGE, 'fraction', 'Model FLOP utilization of the last '
+                    'flush window: executed train-step FLOPs (AOT '
+                    'cost_analysis) / (train seconds x DEVICE_PEAK_FLOPS x '
+                    'mesh devices).'),
+    'train/arithmetic_intensity': _m(GAUGE, 'flops/byte', 'FLOPs per byte '
+                                     'accessed of the current train-step '
+                                     'program (lowered-module estimate — '
+                                     'the roofline x-axis).'),
+    'train/step_flops': _m(GAUGE, 'flops', 'Logical FLOPs of one train '
+                           'step at the current dispatch shape (AOT '
+                           'cost_analysis, pre-partitioning).'),
+    'train/step_bytes': _m(GAUGE, 'bytes', 'Bytes accessed by one train '
+                           'step at the current dispatch shape '
+                           '(lowered-module estimate).'),
     # ---- staging ring ----
     'staging/ring_occupancy': _m(GAUGE, 'batches', 'Batches currently held '
                                  'in the device staging ring.'),
@@ -273,6 +288,27 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'index/recall_at10': _m(GAUGE, 'fraction', 'Measured IVF recall@10 '
                             'vs the exact tier on a held-out query '
                             'sample.'),
+    # ---- training goodput plane (telemetry/goodput.py) ----
+    'goodput/productive_s': _m(GAUGE, 's', 'Cumulative wall seconds of '
+                               'productive train-step time this run '
+                               '(fit wall minus typed badput).'),
+    'goodput/badput_s': _m(GAUGE, 's', 'Cumulative badput seconds, '
+                           'kind-labeled: {kind=compile|input_wait|'
+                           'checkpoint|eval|rewind|rewind_replay|preempt|'
+                           'warmup}.'),
+    'goodput/fraction': _m(GAUGE, 'fraction', 'Goodput: productive '
+                           'seconds / fit wall seconds so far (the '
+                           'primary training fleet metric).'),
+    'goodput/anomalies_total': _m(COUNTER, 'anomalies', 'Step-time anomaly '
+                                  'watchdog fires: sustained regression '
+                                  'past GOODPUT_ANOMALY_SIGMA robust '
+                                  'deviations of the dispatch shape\'s '
+                                  'rolling median (dumps '
+                                  'flight_step_anomaly.jsonl).'),
+    'goodput/autocaptures_total': _m(COUNTER, 'captures', 'Anomaly-'
+                                     'triggered profiler captures armed '
+                                     '(rate-limited to one per '
+                                     'GOODPUT_AUTOCAPTURE_COOLDOWN_SECS).'),
     # ---- profiler capture ----
     'trace/captures_total': _m(COUNTER, 'captures', 'On-demand jax.profiler '
                                'trace captures completed.'),

@@ -22,6 +22,7 @@ compile (compiles are seconds-scale; this is nothing).
 from __future__ import annotations
 
 from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry import goodput
 
 _LISTENER_INSTALLED = False
 
@@ -38,6 +39,9 @@ def _on_event_duration(name: str, secs: float, **_kwargs) -> None:
         reg = core.registry()
         reg.counter('jit/compiles_total').inc()
         reg.timer('jit/compile_ms').record(secs)
+        # compile wall is badput: feed the active goodput ledger (a
+        # single attribute read when no trainer has one armed)
+        goodput.on_compile(secs)
 
 
 def install_compile_listener() -> bool:

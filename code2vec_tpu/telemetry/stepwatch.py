@@ -14,6 +14,7 @@ import time
 from typing import Optional
 
 from code2vec_tpu.telemetry import core
+from code2vec_tpu.telemetry import goodput as goodput_lib
 from code2vec_tpu.telemetry import memory as memory_lib
 from code2vec_tpu.telemetry.exporters import (ConsoleExporter, JsonlExporter,
                                               PrometheusExporter)
@@ -69,6 +70,28 @@ class StepTelemetry:
         memory_lib.configure(dump_dir=self.dir)
         self.flush_every = max(1, getattr(config,
                                           'TELEMETRY_FLUSH_EVERY_STEPS', 50))
+        # ---- training goodput plane (telemetry/goodput.py) ----
+        self.goodput = goodput_lib.GoodputLedger(
+            os.path.join(self.dir, 'intervals%s.jsonl' % suffix),
+            log=self.log)
+        try:
+            import jax
+            device_kind = jax.local_devices()[0].device_kind
+            self._num_devices = jax.device_count()
+        except Exception:  # jax-less construction (unit tests)
+            device_kind = None
+            self._num_devices = 1
+        self.peak_flops = goodput_lib.resolve_peak_flops(
+            getattr(config, 'DEVICE_PEAK_FLOPS', -1.0), device_kind)
+        sigma = getattr(config, 'GOODPUT_ANOMALY_SIGMA', 6.0)
+        cooldown = getattr(config, 'GOODPUT_AUTOCAPTURE_COOLDOWN_SECS',
+                           600.0)
+        self.anomaly = goodput_lib.StepAnomalyWatchdog(
+            sigma, cooldown, dump_dir=self.dir,
+            on_capture=self.trace.request,
+            on_record=self.goodput.note_anomaly,
+            suffix=suffix, log=self.log)
+        self._window_excluded = 0.0
         self.exporters = [
             JsonlExporter(self.dir, filename='metrics%s.jsonl' % suffix),
             PrometheusExporter(self.dir, filename='metrics%s.prom' % suffix),
@@ -99,13 +122,38 @@ class StepTelemetry:
         now = time.monotonic()
         elapsed = max(now - self._window_t0, 1e-9)
         reg = self.registry
+        # train/examples_per_sec measures TRAIN steps: subtract the
+        # window's eval/checkpoint/rewind/preempt interval seconds (the
+        # goodput ledger marks them) from the wall window, so a slow
+        # eval no longer dilutes the exported throughput gauge
+        excluded = self.goodput.rate_excluded_total()
+        excluded_delta = min(max(excluded - self._window_excluded, 0.0),
+                             elapsed - 1e-9)
+        self._window_excluded = excluded
+        train_elapsed = max(elapsed - excluded_delta, 1e-9)
         reg.gauge('train/examples_per_sec').set(
-            self._window_examples / elapsed)
+            self._window_examples / train_elapsed)
         reg.gauge('train/contexts_per_sec').set(
-            self._window_contexts / elapsed)
+            self._window_contexts / train_elapsed)
         self._window_t0 = now
         self._window_examples = 0
         self._window_contexts = 0
+        # goodput/* totals + the window's MFU off the harvested FLOPs
+        self.goodput.export_gauges(reg)
+        window = self.goodput.harvest_window()
+        mfu_value = None
+        if window['flops'] > 0:
+            mfu_value = goodput_lib.mfu(window['flops'], train_elapsed,
+                                        self.peak_flops, self._num_devices)
+            reg.gauge('train/mfu').set(mfu_value)
+            flops, byts = self.goodput.current_cost()
+            reg.gauge('train/step_flops').set(flops)
+            reg.gauge('train/step_bytes').set(byts)
+            intensity = self.goodput.arithmetic_intensity()
+            if intensity is not None:
+                reg.gauge('train/arithmetic_intensity').set(intensity)
+        if window['steps'] or window['productive_s'] > 0:
+            self.goodput.write_window(step, window, train_elapsed, mfu_value)
         # refresh the mem/* gauges so every flush exports the current
         # ledger attribution alongside the phase timers
         memory_lib.ledger().export_gauges()
@@ -117,6 +165,8 @@ class StepTelemetry:
         """Re-arm recording (fit entry) — the counterpart of shutdown()'s
         disable, so fit can be called repeatedly on one trainer."""
         core.enable()
+        goodput_lib.activate(self.goodput)
+        self.goodput.run_start()
 
     def shutdown(self, step: int) -> None:
         """Final flush + stop any live capture (fit teardown), then drop
@@ -124,5 +174,10 @@ class StepTelemetry:
         leave later non-telemetry trainers/readers in this process paying
         the pipeline-recording cost into an unexported registry."""
         self.trace.shutdown()
+        # final window BEFORE run_end so every window record sits inside
+        # its run span (goodput_report.split_spans closes a span at the
+        # run_end line; a trailing window would read as a crashed span)
         self.flush_now(step)
+        self.goodput.run_end(step)
+        goodput_lib.deactivate(self.goodput)
         core.disable()

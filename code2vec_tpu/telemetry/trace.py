@@ -51,6 +51,15 @@ class TraceController:
     def active(self) -> bool:
         return self._active_dir is not None
 
+    def request(self, step: int) -> None:
+        """Arm a one-shot capture to start at (or after — ``_should_start``
+        matches exactly, so pass the next step the trainer will offer)
+        step ``step``.  The anomaly watchdog's auto-capture entry; same
+        arming as the touch-file trigger.  No-op while a capture is
+        already active or armed."""
+        if self._active_dir is None and self._armed_at < 0:
+            self._armed_at = step
+
     def _should_start(self, step: int) -> bool:
         if step == self.trace_at_step or step == self._armed_at:
             return True

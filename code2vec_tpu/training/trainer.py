@@ -37,6 +37,7 @@ from code2vec_tpu.models import functional
 from code2vec_tpu.ops.topk import sharded_top_k
 from code2vec_tpu.parallel import mesh as mesh_lib
 from code2vec_tpu.resilience import faults
+from code2vec_tpu.telemetry import goodput as goodput_lib
 
 # package logger: 'code2vec_tpu.training.trainer' — propagates to the
 # 'code2vec_tpu' root logger Config.get_logger configures
@@ -148,6 +149,9 @@ class Trainer:
         # Telemetry (OBSERVABILITY.md): None when disabled — every
         # instrumented site below is then a single `is None` check.
         self._telemetry = None
+        # dispatch shapes whose AOT step cost (FLOPs/bytes for train/mfu)
+        # has been captured — first sight only, telemetry path only
+        self._cost_keys = set()
         if getattr(config, 'TELEMETRY', False):
             from code2vec_tpu.telemetry import StepTelemetry
             self._telemetry = StepTelemetry(
@@ -660,6 +664,47 @@ class Trainer:
         except Exception:
             return None
 
+    @staticmethod
+    def _program_cost(fn, *args) -> Optional[dict]:
+        """One jitted program's AOT cost record: logical FLOPs + bytes
+        accessed from ``Lowered.cost_analysis()`` — analysis of the
+        lowered (pre-partitioning) module, so it costs one trace +
+        lowering but NO extra backend compile (a telemetry run keeps
+        zero post-warmup compiles).  None where the version/backend has
+        no cost analysis."""
+        try:
+            cost = fn.lower(*args).cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops = float(cost.get('flops', 0.0))
+            if flops <= 0:
+                return None
+            return {'flops': flops,
+                    'bytes_accessed': float(cost.get('bytes accessed', 0.0))}
+        except Exception:
+            return None
+
+    def train_program_cost(self, state: TrainerState, arrays
+                           ) -> Optional[dict]:
+        """AOT FLOPs/bytes of the train-step program for the shapes of
+        ``arrays`` (either wire) — the MFU/roofline numerator
+        (telemetry/goodput.py, OBSERVABILITY.md "Training goodput")."""
+        fn = (self._train_step_packed if len(arrays) == 4
+              else self._train_step)
+        return self._program_cost(fn, state, arrays)
+
+    def _maybe_record_step_cost(self, shape_key: str, state, arrays) -> None:
+        """First sight of a dispatch shape: capture its AOT step cost
+        into the goodput ledger (telemetry path; rides the same
+        first-sight cadence as the capacity tracker)."""
+        if shape_key in self._cost_keys:
+            return
+        self._cost_keys.add(shape_key)
+        cost = self.train_program_cost(state, arrays)
+        if cost is not None:
+            self._telemetry.goodput.set_step_cost(
+                shape_key, cost['flops'], cost['bytes_accessed'])
+
     def train_program_memory(self, state: TrainerState, arrays
                              ) -> Optional[dict]:
         """AOT memory analysis of the train-step program for the shapes
@@ -818,8 +863,19 @@ class Trainer:
             keys the rewind ceiling in state.step units (after an
             earlier rewind they lag batch_num, and checkpoints are
             keyed by state.step)."""
-            return guard.handle(batch_num, [float(x) for x in losses_host],
-                                host_batch, step_now=int(state.step))
+            step_before = int(state.step)
+            with goodput_lib.interval(goodput_lib.KIND_REWIND):
+                new_state = guard.handle(batch_num,
+                                         [float(x) for x in losses_host],
+                                         host_batch,
+                                         step_now=step_before)
+            if tele is not None:
+                # the steps from the restored checkpoint back to the
+                # rewind point re-train lost progress: badput, not
+                # productive (goodput ledger bills them as they run)
+                tele.goodput.mark_replay(step_before
+                                         - int(new_state.step))
+            return new_state
         if tele is not None:
             tele.resume()  # shutdown() in fit's finally disables globally
         self._profiling = False
@@ -845,9 +901,13 @@ class Trainer:
                             watched('next staged batch (batch %d)',
                                     batch_num):
                         item = next(staged, None)
-                    tele.batch_wait.record(max(
+                    wait_s = max(
                         0.0, (time.perf_counter() - iter_t0)
-                        - (tele.h2d.total - h2d_before)))
+                        - (tele.h2d.total - h2d_before))
+                    tele.batch_wait.record(wait_s)
+                    # iteration-start mark for the goodput ledger; wait
+                    # beyond the pipeline's steady poll cost is badput
+                    tele.goodput.note_input_wait(wait_s)
                 else:
                     with watched('next staged batch (batch %d)', batch_num):
                         item = next(staged, None)
@@ -863,7 +923,10 @@ class Trainer:
                         'boundary %d for a final snapshot save.'
                         % (preemption.signal_name, batch_num))
                     if on_preempt is not None:
-                        on_preempt(epoch, batch_num, state)
+                        with goodput_lib.interval(goodput_lib.KIND_PREEMPT):
+                            on_preempt(epoch, batch_num, state)
+                    if tele is not None:
+                        tele.goodput.run_end(batch_num, reason='preempt')
                     return state
                 arrays, host_batch = item
                 # step-interval checkpointing fires at the TOP of the next
@@ -875,7 +938,8 @@ class Trainer:
                 if on_save_interval is not None and batch_num > 0 and \
                         config.SAVE_EVERY_N_STEPS > 0 and \
                         batch_num % config.SAVE_EVERY_N_STEPS == 0:
-                    on_save_interval(epoch, batch_num, state)
+                    with goodput_lib.interval(goodput_lib.KIND_CHECKPOINT):
+                        on_save_interval(epoch, batch_num, state)
                 if config.PROFILE_DIR and not profile_done:
                     # jax.profiler cannot nest: the fixed window must also
                     # yield to a live on-demand capture (the controller
@@ -903,14 +967,26 @@ class Trainer:
                     if len(arrays) == 4:
                         # each NEW packed capacity = one more jit
                         # specialization of the whole step program
+                        shape_key = 'packed:%d' % int(arrays[0].shape[1])
                         tele.capacity.observe(int(arrays[0].shape[1]),
                                               batch_num)
+                    else:
+                        shape_key = 'planes:%d' % int(arrays[0].shape[0])
+                    # first sight of a dispatch shape: AOT step FLOPs/
+                    # bytes for the MFU gauges (lowering only — no
+                    # extra backend compile)
+                    self._maybe_record_step_cost(shape_key, state, arrays)
                     with jax.profiler.StepTraceAnnotation(
                             'train', step_num=batch_num), \
                             tele.dispatch.time():
                         state, loss = self.train_step_placed(state, arrays)
                 else:
                     state, loss = self.train_step_placed(state, arrays)
+                if faults.maybe_fire('slow_step', step=batch_num):
+                    # a sustained per-step stall shaped like a degraded
+                    # input stage or a throttled device — the step-time
+                    # anomaly watchdog's drill (OBSERVABILITY.md)
+                    time.sleep(faults.SLOW_STEP_SECONDS)
                 if faults.maybe_fire('nan_loss', step=batch_num):
                     # poison on device: keeps the real loss's dtype and
                     # sharding, so the window sync path is exercised
@@ -983,14 +1059,23 @@ class Trainer:
                             window_examples = 0
                             window_start = time.time()
                             continue
-                    on_eval_interval(batch_num, state)
+                    with goodput_lib.interval(goodput_lib.KIND_EVAL):
+                        on_eval_interval(batch_num, state)
                     # restart the throughput window completely: a partial
                     # window timed from post-eval would overstate samples/sec
                     window_losses = []
                     window_examples = 0
                     window_start = time.time()
                 if tele is not None:
-                    tele.step_total.record(time.perf_counter() - iter_t0)
+                    iter_secs = time.perf_counter() - iter_t0
+                    tele.step_total.record(iter_secs)
+                    # goodput: clean step seconds = iteration minus the
+                    # badput accrued inside it; compile-free samples feed
+                    # the step-time anomaly watchdog
+                    clean_s, had_compile = tele.goodput.step_done(
+                        batch_num, iter_secs, shape_key)
+                    if not had_compile:
+                        tele.anomaly.observe(shape_key, clean_s, batch_num)
                     tele.after_step(batch_num)
                     self._last_batch_num = batch_num
             if (tele is not None or guard is not None) and window_losses:
@@ -1006,7 +1091,11 @@ class Trainer:
                 with watched('epoch-end window sync (batch %d)', batch_num):
                     losses = jax.device_get(window_losses)
                 if tele is not None:
-                    tele.sync.record(time.perf_counter() - sync_t0)
+                    sync_s = time.perf_counter() - sync_t0
+                    tele.sync.record(sync_s)
+                    # this sync drains dispatched device work — real
+                    # training progress outside any iteration's seconds
+                    tele.goodput.note_productive(sync_s)
                 if guard is not None and \
                         not np.isfinite(float(np.sum(losses))):
                     state = rewind(losses)

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Reconstruct a training run's goodput story from ``intervals.jsonl``
+(telemetry/goodput.py).
+
+Reads the durable goodput ledger a telemetry-enabled fit appends —
+``run_start`` / ``window`` / ``interval`` / ``anomaly`` / ``run_end``
+records — and prints:
+
+- the goodput line: productive seconds over wall, per run span;
+- the badput breakdown table (compile, input_wait, checkpoint, eval,
+  rewind, rewind_replay, preempt, warmup) plus an explicit
+  ``unattributed`` row, so the buckets visibly sum to wall — the
+  honesty check, same contract as memory_report's reconciliation;
+- restart gaps: wall time between a ``run_end(reason=preempt)`` and
+  the next ``run_start`` (time the job existed but trained nothing);
+- the MFU timeline from the per-flush window records;
+- the anomaly list (step, shape, step-time vs median, whether a
+  profiler capture auto-triggered).
+
+Multi-process runs write one ledger per process
+(``intervals.proc<N>.jsonl``); pass the telemetry DIRECTORY to merge
+them — per-process spans are reported separately (their wall clocks
+overlap; summing would double-count).
+
+jax-free by design (OBSERVABILITY.md "Training goodput").
+
+    python scripts/goodput_report.py telemetry/intervals.jsonl
+    python scripts/goodput_report.py telemetry/ --json
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+# keep in sync with telemetry/goodput.py BADPUT_KINDS (jax-free script:
+# no package import)
+BADPUT_KINDS = ('compile', 'input_wait', 'checkpoint', 'eval', 'rewind',
+                'rewind_replay', 'preempt', 'warmup')
+
+
+def load_records(path):
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail line of a crashed run
+    return records
+
+
+def discover(target):
+    """-> [(proc_label, path)].  A file is one ledger; a directory is
+    the proc-0 ledger plus any intervals.proc<N>.jsonl siblings."""
+    if os.path.isdir(target):
+        paths = sorted(glob.glob(os.path.join(target,
+                                              'intervals*.jsonl')))
+        if not paths:
+            raise FileNotFoundError(
+                'no intervals*.jsonl under %s (was the run telemetry-'
+                'enabled?)' % target)
+    else:
+        paths = [target]
+    out = []
+    for path in paths:
+        name = os.path.basename(path)
+        label = name[len('intervals'):-len('.jsonl')].lstrip('.') \
+            if name.startswith('intervals') else name
+        out.append((label or 'proc0', path))
+    return out
+
+
+def split_spans(records):
+    """Records -> run spans (run_start .. run_end/EOF).  A crash loses
+    the run_end line; the span is then reconstructed from its windows
+    so the report still renders."""
+    spans, current = [], None
+    for record in records:
+        kind = record.get('kind')
+        if kind == 'run_start':
+            if current is not None:
+                spans.append(current)
+            current = {'start': record, 'end': None, 'windows': [],
+                       'intervals': [], 'anomalies': []}
+            continue
+        if current is None:
+            if spans:
+                # trailing flush records after a run_end (older ledgers
+                # wrote the final window post-run_end) belong to the
+                # just-closed span, not a phantom crash span
+                current = spans.pop()
+            else:
+                # tolerate a truncated head: synthesize an open span
+                current = {'start': None, 'end': None, 'windows': [],
+                           'intervals': [], 'anomalies': []}
+        if kind == 'run_end':
+            current['end'] = record
+            spans.append(current)
+            current = None
+        elif kind == 'window':
+            current['windows'].append(record)
+        elif kind == 'interval':
+            current['intervals'].append(record)
+        elif kind == 'anomaly':
+            current['anomalies'].append(record)
+    if current is not None:
+        spans.append(current)
+    return spans
+
+
+def span_totals(span):
+    """Totals for one run span: from its run_end record when present,
+    else rebuilt from the window records (crash-safe path)."""
+    end = span['end']
+    if end is not None:
+        return {'wall_s': end.get('wall_s', 0.0),
+                'productive_s': end.get('productive_s', 0.0),
+                'steps': end.get('steps', 0),
+                'badput_s': dict(end.get('badput_s', {})),
+                'reason': end.get('reason', 'done'),
+                'reconstructed': False}
+    badput = {kind: 0.0 for kind in BADPUT_KINDS}
+    productive = wall = 0.0
+    steps = 0
+    for window in span['windows']:
+        productive += window.get('productive_s', 0.0)
+        wall += window.get('elapsed_s', 0.0)
+        steps = max(steps, window.get('step', 0))
+        for kind, secs in (window.get('badput_s') or {}).items():
+            badput[kind] = badput.get(kind, 0.0) + secs
+    return {'wall_s': wall, 'productive_s': productive, 'steps': steps,
+            'badput_s': badput, 'reason': 'CRASH (no run_end)',
+            'reconstructed': True}
+
+
+def restart_gaps(spans):
+    """Wall seconds between each run_end and the next run_start — job
+    alive but training nothing (preemption restart, scheduler requeue)."""
+    gaps = []
+    for prev, nxt in zip(spans, spans[1:]):
+        if prev['end'] is None or nxt['start'] is None:
+            continue
+        gap = nxt['start'].get('wall', 0) - prev['end'].get('wall', 0)
+        if gap > 0:
+            gaps.append({'after_reason': prev['end'].get('reason'),
+                         'gap_s': gap})
+    return gaps
+
+
+def summarize(spans):
+    per_span = [span_totals(span) for span in spans]
+    gaps = restart_gaps(spans)
+    total_wall = sum(t['wall_s'] for t in per_span) \
+        + sum(g['gap_s'] for g in gaps)
+    total_productive = sum(t['productive_s'] for t in per_span)
+    badput = {kind: 0.0 for kind in BADPUT_KINDS}
+    for totals in per_span:
+        for kind, secs in totals['badput_s'].items():
+            badput[kind] = badput.get(kind, 0.0) + secs
+    badput['restart_gap'] = sum(g['gap_s'] for g in gaps)
+    attributed = total_productive + sum(badput.values())
+    badput['unattributed'] = max(0.0, total_wall - attributed)
+    return {'wall_s': total_wall, 'productive_s': total_productive,
+            'goodput_fraction': (total_productive / total_wall
+                                 if total_wall > 0 else 0.0),
+            'steps': sum(t['steps'] for t in per_span),
+            'badput_s': badput, 'spans': per_span,
+            'restart_gaps': gaps}
+
+
+def fmt_s(seconds):
+    return '%10.2fs' % seconds
+
+
+def print_summary(summary, label):
+    print('== %s: %d run span(s), %d step(s) =='
+          % (label, len(summary['spans']), summary['steps']))
+    wall = max(summary['wall_s'], 1e-9)
+    print('goodput: %.1f%%  (%s productive of %s wall)'
+          % (100.0 * summary['goodput_fraction'],
+             fmt_s(summary['productive_s']).strip(),
+             fmt_s(summary['wall_s']).strip()))
+    print()
+    print('%-14s %11s %7s' % ('bucket', 'seconds', 'share'))
+    print('%-14s %11s %6.1f%%' % ('productive',
+                                  fmt_s(summary['productive_s']).strip(),
+                                  100.0 * summary['productive_s'] / wall))
+    for kind, secs in sorted(summary['badput_s'].items(),
+                             key=lambda kv: -kv[1]):
+        if secs <= 0 and kind != 'unattributed':
+            continue
+        print('%-14s %11s %6.1f%%' % (kind, fmt_s(secs).strip(),
+                                      100.0 * secs / wall))
+    for totals in summary['spans']:
+        if totals['reconstructed']:
+            print('NOTE: a span had no run_end record (crash?); its '
+                  'totals were rebuilt from flush windows and '
+                  'understate wall by up to one flush interval.')
+            break
+
+
+def print_mfu_timeline(spans, width):
+    rows = [(w.get('step'), w.get('mfu'), w.get('elapsed_s'))
+            for span in spans for w in span['windows']
+            if w.get('mfu') is not None]
+    if not rows:
+        return
+    print()
+    print('MFU timeline (per telemetry flush window):')
+    peak = max(m for _s, m, _e in rows)
+    for step, mfu, _elapsed in rows:
+        bar = '#' * int(round(width * mfu / peak)) if peak > 0 else ''
+        print('  step %-8s %7.2f%%  %s' % (step, 100.0 * mfu, bar))
+
+
+def print_anomalies(spans):
+    anomalies = [a for span in spans for a in span['anomalies']]
+    if not anomalies:
+        return
+    print()
+    print('step-time anomalies (%d):' % len(anomalies))
+    for a in anomalies:
+        print('  %s step %-7s %-12s %7.1fms vs median %7.1fms '
+              '(%.1f robust sigmas)%s'
+              % (time.strftime('%H:%M:%S',
+                               time.localtime(a.get('wall', 0))),
+                 a.get('step'), a.get('shape', '?'),
+                 a.get('step_ms', 0.0), a.get('median_ms', 0.0),
+                 a.get('sigma', 0.0),
+                 '  [profiler capture auto-triggered]'
+                 if a.get('autocapture') else ''))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Reconstruct training goodput from intervals.jsonl '
+                    '(OBSERVABILITY.md "Training goodput").')
+    parser.add_argument('target',
+                        help='intervals.jsonl, or the telemetry '
+                             'directory (merges intervals.proc<N>.jsonl '
+                             'for multi-process runs)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit one machine-readable JSON line per '
+                             'process instead of tables')
+    parser.add_argument('--width', type=int, default=40,
+                        help='MFU timeline bar width (default 40)')
+    args = parser.parse_args(argv)
+
+    first = True
+    for label, path in discover(args.target):
+        spans = split_spans(load_records(path))
+        summary = summarize(spans)
+        if args.json:
+            print(json.dumps({'proc': label, **{
+                key: summary[key] for key in
+                ('wall_s', 'productive_s', 'goodput_fraction', 'steps',
+                 'badput_s', 'restart_gaps')}}))
+            continue
+        if not first:
+            print()
+        first = False
+        print_summary(summary, label)
+        print_mfu_timeline(spans, args.width)
+        print_anomalies(spans)
+    return 0
+
+
+if __name__ == '__main__':
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `goodput_report.py ... | head` closes the pipe mid-table; die
+        # quietly like any well-behaved filter
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

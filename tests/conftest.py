@@ -17,6 +17,7 @@ if '--xla_force_host_platform_device_count' not in _flags:
         _flags + ' --xla_force_host_platform_device_count=8').strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
 # Newer jax (the toolchain this repo was grown on) defaults the
@@ -34,3 +35,38 @@ def pytest_configure(config):
     # acceptance) don't warn as typos
     config.addinivalue_line(
         'markers', 'slow: heavy acceptance tests, excluded from tier-1')
+
+
+# Tier-1 runtime-budget guard (ISSUE 17): the suite runs under a hard
+# wall-clock cap (ROADMAP.md), and single tests creeping past ~20s are
+# how the cap gets eaten one PR at a time.  Flag them loudly at the end
+# of the run so the offender is moved behind @pytest.mark.slow (or
+# shrunk) BEFORE the cap is at risk — a warning, not a failure, because
+# CI machines vary.
+TIER1_SINGLE_TEST_BUDGET_S = 20.0
+_over_budget = []
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != 'call':
+        return
+    if item.get_closest_marker('slow') is not None:
+        return  # opted out of tier-1: its duration is its own business
+    if report.duration > TIER1_SINGLE_TEST_BUDGET_S:
+        _over_budget.append((item.nodeid, report.duration))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _over_budget:
+        return
+    terminalreporter.section('tier-1 runtime budget')
+    terminalreporter.write_line(
+        'WARNING: %d test(s) exceeded the ~%.0fs single-test tier-1 '
+        'budget — mark them @pytest.mark.slow or shrink them '
+        '(tests/conftest.py):' % (len(_over_budget),
+                                  TIER1_SINGLE_TEST_BUDGET_S))
+    for nodeid, duration in sorted(_over_budget, key=lambda x: -x[1]):
+        terminalreporter.write_line('  %7.1fs  %s' % (duration, nodeid))
