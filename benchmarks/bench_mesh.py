@@ -363,6 +363,249 @@ def run_memo_arm(model, index, profile, offered_rows_per_s: float,
     }
 
 
+def run_stepped_arm(model, lines, capacity: float, max_lines: int,
+                    secs: float, compiles) -> list:
+    """Stepped-offered-load arm (SERVING.md "Elastic fleet"): one
+    process-mode replica with the SLO/queue-driven autoscaler live,
+    driven low -> high -> low.  The high step must pull the fleet to 2
+    replicas (scale-up latency = load step to the new replica LIVE,
+    cold start included); the low step must drain it back to 1
+    (scale-down latency = load step to the drained slot retired); p99
+    over requests submitted DURING each transition window is reported
+    next to steady-state p99 — the cost of an elastic transition is a
+    latency bulge, never a lost or misrouted request."""
+    import random as random_lib
+    import threading
+    from code2vec_tpu.serving.errors import ServingError
+    config = model.config
+    knobs = dict(
+        MESH_REPLICA_MODE='process',
+        AUTOSCALE_MAX_REPLICAS=2, AUTOSCALE_MIN_REPLICAS=1,
+        AUTOSCALE_INTERVAL_SECS=0.25,
+        # the shared queue's admission bound caps visible backlog, so
+        # the up threshold must sit well UNDER bound/service_rate or a
+        # bounded queue can never look busy enough to scale
+        AUTOSCALE_UP_QUEUE_SECS=0.02,
+        AUTOSCALE_UP_COOLDOWN_SECS=2.0,
+        AUTOSCALE_DOWN_COOLDOWN_SECS=2.0,
+        AUTOSCALE_DOWN_IDLE_SECS=1.0,
+        AUTOSCALE_DOWN_UTILIZATION=0.9,
+        AUTOSCALE_FLAP_WINDOW_SECS=120.0, AUTOSCALE_FLAP_LIMIT=20)
+    old = {name: getattr(config, name) for name in knobs}
+    for name, value in knobs.items():
+        setattr(config, name, value)
+    try:
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  max_delay_ms=2.0)
+    finally:
+        for name, value in old.items():
+            setattr(config, name, value)
+    records = []
+    lat = []
+    lat_lock = threading.Lock()
+    shed = [0]
+    rng = random_lib.Random(17)
+    live_mark = {'t': None}
+    stats_gate = [0.0]
+
+    def live_replicas() -> int:
+        # throttled: the pacing loop polls this per submit
+        now = time.perf_counter()
+        if now < stats_gate[0] and live_mark.get('last') is not None:
+            return live_mark['last']
+        stats_gate[0] = now + 0.05
+        live_mark['last'] = mesh.stats()['replicas_live']
+        return live_mark['last']
+
+    def drive(rate_rows_per_s: float, seconds: float = None,
+              until=None, timeout: float = 180.0):
+        """Paced submits at the offered rate until the duration (or
+        the condition) is reached; returns (elapsed_s, condition_met)."""
+        t_start = time.perf_counter()
+        next_t = t_start
+        while True:
+            now = time.perf_counter()
+            if until is not None and until():
+                return now - t_start, True
+            if seconds is not None and now - t_start >= seconds:
+                return now - t_start, False
+            if until is not None and now - t_start >= timeout:
+                return now - t_start, False
+            n = rng.randint(1, max_lines)
+            request_lines = [rng.choice(lines) for _ in range(n)]
+            t_submit = time.perf_counter()
+            try:
+                future = mesh.submit(request_lines, tier='topk')
+            except ServingError:
+                shed[0] += 1
+            else:
+                def stamp(done, t_submit=t_submit):
+                    # completion-time latency, stamped when the future
+                    # RESOLVES (not when the drain loop reaches it)
+                    if done.exception() is None:
+                        with lat_lock:
+                            lat.append((t_submit,
+                                        time.perf_counter() - t_submit))
+                future.add_done_callback(stamp)
+                records.append(future)
+            next_t += n / rate_rows_per_s
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+            else:
+                if -pause > 1.0:
+                    # the generator fell behind the schedule (caller-
+                    # thread tokenize is part of the serving contract):
+                    # don't accumulate debt into a burst, and yield so
+                    # the fleet and the autoscaler keep their cores
+                    next_t = time.perf_counter()
+                time.sleep(0.0005)
+
+    def drive_burst(rows_per_burst: float, period_s: float,
+                    seconds: float = None, until=None,
+                    timeout: float = 180.0):
+        """Bursty offered load: ``rows_per_burst`` rows submitted
+        back-to-back each ``period_s``.  A paced generator sharing
+        cores with the fleet cannot reliably out-offer it (the
+        tokenize-in-caller contract), but a burst pins the bounded
+        queue full on every period — the unambiguous shape of a load
+        step, which is what the scale-up trigger must see."""
+        t_start = time.perf_counter()
+        while True:
+            now = time.perf_counter()
+            if until is not None and until():
+                return now - t_start, True
+            if seconds is not None and now - t_start >= seconds:
+                return now - t_start, False
+            if until is not None and now - t_start >= timeout:
+                return now - t_start, False
+            sent = 0
+            while sent < rows_per_burst:
+                n = rng.randint(1, max_lines)
+                request_lines = [rng.choice(lines) for _ in range(n)]
+                t_submit = time.perf_counter()
+                try:
+                    future = mesh.submit(request_lines, tier='topk')
+                except ServingError:
+                    shed[0] += 1
+                else:
+                    def stamp(done, t_submit=t_submit):
+                        if done.exception() is None:
+                            with lat_lock:
+                                lat.append(
+                                    (t_submit,
+                                     time.perf_counter() - t_submit))
+                    future.add_done_callback(stamp)
+                    records.append(future)
+                sent += n
+            rest = period_s - (time.perf_counter() - now)
+            if rest > 0:
+                time.sleep(rest)
+
+    warm_compiles = compiles.value
+    windows = {}
+    try:
+        # process-replica capacity probe: the thread-mode calibration
+        # over-reads a worker's capacity (no IPC, no wire) — the steps
+        # are sized against THIS mesh's single replica so 'high' is a
+        # genuine 2x overload, not a host-starving flood
+        proc_capacity = 0.0
+        for _ in range(2):
+            probe = []
+            probe_rows = 0
+            t_probe = time.perf_counter()
+            for _ in range(32):
+                n = rng.randint(1, max_lines)
+                probe_rows += n
+                probe.append(mesh.submit(
+                    [rng.choice(lines) for _ in range(n)],
+                    tier='topk'))
+            for future in probe:
+                future.result(timeout=600)
+            proc_capacity = max(
+                proc_capacity,
+                probe_rows / (time.perf_counter() - t_probe))
+        low = 0.4 * proc_capacity
+        high = 2.0 * proc_capacity
+        # steady low: one replica is comfortable, no scaling
+        drive(low, seconds=max(2.0, secs * 0.4))
+        base_up = mesh.stats()['autoscaler']['scale_up_total']
+        # ---- STEP UP: the high step must pull a second replica ----
+        # 2x offered as half-second bursts of one replica-second of
+        # rows each: every burst refills the bounded queue, so the
+        # drain estimate stays over the up threshold for as long as
+        # the step lasts
+        t_step_up = time.perf_counter()
+        _, scaled = drive_burst(proc_capacity, 0.5,
+                                until=lambda: live_replicas() >= 2)
+        t_live2 = time.perf_counter()
+        windows['up'] = (t_step_up, t_live2, scaled)
+        # steady at 2: the transition bulge must clear
+        drive_burst(proc_capacity, 0.5, seconds=max(2.0, secs * 0.3))
+        # ---- STEP DOWN: sustained low must drain the extra out ----
+        t_step_down = time.perf_counter()
+        _, drained = drive(
+            low, until=lambda: live_replicas() <= 1
+            and mesh.stats()['autoscaler']['scale_down_total'] >= 1)
+        t_live1 = time.perf_counter()
+        windows['down'] = (t_step_down, t_live1, drained)
+        drive(low, seconds=max(1.0, secs * 0.2))
+        asc_stats = mesh.stats()['autoscaler']
+        retired = [(r['replica'], r['retired_reason'])
+                   for r in mesh.stats()['replicas'] if r['retired']]
+        # drain every admitted future (latencies stamped by the done
+        # callbacks above); failures must all be typed
+        typed = 0
+        for future in records:
+            try:
+                future.result(timeout=600)
+            except ServingError:
+                typed += 1
+    finally:
+        mesh.close()
+    postwarm = compiles.value - warm_compiles
+
+    def p99_ms(pairs):
+        arr = np.asarray(sorted(l for _, l in pairs)) * 1e3
+        return round(float(np.percentile(arr, 99)), 1) if len(arr) \
+            else None
+
+    up_t0, up_t1, scaled = windows['up']
+    down_t0, down_t1, drained = windows['down']
+    in_up = [p for p in lat if up_t0 <= p[0] < up_t1]
+    in_down = [p for p in lat if down_t0 <= p[0] < down_t1]
+    steady = [p for p in lat
+              if not (up_t0 <= p[0] < up_t1)
+              and not (down_t0 <= p[0] < down_t1)]
+    out = []
+    out.append({'metric': 'mesh_stepped_scale_up_s',
+                'value': round(up_t1 - up_t0, 2) if scaled else None,
+                'reached_2_replicas': scaled,
+                'offered_low_rows_per_sec': round(low, 1),
+                'offered_high_rows_per_sec': round(high, 1),
+                'process_capacity_rows_per_sec_1r':
+                    round(proc_capacity, 1),
+                'scale_up_total': asc_stats['scale_up_total'],
+                'scale_up_before_step': base_up})
+    out.append({'metric': 'mesh_stepped_scale_down_s',
+                'value': (round(down_t1 - down_t0, 2)
+                          if drained else None),
+                'drained_to_1_replica': drained,
+                'scale_down_total': asc_stats['scale_down_total'],
+                'retired': retired})
+    out.append({'metric': 'mesh_stepped_transition_p99_ms',
+                'value': p99_ms(in_up + in_down),
+                'up_p99_ms': p99_ms(in_up),
+                'down_p99_ms': p99_ms(in_down),
+                'steady_p99_ms': p99_ms(steady),
+                'delivered': len(lat), 'typed_failures': typed,
+                'shed_at_admission': shed[0],
+                'flap_freezes_total': asc_stats['flap_freezes_total'],
+                'postwarm_compiles': postwarm,
+                'host_cores': os.cpu_count()})
+    return out
+
+
 def measure_capacity(model, index, profile, reps: int = 2) -> float:
     """One replica's sustainable rows/s: open-loop firehose (no arrival
     pacing, no deadline) through a 1-replica mesh — delivered rows over
@@ -413,6 +656,14 @@ def main() -> None:
                         default=2000.0,
                         help='per-request SLO deadline under load '
                              '(drives shed/expiry at saturation)')
+    parser.add_argument('--stepped-load', action='store_true',
+                        help='run the stepped-offered-load elasticity '
+                             'arm instead of the replica-scaling arms: '
+                             'low -> high -> low against one process '
+                             'replica with the autoscaler live; '
+                             'reports scale-up/scale-down latency and '
+                             'transition p99 (SERVING.md "Elastic '
+                             'fleet")')
     parser.add_argument('--zipf-alpha', type=float, default=0.0,
                         help='run the memoization-tier comparison '
                              'instead of the replica-scaling arms: '
@@ -458,7 +709,11 @@ def main() -> None:
     config = Config(
         TRAIN_DATA_PATH_PREFIX=prefix, DL_FRAMEWORK='jax',
         VERBOSE_MODE=0, READER_USE_NATIVE=False,
-        MAX_CONTEXTS=args.contexts, SERVING_BATCH_BUCKETS=args.buckets)
+        MAX_CONTEXTS=args.contexts, SERVING_BATCH_BUCKETS=args.buckets,
+        # the stepped arm scales PROCESS replicas: workers restore
+        # params from the checkpoint store
+        MODEL_SAVE_PATH=(os.path.join(workdir, 'model')
+                         if args.stepped_load else ''))
     model = Code2VecModel(config)
     index = _MiniIndex(config.CODE_VECTOR_SIZE)
 
@@ -477,6 +732,19 @@ def main() -> None:
     cal_profile = make_profile(lines, 192 if smoke else 512,
                                args.max_request_lines, seed=11)
     capacity = measure_capacity(model, index, cal_profile)
+
+    if args.stepped_load:
+        # ---- elasticity arm (stage mesh_stepped) ----
+        model.save(state=model.state, epoch=0, wait=True)
+        emit({'metric': 'mesh_capacity_rows_per_sec_1r',
+              'value': round(capacity, 1)})
+        for record in run_stepped_arm(model, lines, capacity,
+                                      args.max_request_lines,
+                                      args.secs, compiles):
+            emit(record)
+        emit({'metric': 'mesh_peak_hbm_bytes',
+              **benchlib.device_memory_record()})
+        return
 
     if args.zipf_alpha > 0:
         # ---- memoization-tier comparison (stage mesh_memo) ----

@@ -144,6 +144,12 @@ probe_or_record "after mesh" || exit 3
 # zero-postwarm-compile check with the cache in front of the fleet
 run_stage mesh_memo 900 python benchmarks/bench_mesh.py --zipf-alpha 1.1
 probe_or_record "after mesh_memo" || exit 3
+# elastic fleet (ISSUE 18): stepped offered load (low -> high -> low)
+# against one process replica with the SLO/queue-driven autoscaler
+# live — scale-up latency (decision + worker cold start), scale-down
+# drain latency, and transition-vs-steady p99
+run_stage mesh_stepped 900 python benchmarks/bench_mesh.py --stepped-load
+probe_or_record "after mesh_stepped" || exit 3
 # mesh chaos soak (ISSUE 14): paced load + periodic kill_worker/
 # drop_heartbeat faults against socket-mode workers — zero lost
 # admitted requests, zero post-warmup parent compiles, bounded p99

@@ -101,6 +101,19 @@ def main() -> None:
                                # memo arm + Zipf shape, with the
                                # device-work-saved column the tier is
                                # judged on
+                               # elastic-fleet transitions (ISSUE 18):
+                               # scale-up/scale-down latency and the
+                               # transition-vs-steady p99 from the
+                               # stepped-load arm, plus the soak's
+                               # elastic drill columns
+                               'steady_p99_ms', 'up_p99_ms',
+                               'down_p99_ms', 'scale_up_total',
+                               'scale_down_total',
+                               'reached_2_replicas',
+                               'drained_to_1_replica',
+                               'flap_freezes_total', 'retired_reason',
+                               'rid',
+                               'process_capacity_rows_per_sec_1r',
                                'memo', 'zipf_alpha', 'hit_rate',
                                'cache_p99_ms', 'live_p99_ms',
                                'semantic_hits', 'semantic_agreement',

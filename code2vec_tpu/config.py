@@ -470,8 +470,61 @@ class Config:
     MESH_RESTART_BACKOFF_SECS: float = 0.5
     # Bind address of the socket-mode mesh listener. 127.0.0.1 keeps
     # spawned-local workers loopback-only; a routable address lets
-    # workers on other machines dial in.
+    # workers on other machines dial in (scripts/mesh_worker.py dials
+    # it and the mesh ADOPTS the dial-in — SERVING.md "Elastic fleet").
     MESH_SOCKET_HOST: str = '127.0.0.1'
+    # ---- elastic fleet (SERVING.md "Elastic fleet") ----
+    # Per-replica device placement: partition jax.devices() into
+    # disjoint slices of this many devices, one slice per replica, so
+    # N replicas on one host stop time-sharing the same chips. Each
+    # worker builds its own sub-mesh over its slice — the warm ladder,
+    # the ragged kernel's shard_map, and the memory ledger all follow
+    # the slice geometry. Must be a multiple of MESH_MODEL_AXIS_SIZE.
+    # 0 (default) = off: every replica sees the full device set.
+    # Worker modes only ('process'/'socket'): thread replicas share
+    # the trainer's programs, which are compiled over the parent mesh.
+    MESH_DEVICES_PER_REPLICA: int = 0
+    # Internal plumbing for placement: comma-separated indices into
+    # jax.devices() this process's mesh is built over (create_mesh).
+    # The ServingMesh sets it in per-worker config overrides to pin a
+    # worker onto its slice; scripts/mesh_worker.py exposes it as
+    # --device-indices for orchestrator-spawned workers. '' = all.
+    MESH_DEVICE_INDICES: str = ''
+    # ---- SLO-driven autoscaler (serving/autoscaler.py, SERVING.md) ----
+    # Fleet-size bounds for the autoscaler control loop. MAX 0
+    # (default) keeps the autoscaler OFF — the fleet stays the shape
+    # it was built with. MAX > 0 arms the loop: scale-up spawns (or
+    # requests via hook) up to MAX, scale-down drains via retire()
+    # (never a kill) down to MIN.
+    AUTOSCALE_MIN_REPLICAS: int = 1
+    AUTOSCALE_MAX_REPLICAS: int = 0
+    # Control-loop evaluation period in seconds.
+    AUTOSCALE_INTERVAL_SECS: float = 5.0
+    # Scale-UP trigger: the front queue's drain estimate (queued rows
+    # over the fleet's observed service rate) exceeding this many
+    # seconds means the current fleet cannot absorb the backlog.
+    AUTOSCALE_UP_QUEUE_SECS: float = 2.0
+    # Optional second scale-UP trigger: SLO error-budget burn rate
+    # (serving/slo.py) above this on BOTH the fast and slow windows.
+    # 0 disables the burn leg (queue-drain only).
+    AUTOSCALE_UP_BURN: float = 0.0
+    # Scale-DOWN trigger: the fleet must look over-provisioned for
+    # this many CONSECUTIVE seconds — the drain estimate recomputed
+    # with one fewer replica stays under AUTOSCALE_DOWN_UTILIZATION x
+    # AUTOSCALE_UP_QUEUE_SECS and no SLO burn alert is pending.
+    AUTOSCALE_DOWN_IDLE_SECS: float = 30.0
+    AUTOSCALE_DOWN_UTILIZATION: float = 0.5
+    # Per-direction cooldowns: seconds after a scale-up (resp. -down)
+    # before the NEXT transition in either direction is considered —
+    # a new replica needs its warmup before the signals mean anything.
+    AUTOSCALE_UP_COOLDOWN_SECS: float = 10.0
+    AUTOSCALE_DOWN_COOLDOWN_SECS: float = 60.0
+    # Flap guard: more than AUTOSCALE_FLAP_LIMIT direction REVERSALS
+    # inside AUTOSCALE_FLAP_WINDOW_SECS freezes the autoscaler (no
+    # transitions, autoscale/flap_freezes_total increments) until the
+    # window drains — oscillating demand must not thrash the fleet.
+    AUTOSCALE_FLAP_WINDOW_SECS: float = 120.0
+    AUTOSCALE_FLAP_LIMIT: int = 2
     # ---- fleet observability (OBSERVABILITY.md "Fleet observability") ----
     # Worker telemetry backhaul: -1 = auto (workers enable telemetry
     # iff the parent process had it enabled at spawn, so the fleet
@@ -1356,6 +1409,51 @@ class Config:
         if self.MESH_TELEMETRY_BACKHAUL not in (-1, 0, 1):
             raise ValueError('config.MESH_TELEMETRY_BACKHAUL must be '
                              '-1 (auto), 0 (off) or 1 (on).')
+        if self.MESH_DEVICES_PER_REPLICA < 0:
+            raise ValueError('config.MESH_DEVICES_PER_REPLICA must be '
+                             '>= 0 (0 = replicas share the full '
+                             'device set).')
+        if self.MESH_DEVICES_PER_REPLICA > 0 and \
+                self.MESH_DEVICES_PER_REPLICA % max(
+                    1, self.MESH_MODEL_AXIS_SIZE) != 0:
+            raise ValueError('config.MESH_DEVICES_PER_REPLICA must be a '
+                             'multiple of MESH_MODEL_AXIS_SIZE (each '
+                             'slice builds its own (data, model) '
+                             'sub-mesh).')
+        if self.AUTOSCALE_MIN_REPLICAS < 1:
+            raise ValueError('config.AUTOSCALE_MIN_REPLICAS must be '
+                             '>= 1.')
+        if self.AUTOSCALE_MAX_REPLICAS < 0:
+            raise ValueError('config.AUTOSCALE_MAX_REPLICAS must be >= 0 '
+                             '(0 keeps the autoscaler off).')
+        if self.AUTOSCALE_MAX_REPLICAS > 0 and \
+                self.AUTOSCALE_MAX_REPLICAS < self.AUTOSCALE_MIN_REPLICAS:
+            raise ValueError('config.AUTOSCALE_MAX_REPLICAS must be >= '
+                             'AUTOSCALE_MIN_REPLICAS when armed.')
+        if self.AUTOSCALE_INTERVAL_SECS <= 0:
+            raise ValueError('config.AUTOSCALE_INTERVAL_SECS must be '
+                             '> 0.')
+        if self.AUTOSCALE_UP_QUEUE_SECS <= 0:
+            raise ValueError('config.AUTOSCALE_UP_QUEUE_SECS must be '
+                             '> 0.')
+        if self.AUTOSCALE_UP_BURN < 0:
+            raise ValueError('config.AUTOSCALE_UP_BURN must be >= 0 '
+                             '(0 disables the burn leg).')
+        if self.AUTOSCALE_DOWN_IDLE_SECS < 0:
+            raise ValueError('config.AUTOSCALE_DOWN_IDLE_SECS must be '
+                             '>= 0.')
+        if not 0.0 < self.AUTOSCALE_DOWN_UTILIZATION <= 1.0:
+            raise ValueError('config.AUTOSCALE_DOWN_UTILIZATION must be '
+                             'in (0, 1].')
+        if self.AUTOSCALE_UP_COOLDOWN_SECS < 0 or \
+                self.AUTOSCALE_DOWN_COOLDOWN_SECS < 0:
+            raise ValueError('config.AUTOSCALE_*_COOLDOWN_SECS must be '
+                             '>= 0.')
+        if self.AUTOSCALE_FLAP_WINDOW_SECS <= 0:
+            raise ValueError('config.AUTOSCALE_FLAP_WINDOW_SECS must be '
+                             '> 0.')
+        if self.AUTOSCALE_FLAP_LIMIT < 1:
+            raise ValueError('config.AUTOSCALE_FLAP_LIMIT must be >= 1.')
         if not 0.0 <= self.SERVING_SLO_AVAILABILITY < 1.0:
             raise ValueError('config.SERVING_SLO_AVAILABILITY must be '
                              'in [0, 1) (0 disables; 1.0 would leave '

@@ -37,7 +37,17 @@ MODEL_AXIS = 'model'
 def create_mesh(config: Optional[Config] = None,
                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the (data, model) mesh. ``MESH_DATA_AXIS_SIZE == -1`` means
-    'all devices not used by the model axis'."""
+    'all devices not used by the model axis'.
+
+    ``MESH_DEVICE_INDICES`` (comma-separated indices into
+    ``jax.devices()``) restricts the mesh to a device SLICE — how a
+    placement-pinned serving-mesh worker builds its sub-mesh over the
+    chips its slice owns instead of time-sharing the host's full set
+    (SERVING.md "Elastic fleet"). An explicit ``devices`` argument wins
+    over the config knob."""
+    if devices is None and config is not None and \
+            getattr(config, 'MESH_DEVICE_INDICES', ''):
+        devices = device_slice(config.MESH_DEVICE_INDICES)
     devices = list(devices if devices is not None else jax.devices())
     model_size = config.MESH_MODEL_AXIS_SIZE if config else 1
     data_size = config.MESH_DATA_AXIS_SIZE if config else -1
@@ -51,6 +61,48 @@ def create_mesh(config: Optional[Config] = None,
                 data_size, model_size, len(devices)))
     device_grid = np.asarray(devices).reshape(data_size, model_size)
     return Mesh(device_grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def device_slice(indices: str) -> list:
+    """Resolve a comma-separated index spec ('0,1,2') against
+    ``jax.devices()``; raises on malformed, duplicate, or out-of-range
+    indices so a misplaced worker fails its handshake typed instead of
+    silently building a mesh over the wrong chips."""
+    try:
+        idx = [int(tok) for tok in indices.split(',') if tok.strip()]
+    except ValueError:
+        raise ValueError(
+            'MESH_DEVICE_INDICES must be comma-separated integers, got '
+            '{!r}.'.format(indices))
+    if not idx:
+        raise ValueError('MESH_DEVICE_INDICES resolved to an empty '
+                         'device slice: {!r}.'.format(indices))
+    if len(set(idx)) != len(idx):
+        raise ValueError('MESH_DEVICE_INDICES has duplicate indices: '
+                         '{!r}.'.format(indices))
+    all_devices = jax.devices()
+    bad = [i for i in idx if i < 0 or i >= len(all_devices)]
+    if bad:
+        raise ValueError(
+            'MESH_DEVICE_INDICES {!r} out of range for {} visible '
+            'devices.'.format(bad, len(all_devices)))
+    return [all_devices[i] for i in idx]
+
+
+def partition_device_indices(n_slices: int, per_slice: int) -> list:
+    """Partition ``jax.devices()`` index space into ``n_slices``
+    DISJOINT contiguous slices of ``per_slice`` devices each — the
+    serving mesh's placement table (one slice per replica). Raises when
+    the host doesn't have enough devices; contiguity keeps a slice's
+    chips ICI-adjacent under the usual host enumeration order."""
+    total = len(jax.devices())
+    if n_slices * per_slice > total:
+        raise ValueError(
+            'Placement wants {} slices x {} devices but only {} are '
+            'visible (MESH_DEVICES_PER_REPLICA too big for the '
+            'replica count).'.format(n_slices, per_slice, total))
+    return [list(range(s * per_slice, (s + 1) * per_slice))
+            for s in range(n_slices)]
 
 
 def param_specs() -> Code2VecParams:

@@ -109,6 +109,19 @@ FAULT_POINTS: Dict[str, str] = {
                  'network partitioned — results AND heartbeats vanish '
                  'while both endpoints stay up (exercises liveness '
                  'detection and redispatch of the blackholed batch).',
+    'spawn_fail': 'serving/mesh.py _spawn_worker: the triggering spawn '
+                  'attempt raises before the worker process starts — '
+                  'the shape of an exec/resource failure on the host '
+                  '(exercises restart-budget accounting and autoscaler '
+                  'scale-up failure handling: a failed scale-up must '
+                  'not wedge the control loop or leak a slot).',
+    'adopt_stall': 'serving/mesh.py worker startup (also reached by '
+                   'scripts/mesh_worker.py): the triggering worker '
+                   'dials in but stalls ADOPT_STALL_SECONDS before '
+                   'sending its ready frame — the shape of an adopted '
+                   'worker wedging mid-cold-start (exercises the '
+                   'adoption timeout: the dial-in is dropped typed '
+                   'instead of wedging the adoption loop).',
 }
 
 #: how long a fired ``hang_input`` blocks.  Long enough that only a
@@ -121,6 +134,12 @@ HANG_SECONDS = 600.0
 #: queue bound, short enough that a windowed drill stays inside test
 #: budgets.
 SLOW_DISPATCH_SECONDS = 0.25
+
+#: how long a fired ``adopt_stall`` delays a worker's ready frame.
+#: Longer than the adoption loop's ready timeout in the drills (which
+#: pin it down via config), short enough that the stalled worker
+#: process unwinds inside a test budget.
+ADOPT_STALL_SECONDS = 20.0
 
 #: how long a fired ``slow_step`` stalls one hot-loop train step.
 #: Far past any smoke-model step's median + GOODPUT_ANOMALY_SIGMA

@@ -1,0 +1,389 @@
+"""SLO-driven autoscaler for the serving mesh (SERVING.md "Elastic
+fleet").
+
+PR 14 made replica death a non-event and PR 15 made the fleet
+observable, but replica COUNT was still fixed at build time: a diurnal
+load swing either burns the SLO budget (fleet too small) or wastes
+chips (fleet too big).  This module closes the control loop — the
+elastic-scaling leg of the Ads-serving stack (PAPERS.md, arXiv
+2501.10546): replicas behind one queue, scaled against an explicit
+error budget.
+
+**Signals.**  Two scale-UP triggers, evaluated every
+``AUTOSCALE_INTERVAL_SECS``:
+
+- the front queue's drain estimate (``FrontQueue.drain_seconds``:
+  admitted rows / fleet service rate) exceeds
+  ``AUTOSCALE_UP_QUEUE_SECS`` — backlog is outrunning the fleet; a
+  stalled fleet with backlog (rate 0) reads as infinite drain;
+- the ``SloMonitor`` burn rate (``AUTOSCALE_UP_BURN`` > 0 arms this
+  leg): BOTH burn windows of any active SLO above the threshold means
+  the error budget is burning — add capacity even if the queue still
+  looks shallow (slow replicas, not deep queues, burn p99).
+
+Scale-DOWN is deliberately timid: the fleet must look over-provisioned
+CONTINUOUSLY for ``AUTOSCALE_DOWN_IDLE_SECS`` — the drain estimate
+with one FEWER replica still under ``AUTOSCALE_DOWN_UTILIZATION x
+AUTOSCALE_UP_QUEUE_SECS`` and no SLO burning — before one replica is
+drained out.
+
+**Actions.**  Scale-up spawns a local replica (``mesh.add_replica()``
+— its own device slice under placement, re-adopted onto the fleet's
+current params step) or, with a ``spawn`` hook installed, asks the
+ORCHESTRATOR for capacity instead (the hook fires; the new worker
+arrives later as an adoption dial-in).  Scale-down is a coordinated
+``mesh.retire(rid, reason='autoscale')`` — a drain, NEVER a kill:
+in-flight batches deliver, the queue redirects, zero admitted requests
+are lost across the transition.  Adopted (orchestrator-owned) and
+canarying replicas are never chosen as drain victims.
+
+**Guard rails.**  ``AUTOSCALE_MIN_REPLICAS`` / ``AUTOSCALE_MAX_REPLICAS``
+bound the fleet; per-direction cooldowns (``AUTOSCALE_UP_COOLDOWN_SECS``
+/ ``AUTOSCALE_DOWN_COOLDOWN_SECS``) stop a single signal from storming;
+and a flap guard freezes ALL scaling for ``AUTOSCALE_FLAP_WINDOW_SECS``
+once direction reversals in that window reach ``AUTOSCALE_FLAP_LIMIT``
+(an oscillating loop is a mis-tuned loop — freezing and counting
+``autoscale/flap_freezes_total`` beats thrashing warm ladders).
+
+Every transition is traced (``autoscale.transition``), metered
+(``autoscale/*``), and logged with its signal values, so a post-mortem
+can replay WHY the fleet changed shape.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.telemetry.core import Counter, Gauge
+
+
+class Autoscaler:
+    """The mesh's scaling control loop: one ticker thread reading the
+    queue drain estimate + SLO burns, deciding up/down under bounds,
+    cooldowns, and the flap guard.  Built and owned by ``ServingMesh``
+    when ``AUTOSCALE_MAX_REPLICAS > 0``; ``tick()`` is public so
+    drills can step the loop without waiting out the interval."""
+
+    # the ticker mutates, stats()/close() read from other threads
+    # (lock-discipline rule, ANALYSIS.md):
+    # graftlint: guard Autoscaler._transitions,_last_up,_last_down,_idle_since,_frozen_until,_last_decision,_closed by _lock
+    def __init__(self, mesh, config, spawn=None, tracer=None, log=None):
+        self.mesh = mesh
+        self.min_replicas = max(1, int(config.AUTOSCALE_MIN_REPLICAS))
+        self.max_replicas = int(config.AUTOSCALE_MAX_REPLICAS)
+        self.interval_s = float(config.AUTOSCALE_INTERVAL_SECS)
+        self.up_queue_s = float(config.AUTOSCALE_UP_QUEUE_SECS)
+        self.up_burn = float(config.AUTOSCALE_UP_BURN)
+        self.down_idle_s = float(config.AUTOSCALE_DOWN_IDLE_SECS)
+        self.down_utilization = float(config.AUTOSCALE_DOWN_UTILIZATION)
+        self.up_cooldown_s = float(config.AUTOSCALE_UP_COOLDOWN_SECS)
+        self.down_cooldown_s = float(
+            config.AUTOSCALE_DOWN_COOLDOWN_SECS)
+        self.flap_window_s = float(config.AUTOSCALE_FLAP_WINDOW_SECS)
+        self.flap_limit = max(1, int(config.AUTOSCALE_FLAP_LIMIT))
+        #: orchestrator hook: scale-up REQUESTS capacity instead of
+        #: spawning locally (the worker arrives as an adoption dial-in)
+        self.spawn = spawn
+        self.tracer = tracer
+        self.log = log if log is not None else (lambda msg: None)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        #: (t_mono, direction) of recent transitions — the flap guard's
+        #: reversal window
+        self._transitions: collections.deque = collections.deque()
+        self._last_up = -float('inf')
+        self._last_down = -float('inf')
+        #: when the sustained-low-pressure clock started (None = the
+        #: fleet is not currently over-provisioned)
+        self._idle_since: Optional[float] = None
+        self._frozen_until = 0.0
+        self._last_decision = 'hold'
+        self.scale_up_total = Counter('autoscale/scale_up_total')
+        self.scale_down_total = Counter('autoscale/scale_down_total')
+        self.scale_up_failed_total = Counter(
+            'autoscale/scale_up_failed_total')
+        self.flap_freezes_total = Counter('autoscale/flap_freezes_total')
+        self.target_gauge = Gauge('autoscale/replicas_target')
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> 'Autoscaler':
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            daemon=True,
+                                            name='mesh-autoscale')
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=180.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as exc:  # the loop must survive blips
+                self.log('autoscale: tick failed: %r' % exc)
+
+    # -------------------------------------------------------- signals
+    def _fleet_size(self) -> int:
+        """Serving replicas (not retired, not dead) — what a scale
+        decision is sized against."""
+        mesh = self.mesh
+        with mesh._lock:
+            return sum(1 for s in mesh._replicas
+                       if not s.retired and not s.dead)
+
+    def _burning(self) -> bool:
+        """True when any active SLO burns over the scale-up threshold
+        on BOTH windows (the multiwindow rule — a blip never scales)."""
+        slo = self.mesh._slo
+        if slo is None or self.up_burn <= 0:
+            return False
+        return any(fast > self.up_burn and slow > self.up_burn
+                   for fast, slow in slo.burns().values())
+
+    def _over_budget(self) -> bool:
+        """Any active SLO burning its budget faster than allowed
+        (fast burn > 1): scale-DOWN is vetoed while true."""
+        slo = self.mesh._slo
+        if slo is None:
+            return False
+        return any(fast > 1.0 for fast, _slow in slo.burns().values())
+
+    # ------------------------------------------------------- decision
+    def tick(self) -> str:
+        """One control-loop evaluation; returns the decision
+        ('up' | 'down' | 'hold' | 'frozen') for drills to assert on."""
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return 'hold'
+            frozen = now < self._frozen_until
+        if frozen:
+            self._note_decision('frozen')
+            return 'frozen'
+        n = self._fleet_size()
+        drain_s, rows, rate = self.mesh._queue.drain_seconds()
+        burning = self._burning()
+        if n < self.min_replicas or \
+                ((drain_s > self.up_queue_s or burning)
+                 and n < self.max_replicas):
+            with self._lock:
+                in_cooldown = now - self._last_up < self.up_cooldown_s
+            if not in_cooldown:
+                self._scale_up(n, drain_s, rows, rate, burning, now)
+                return 'up'
+            self._note_decision('hold')
+            return 'hold'
+        # ---- scale-down leg: sustained low pressure only ----
+        down_ok = False
+        if n > self.min_replicas and not burning \
+                and not self._over_budget():
+            # would the fleet MINUS one replica still be comfortable?
+            # per-replica rate = rate/n; with rows and n-1 replicas the
+            # projected drain must sit under the utilization floor
+            if rows <= 0:
+                projected = 0.0
+            elif rate <= 0:
+                projected = float('inf')
+            else:
+                projected = rows / (rate * (n - 1) / n)
+            down_ok = (projected
+                       < self.down_utilization * self.up_queue_s)
+        with self._lock:
+            if not down_ok:
+                self._idle_since = None
+                self._last_decision = 'hold'
+                return 'hold'
+            if self._idle_since is None:
+                self._idle_since = now
+            sustained = now - self._idle_since >= self.down_idle_s
+            in_cooldown = now - self._last_down < self.down_cooldown_s
+        if sustained and not in_cooldown:
+            if self._scale_down(n, drain_s, rows, rate, now):
+                return 'down'
+        self._note_decision('hold')
+        return 'hold'
+
+    def _note_decision(self, decision: str) -> None:
+        with self._lock:
+            self._last_decision = decision
+
+    def _note_transition(self, direction: str, now: float) -> bool:
+        """Record a transition; returns False (and freezes) when the
+        reversal count inside the flap window hits the limit."""
+        with self._lock:
+            horizon = now - self.flap_window_s
+            while self._transitions and \
+                    self._transitions[0][0] < horizon:
+                self._transitions.popleft()
+            reversals = sum(
+                1 for (_, a), (_, b) in zip(self._transitions,
+                                            list(self._transitions)[1:])
+                if a != b)
+            if self._transitions and \
+                    self._transitions[-1][1] != direction:
+                reversals += 1
+            if reversals >= self.flap_limit:
+                self._frozen_until = now + self.flap_window_s
+                self._last_decision = 'frozen'
+                frozen_for = self.flap_window_s
+            else:
+                self._transitions.append((now, direction))
+                return True
+        self.flap_freezes_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'autoscale/flap_freezes_total').inc()
+        self.log('autoscale: FLAP GUARD — %d direction reversals '
+                 'inside %.0fs (limit %d); freezing all scaling for '
+                 '%.0fs (re-tune the thresholds instead of thrashing '
+                 'warm ladders)'
+                 % (self.flap_limit, self.flap_window_s,
+                    self.flap_limit, frozen_for))
+        return False
+
+    # -------------------------------------------------------- actions
+    def _trace(self, direction: str, attrs: Dict[str, object]):
+        if self.tracer is None:
+            return None
+        attrs = dict(attrs)
+        attrs['direction'] = direction
+        return self.tracer.begin('autoscale.transition', attrs=attrs)
+
+    def _set_target(self, target: int) -> None:
+        self.target_gauge.set(target)
+        if tele_core.enabled():
+            tele_core.registry().gauge(
+                'autoscale/replicas_target').set(target)
+
+    def _scale_up(self, n: int, drain_s: float, rows: int,
+                  rate: float, burning: bool, now: float) -> None:
+        if not self._note_transition('up', now):
+            return
+        with self._lock:
+            self._last_up = now
+            self._idle_since = None
+            self._last_decision = 'up'
+        reason = ('slo_burn' if burning and drain_s <= self.up_queue_s
+                  else 'queue_drain' if not burning
+                  else 'queue_drain+slo_burn')
+        self._set_target(n + 1)
+        trace = self._trace('up', {
+            'from': n, 'to': n + 1, 'reason': reason,
+            'drain_s': None if drain_s == float('inf') else drain_s,
+            'queue_rows': rows, 'fleet_rows_per_s': rate})
+        self.log('autoscale: scaling UP %d -> %d (%s: drain %.1fs vs '
+                 '%.1fs, %d rows queued, fleet %.0f rows/s%s)'
+                 % (n, n + 1, reason,
+                    drain_s if drain_s != float('inf') else -1.0,
+                    self.up_queue_s, rows, rate,
+                    ', slo burning' if burning else ''))
+        try:
+            if self.spawn is not None:
+                # orchestrator-owned capacity: the hook requests a
+                # worker; it arrives later as an adoption dial-in
+                self.spawn(self.mesh)
+            else:
+                self.mesh.add_replica()
+        except BaseException as exc:
+            self.scale_up_failed_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'autoscale/scale_up_failed_total').inc()
+            self.log('autoscale: scale-up FAILED (%r); cooldown '
+                     'applies before the next attempt' % exc)
+            if trace is not None:
+                trace.finish(status='error', reason=repr(exc))
+            return
+        self.scale_up_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'autoscale/scale_up_total').inc()
+        if trace is not None:
+            trace.finish(status='ok')
+
+    def _pick_victim(self) -> Optional[str]:
+        """NEWEST eligible replica drains first (LIFO keeps the
+        longest-warm ladders serving).  Never an adopted worker (the
+        orchestrator owns its lifecycle), never the canary (a rollover
+        in flight must conclude), never an already-dead slot (the
+        supervisor owns it)."""
+        mesh = self.mesh
+        with mesh._lock:
+            for slot in reversed(mesh._replicas):
+                if slot.retired or slot.dead or slot.canarying \
+                        or slot.adopted:
+                    continue
+                return slot.rid
+        return None
+
+    def _scale_down(self, n: int, drain_s: float, rows: int,
+                    rate: float, now: float) -> bool:
+        victim = self._pick_victim()
+        if victim is None:
+            self._note_decision('hold')
+            return False
+        if not self._note_transition('down', now):
+            return False
+        with self._lock:
+            self._last_down = now
+            self._idle_since = None
+            self._last_decision = 'down'
+        self._set_target(n - 1)
+        trace = self._trace('down', {
+            'from': n, 'to': n - 1, 'replica': victim,
+            'drain_s': None if drain_s == float('inf') else drain_s,
+            'queue_rows': rows, 'fleet_rows_per_s': rate})
+        self.log('autoscale: scaling DOWN %d -> %d — draining replica '
+                 '%s (drain %.2fs, %d rows queued, fleet %.0f rows/s; '
+                 'sustained %.0fs under the utilization floor)'
+                 % (n, n - 1, victim,
+                    drain_s if drain_s != float('inf') else -1.0,
+                    rows, rate, self.down_idle_s))
+        try:
+            # a DRAIN, never a kill: in-flight batches deliver and the
+            # queue redirects before the engine closes
+            self.mesh.retire(victim, reason='autoscale')
+        except BaseException as exc:
+            self.log('autoscale: scale-down of %s failed (%r)'
+                     % (victim, exc))
+            if trace is not None:
+                trace.finish(status='error', reason=repr(exc))
+            return False
+        self.scale_down_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'autoscale/scale_down_total').inc()
+        if trace is not None:
+            trace.finish(status='ok')
+        return True
+
+    # --------------------------------------------------------- report
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            frozen_for = max(0.0, self._frozen_until - time.monotonic())
+            decision = self._last_decision
+            transitions = len(self._transitions)
+        return {
+            'min_replicas': self.min_replicas,
+            'max_replicas': self.max_replicas,
+            'scale_up_total': self.scale_up_total.snapshot(),
+            'scale_down_total': self.scale_down_total.snapshot(),
+            'scale_up_failed_total':
+                self.scale_up_failed_total.snapshot(),
+            'flap_freezes_total': self.flap_freezes_total.snapshot(),
+            'replicas_target': self.target_gauge.snapshot(),
+            'last_decision': decision,
+            'recent_transitions': transitions,
+            'frozen_for_s': frozen_for,
+        }

@@ -52,6 +52,17 @@ class ReplicaDead(ServingError):
     restart) serves them."""
 
 
+class AdoptionRejected(ServingError):
+    """An externally-spawned worker dialed the mesh listener but failed
+    adoption validation — wire-proto / batch-wire-format mismatch, a
+    warm-tier ladder that does not cover the fleet's, a duplicate
+    replica id, or a ready frame that never arrived within the adoption
+    timeout.  The dial-in is answered with a typed ``adopt_rejected``
+    frame and closed; the orchestrator that spawned the worker owns the
+    retry (restart supervision for external workers is explicitly NOT
+    the mesh's job — SERVING.md "Elastic fleet")."""
+
+
 class WireError(ServingError):
     """A mesh transport frame failed validation — bad magic, truncated
     body, or CRC mismatch (the on-wire shape of a worker dying mid-

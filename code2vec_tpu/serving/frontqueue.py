@@ -295,6 +295,23 @@ class FrontQueue:
         with self._lock:
             return sum(self._pending_rows.values())
 
+    def drain_seconds(self) -> Tuple[float, int, float]:
+        """Estimated seconds to drain everything ADMITTED (queued +
+        reserved rows) at the current fleet service rate — the
+        autoscaler's queue-pressure signal (serving/autoscaler.py).
+        Returns ``(drain_s, rows, rate)``; a zero rate with rows
+        admitted reads as ``inf`` — a stalled fleet with backlog is
+        maximal pressure, not zero."""
+        with self._lock:
+            rows = (sum(self._pending_rows.values())
+                    + self._reserved_rows)
+        rate = self._fleet_rate()
+        if rows <= 0:
+            return 0.0, 0, rate
+        if rate <= 0:
+            return float('inf'), rows, rate
+        return rows / rate, rows, rate
+
     def peak_rows(self) -> int:
         with self._lock:
             return self._peak_rows

@@ -120,7 +120,8 @@ from code2vec_tpu.serving import slo as slo_lib
 from code2vec_tpu.serving import transport as transport_lib
 from code2vec_tpu.serving.engine import (ServingEngine, _Request,
                                          _resolve)
-from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+from code2vec_tpu.serving.errors import (AdoptionRejected,
+                                         DeadlineExceeded, EngineClosed,
                                          EngineOverloaded, ReplicaDead,
                                          WireError)
 from code2vec_tpu.serving.frontqueue import FrontQueue
@@ -150,16 +151,31 @@ class _ReplicaSlot:
     safe redispatch excludes) or retires it permanently once the
     window-scoped restart budget is spent."""
 
-    __slots__ = ('rid', 'transport', 'thread', 'retired', 'inflight',
-                 'rows_dispatched', 'batches', 'breaker_fails',
-                 'breaker_state', 'breaker_open_until', 'canarying',
-                 'dead', 'restarting', 'restart_times', 'restarts')
+    __slots__ = ('rid', 'transport', 'thread', 'retired',
+                 'retired_reason', 'adopted', 'device_indices',
+                 'inflight', 'rows_dispatched', 'batches',
+                 'breaker_fails', 'breaker_state', 'breaker_open_until',
+                 'canarying', 'dead', 'restarting', 'restart_times',
+                 'restarts')
 
     def __init__(self, rid: str, transport):
         self.rid = rid
         self.transport = transport
         self.thread: Optional[threading.Thread] = None
         self.retired = False
+        #: why this slot retired ('restart_budget' | 'drain' |
+        #: 'autoscale' | 'adopted_worker_exit'): an autoscaler
+        #: post-mortem must tell budget-retire from drain
+        self.retired_reason: Optional[str] = None
+        #: externally-spawned worker the mesh adopted: its restart
+        #: supervision belongs to the ORCHESTRATOR that spawned it —
+        #: its death retires the slot instead of charging the local
+        #: restart budget (SERVING.md "Elastic fleet")
+        self.adopted = False
+        #: this replica's device slice (indices into jax.devices())
+        #: under MESH_DEVICES_PER_REPLICA placement; None when
+        #: placement is off (every replica time-shares the host)
+        self.device_indices: Optional[List[int]] = None
         self.inflight = 0
         self.rows_dispatched = 0
         self.batches = 0
@@ -237,7 +253,8 @@ class _WorkerReplica:
                  on_batch_done, log, on_worker_dead=None,
                  on_telemetry=None, on_spans=None,
                  listener: Optional[transport_lib.SocketListener] = None,
-                 start_timeout_s: float = 600.0):
+                 start_timeout_s: float = 600.0,
+                 channel: Optional[object] = None):
         import multiprocessing
         self.rid = rid
         self.mode = mode
@@ -273,7 +290,17 @@ class _WorkerReplica:
         #: the ready handshake's {'params_step', 'capabilities'}
         self.ready_info: Dict[str, object] = {}
         ctx = multiprocessing.get_context('spawn')
-        if mode == 'socket':
+        if channel is not None:
+            # ADOPTED worker (SERVING.md "Elastic fleet"): an external
+            # orchestrator exec'd scripts/mesh_worker.py against the
+            # mesh listener and this dial-in arrived with an
+            # unexpected rid.  There is no local process to spawn,
+            # join, or supervise — restart supervision for adopted
+            # workers is the orchestrator's job; a later death just
+            # retires the slot.
+            self._proc = None
+            self._channel = channel
+        elif mode == 'socket':
             address = listener.address
             self._channel = None  # claimed from the listener at ready
             self._proc = ctx.Process(
@@ -299,6 +326,14 @@ class _WorkerReplica:
         self._control: Dict[int, Future] = {}
         self._receiver: Optional[threading.Thread] = None
 
+    def _reap_on_start_failure(self) -> None:
+        """Failed-startup cleanup: a SPAWNED worker is reaped (process
+        + channel); an ADOPTED one has no local process and its channel
+        must stay open — the adoption path still owes the dial-in a
+        typed ``adopt_rejected`` frame before the close."""
+        if self._proc is not None:
+            self.reap()
+
     def wait_ready(self) -> None:
         """Block until the worker reported ready, then start the
         receiver.  Must run before the first dispatch/control call.
@@ -317,17 +352,17 @@ class _WorkerReplica:
                     self.rid, self._start_timeout_s, cancel=self._cancel,
                     pid=self._proc.pid)
             except BaseException as exc:
-                self.reap()
+                self._reap_on_start_failure()
                 raise RuntimeError(
                     'mesh replica %s worker never dialed in: %r'
                     % (self.rid, exc))
         while not self._channel.poll(0.25):
             if self._cancel.is_set():
-                self.reap()
+                self._reap_on_start_failure()
                 raise RuntimeError('mesh replica %s startup cancelled '
                                    '(mesh closing)' % self.rid)
             if time.perf_counter() >= deadline:
-                self.reap()
+                self._reap_on_start_failure()
                 raise RuntimeError(
                     'mesh replica %s worker did not come up within %.0fs'
                     % (self.rid, self._start_timeout_s))
@@ -335,18 +370,18 @@ class _WorkerReplica:
             msg = self._channel.recv()
         except (EOFError, OSError, WireError) as exc:
             # worker died before it could even report its failure
-            self.reap()
+            self._reap_on_start_failure()
             raise RuntimeError(
                 'mesh replica %s worker exited during startup (%r) — '
                 'check the worker log; worker replicas need a '
                 'checkpointed model with a retained step'
                 % (self.rid, exc))
         if msg[0] == 'failed':
-            self.reap()
+            self._reap_on_start_failure()
             raise RuntimeError('mesh replica %s worker failed to '
                                'start: %s' % (self.rid, msg[1]))
         if msg[0] != 'ready':
-            self.reap()
+            self._reap_on_start_failure()
             raise RuntimeError('mesh replica %s worker failed to start: '
                                '%r' % (self.rid, msg))
         self.ready_info = msg[1] if len(msg) > 1 and \
@@ -612,7 +647,8 @@ class _WorkerReplica:
         abandoned, without the graceful close handshake."""
         self.kill()
         try:
-            self._proc.join(timeout=30.0)
+            if self._proc is not None:
+                self._proc.join(timeout=30.0)
         except Exception:
             pass
 
@@ -676,9 +712,10 @@ class _WorkerReplica:
         if self._receiver is not threading.current_thread():
             # the worker-dead path closes from the receiver itself
             self._receiver.join(timeout=30.0)
-        self._proc.join(timeout=60.0)
-        if self._proc.is_alive():
-            self._proc.terminate()
+        if self._proc is not None:
+            self._proc.join(timeout=60.0)
+            if self._proc.is_alive():
+                self._proc.terminate()
         if self._channel is not None:
             self._channel.close()
 
@@ -789,13 +826,23 @@ def _replica_worker_main(rid: str, config_overrides: Dict[str, object],
             except BaseException:
                 return  # wire gone: the serve loop is exiting too
 
+    if faults.maybe_fire('adopt_stall'):
+        # the drilled shape of a worker wedging between dial-in and
+        # ready: the mesh's bounded adoption wait (or startup timeout)
+        # must drop it typed instead of hanging the adoption thread
+        time.sleep(faults.ADOPT_STALL_SECONDS)
     engine_stats = engine.stats()
     send(('ready', {
         'params_step': engine_stats.get('params_step'),
         't_mono': time.perf_counter(),
+        # 'devices' is the placement view: under MESH_DEVICE_INDICES
+        # this worker's sub-mesh covers exactly its slice, and the
+        # mesh's stats/assertions read the slice from here
         'capabilities': {'tiers': list(config.serving_warm_tiers),
                          'wire': config.BATCH_WIRE_FORMAT,
-                         'proto': transport_lib.WIRE_PROTO},
+                         'proto': transport_lib.WIRE_PROTO,
+                         'devices': [int(d.id) for d in
+                                     model.mesh.devices.flatten()]},
     }))
     beats = threading.Thread(target=beat_loop, daemon=True,
                              name='mesh-beat-%s' % rid)
@@ -893,7 +940,7 @@ class ServingMesh:
     # decode-completion hooks, the supervisor, the liveness monitor,
     # and control calls (lock-discipline rule, ANALYSIS.md); _cond
     # wraps _lock:
-    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s,_restart_pending by _lock|_cond
+    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s,_restart_pending,_next_rid by _lock|_cond
     def __init__(self, model, replicas: Optional[int] = None,
                  tiers: Optional[Sequence[str]] = None,
                  mode: Optional[str] = None,
@@ -975,7 +1022,39 @@ class ServingMesh:
         # makes admitted results bit-identical to a single engine's)
         self._reader = PathContextReader(model.vocabs, config,
                                          EstimatorAction.Predict)
-        self.data_axis = model.mesh.shape[mesh_lib.DATA_AXIS]
+        # ---- per-replica device placement (SERVING.md "Elastic
+        # fleet") ----  MESH_DEVICES_PER_REPLICA partitions
+        # jax.devices() into disjoint contiguous slices; each worker
+        # builds its own sub-mesh over its slice, so N replicas on one
+        # host stop contending for the same chips.
+        self.devices_per_replica = max(
+            0, int(config.MESH_DEVICES_PER_REPLICA))
+        self._placement: Optional[List[List[int]]] = None
+        if self.devices_per_replica > 0:
+            if self.mode == 'thread':
+                raise ValueError(
+                    'MESH_DEVICES_PER_REPLICA needs a worker mode '
+                    "(MESH_REPLICA_MODE 'process' or 'socket'): thread "
+                    "replicas dispatch through the parent trainer's "
+                    'programs, which are compiled over the FULL parent '
+                    'mesh and cannot be re-placed per replica')
+            # carve enough slices for the autoscaler's ceiling, not
+            # just the build-time fleet: scale-up must never fail on
+            # placement the mesh could have reserved up front
+            n_slices = n
+            if config.AUTOSCALE_MAX_REPLICAS > 0:
+                n_slices = max(n, int(config.AUTOSCALE_MAX_REPLICAS))
+            self._placement = mesh_lib.partition_device_indices(
+                n_slices, self.devices_per_replica)
+        if self._placement is not None:
+            # placement on: the submit-side geometry follows a SLICE's
+            # data axis, not the parent mesh's — a parent-ladder top
+            # bucket wider than the slice ladder's would tokenize
+            # batches no replica has a warm program for
+            self.data_axis = (self.devices_per_replica
+                              // max(1, int(config.MESH_MODEL_AXIS_SIZE)))
+        else:
+            self.data_axis = model.mesh.shape[mesh_lib.DATA_AXIS]
         self.buckets = engine_lib.batch_ladder(
             config.serving_batch_buckets, self.data_axis)
         bound = (queue_bound if queue_bound is not None
@@ -1015,6 +1094,19 @@ class ServingMesh:
         self._liveness_thread: Optional[threading.Thread] = None
         self._listener: Optional[transport_lib.SocketListener] = None
         self._model_config_overrides: Optional[Dict[str, object]] = None
+        # elastic fleet (SERVING.md "Elastic fleet"): scale-up needs
+        # the model handle to build new replicas; adoption needs a
+        # thread watching the listener for dial-ins the mesh never
+        # spawned; rids stay unique across scale-downs and -ups
+        self._model = model
+        self._next_rid = n
+        self._adopt_thread: Optional[threading.Thread] = None
+        #: externally-owned workers' ready wait (dial-in -> ready
+        #: frame): covers the dialed-in worker's cold start — it dials
+        #: FIRST, then builds + warms (scripts/mesh_worker.py).  Drills
+        #: shorten it to exercise adopt_stall.
+        self.adopt_ready_timeout_s = 600.0
+        self._autoscaler = None
         # instruments (mesh-level; per-replica series ride the engines'
         # replica-labeled mirrors)
         self.requests_total = Counter('mesh/requests_total')
@@ -1028,6 +1120,12 @@ class ServingMesh:
         self.live_gauge = Gauge('mesh/replicas_live')
         self.restarts_total = Counter('mesh/restarts_total')
         self.redispatched_total = Counter('mesh/redispatched_total')
+        # elastic-fleet accounting: WHY replicas leave, and how many
+        # external workers the mesh adopted vs turned away
+        self.retired_total = Counter('mesh/retired_total')
+        self.adopted_total = Counter('mesh/adopted_total')
+        self.adoption_rejected_total = Counter(
+            'mesh/adoption_rejected_total')
         self.heartbeat_misses_total = Counter(
             'mesh/heartbeat_misses_total')
         # fleet observability plane (OBSERVABILITY.md "Fleet
@@ -1124,9 +1222,13 @@ class ServingMesh:
                         on_batch_done=self._on_batch_done,
                         log=self.log)
                     transport = _ThreadReplica(engine)
+                    device_indices = None
                 else:
-                    transport = self._spawn_worker(rid)
-                self._replicas.append(_ReplicaSlot(rid, transport))
+                    device_indices = self._allocate_slice_locked()
+                    transport = self._spawn_worker(rid, device_indices)
+                slot = _ReplicaSlot(rid, transport)
+                slot.device_indices = device_indices
+                self._replicas.append(slot)
             for slot in self._replicas:
                 # process workers spawned above cold-start in parallel;
                 # this pass just collects their 'ready' handshakes
@@ -1165,11 +1267,49 @@ class ServingMesh:
                     target=self._liveness_loop, daemon=True,
                     name='mesh-liveness')
                 self._liveness_thread.start()
+        if self.mode == 'socket':
+            # adoption (SERVING.md "Elastic fleet"): dial-ins with a
+            # rid the mesh never spawned are externally-owned workers
+            # asking to join; this thread validates and seats them
+            self._adopt_thread = threading.Thread(
+                target=self._adoption_loop, daemon=True,
+                name='mesh-adopt')
+            self._adopt_thread.start()
+        if config.AUTOSCALE_MAX_REPLICAS > 0:
+            from code2vec_tpu.serving.autoscaler import Autoscaler
+            self._autoscaler = Autoscaler(self, config,
+                                          tracer=self._tracer,
+                                          log=self.log)
+            self._autoscaler.start()
 
-    def _spawn_worker(self, rid: str) -> '_WorkerReplica':
-        """One worker transport (initial fleet build AND supervised
-        restart): the worker cold-starts from the checkpoint store and
-        reports ready over the framed wire."""
+    def _allocate_slice_locked(self) -> Optional[List[int]]:
+        """First free device slice of the placement table (None with
+        placement off).  Slices held by non-retired slots are taken —
+        a retired slot's slice is free for the next scale-up; a
+        restart reuses its own slot's slice without coming here."""
+        if self._placement is None:
+            return None
+        used = {tuple(s.device_indices) for s in self._replicas
+                if s.device_indices is not None and not s.retired}
+        for indices in self._placement:
+            if tuple(indices) not in used:
+                return list(indices)
+        raise RuntimeError(
+            'no free device slice: %d slices of %d device(s) are all '
+            'held by serving replicas (raise AUTOSCALE_MAX_REPLICAS/'
+            'MESH_REPLICAS only as far as the placement table allows)'
+            % (len(self._placement), self.devices_per_replica))
+
+    def _spawn_worker(self, rid: str,
+                      device_indices: Optional[List[int]] = None
+                      ) -> '_WorkerReplica':
+        """One worker transport (initial fleet build, supervised
+        restart AND autoscaler scale-up): the worker cold-starts from
+        the checkpoint store and reports ready over the framed wire."""
+        if faults.maybe_fire('spawn_fail'):
+            raise RuntimeError(
+                'FAULT_INJECT spawn_fail: worker %s spawn refused '
+                'before process start' % rid)
         overrides = dict(self._model_config_overrides)
         if overrides.get('MESH_TELEMETRY_BACKHAUL', -1) == -1:
             # resolve the backhaul AUTO at SPAWN time, not mesh build:
@@ -1178,6 +1318,16 @@ class ServingMesh:
             # fleet merge silently stays partial
             overrides['MESH_TELEMETRY_BACKHAUL'] = (
                 1 if tele_core.enabled() else 0)
+        if device_indices:
+            # placement: the worker builds its sub-mesh over exactly
+            # this slice (parallel/mesh.py create_mesh)
+            overrides['MESH_DEVICE_INDICES'] = ','.join(
+                str(i) for i in device_indices)
+        if self._listener is not None:
+            # register the rid BEFORE the process exists: a dial-in
+            # racing this registration must land in the claim table,
+            # not the adoption queue
+            self._listener.expect(rid)
         return _WorkerReplica(
             rid, self.mode, overrides,
             on_batch_done=self._on_worker_batch_done,
@@ -1239,6 +1389,19 @@ class ServingMesh:
             if dropped:
                 reg.counter(
                     'tracing/remote_spans_dropped_total').inc(dropped)
+
+    def _note_retired(self, reason: str) -> None:
+        """Retirement accounting: the unlabeled total plus a
+        reason-labeled series (mirrors the dispatch_share labeling
+        idiom) — a post-mortem can tell budget-retire from drain from
+        an orchestrator-owned worker exiting."""
+        self.retired_total.inc()
+        if tele_core.enabled():
+            from code2vec_tpu.telemetry import catalog
+            reg = tele_core.registry()
+            reg.counter('mesh/retired_total').inc()
+            reg.counter(catalog.labeled(
+                'mesh/retired_total', 'reason', reason)).inc()
 
     def _on_worker_telemetry(self, transport, snapshot,
                              ledger) -> None:
@@ -1484,26 +1647,47 @@ class ServingMesh:
         it: members are re-admitted ONCE at the front of the shared
         queue with this incarnation excluded and their deadlines
         intact, so the crash costs latency, not answers."""
+        adopted_exit = False
         with self._cond:
             slot = next((s for s in self._replicas
                          if s.transport is transport), None)
             if slot is not None and not slot.retired and not slot.dead:
                 slot.dead = True
                 slot.inflight = 0
+                if slot.adopted:
+                    # restart supervision for an adopted worker belongs
+                    # to the ORCHESTRATOR that spawned it: retire the
+                    # slot instead of charging the LOCAL restart budget
+                    # (a redial lands as a fresh adoption); its
+                    # in-flight batches still redispatch below
+                    slot.retired = True
+                    slot.retired_reason = 'adopted_worker_exit'
+                    adopted_exit = True
                 self._cond.notify_all()  # puller exits, supervisor wakes
         requeued = failed = 0
         for taken, _rows in pending:
             got = self._redispatch_batch(transport, slot, taken, reason)
             requeued += got
             failed += len(taken) - got
+        if adopted_exit:
+            self._note_retired('adopted_worker_exit')
         self._set_serving_gauge_locked_free()
         self._set_live_gauge_locked_free()
         self._queue.kick()
-        self.log('mesh: replica %s worker DEAD (%s): %d request(s) '
-                 'redispatched to the front of the queue, %d failed '
-                 'typed; supervisor will restart it within the budget'
-                 % (slot.rid if slot is not None else '?', reason,
-                    requeued, failed))
+        if adopted_exit:
+            self.log('mesh: ADOPTED replica %s worker exited (%s): %d '
+                     'request(s) redispatched, %d failed typed; its '
+                     'orchestrator owns the restart — the local budget '
+                     'is not charged'
+                     % (slot.rid, reason, requeued, failed))
+            self._fail_queue_if_fleet_empty()
+        else:
+            self.log('mesh: replica %s worker DEAD (%s): %d request(s) '
+                     'redispatched to the front of the queue, %d failed '
+                     'typed; supervisor will restart it within the '
+                     'budget'
+                     % (slot.rid if slot is not None else '?', reason,
+                        requeued, failed))
         try:
             transport.reap()  # the corpse: SIGKILL + join, no handshake
         except Exception:
@@ -1612,6 +1796,7 @@ class ServingMesh:
                         return
                     slot = next((s for s in self._replicas
                                  if s.dead and not s.retired
+                                 and not s.adopted
                                  and not s.restarting), None)
                     if slot is None:
                         self._cond.wait(0.2)
@@ -1621,6 +1806,7 @@ class ServingMesh:
                     slot.restart_times.popleft()
                 if len(slot.restart_times) >= self.restart_limit:
                     slot.retired = True
+                    slot.retired_reason = 'restart_budget'
                     retire = True
                 else:
                     slot.restarting = True
@@ -1633,6 +1819,7 @@ class ServingMesh:
                          'queue serves through the remaining replicas'
                          % (slot.rid, self.restart_limit,
                             self.restart_window_s))
+                self._note_retired('restart_budget')
                 self._set_serving_gauge_locked_free()
                 self._set_live_gauge_locked_free()
                 self._fail_queue_if_fleet_empty()
@@ -1647,7 +1834,11 @@ class ServingMesh:
                      % (slot.rid, attempt, backoff))
             transport = None
             try:
-                transport = self._spawn_worker(slot.rid)
+                # a placed replica restarts onto ITS OWN slice: the
+                # warm ladder it cold-starts is placement-identical to
+                # the incarnation it replaces
+                transport = self._spawn_worker(slot.rid,
+                                               slot.device_indices)
                 with self._lock:
                     self._restart_pending = transport
                 if self._close_event.is_set():
@@ -1740,6 +1931,238 @@ class ServingMesh:
             request.fail(ReplicaDead(
                 'every mesh replica has retired; the queue cannot '
                 'drain'))
+
+    # --------------------------------------------------- elastic fleet
+    def add_replica(self) -> str:
+        """Scale the fleet UP by one locally-built replica (the
+        autoscaler's spawn leg; also a public operator verb).  Worker
+        modes spawn + cold-start a new worker — on its own device
+        slice under placement — and re-adopt it onto the fleet's
+        CURRENT params step before its puller touches the queue;
+        thread mode builds a sibling engine over the shared trainer
+        (cache-hit warmup, zero new compiles).  Returns the new rid."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosed('ServingMesh is closed')
+            rid = 'r%d' % self._next_rid
+            self._next_rid += 1
+            device_indices = (None if self.mode == 'thread'
+                              else self._allocate_slice_locked())
+            seed_step = self._params_step
+        if self.mode == 'thread':
+            model = self._model
+            engine = ServingEngine(
+                self.config, model.trainer, model.params, model.vocabs,
+                decode_table=model._target_index_to_word,
+                tiers=self.tiers,
+                deadline_ms=0.0, queue_bound=-1,
+                canary_batches=self.canary_batches,
+                canary_agreement=self.canary_agreement,
+                param_source=self._param_source,
+                params_step=seed_step,
+                tracer=self._tracer,
+                tracing_sample_rate=(0.0 if self._tracer is None
+                                     else None),
+                replica_id=rid, external_dispatch=True,
+                on_batch_done=self._on_batch_done,
+                log=self.log)
+            engine.warmup()  # trainer jit caches: cache-hit, 0 compiles
+            transport = _ThreadReplica(engine)
+            # the model's pytree may predate a fleet rollover: adopt
+            # the CURRENT params from a serving sibling (pointer swap)
+            with self._cond:
+                donor = next(
+                    (s for s in self._replicas
+                     if isinstance(s.transport, _ThreadReplica)
+                     and not s.retired and not s.dead), None)
+                step = self._params_step
+            if donor is not None:
+                engine.adopt_params(donor.transport.engine.params,
+                                    step=step)
+        else:
+            transport = self._spawn_worker(rid, device_indices)
+            try:
+                transport.wait_ready()
+                # wait out an in-flight rollover, then serve the step
+                # the fleet settled on (the supervisor's re-adoption
+                # leg, reused for scale-up)
+                with self._cond:
+                    while self._rollover is not None and \
+                            not self._closed:
+                        self._cond.wait(0.1)
+                    fleet_step = self._params_step
+                worker_step = transport.ready_info.get('params_step')
+                if fleet_step is not None and worker_step != fleet_step:
+                    transport.adopt(None, fleet_step, fleet_step)
+            except BaseException:
+                try:
+                    transport.reap()
+                except Exception:
+                    pass
+                raise
+        self._seat_replica(rid, transport, device_indices,
+                           adopted=False)
+        self.log('mesh: scaled UP — replica %s joined the fleet%s'
+                 % (rid, (' on devices %s' % (device_indices,))
+                    if device_indices else ''))
+        return rid
+
+    def _seat_replica(self, rid: str, transport,
+                      device_indices: Optional[List[int]],
+                      adopted: bool) -> None:
+        """Append a ready transport to the replica table and start its
+        puller (scale-up and adoption share this tail)."""
+        with self._cond:
+            if self._closed:
+                closed = True
+            else:
+                closed = False
+                slot = _ReplicaSlot(rid, transport)
+                slot.adopted = adopted
+                slot.device_indices = device_indices
+                self._replicas.append(slot)
+                slot.thread = threading.Thread(
+                    target=self._pull_loop, args=(slot, transport),
+                    daemon=True, name='mesh-pull-%s' % rid)
+                slot.thread.start()
+                self._cond.notify_all()
+        if closed:
+            try:
+                transport.close()
+            except BaseException:
+                pass
+            raise EngineClosed('ServingMesh closed during scale-up')
+        self.replicas_gauge.set(len(self._replicas))
+        if tele_core.enabled():
+            tele_core.registry().gauge(
+                'mesh/replicas').set(len(self._replicas))
+        self._set_serving_gauge_locked_free()
+        self._set_live_gauge_locked_free()
+        self._queue.kick()
+
+    def _adoption_loop(self) -> None:
+        """Socket mode: seat externally-spawned workers.  A dial-in
+        whose rid the mesh never registered (``SocketListener``'s
+        unclaimed path) is an orchestrator-owned worker asking to
+        join: validate its capabilities, re-adopt it onto the fleet's
+        current step, and give it a puller — or turn it away typed."""
+        while not self._close_event.is_set():
+            got = self._listener.wait_adoptable(
+                0.25, cancel=self._close_event)
+            if got is None:
+                continue
+            rid, channel, _hello = got
+            try:
+                self._adopt_dialin(rid, channel)
+            except EngineClosed:
+                try:
+                    channel.close()
+                except BaseException:
+                    pass
+                return
+            except BaseException as exc:
+                self.adoption_rejected_total.inc()
+                if tele_core.enabled():
+                    tele_core.registry().counter(
+                        'mesh/adoption_rejected_total').inc()
+                self.log('mesh: adoption of dial-in %r REJECTED: %s'
+                         % (rid, exc))
+                try:
+                    # typed answer before the close: the worker (and
+                    # its orchestrator's logs) learn WHY
+                    channel.send(('adopt_rejected', str(exc)))
+                except BaseException:
+                    pass
+                try:
+                    channel.close()
+                except BaseException:
+                    pass
+
+    def _adopt_dialin(self, rid: str, channel) -> None:
+        """Validate + seat ONE adoptable dial-in (raises
+        ``AdoptionRejected`` to turn it away typed)."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed('ServingMesh is closed')
+            if any(s.rid == rid and not s.retired
+                   for s in self._replicas):
+                raise AdoptionRejected(
+                    'rid %r already names a serving replica in this '
+                    'fleet; external workers need unique --rid values'
+                    % rid)
+        transport = _WorkerReplica(
+            rid, 'socket', {},
+            on_batch_done=self._on_worker_batch_done,
+            on_worker_dead=self._on_worker_dead,
+            on_telemetry=self._on_worker_telemetry,
+            on_spans=self._note_stitched,
+            listener=self._listener, log=self.log,
+            start_timeout_s=self.adopt_ready_timeout_s,
+            channel=channel)
+        try:
+            transport.wait_ready()
+        except BaseException as exc:
+            raise AdoptionRejected(
+                'worker %r dialed in but never reported ready within '
+                '%.0fs: %r' % (rid, self.adopt_ready_timeout_s, exc))
+        caps = transport.ready_info.get('capabilities') or {}
+        try:
+            if caps.get('proto') != transport_lib.WIRE_PROTO:
+                raise AdoptionRejected(
+                    'worker %r speaks wire proto %r, this mesh speaks '
+                    '%d' % (rid, caps.get('proto'),
+                            transport_lib.WIRE_PROTO))
+            if caps.get('wire') != self.config.BATCH_WIRE_FORMAT:
+                raise AdoptionRejected(
+                    'worker %r ships batches as %r, this mesh expects '
+                    '%r' % (rid, caps.get('wire'),
+                            self.config.BATCH_WIRE_FORMAT))
+            missing = set(self.tiers) - set(caps.get('tiers') or ())
+            if missing:
+                raise AdoptionRejected(
+                    'worker %r did not warm tier(s) %s this mesh '
+                    'serves; its first dispatch there would compile on '
+                    'the serving path' % (rid, sorted(missing)))
+            # re-adopt onto the fleet's CURRENT step — an adoption
+            # landing mid-rollover waits the rollover out first, so
+            # the step read here is the one the fleet settled on
+            with self._cond:
+                while self._rollover is not None and not self._closed:
+                    self._cond.wait(0.1)
+                if self._closed:
+                    raise EngineClosed('ServingMesh is closed')
+                fleet_step = self._params_step
+            worker_step = transport.ready_info.get('params_step')
+            if fleet_step is not None and worker_step != fleet_step:
+                self.log('mesh: adopting %s at step %s; re-adopting '
+                         'the fleet\'s current step %d'
+                         % (rid, worker_step, fleet_step))
+                transport.adopt(None, fleet_step, fleet_step)
+        except BaseException as exc:
+            try:
+                # typed answer BEFORE tearing the wire down (cancel
+                # closes the channel; the adoption loop's fallback
+                # send would find it already gone)
+                channel.send(('adopt_rejected', str(exc)))
+            except BaseException:
+                pass
+            try:
+                transport.cancel()  # stop the receiver; close the wire
+            except BaseException:
+                pass
+            raise
+        devices = caps.get('devices')
+        self._seat_replica(rid, transport,
+                           list(devices) if devices else None,
+                           adopted=True)
+        self.adopted_total.inc()
+        if tele_core.enabled():
+            tele_core.registry().counter('mesh/adopted_total').inc()
+        self.log('mesh: ADOPTED externally-spawned worker %s (step %s, '
+                 'devices %s); restart supervision stays with its '
+                 'orchestrator'
+                 % (rid, transport.ready_info.get('params_step'),
+                    devices))
 
     def _complete(self, slot: _ReplicaSlot, rows: int,
                   taken: List[_Request], ok: bool) -> None:
@@ -2276,13 +2699,24 @@ class ServingMesh:
             slot.transport.warmup()
         return self
 
-    def retire(self, replica_id: str, timeout: float = 120.0) -> None:
+    def retire(self, replica_id: str, timeout: float = 120.0,
+               reason: str = 'drain') -> None:
         """Drain one replica out of the fleet: it stops pulling, its
         in-flight batches deliver, its engine closes; the shared queue
-        redirects to the remaining replicas throughout."""
+        redirects to the remaining replicas throughout.  ``reason``
+        lands in ``stats()``'s ``retired_reason`` and the
+        reason-labeled ``mesh/retired_total`` (the autoscaler passes
+        'autoscale'; operators get the 'drain' default)."""
         with self._cond:
+            # prefer a non-retired slot: an adopted worker that died
+            # and redialed leaves a retired slot with the same rid
+            # behind, and retire() must drain the LIVE incarnation
             slot = next((s for s in self._replicas
-                         if s.rid == replica_id), None)
+                         if s.rid == replica_id and not s.retired),
+                        None)
+            if slot is None:
+                slot = next((s for s in self._replicas
+                             if s.rid == replica_id), None)
             if slot is None:
                 raise ValueError('no replica %r in this mesh (%s)'
                                  % (replica_id,
@@ -2290,8 +2724,10 @@ class ServingMesh:
             if slot.retired:
                 return
             slot.retired = True
+            slot.retired_reason = reason
             was_dead = slot.dead
             self._cond.notify_all()
+        self._note_retired(reason)
         self._queue.kick()
         if slot.thread is not None:
             slot.thread.join(timeout)
@@ -2316,6 +2752,14 @@ class ServingMesh:
             replicas = [{
                 'replica': slot.rid,
                 'retired': slot.retired,
+                'retired_reason': slot.retired_reason,
+                'adopted': slot.adopted,
+                # placement view: the parent-assigned slice for spawned
+                # workers, the worker's self-reported sub-mesh for
+                # adopted ones — per-slice HBM attribution is this row's
+                # 'devices' next to its 'worker_memory' ledger rollup
+                'devices': (list(slot.device_indices)
+                            if slot.device_indices else None),
                 'dead': slot.dead,
                 'restarts': slot.restarts,
                 'breaker_state': slot.breaker_state,
@@ -2356,6 +2800,20 @@ class ServingMesh:
                 self.breaker_open_total.snapshot(),
             'restarts_total': self.restarts_total.snapshot(),
             'redispatched_total': self.redispatched_total.snapshot(),
+            'retired_total': self.retired_total.snapshot(),
+            'adopted_total': self.adopted_total.snapshot(),
+            'adoption_rejected_total':
+                self.adoption_rejected_total.snapshot(),
+            'proto_rejected_total': (
+                self._listener.rejected_total
+                if self._listener is not None else 0),
+            'placement': (
+                {'devices_per_replica': self.devices_per_replica,
+                 'slices': len(self._placement),
+                 'data_axis': self.data_axis}
+                if self._placement is not None else None),
+            'autoscaler': (self._autoscaler.stats()
+                           if self._autoscaler is not None else None),
             'heartbeat_misses_total':
                 self.heartbeat_misses_total.snapshot(),
             'replicas_live': self.live_gauge.snapshot(),
@@ -2409,6 +2867,10 @@ class ServingMesh:
             self._cond.notify_all()
         self._follow_stop.set()
         self._close_event.set()
+        if self._autoscaler is not None:
+            # the autoscaler must stop DECIDING before the fleet it
+            # reads starts tearing down
+            self._autoscaler.close()
         if restart_pending is not None:
             # interrupt a supervisor blocked in wait_ready: the worker
             # cold start must not outlive (or be leaked by) the mesh
@@ -2434,6 +2896,8 @@ class ServingMesh:
             self._supervisor.join(timeout=60.0)
         if self._liveness_thread is not None:
             self._liveness_thread.join(timeout=60.0)
+        if self._adopt_thread is not None:
+            self._adopt_thread.join(timeout=60.0)
         for slot in self._replicas:
             if slot.thread is not None:
                 slot.thread.join()

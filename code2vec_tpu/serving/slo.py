@@ -208,6 +208,15 @@ class SloMonitor:
             burns = self._burns_locked()
         self._export_burns(burns)
 
+    def burns(self) -> Dict[str, tuple]:
+        """Current ``(fast_burn, slow_burn)`` per ACTIVE SLO key —
+        the autoscaler's scale-up signal (serving/autoscaler.py) reads
+        this directly instead of parsing ``stats()``.  Evicts at read
+        time, so a burn decays after traffic stops."""
+        with self._lock:
+            all_burns = self._burns_locked()
+            return {key: all_burns[key] for key in self._active_keys()}
+
     def _active_keys(self):
         if self.availability > 0:
             yield 'availability'

@@ -304,13 +304,24 @@ class SocketListener:
     """The mesh's accept side of socket mode: workers dial in, send a
     ``hello`` frame, and are claimed BY RID — so N workers can cold-
     start concurrently and connect in any order, and a worker on
-    another machine only needs the (host, port) pair."""
+    another machine only needs the (host, port) pair.
 
-    # the accept thread fills _by_rid while wait_ready callers claim
-    # from it and close() tears it down (lock-discipline rule,
-    # ANALYSIS.md); _cond wraps _lock, so holding either alias guards
-    # the fields:
-    # graftlint: guard SocketListener._by_rid,_closed by _lock|_cond
+    Two dial-in classes (SERVING.md "Elastic fleet"): a rid the mesh
+    ``expect()``ed (it spawned that worker) parks in ``_by_rid`` for
+    ``claim()``; any OTHER rid is an externally-spawned worker
+    (scripts/mesh_worker.py, launched by an orchestrator against a
+    routable listener) and queues for ADOPTION — ``wait_adoptable()``
+    hands it to the mesh's adoption loop instead of dropping it.  A
+    hello speaking the wrong wire protocol is rejected TYPED: the
+    worker receives an ``('adopt_rejected', reason)`` frame before the
+    close, so a version-skewed orchestrator fleet learns why its
+    workers never join instead of watching silent disconnects."""
+
+    # the accept thread fills _by_rid/_adoptable while wait_ready
+    # callers claim, the adoption loop pops, and close() tears it all
+    # down (lock-discipline rule, ANALYSIS.md); _cond wraps _lock, so
+    # holding either alias guards the fields:
+    # graftlint: guard SocketListener._by_rid,_closed,_expected,_adoptable,_rejected by _lock|_cond
     def __init__(self, host: str = '127.0.0.1'):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -321,6 +332,9 @@ class SocketListener:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._by_rid: Dict[str, Tuple[SocketTransport, dict]] = {}
+        self._expected: set = set()
+        self._adoptable: List[Tuple[str, SocketTransport, dict]] = []
+        self._rejected = 0
         self._closed = False
         self._thread = threading.Thread(target=self._accept_loop,
                                         daemon=True, name='mesh-listen')
@@ -342,7 +356,22 @@ class SocketListener:
                 transport = SocketTransport(conn)
                 hello = transport.recv()
                 conn.settimeout(None)
-                if hello[0] != 'hello' or hello[2] != WIRE_PROTO:
+                if hello[0] != 'hello':
+                    raise WireError('bad worker hello %r' % (hello[:1],))
+                if hello[2] != WIRE_PROTO:
+                    # typed rejection frame BEFORE the close: the
+                    # dial-in (an orchestrator-spawned worker built
+                    # against another wire version) learns why it was
+                    # refused instead of seeing a bare disconnect
+                    with self._lock:
+                        self._rejected += 1
+                    try:
+                        transport.send((
+                            'adopt_rejected',
+                            'wire proto %r != listener proto %d'
+                            % (hello[2], WIRE_PROTO)))
+                    except (OSError, WireError):
+                        pass
                     raise WireError(
                         'bad worker hello %r (wire proto %d expected)'
                         % (hello[:3], WIRE_PROTO))
@@ -357,9 +386,48 @@ class SocketListener:
                 if self._closed:
                     transport.close()
                     return
-                self._by_rid[hello[1]] = (transport,
-                                          {'pid': hello[3]})
+                rid, info = hello[1], {'pid': hello[3]}
+                if rid in self._expected:
+                    self._by_rid[rid] = (transport, info)
+                else:
+                    # unclaimed rid: nobody here spawned this worker —
+                    # park it for adoption rather than dropping it
+                    self._adoptable.append((rid, transport, info))
                 self._cond.notify_all()
+
+    def expect(self, rid: str) -> None:
+        """Register a rid THIS mesh is about to spawn, so its dial-in
+        routes to ``claim()`` instead of the adoption queue.  Must run
+        before the worker process starts (the dial can beat any later
+        bookkeeping)."""
+        with self._cond:
+            self._expected.add(rid)
+
+    def wait_adoptable(self, timeout: float,
+                       cancel: Optional[threading.Event] = None
+                       ) -> Optional[Tuple[str, SocketTransport, dict]]:
+        """Block up to ``timeout`` for one externally-spawned dial-in;
+        returns ``(rid, transport, info)`` or None (timeout, cancel, or
+        listener closed)."""
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while True:
+                if self._adoptable:
+                    return self._adoptable.pop(0)
+                if self._closed:
+                    return None
+                if cancel is not None and cancel.is_set():
+                    return None
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(min(remaining, 0.25))
+
+    @property
+    def rejected_total(self) -> int:
+        """Dial-ins refused at the hello (wrong wire protocol)."""
+        with self._lock:
+            return self._rejected
 
     def claim(self, rid: str, timeout: float,
               cancel: Optional[threading.Event] = None,
@@ -408,6 +476,9 @@ class SocketListener:
             self._closed = True
             unclaimed = list(self._by_rid.values())
             self._by_rid.clear()
+            unclaimed.extend((t, info) for _rid, t, info
+                             in self._adoptable)
+            self._adoptable.clear()
             self._cond.notify_all()
         try:
             self._sock.close()

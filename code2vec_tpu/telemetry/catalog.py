@@ -235,6 +235,54 @@ CATALOG: Dict[str, Dict[str, str]] = {
                                       'telemetry/ledger snapshots '
                                       'merged replica-labeled into the '
                                       'fleet registry off heartbeats.'),
+    # ---- elastic fleet (mesh placement / adoption / autoscaler) ----
+    'mesh/retired_total': _m(COUNTER, 'replicas', 'Replicas permanently '
+                             'retired from the fleet, plus a '
+                             'reason-labeled series: {reason=drain|'
+                             'autoscale|restart_budget|adopted_worker_'
+                             'exit} — a post-mortem can tell a planned '
+                             'drain from a budget exhaustion from an '
+                             'orchestrator-owned worker exiting.'),
+    'mesh/adopted_total': _m(COUNTER, 'workers', 'Externally-spawned '
+                             'workers ADOPTED into the fleet off an '
+                             'unclaimed dial-in (capability handshake '
+                             'passed, re-adopted onto the fleet params '
+                             'step; restart supervision stays with '
+                             'their orchestrator).'),
+    'mesh/adoption_rejected_total': _m(COUNTER, 'workers', 'Adoption '
+                                       'dial-ins rejected after the '
+                                       'hello: duplicate rid, ready '
+                                       'timeout, or capability '
+                                       'mismatch (tiers/wire); the '
+                                       'worker gets a typed '
+                                       'adopt_rejected frame.'),
+    'autoscale/replicas_target': _m(GAUGE, 'replicas', 'Fleet size the '
+                                    'SLO-driven autoscaler currently '
+                                    'wants (clamped to AUTOSCALE_MIN/'
+                                    'MAX_REPLICAS).'),
+    'autoscale/scale_up_total': _m(COUNTER, 'transitions', 'Autoscaler '
+                                   'scale-up transitions that seated a '
+                                   'new replica (queue drain estimate '
+                                   'over AUTOSCALE_UP_QUEUE_SECS, or '
+                                   'SLO burn over AUTOSCALE_UP_BURN).'),
+    'autoscale/scale_up_failed_total': _m(COUNTER, 'transitions',
+                                          'Scale-up attempts whose '
+                                          'spawn/seat failed (counted, '
+                                          'not fatal; the up-cooldown '
+                                          'applies before the retry).'),
+    'autoscale/scale_down_total': _m(COUNTER, 'transitions',
+                                     'Autoscaler scale-down '
+                                     'transitions: newest eligible '
+                                     'replica drained and retired '
+                                     '{reason=autoscale} after the '
+                                     'sustained-idle window.'),
+    'autoscale/flap_freezes_total': _m(COUNTER, 'freezes', 'Flap-guard '
+                                       'trips: too many direction '
+                                       'reversals inside '
+                                       'AUTOSCALE_FLAP_WINDOW_SECS — '
+                                       'all scaling frozen for one '
+                                       'window instead of thrashing '
+                                       'warm compile ladders.'),
     # ---- memoization tier (code2vec_tpu/serving/memo.py, SERVING.md) ----
     'memo/hits_total': _m(COUNTER, 'requests', 'Requests served from '
                           'the exact memo tier at mesh admission (zero '

@@ -120,6 +120,11 @@ SPAN_CATALOG: Dict[str, str] = {
                         'saved work off these.',
     'extractor.call': 'One ExtractorPool call (attrs: attempt count, '
                       'breaker state, outcome).',
+    'autoscale.transition': 'One autoscaler scale transition, decision '
+                            'to seated/retired replica (attrs: '
+                            'direction=up|down, replicas, queue drain '
+                            'estimate, burn flags; status=error on a '
+                            'failed spawn/drain).',
 }
 
 #: span names that originate in a REMOTE worker process and reach the

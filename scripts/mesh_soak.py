@@ -28,7 +28,15 @@ The assertions are the self-healing contract:
   mid-soak fleet rollover drills (``--rollovers``) swap params to a
   freshly saved step and assert the swap atomically invalidated the
   cache: zero entries survive, the first post-swap duplicate runs
-  LIVE, and the generation advanced per completed rollover.
+  LIVE, and the generation advanced per completed rollover;
+- **elastic transitions survive the chaos** (SERVING.md "Elastic
+  fleet") — mid-soak the fleet SCALES UP by one replica while the
+  kill/heartbeat chaos keeps firing (the cold start, step re-adopt,
+  and queue join must not lose a request), serves through it, then
+  DRAINS that replica back out while a ``partition`` fault blackholes
+  parent-side frames — the liveness monitor, not the drain, must break
+  the stall, the retirement lands typed (``retired_reason='drain'``),
+  and zero admitted requests are lost across both transitions.
 
 Prints one JSON line per metric (``mesh_soak_*``); exit 1 on any
 violation.  ``BENCH_SMOKE=1`` shrinks shapes and duration for the
@@ -47,6 +55,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -93,6 +102,11 @@ def main() -> int:
                              'must atomically invalidate the memo '
                              'cache (generation bump) with zero stale '
                              'serves after the swap')
+    parser.add_argument('--elastic', type=int, default=1,
+                        help='run the elastic-transition drill: scale '
+                             'up one replica under the kill chaos, '
+                             'serve, then drain it back out during a '
+                             'partition window (0 disables)')
     parser.add_argument('--rows', type=int, default=200 if smoke else 1000)
     parser.add_argument('--contexts', type=int, default=6 if smoke else 50)
     parser.add_argument('--tokens', type=int, default=500 if smoke else 5000)
@@ -103,6 +117,7 @@ def main() -> int:
     from benchmarks.bench_serving import synthesize_dataset
     from code2vec_tpu.config import Config
     from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.resilience import faults
     from code2vec_tpu.serving.errors import ServingError
     from code2vec_tpu.telemetry import core as tele_core
     from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
@@ -198,14 +213,65 @@ def main() -> int:
                           # already ran; nothing stale was delivered
             return True, None
 
+        drill_state = {'scale_rid': None, 'scale_ms': None,
+                       'drain_ms': None, 'drain_reason': None}
+
+        def elastic_drill():
+            """Scale-up-under-kill, then drain-during-partition
+            (SERVING.md "Elastic fleet").  Runs CONCURRENTLY with the
+            paced generator: the transitions happen under live load
+            and live chaos, which is the whole point."""
+            t = time.perf_counter()
+            try:
+                rid = mesh.add_replica()
+            except Exception as exc:
+                violations.append(
+                    'scale-up-under-kill drill failed: %r' % exc)
+                return
+            drill_state['scale_rid'] = rid
+            drill_state['scale_ms'] = (time.perf_counter() - t) * 1e3
+            # let the new replica pull some of the paced load before
+            # draining it back out
+            time.sleep(max(1.0, args.secs * 0.15))
+            # the partition window: parent-side frames (results AND
+            # heartbeats, from every worker) blackhole while the drain
+            # is in flight — liveness detection must break any stall
+            faults.configure(fault_spec + ',partition@frame=0..19')
+            t = time.perf_counter()
+            try:
+                mesh.retire(rid, timeout=120.0, reason='drain')
+                drill_state['drain_ms'] = \
+                    (time.perf_counter() - t) * 1e3
+            except Exception as exc:
+                violations.append(
+                    'drain-during-partition drill failed: %r' % exc)
+            finally:
+                # restore the soak's ambient plan (the configure above
+                # replaced it parent-side; worker plans are per-process
+                # and unaffected)
+                faults.configure(fault_spec)
+            row = next((r for r in mesh.stats()['replicas']
+                        if r['replica'] == rid), None)
+            drill_state['drain_reason'] = (row['retired_reason']
+                                           if row else None)
+
+        elastic_thread = None
         futures = []
         stamps = []
         t0 = time.perf_counter()
         deadline = t0 + args.secs
+        elastic_at = (t0 + args.secs * 0.3 if args.elastic else None)
         roll_idx = 0
         roll_times = [t0 + args.secs * (i + 1) / (args.rollovers + 1)
                       for i in range(args.rollovers)]
         while time.perf_counter() < deadline:
+            if elastic_at is not None and \
+                    time.perf_counter() >= elastic_at:
+                elastic_at = None
+                elastic_thread = threading.Thread(
+                    target=elastic_drill, daemon=True,
+                    name='soak-elastic-drill')
+                elastic_thread.start()
             if roll_idx < len(roll_times) and \
                     time.perf_counter() >= roll_times[roll_idx]:
                 ok_drill, err = rollover_drill(roll_idx)
@@ -235,6 +301,19 @@ def main() -> int:
                 futures.append(None)  # typed shed at admission: fine
                 stamps.append(time.perf_counter())
             time.sleep(args.interval_ms / 1e3)
+        if elastic_at is not None:
+            # the soak ended before the drill's start mark (a very
+            # short --secs): run it now so the contract still gets
+            # exercised once
+            elastic_thread = threading.Thread(
+                target=elastic_drill, daemon=True,
+                name='soak-elastic-drill')
+            elastic_thread.start()
+        if elastic_thread is not None:
+            elastic_thread.join(timeout=300.0)
+            if elastic_thread.is_alive():
+                violations.append('elastic drill wedged (scale-up or '
+                                  'partitioned drain never finished)')
         # drain: every admitted future must RESOLVE — results or typed
         from concurrent.futures import TimeoutError as FutureTimeout
         ok = shed = typed = lost = untyped = 0
@@ -337,6 +416,26 @@ def main() -> int:
           'replica_breaker_open_total':
               stats['replica_breaker_open_total']})
     emit({'metric': 'mesh_soak_postwarm_compiles', 'value': postwarm})
+    if args.elastic:
+        if drill_state['scale_ms'] is None:
+            violations.append('scale-up-under-kill never completed')
+        if drill_state['drain_ms'] is None:
+            violations.append(
+                'drain-during-partition never completed')
+        elif drill_state['drain_reason'] != 'drain':
+            violations.append(
+                "drained replica retired as %r, expected 'drain'"
+                % (drill_state['drain_reason'],))
+        emit({'metric': 'mesh_soak_scale_up_ms',
+              'value': (round(drill_state['scale_ms'], 1)
+                        if drill_state['scale_ms'] is not None
+                        else None),
+              'rid': drill_state['scale_rid']})
+        emit({'metric': 'mesh_soak_drain_partition_ms',
+              'value': (round(drill_state['drain_ms'], 1)
+                        if drill_state['drain_ms'] is not None
+                        else None),
+              'retired_reason': drill_state['drain_reason']})
     if memo_on:
         # memoization-tier soak contract (SERVING.md "Memoization
         # tier"): the cache must actually serve under the duplicate-

@@ -210,6 +210,42 @@ def test_bench_mesh_smoke_fixed_offered_load():
         assert scaling['value'] >= 1.8, scaling
 
 
+@pytest.mark.slow
+def test_bench_mesh_stepped_load_smoke():
+    """ISSUE 18: the stepped-offered-load elasticity arm must survive
+    import/config rot — low -> high -> low against one process replica
+    with the SLO/queue-driven autoscaler live: the high step pulls a
+    second replica (scale-up latency reported, cold start included),
+    the low step drains it back out typed ('autoscale'), transition
+    p99 is reported next to steady-state p99, and the parent compiles
+    NOTHING after warmup across both transitions."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'bench_mesh.py'),
+         '--stepped-load'],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line)
+               for line in proc.stdout.splitlines() if line.strip()]
+    by_metric = {r['metric']: r for r in records}
+    up = by_metric['mesh_stepped_scale_up_s']
+    assert up['reached_2_replicas'] is True
+    assert up['value'] is not None and up['value'] > 0
+    assert up['scale_up_total'] >= 1
+    assert up['process_capacity_rows_per_sec_1r'] > 0
+    down = by_metric['mesh_stepped_scale_down_s']
+    assert down['drained_to_1_replica'] is True
+    assert down['value'] is not None and down['scale_down_total'] >= 1
+    assert ['r1', 'autoscale'] in down['retired']
+    p99 = by_metric['mesh_stepped_transition_p99_ms']
+    assert p99['value'] is not None
+    assert p99['steady_p99_ms'] is not None
+    assert p99['postwarm_compiles'] == 0
+    assert p99['typed_failures'] == 0
+
+
 def _run_mesh_soak(extra_args=(), timeout=600, smoke=True):
     env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO)
     if smoke:
@@ -252,6 +288,15 @@ def test_mesh_soak_smoke_self_heals_without_losing_requests():
     assert memo['value'] > 0 and memo['hit_rate'] > 0, memo
     assert memo['rollovers'] >= 1, memo
     assert memo['generation'] >= memo['rollovers'], memo
+    # ISSUE 18: the elastic drill rode the same soak — a scale-up
+    # completed UNDER the kill chaos and the scaled-up replica drained
+    # back out typed during a partition window (rc 0 already covers
+    # the zero-lost contract across both transitions)
+    scale = by_metric['mesh_soak_scale_up_ms']
+    assert scale['value'] is not None and scale['rid'], scale
+    drain = by_metric['mesh_soak_drain_partition_ms']
+    assert drain['value'] is not None, drain
+    assert drain['retired_reason'] == 'drain', drain
 
 
 @pytest.mark.slow

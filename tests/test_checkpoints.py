@@ -29,9 +29,15 @@ def test_save_creates_sidecar_and_checkpoints(tmp_path):
     assert (model_dir / 'saved_model__entire-model').is_dir()
 
 
+# tier-1 budget (conftest report): the same-backend pairs carry the
+# round-trip property; the cross-backend pairs re-run the full train
+# for ~6s each and ride in the slow tier
 @pytest.mark.parametrize('train_framework,load_framework',
                          [('jax', 'jax'), ('flax', 'flax'),
-                          ('jax', 'flax'), ('flax', 'jax')])
+                          pytest.param('jax', 'flax',
+                                       marks=pytest.mark.slow),
+                          pytest.param('flax', 'jax',
+                                       marks=pytest.mark.slow)])
 def test_load_params_reproduces_predictions(tmp_path, train_framework,
                                             load_framework):
     """Checkpoints use a canonical params layout: a model trained under
@@ -112,7 +118,8 @@ def test_resume_training_continues_from_epoch(tmp_path):
 
 @pytest.mark.parametrize('saved_mu,resume_mu',
                          [('float32', 'bfloat16'),
-                          ('bfloat16', 'float32')])
+                          pytest.param('bfloat16', 'float32',
+                                       marks=pytest.mark.slow)])
 def test_resume_across_adam_mu_dtype(tmp_path, saved_mu, resume_mu):
     """ADAM_MU_DTYPE's default flipped fp32 -> bf16 (2026-07-31 A/B):
     resuming an older checkpoint under the new default (and vice versa)
@@ -139,7 +146,8 @@ def test_resume_across_adam_mu_dtype(tmp_path, saved_mu, resume_mu):
 
 @pytest.mark.parametrize('saved_nu,resume_nu',
                          [('float32', 'bfloat16'),
-                          ('bfloat16', 'float32')])
+                          pytest.param('bfloat16', 'float32',
+                                       marks=pytest.mark.slow)])
 def test_resume_across_adam_nu_dtype(tmp_path, saved_nu, resume_nu):
     """ADAM_NU_DTYPE is gated on the same flip rule as mu was: cross-dtype
     resume must adapt in both directions — restore the second moment as
@@ -164,6 +172,7 @@ def test_resume_across_adam_nu_dtype(tmp_path, saved_nu, resume_nu):
     model2.train()  # epoch 1 runs under the configured nu dtype
 
 
+@pytest.mark.slow  # two full trains (~10s); tier-1 budget headroom
 def test_resume_across_opt_state_sharding_modes(tmp_path):
     """A checkpoint written with the mirrored moment layout resumes under
     OPTIMIZER_STATE_SHARDING='zero' (and the moments land zero-sharded):
@@ -190,6 +199,7 @@ def test_resume_across_opt_state_sharding_modes(tmp_path):
     model2.train()  # epoch 1 runs under the zero layout without error
 
 
+@pytest.mark.slow  # three full trains (~11s); tier-1 budget headroom
 def test_resume_across_fused_ce_and_mesh_reshape(tmp_path):
     """ADVICE r3: the fused-CE target-table allocation folds in the vocab
     tile and mesh model-axis size, so its row count is topology-dependent —
@@ -250,6 +260,7 @@ def test_resume_across_fused_ce_and_mesh_reshape(tmp_path):
         after_train.topk_predicted_words[:m]
 
 
+@pytest.mark.slow  # train + release + resume (~10s); budget headroom
 def test_release_rows_rewrite_does_not_poison_older_checkpoints(tmp_path):
     """ADVICE r4: one meta.json serves the whole history, and its
     target_vocab_rows tracks only the NEWEST writer — after a --release

@@ -102,6 +102,11 @@ def test_socket_transport_roundtrip_partial_and_eof():
 def test_socket_listener_claims_by_rid_and_validates_hello():
     listener = transport_lib.SocketListener('127.0.0.1')
     try:
+        # a spawned worker's rid is expect()ed BEFORE it dials; an
+        # unregistered rid would queue for adoption instead
+        listener.expect('r0')
+        listener.expect('r1')
+        listener.expect('rX')
         # dial out of order: r1 first, then r0 — claims are rid-keyed
         t1 = transport_lib.dial(listener.address, 'r1', pid=111)
         t0 = transport_lib.dial(listener.address, 'r0', pid=100)
@@ -112,13 +117,17 @@ def test_socket_listener_claims_by_rid_and_validates_hello():
         assert got0.recv() == ('ready', {'params_step': 5})
         got1.send(('close', 0))
         assert t1.recv() == ('close', 0)
-        # a peer speaking the wrong protocol version is dropped, never
-        # claimable
+        # a peer speaking the wrong protocol version is rejected TYPED
+        # at the hello — never claimable, even though it was expected
         bad = socket.create_connection(listener.address, timeout=5.0)
-        transport_lib.SocketTransport(bad).send(
+        bad_transport = transport_lib.SocketTransport(bad)
+        bad_transport.send(
             ('hello', 'rX', transport_lib.WIRE_PROTO + 1, 1))
+        kind, why = bad_transport.recv()[:2]
+        assert kind == 'adopt_rejected' and 'proto' in why
         with pytest.raises(TimeoutError):
             listener.claim('rX', timeout=0.8)
+        assert listener.rejected_total == 1
         for transport in (t0, t1, got0, got1):
             transport.close()
         bad.close()

@@ -64,7 +64,11 @@ class TestTwinVsDense:
     encode, over the full structural property space."""
 
     @pytest.mark.parametrize('token_pad,path_pad', [(0, 0), (1, 2)])
-    @pytest.mark.parametrize('data_shards', [1, 2, 4])
+    # tier-1 budget: 1 (unsharded) and 4 (the real mesh width) bound
+    # the property space; the intermediate width rides the slow tier
+    @pytest.mark.parametrize(
+        'data_shards',
+        [1, pytest.param(2, marks=pytest.mark.slow), 4])
     def test_property_regime(self, token_pad, path_pad, data_shards):
         rng = np.random.default_rng(7)
         params = small_params()
@@ -403,6 +407,7 @@ class TestFusedBackward:
             ragged_mesh=mesh))(params)
         assert_grads_close(grads_k, grads_d, params._fields)
 
+    @pytest.mark.slow  # three consumers x jit (~11s); budget headroom
     def test_dropout_bit_match_fused_vs_twin(self):
         """One threaded key, three consumers — the autodiff twin, the
         custom-VJP twin pair, the custom-VJP kernel pair — must all

@@ -11,8 +11,11 @@ drills: SIGKILL mid-batch -> crash-safe redispatch + supervised restart
 hung or partitioned worker, and the restart budget retiring a flapping
 replica typed."""
 import contextlib
+import json
 import os
 import signal
+import subprocess
+import sys
 import threading
 import time
 import types
@@ -24,6 +27,8 @@ from code2vec_tpu.config import Config
 from code2vec_tpu.resilience import faults
 from code2vec_tpu.serving import frontqueue as frontqueue_lib
 from code2vec_tpu.serving import mesh as mesh_lib
+from code2vec_tpu.serving import transport as transport_lib
+from code2vec_tpu.serving.autoscaler import Autoscaler
 from code2vec_tpu.serving.engine import _Request
 from code2vec_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
                                          EngineOverloaded)
@@ -819,3 +824,501 @@ def test_partition_liveness_detects_and_redispatches(proc_model):
             faults.configure('')
             mesh.close()
             _assert_healing_threads_reaped(mesh)
+
+
+# -------------------------------------------------- elastic fleet (18)
+def test_partition_device_indices_disjoint_and_bounded():
+    """Placement math (parallel/mesh.py): contiguous, disjoint,
+    exhaustion-checked against the visible device count."""
+    from code2vec_tpu.parallel import mesh as pmesh
+    slices = pmesh.partition_device_indices(4, 2)
+    assert slices == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    flat = [i for s in slices for i in s]
+    assert len(flat) == len(set(flat))
+    with pytest.raises(ValueError, match='device'):
+        pmesh.partition_device_indices(5, 2)  # 10 > 8 visible
+    assert pmesh.device_slice('4,5') is not None
+    with pytest.raises(ValueError):
+        pmesh.device_slice('4,99')
+
+
+def test_placement_rejects_thread_mode(model):
+    with _cfg(model, MESH_DEVICES_PER_REPLICA=2):
+        with pytest.raises(ValueError, match='worker mode'):
+            model.serving_mesh(replicas=1, tiers=('topk',),
+                               mode='thread', warmup=False)
+
+
+class _StubQueue:
+    def __init__(self):
+        self.next = (0.0, 0, 1.0)
+
+    def drain_seconds(self):
+        return self.next
+
+    def kick(self):
+        pass
+
+
+class _StubMesh:
+    """Just enough mesh for Autoscaler's unit surface: a replica
+    table under _lock, a queue drain estimate, and the two verbs."""
+
+    def __init__(self, n=1):
+        self._lock = threading.Lock()
+        self._queue = _StubQueue()
+        self._slo = None
+        self._replicas = [mesh_lib._ReplicaSlot('r%d' % i, None)
+                          for i in range(n)]
+        self.retired = []
+
+    def add_replica(self):
+        rid = 'r%d' % len(self._replicas)
+        self._replicas.append(mesh_lib._ReplicaSlot(rid, None))
+        return rid
+
+    def retire(self, rid, timeout=120.0, reason='drain'):
+        for slot in self._replicas:
+            if slot.rid == rid:
+                slot.retired = True
+                slot.retired_reason = reason
+        self.retired.append((rid, reason))
+        return True
+
+
+def _asc_cfg(**overrides):
+    fields = dict(AUTOSCALE_MIN_REPLICAS=1, AUTOSCALE_MAX_REPLICAS=3,
+                  AUTOSCALE_INTERVAL_SECS=3600.0,
+                  AUTOSCALE_UP_QUEUE_SECS=2.0, AUTOSCALE_UP_BURN=0.0,
+                  AUTOSCALE_DOWN_IDLE_SECS=0.0,
+                  AUTOSCALE_DOWN_UTILIZATION=0.5,
+                  AUTOSCALE_UP_COOLDOWN_SECS=0.0,
+                  AUTOSCALE_DOWN_COOLDOWN_SECS=0.0,
+                  AUTOSCALE_FLAP_WINDOW_SECS=120.0,
+                  AUTOSCALE_FLAP_LIMIT=2)
+    fields.update(overrides)
+    return types.SimpleNamespace(**fields)
+
+
+def test_autoscaler_decisions_bounds_cooldowns_and_flap_guard():
+    """Control-loop unit: backlog scales up under the max bound and
+    the up-cooldown; an empty queue scales down only after SUSTAINED
+    low pressure and never below the min; direction thrash trips the
+    flap guard into a freeze instead of oscillating."""
+    mesh = _StubMesh(1)
+    asc = Autoscaler(mesh, _asc_cfg(AUTOSCALE_UP_COOLDOWN_SECS=30.0))
+    mesh._queue.next = (10.0, 80, 8.0)  # drain 10s > 2s threshold
+    assert asc.tick() == 'up'
+    assert len(mesh._replicas) == 2
+    assert asc.stats()['scale_up_total'] == 1
+    assert asc.stats()['replicas_target'] == 2
+    # same backlog, inside the up-cooldown: hold, not storm
+    assert asc.tick() == 'hold'
+    assert len(mesh._replicas) == 2
+
+    # ---- scale-down: sustained idleness, min bound, LIFO victim ----
+    mesh2 = _StubMesh(2)
+    mesh2._replicas[1].adopted = True  # orchestrator-owned: never drain
+    asc2 = Autoscaler(mesh2, _asc_cfg(AUTOSCALE_DOWN_IDLE_SECS=0.2))
+    mesh2._queue.next = (0.0, 0, 8.0)
+    assert asc2.tick() == 'hold'  # idle clock starts; not sustained yet
+    time.sleep(0.25)
+    assert asc2.tick() == 'down'
+    # r1 is adopted, so LIFO falls back to r0... but r0 draining would
+    # drop the fleet to only the adopted worker — that IS the contract:
+    # the victim must be the newest LOCAL replica
+    assert mesh2.retired == [('r0', 'autoscale')]
+    # min bound: fleet of 1 serving (r1) never drains below min
+    time.sleep(0.25)
+    assert asc2.tick() == 'hold'
+
+    # ---- flap guard: up -> down -> (blocked up) freezes scaling ----
+    mesh3 = _StubMesh(1)
+    asc3 = Autoscaler(mesh3, _asc_cfg(AUTOSCALE_FLAP_LIMIT=2,
+                                      AUTOSCALE_FLAP_WINDOW_SECS=60.0))
+    mesh3._queue.next = (10.0, 80, 8.0)
+    assert asc3.tick() == 'up'
+    mesh3._queue.next = (0.0, 0, 8.0)
+    assert asc3.tick() == 'down'  # reversal 1
+    mesh3._queue.next = (10.0, 80, 8.0)
+    tick = asc3.tick()  # reversal 2 == limit: freeze, no transition
+    assert asc3.stats()['flap_freezes_total'] == 1
+    assert asc3.tick() == 'frozen'
+    assert len([s for s in mesh3._replicas if not s.retired]) == 1
+
+    # ---- spawn hook: capacity requested, not locally spawned ----
+    mesh4 = _StubMesh(1)
+    asked = []
+    asc4 = Autoscaler(mesh4, _asc_cfg(), spawn=asked.append)
+    mesh4._queue.next = (float('inf'), 40, 0.0)  # stalled fleet
+    assert asc4.tick() == 'up'
+    assert asked == [mesh4] and len(mesh4._replicas) == 1
+
+
+def test_frontqueue_drain_seconds_estimate():
+    queue = frontqueue_lib.FrontQueue(('topk',), bound=None,
+                                      fleet_rate=lambda: 4.0)
+    assert queue.drain_seconds() == (0.0, 0, 4.0)
+    queue.admit(8, 'topk', None)
+    queue.enqueue('topk', [_fake_request(8)], 8)
+    drain_s, rows, rate = queue.drain_seconds()
+    assert (drain_s, rows, rate) == (2.0, 8, 4.0)
+    stalled = frontqueue_lib.FrontQueue(('topk',), bound=None,
+                                        fleet_rate=lambda: 0.0)
+    stalled.admit(4, 'topk', None)
+    stalled.enqueue('topk', [_fake_request(4)], 4)
+    assert stalled.drain_seconds()[0] == float('inf')
+
+
+def _dial_raw(mesh, rid, proto=None):
+    """Hand-rolled worker dial-in: the wire any external orchestrator
+    speaks (scripts/mesh_worker.py does exactly this via transport.dial)."""
+    import socket as socket_lib
+    conn = socket_lib.create_connection(tuple(mesh._listener.address),
+                                        timeout=30.0)
+    channel = transport_lib.SocketTransport(conn)
+    channel.send(('hello', rid,
+                  transport_lib.WIRE_PROTO if proto is None else proto,
+                  4242))
+    return channel
+
+
+def _ready_frame(model, step, tiers=('topk',), devices=None):
+    caps = {'tiers': list(tiers), 'wire': model.config.BATCH_WIRE_FORMAT,
+            'proto': transport_lib.WIRE_PROTO}
+    if devices is not None:
+        caps['devices'] = list(devices)
+    return ('ready', {'params_step': step,
+                      't_mono': time.perf_counter(),
+                      'capabilities': caps})
+
+
+def test_adoption_dialins_validated_and_adopted_death_budget_free(
+        proc_model):
+    """Adoption edges (SERVING.md "Elastic fleet"): a wrong-proto
+    dial-in is rejected typed at the listener; a dial-in that never
+    reports ready is dropped typed after the bounded adoption wait
+    (the adopt_stall shape); a ready worker missing a warm tier is
+    turned away typed; a WELL-FORMED unknown-rid dial-in is adopted
+    and seated; a duplicate rid is refused; and the adopted worker's
+    death retires its slot typed WITHOUT charging the local restart
+    budget — its restart supervision belongs to the orchestrator."""
+    model = proc_model
+    with _cfg(model, MESH_HEARTBEAT_SECS=30.0, MESH_HEARTBEAT_MISSES=2,
+              MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='socket', max_delay_ms=0.0)
+    mesh.adopt_ready_timeout_s = 1.0
+    channels = []
+    try:
+        # (a) wrong wire proto: typed rejection AT the listener
+        bad = _dial_raw(mesh, 'ext-proto', proto=1)
+        channels.append(bad)
+        kind, why = bad.recv()[:2]
+        assert kind == 'adopt_rejected' and 'proto' in why
+        _wait_until(lambda: mesh.stats()['proto_rejected_total'] >= 1,
+                    what='listener proto rejection counter')
+        # (b) dialed in but never ready: bounded wait, typed drop (the
+        # adopt_stall drill's shape — the frame arrives BEFORE the
+        # close, so the orchestrator's logs learn why)
+        ghost = _dial_raw(mesh, 'ext-ghost')
+        channels.append(ghost)
+        kind, why = ghost.recv()[:2]  # blocks ~adopt_ready_timeout_s
+        assert kind == 'adopt_rejected' and 'ready' in why
+        # the typed frame can reach the client a beat before the
+        # adoption loop's counter lands: wait, don't read-once
+        _wait_until(lambda: mesh.stats()['adoption_rejected_total'] == 1,
+                    what='never-ready rejection counter')
+        # (c) ready but missing a warm tier this mesh serves: typed
+        cold = _dial_raw(mesh, 'ext-cold')
+        channels.append(cold)
+        cold.send(_ready_frame(model, 0, tiers=()))
+        kind, why = cold.recv()[:2]
+        assert kind == 'adopt_rejected' and 'tier' in why
+        _wait_until(lambda: mesh.stats()['adoption_rejected_total'] == 2,
+                    what='missing-tier rejection counter')
+        # (d) a well-formed unknown-rid dial-in IS adopted and seated
+        step = mesh.stats()['params_step']
+        good = _dial_raw(mesh, 'ext-fake')
+        channels.append(good)
+        good.send(_ready_frame(model, step, devices=(6, 7)))
+        _wait_until(lambda: mesh.stats()['adopted_total'] == 1,
+                    timeout=30.0, what='adoption of ext-fake')
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['ext-fake']['adopted'] is True
+        assert rows['ext-fake']['devices'] == [6, 7]
+        assert rows['ext-fake']['retired'] is False
+        # (e) duplicate rid while the first incarnation serves: typed
+        dupe = _dial_raw(mesh, 'ext-fake')
+        channels.append(dupe)
+        kind, why = dupe.recv()[:2]
+        assert kind == 'adopt_rejected' and 'unique' in why
+        _wait_until(lambda: mesh.stats()['adoption_rejected_total'] == 3,
+                    what='duplicate-rid rejection counter')
+        # (f) adopted worker dies -> typed retirement, ZERO charge on
+        # the LOCAL restart budget (the orchestrator owns its restarts)
+        restarts_before = mesh.stats()['restarts_total']
+        good.close()  # the worker's end of the wire drops
+        slot = next(s for s in mesh._replicas if s.rid == 'ext-fake')
+        _wait_until(lambda: slot.retired, timeout=30.0,
+                    what='adopted-worker death retirement')
+        assert slot.retired_reason == 'adopted_worker_exit'
+        time.sleep(0.3)  # a (wrong) supervised restart would act now
+        assert mesh.stats()['restarts_total'] == restarts_before
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['ext-fake']['retired_reason'] == 'adopted_worker_exit'
+        assert mesh.stats()['retired_total'] == 1
+        # the local fleet is untouched: r0 still serves
+        assert mesh.predict([PREDICT_LINES[0]], tier='topk',
+                            timeout=120)[0].topk_predicted_words
+    finally:
+        for channel in channels:
+            try:
+                channel.close()
+            except Exception:
+                pass
+        mesh.close()
+        _assert_healing_threads_reaped(mesh)
+
+
+def _fake_worker_loop(channel, calls):
+    """Worker-side control protocol, just enough for adoption: answer
+    the re-adopt (load_params + poll_rollover) and the close."""
+    try:
+        while True:
+            msg = channel.recv()
+            kind, seq = msg[0], msg[1]
+            if kind == 'load_params':
+                calls.append(('load_params', msg[2]))
+                channel.send(('result', seq, True))
+            elif kind == 'poll_rollover':
+                channel.send(('result', seq, {'swapped': True}))
+            elif kind == 'stats':
+                channel.send(('result', seq, {'replica': 'fake'}))
+            elif kind == 'close':
+                channel.send(('closed', seq))
+                return
+    except Exception:
+        return
+
+
+def test_adoption_mid_rollover_waits_then_serves_fleet_step(proc_model):
+    """An adoption landing while a fleet rollover is in flight WAITS
+    the rollover out, then re-adopts the dial-in onto the step the
+    fleet settled on — never the step the worker cold-started at."""
+    model = proc_model
+    with _cfg(model, MESH_HEARTBEAT_SECS=30.0, MESH_HEARTBEAT_MISSES=2):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='socket', max_delay_ms=0.0)
+    try:
+        with mesh._cond:
+            mesh._rollover = {'drill': 'held-open'}
+        channel = _dial_raw(mesh, 'ext-roll')
+        calls = []
+        threading.Thread(target=_fake_worker_loop, args=(channel, calls),
+                         daemon=True, name='fake-ext-roll').start()
+        channel.send(_ready_frame(model, 123))  # a stale cold-start step
+        time.sleep(0.8)  # validated by now; parked on the rollover gate
+        assert mesh.stats()['adopted_total'] == 0
+        assert calls == []  # NOT re-adopted against the in-flight step
+        with mesh._cond:
+            mesh._params_step = 5  # the step the rollover settled on
+            mesh._rollover = None
+            mesh._cond.notify_all()
+        _wait_until(lambda: mesh.stats()['adopted_total'] == 1,
+                    timeout=30.0, what='post-rollover adoption')
+        assert calls == [('load_params', 5)]
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['ext-roll']['adopted'] is True
+    finally:
+        mesh.close()
+        _assert_healing_threads_reaped(mesh)
+
+
+def test_autoscale_scale_up_spawn_failure_counted_not_fatal(proc_model):
+    """The spawn_fail fault point: a scale-up whose worker spawn
+    refuses is counted (autoscale/scale_up_failed_total), leaves the
+    fleet intact, and every admitted request still drains on the
+    existing replicas — a failed scale-up costs latency, not answers."""
+    model = proc_model
+    with _cfg(model, FAULT_INJECT='slow_dispatch@req=0..63',
+              AUTOSCALE_MAX_REPLICAS=2, AUTOSCALE_MIN_REPLICAS=1,
+              AUTOSCALE_INTERVAL_SECS=3600.0,
+              AUTOSCALE_UP_QUEUE_SECS=0.05,
+              AUTOSCALE_UP_COOLDOWN_SECS=0.0,
+              MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=4,
+              MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5):
+        mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                  mode='process', max_delay_ms=0.0)
+    try:
+        asc = mesh._autoscaler
+        assert asc is not None
+        mesh.predict([PREDICT_LINES[0]], tier='topk', timeout=120)
+        # slow workers + a backlog: the drain estimate crosses the
+        # scale-up threshold
+        admitted = [mesh.submit([PREDICT_LINES[(i + j) % 3]
+                                 for j in range(3)], tier='topk')
+                    for i in range(20)]
+        faults.configure('spawn_fail@spawn=0')  # parent-side plan
+        assert asc.tick() == 'up'
+        asc_stats = mesh.stats()['autoscaler']
+        assert asc_stats['scale_up_failed_total'] == 1
+        assert asc_stats['scale_up_total'] == 0
+        assert mesh.stats()['replicas_live'] == 1
+        faults.configure('')
+        for future in admitted:  # zero lost admitted requests
+            assert future.result(timeout=120)
+    finally:
+        mesh.close()
+        _assert_healing_threads_reaped(mesh)
+
+
+def test_elastic_fleet_acceptance_drill(tmp_path_factory, tmp_path):
+    """The ISSUE 18 acceptance drill: a placed socket fleet under
+    stepped offered load and mid-batch worker-kill chaos scales
+    1 -> 2 -> 1 through the SLO/queue-driven autoscaler with ZERO lost
+    admitted requests and ZERO post-warmup parent compiles; replicas
+    land on DISJOINT device slices (asserted from placement stats);
+    and an EXTERNALLY-spawned worker (scripts/mesh_worker.py, the
+    orchestrator path) is adopted mid-run and serves bit-identical
+    results on its own slice.  The kill is the kill_worker chaos
+    shape — a SIGKILL landing mid-batch (slow_dispatch holds worker
+    batches >=250ms so the kill deterministically interrupts one)."""
+    from code2vec_tpu.telemetry import core
+    from code2vec_tpu.telemetry.jit_tracker import install_compile_listener
+    model = _checkpointed_model(tmp_path_factory, 'elastic')
+    core.reset()
+    core.enable()
+    mesh = None
+    ext = None
+    try:
+        assert install_compile_listener()
+        compiles = core.registry().counter('jit/compiles_total')
+        with _cfg(model, FAULT_INJECT='slow_dispatch@req=0..63',
+                  MESH_DEVICES_PER_REPLICA=2,
+                  AUTOSCALE_MAX_REPLICAS=2, AUTOSCALE_MIN_REPLICAS=1,
+                  AUTOSCALE_INTERVAL_SECS=3600.0,  # drills drive tick()
+                  AUTOSCALE_UP_QUEUE_SECS=0.05,
+                  AUTOSCALE_UP_COOLDOWN_SECS=0.0,
+                  AUTOSCALE_DOWN_COOLDOWN_SECS=0.0,
+                  AUTOSCALE_DOWN_IDLE_SECS=0.3,
+                  AUTOSCALE_DOWN_UTILIZATION=0.9,
+                  AUTOSCALE_FLAP_LIMIT=10,
+                  MESH_HEARTBEAT_SECS=0.25, MESH_HEARTBEAT_MISSES=4,
+                  MESH_RESTART_BACKOFF_SECS=0.05, MESH_RESTART_LIMIT=5):
+            mesh = model.serving_mesh(replicas=1, tiers=('topk',),
+                                      mode='socket', max_delay_ms=0.0)
+        asc = mesh._autoscaler
+        assert asc is not None
+        stats = mesh.stats()
+        assert stats['placement'] == {'devices_per_replica': 2,
+                                      'slices': 2, 'data_axis': 2}
+        assert stats['replicas'][0]['devices'] == [0, 1]
+        # the fleet's reference answers (replica r0 on its 2-device
+        # slice): every later result — sibling, restarted, adopted —
+        # must match these BIT-identically
+        expected = {
+            line: mesh.predict([line], tier='topk',
+                               timeout=180)[0].topk_predicted_words
+            for line in PREDICT_LINES}
+        warm = compiles.value
+        # external orchestrator leg: exec scripts/mesh_worker.py
+        # against the listener with its own disjoint slice; it cold
+        # starts CONCURRENTLY with the load phases below
+        overrides = dict(mesh._model_config_overrides)
+        cfg_path = tmp_path / 'ext_worker.json'
+        cfg_path.write_text(json.dumps(overrides))
+        host, port = mesh._listener.address
+        script = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(mesh_lib.__file__))), '..', 'scripts',
+            'mesh_worker.py')
+        ext = subprocess.Popen(
+            [sys.executable, os.path.abspath(script),
+             '--address', '%s:%d' % (host, port), '--rid', 'ext-drill',
+             '--config-json', str(cfg_path), '--device-indices', '4,5'],
+            env=dict(os.environ, JAX_PLATFORMS='cpu'),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        # ---- load step UP: backlog outruns one slow replica ----
+        wave1 = [[PREDICT_LINES[(i + j) % 3] for j in range(3)]
+                 for i in range(20)]
+        admitted = [(lines, mesh.submit(lines, tier='topk'))
+                    for lines in wave1]
+        t_up = time.perf_counter()
+        assert asc.tick() == 'up'  # blocks through the worker spawn
+        scale_up_s = time.perf_counter() - t_up
+        assert scale_up_s < 150.0
+        assert mesh.stats()['autoscaler']['scale_up_total'] == 1
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['r1']['devices'] == [2, 3]  # disjoint slice
+        # ---- chaos: SIGKILL r0 mid-batch while load is in flight ----
+        wave2 = [[PREDICT_LINES[(i + j) % 3] for j in range(3)]
+                 for i in range(20)]
+        admitted += [(lines, mesh.submit(lines, tier='topk'))
+                     for lines in wave2]
+        slot0 = mesh._replicas[0]
+        _wait_until(lambda: slot0.inflight >= 1, timeout=60.0,
+                    what='r0 to hold an in-flight batch')
+        os.kill(slot0.transport.pid, signal.SIGKILL)
+        # zero lost admitted requests, all answers bit-identical
+        for lines, future in admitted:
+            results = future.result(timeout=180)
+            assert len(results) == len(lines)
+            for line, result in zip(lines, results):
+                assert result.topk_predicted_words == expected[line]
+        _wait_until(lambda: mesh.stats()['restarts_total'] >= 1,
+                    timeout=120.0, what='supervised restart of r0')
+        # ---- adoption lands mid-run ----
+        _wait_until(lambda: mesh.stats()['adopted_total'] >= 1,
+                    timeout=300.0, what='adoption of ext-drill')
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['ext-drill']['adopted'] is True
+        assert rows['ext-drill']['devices'] == [4, 5]  # its own slice
+        ext_slot = next(s for s in mesh._replicas
+                        if s.rid == 'ext-drill')
+        deadline = time.perf_counter() + 120.0
+        while ext_slot.batches == 0:  # until the adoptee itself served
+            assert time.perf_counter() < deadline, \
+                'adopted worker never served'
+            for line in PREDICT_LINES:
+                (res,) = mesh.predict([line], tier='topk', timeout=180)
+                assert res.topk_predicted_words == expected[line]
+        per = {s.get('replica'): s for s in mesh.replica_stats()}
+        assert per['ext-drill'].get('params_step') == \
+            mesh.stats()['params_step']
+        # ---- orchestrator-owned death: no local budget charge ----
+        restarts_before = mesh.stats()['restarts_total']
+        ext.kill()
+        _wait_until(lambda: ext_slot.retired, timeout=60.0,
+                    what='adopted-worker exit retirement')
+        assert ext_slot.retired_reason == 'adopted_worker_exit'
+        time.sleep(0.6)
+        assert mesh.stats()['restarts_total'] == restarts_before
+        # ---- load steps DOWN: sustained idleness drains r1 out ----
+        assert asc.tick() in ('hold', 'down')  # idle clock starts
+        time.sleep(0.4)
+        _wait_until(lambda: asc.tick() in ('down', 'hold')
+                    and mesh.stats()['autoscaler']['scale_down_total']
+                    >= 1, timeout=60.0, what='autoscaler scale-down')
+        rows = {r['replica']: r for r in mesh.stats()['replicas']}
+        assert rows['r1']['retired'] is True
+        assert rows['r1']['retired_reason'] == 'autoscale'
+        assert mesh.stats()['replicas_live'] == 1
+        # the drained fleet still serves, still bit-identical
+        (res,) = mesh.predict([PREDICT_LINES[1]], tier='topk',
+                              timeout=180)
+        assert res.topk_predicted_words == expected[PREDICT_LINES[1]]
+        # zero post-warmup compiles in the parent across scale-up,
+        # kill+restart, adoption, and scale-down
+        assert compiles.value - warm == 0, (
+            '%d parent-side compiles during the elastic drill'
+            % (compiles.value - warm))
+    finally:
+        if ext is not None:
+            ext.kill()
+            ext.wait(timeout=60)
+        if mesh is not None:
+            mesh.close()
+            _assert_healing_threads_reaped(mesh)
+        model.close_stores()
+        core.disable()
+        core.reset()
