@@ -22,6 +22,12 @@ Prints one JSON line per metric:
   {"metric": "index_ivf_recall_at10", "value": ..., "nprobe": ...}
   {"metric": "index_ivf_curve", "points": [{"nprobe", "recall",
    "queries_per_sec"}, ...]}
+  {"metric": "index_quant_recall_at10", "kind": "int8"|"pq", ...}
+  {"metric": "index_quant_queries_per_sec", "kind": ...,
+   "device_bytes_per_vector": ..., "compression_vs_f16": ...,
+   "postwarm_compiles": 0}
+  {"metric": "index_quant_insert_vectors_per_sec", "rows": ...,
+   "self_hit_at1": ..., "segments": ...}
 
 BENCH_SMOKE=1 shrinks the corpus for a CPU smoke run (metrics carry a
 ``smoke`` field). On-chip runs go through benchmarks/capture_all.sh
@@ -94,7 +100,15 @@ def main() -> None:
     parser.add_argument('--reps', type=int, default=3,
                         help='repetitions per variant; best wall time '
                              'reported (host-jitter control)')
+    parser.add_argument('--arms', default='all',
+                        choices=['all', 'base', 'quant'],
+                        help="'base' = naive/exact/ivf (capture stage "
+                             "`index`), 'quant' = int8/pq + insert "
+                             "(stage `index_quant`; the exact tier "
+                             "still builds as the recall baseline)")
     args = parser.parse_args()
+    base_arms = args.arms in ('all', 'base')
+    quant_arms = args.arms in ('all', 'quant')
 
     from code2vec_tpu.index import store as store_lib
     from code2vec_tpu.index.exact import ExactIndex
@@ -119,12 +133,13 @@ def main() -> None:
                             [vectors], dtype=args.dtype, metric='cosine')
 
     # ---- naive numpy host loop
-    normed = store.all_rows().astype(np.float32)
-    naive_s = min(benchlib.bench_timer_wall(
-        lambda: naive_numpy_search(normed, queries, args.k))
-        for _ in range(args.reps))
-    emit({'metric': 'index_naive_queries_per_sec',
-          'value': args.queries / naive_s})
+    if base_arms:
+        normed = store.all_rows().astype(np.float32)
+        naive_s = min(benchlib.bench_timer_wall(
+            lambda: naive_numpy_search(normed, queries, args.k))
+            for _ in range(args.reps))
+        emit({'metric': 'index_naive_queries_per_sec',
+              'value': args.queries / naive_s})
 
     # ---- exact tier, warm; compile counter must stay flat after warmup
     core.reset()
@@ -145,28 +160,83 @@ def main() -> None:
     emit({'metric': 'index_exact_queries_per_sec',
           'value': args.queries / exact_s, 'dtype': args.dtype,
           'vectors': args.vectors})
-    emit({'metric': 'index_exact_speedup_vs_numpy',
-          'value': naive_s / exact_s, 'postwarm_compiles': postwarm})
+    if base_arms:
+        emit({'metric': 'index_exact_speedup_vs_numpy',
+              'value': naive_s / exact_s, 'postwarm_compiles': postwarm})
 
     # ---- IVF: recall + throughput across nprobe
-    ivf = IVFIndex.build(store, persist=False)
-    points = []
-    nprobe = 1
-    while nprobe <= min(64, ivf.n_clusters):
-        recall = measure_recall(ivf, index, queries, k=args.k,
-                                nprobe=nprobe)
-        ivf.search(queries, args.k, nprobe=nprobe)  # warm this shape
-        ivf_s = min(benchlib.bench_timer_wall(
-            lambda: ivf.search(queries, args.k, nprobe=nprobe))
-            for _ in range(args.reps))
-        points.append({'nprobe': nprobe, 'recall': round(recall, 4),
-                       'queries_per_sec': args.queries / ivf_s})
-        nprobe *= 2
-    default_recall = measure_recall(ivf, index, queries, k=args.k)
-    emit({'metric': 'index_ivf_recall_at10', 'value': default_recall,
-          'nprobe': ivf.nprobe, 'clusters': ivf.n_clusters,
-          'vectors': args.vectors})
-    emit({'metric': 'index_ivf_curve', 'points': points})
+    if base_arms:
+        ivf = IVFIndex.build(store, persist=False)
+        points = []
+        nprobe = 1
+        while nprobe <= min(64, ivf.n_clusters):
+            recall = measure_recall(ivf, index, queries, k=args.k,
+                                    nprobe=nprobe)
+            ivf.search(queries, args.k, nprobe=nprobe)  # warm this shape
+            ivf_s = min(benchlib.bench_timer_wall(
+                lambda: ivf.search(queries, args.k, nprobe=nprobe))
+                for _ in range(args.reps))
+            points.append({'nprobe': nprobe, 'recall': round(recall, 4),
+                           'queries_per_sec': args.queries / ivf_s})
+            nprobe *= 2
+        default_recall = measure_recall(ivf, index, queries, k=args.k)
+        emit({'metric': 'index_ivf_recall_at10', 'value': default_recall,
+              'nprobe': ivf.nprobe, 'clusters': ivf.n_clusters,
+              'vectors': args.vectors})
+        emit({'metric': 'index_ivf_curve', 'points': points})
+
+    # ---- quantized tier: f16 (above) vs int8 vs PQ — QPS, recall@10
+    # vs exact, device bytes/vector, zero post-warmup compiles
+    if quant_arms:
+        from code2vec_tpu.index.quant import QuantizedIVFIndex
+        f16_bpv = 2 * args.dim
+        quant = None
+        for kind in ('int8', 'pq'):
+            core.reset()
+            core.enable()
+            try:
+                install_compile_listener()
+                compiles = core.registry().counter('jit/compiles_total')
+                quant = QuantizedIVFIndex.build(store, kind=kind)
+                quant.warmup(args.k)
+                quant.search(queries, args.k)  # full-shape warm pass
+                warm_compiles = compiles.value
+                quant_s = min(benchlib.bench_timer_wall(
+                    lambda: quant.search(queries, args.k))
+                    for _ in range(args.reps))
+                postwarm = compiles.value - warm_compiles
+            finally:
+                core.disable()
+                core.reset()
+            recall = measure_recall(quant, index, queries, k=args.k)
+            emit({'metric': 'index_quant_recall_at10', 'kind': kind,
+                  'value': recall, 'rerank': quant.rerank,
+                  'vectors': args.vectors})
+            emit({'metric': 'index_quant_queries_per_sec', 'kind': kind,
+                  'value': args.queries / quant_s,
+                  'postwarm_compiles': postwarm,
+                  'device_bytes_per_vector': quant.bytes_per_vector,
+                  'f16_bytes_per_vector': f16_bpv,
+                  'compression_vs_f16': f16_bpv / quant.bytes_per_vector})
+
+        # ---- live-insert arm (on the PQ index from the last loop
+        # turn): encode + page + device refresh throughput, and the
+        # inserted rows must be queryable immediately (no rebuild)
+        insert_rows = 512 if smoke else 8192
+        extra = synthesize_corpus(insert_rows, args.dim, args.centers,
+                                  seed=7)
+        t0 = time.perf_counter()
+        row_ids = quant.insert(extra)
+        insert_s = time.perf_counter() - t0
+        probe = extra[:min(32, insert_rows)].astype(np.float32)
+        _scores, got = quant.search(probe, 1)
+        hit = float(np.mean([int(got[i, 0]) == int(row_ids[i])
+                             for i in range(probe.shape[0])]))
+        emit({'metric': 'index_quant_insert_vectors_per_sec',
+              'kind': 'pq', 'value': insert_rows / insert_s,
+              'rows': insert_rows, 'self_hit_at1': hit,
+              'segments': quant.segment_count})
+
     # per-stage peak HBM (ISSUE 9): covers the exact store residency
     # AND the IVF cluster-sorted copy on this backend
     emit({'metric': 'index_peak_hbm_bytes',

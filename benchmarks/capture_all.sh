@@ -158,8 +158,13 @@ run_stage mesh_soak 600 python scripts/mesh_soak.py --mode socket
 probe_or_record "after mesh_soak" || exit 3
 # embedding index (ISSUE 5): exact vs IVF throughput/recall curves +
 # the naive numpy host-loop baseline
-run_stage index 900 python benchmarks/bench_index.py
+run_stage index 900 python benchmarks/bench_index.py --arms base
 probe_or_record "after index" || exit 3
+# quantized tier (ISSUE 19): f16 vs int8 vs PQ — QPS, recall@10,
+# device bytes/vector, zero post-warmup compiles — plus the
+# live-insert throughput arm
+run_stage index_quant 900 python benchmarks/bench_index.py --arms quant
+probe_or_record "after index_quant" || exit 3
 # training goodput plane (ISSUE 17): steady-state MFU, goodput
 # fraction, and badput shares of the real hot loop — the healthy
 # baseline a later goodput regression flips against

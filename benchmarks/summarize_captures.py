@@ -126,7 +126,18 @@ def main() -> None:
                                'badput_compile_pct',
                                'badput_input_wait_pct',
                                'arithmetic_intensity',
-                               'steps_per_window')}
+                               'steps_per_window',
+                               # quantized index tier (ISSUE 19):
+                               # int8/pq arms keyed by 'kind' (above)
+                               # — QPS rides 'value'; the bytes/vector
+                               # and compression columns are the <=1/4-
+                               # of-f16 acceptance, 'self_hit_at1' the
+                               # insert arm's queryable-now check
+                               'device_bytes_per_vector',
+                               'f16_bytes_per_vector',
+                               'compression_vs_f16', 'rerank',
+                               'nprobe', 'rows', 'self_hit_at1',
+                               'segments')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

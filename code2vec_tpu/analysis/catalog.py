@@ -64,6 +64,21 @@ SANCTIONED_SYNCS = (
      'func': 'IVFIndex.search', 'kind': 'fetch', 'count': 2,
      'reason': 'search returns host numpy (scores, ids) by contract — '
                'the probe-map back through list_ids is host-side'},
+    {'file': 'code2vec_tpu/index/quant.py',
+     'func': '_assign_chunks', 'kind': 'fetch', 'count': 1,
+     'reason': 'build/insert-path codeword fetch per fixed-shape '
+               'encode chunk (codes land in a host CSR; queries never '
+               'touch this path)'},
+    {'file': 'code2vec_tpu/index/quant.py',
+     'func': 'train_pq', 'kind': 'fetch', 'count': 1,
+     'reason': 'build-path codebook fetch after each Lloyd iteration '
+               '(once per PQ training pass, not per query)'},
+    {'file': 'code2vec_tpu/index/quant.py',
+     'func': 'QuantizedIVFIndex.search', 'kind': 'fetch', 'count': 2,
+     'reason': 'search returns host numpy (scores, ids) by contract — '
+               'the LUT-gather top-k positions map back through '
+               'list_ids / segment row ids host-side, and the optional '
+               'exact re-rank reads the mmap store'},
     {'file': 'code2vec_tpu/model_api.py',
      'func': 'Code2VecModel.predict', 'kind': 'fetch', 'count': 1,
      'reason': 'REPL path: one blocking fetch per interactive request; '
@@ -81,7 +96,7 @@ JIT_ENTRY_POINTS = frozenset((
     'train_step', 'train_step_placed', 'eval_step', 'eval_step_placed',
     'predict_step', 'predict_step_placed',
     '_train_step', '_train_step_packed', '_eval_step', '_eval_step_packed',
-    '_streamed_shard_topk',
+    '_streamed_shard_topk', '_pq_assign_chunk', '_pq_update',
 ))
 
 # Methods returning a jitted program (calling the returned value

@@ -600,6 +600,22 @@ class Config:
     INDEX_NPROBE: int = 8
     # IVF: k-means cluster count; 0 = sqrt(N) heuristic.
     INDEX_CLUSTERS: int = 0
+    # Quantized IVF tier (index/quant.py): '' serves full-precision
+    # rows at INDEX_KIND; 'int8' / 'pq' store compressed codes on
+    # device (int8 = 1/2, PQ = ~1/8 the bytes of f16) with an exact
+    # top-R re-rank from the mmap store (INDEX.md "Quantized tier").
+    INDEX_QUANT: str = ''
+    # Quantized tier: exact re-rank depth R — the recall-recovery dial
+    # (0 serves the quantized order raw).
+    INDEX_RERANK: int = 128
+    # PQ subspace count per vector; 0 = dim/4 clamped to a divisor.
+    INDEX_PQ_M: int = 0
+    # Live inserts: append-segment page size in rows (each segment is
+    # a fixed-shape sidecar probed alongside the base lists).
+    INDEX_SEGMENT_ROWS: int = 4096
+    # Auto-compaction threshold: fold append segments into the base
+    # CSR when their count passes this; 0 = manual compaction only.
+    INDEX_COMPACT_SEGMENTS: int = 8
     # Neighbors returned per query by the serving/CLI paths, and the k
     # the index warm-compiles at load.
     INDEX_NEIGHBORS_K: int = 10
@@ -965,6 +981,28 @@ class Config:
         parser.add_argument('--neighbors-k', dest='index_neighbors_k',
                             type=int, default=None, metavar='K',
                             help='neighbors returned per query')
+        parser.add_argument('--index-quant', dest='index_quant',
+                            choices=['off', 'int8', 'pq'], default=None,
+                            help='quantized IVF tier: int8 or product-'
+                                 'quantized device codes + exact '
+                                 're-rank (INDEX.md "Quantized tier")')
+        parser.add_argument('--index-rerank', dest='index_rerank',
+                            type=int, default=None, metavar='R',
+                            help='exact re-rank depth of the quantized '
+                                 'tier (0 = quantized order only)')
+        parser.add_argument('--index-pq-m', dest='index_pq_m',
+                            type=int, default=None, metavar='M',
+                            help='PQ subspaces per vector (0 = dim/4)')
+        parser.add_argument('--index-segment-rows',
+                            dest='index_segment_rows', type=int,
+                            default=None, metavar='N',
+                            help='append-segment page size (rows) for '
+                                 'live index inserts')
+        parser.add_argument('--index-compact-segments',
+                            dest='index_compact_segments', type=int,
+                            default=None, metavar='S',
+                            help='auto-compact after S append segments '
+                                 '(0 = manual compaction only)')
         parser.add_argument('--opt-state-sharding',
                             dest='opt_state_sharding',
                             choices=['mirror', 'zero'], default=None,
@@ -1121,6 +1159,17 @@ class Config:
             self.INDEX_CLUSTERS = parsed.index_clusters
         if parsed.index_neighbors_k is not None:
             self.INDEX_NEIGHBORS_K = parsed.index_neighbors_k
+        if parsed.index_quant is not None:
+            self.INDEX_QUANT = ('' if parsed.index_quant == 'off'
+                                else parsed.index_quant)
+        if parsed.index_rerank is not None:
+            self.INDEX_RERANK = parsed.index_rerank
+        if parsed.index_pq_m is not None:
+            self.INDEX_PQ_M = parsed.index_pq_m
+        if parsed.index_segment_rows is not None:
+            self.INDEX_SEGMENT_ROWS = parsed.index_segment_rows
+        if parsed.index_compact_segments is not None:
+            self.INDEX_COMPACT_SEGMENTS = parsed.index_compact_segments
         return self
 
     # ------------------------------------------------------- derived props
@@ -1525,6 +1574,21 @@ class Config:
                              '(0 = sqrt(N)).')
         if self.INDEX_NEIGHBORS_K < 1:
             raise ValueError('config.INDEX_NEIGHBORS_K must be >= 1.')
+        if self.INDEX_QUANT not in {'', 'int8', 'pq'}:
+            raise ValueError("config.INDEX_QUANT must be in "
+                             "{'', 'int8', 'pq'} ('' = full-precision "
+                             "tier).")
+        if self.INDEX_RERANK < 0:
+            raise ValueError('config.INDEX_RERANK must be >= 0 '
+                             '(0 disables the exact re-rank).')
+        if self.INDEX_PQ_M < 0:
+            raise ValueError('config.INDEX_PQ_M must be >= 0 '
+                             '(0 = dim/4).')
+        if self.INDEX_SEGMENT_ROWS < 1:
+            raise ValueError('config.INDEX_SEGMENT_ROWS must be >= 1.')
+        if self.INDEX_COMPACT_SEGMENTS < 0:
+            raise ValueError('config.INDEX_COMPACT_SEGMENTS must be '
+                             '>= 0 (0 = manual compaction only).')
         if self.QUERY_NEIGHBORS_PATH and not (self.INDEX_PATH
                                               or self.BUILD_INDEX_FROM):
             raise ValueError(

@@ -15,6 +15,9 @@ extract → train → export → **search** (INDEX.md):
   larger than device memory;
 - ``ivf``     — approximate tier: on-device k-means coarse quantizer,
   inverted lists, ``nprobe``-bounded probing;
+- ``quant``   — quantized tier: int8/PQ codes over the IVF lists
+  (int8 = 1/2, PQ = ~1/8 the device bytes of f16) with a host-exact
+  top-R re-rank, live insert segments, and compaction;
 - ``service`` — build/load/query orchestration and the ServingEngine
   ``submit_neighbors`` composition (one warm round-trip from raw
   context lines to the K most similar corpus methods).

@@ -1,0 +1,1005 @@
+"""Quantized IVF tier: int8/PQ inverted lists, append segments, compaction.
+
+The IVF tier (index/ivf.py) keeps the cluster-sorted rows device-resident
+in the STORE dtype — f16 at best, 2 bytes/dim.  At production corpus
+scale that is the binding constraint: ``HBM_BUDGET_BYTES`` caps the
+resident vector count at ``budget / (2 * dim)``.  This tier swaps the
+resident payload for quantized codes and scores them with the same
+warm-shape discipline:
+
+- **int8** — per-dimension symmetric scales (``scale = maxabs / 127``);
+  the query is pre-scaled once per batch and the probe program is the
+  same gather + f32 einsum as the IVF tier at 1 byte/dim (½ of f16).
+- **pq** — product quantization of the RESIDUALS against the coarse
+  centroids (IVFADC): a row's code describes ``row - centroid[list]``,
+  so the codebooks spend their 256 codewords per subspace on the
+  within-cluster structure instead of re-describing the cluster layout
+  the coarse quantizer already captured.  The dim axis splits into
+  ``M`` subspaces, each with a 256-codeword codebook trained by the
+  same batched Lloyd substrate as the coarse k-means (one jitted
+  update over ALL subspaces: flattened ``segment_sum`` with
+  per-subspace id offsets).  A row stores one uint8 per subspace —
+  ``M`` bytes/vector (dim/4 subspaces by default → 1/8 of f16).
+  Scoring is asymmetric distance: ``q·row ≈ q·centroid + q·residual``
+  — the first term is the coarse score the host already computed (it
+  rides in as a per-candidate operand), the second a per-query LUT
+  ``(Q, M, 256)`` built on device from the f32 query followed by one
+  gather-accumulate over the candidate codes.  One program per (query
+  bucket, probe capacity rung, re-rank depth, segment rung) — LUT
+  build and gather fuse into a single warm XLA program; nothing
+  recompiles per query batch.
+- **re-rank** — quantized scores rank candidates; the top-R survivors
+  are re-scored EXACTLY from the mmap store (``VectorStore.take``) and
+  re-sorted by ``(-score, id)`` on the host.  R (``--index-rerank``)
+  buys back the recall the codes gave up; the recall@10 gate
+  (``index/recall_at10``) licenses the compression.
+
+**Incremental inserts** — new vectors land in bounded append segments:
+host truth (vectors + codes + assignments) persisted as versioned
+``segment_%05d.npz`` sidecars under ``segments.json``, device codes in
+ONE fixed-shape append buffer padded to a ``bucketed_capacity`` rung
+and probed alongside the base lists by the same warm programs (candidate
+positions ``>= base_rows`` select the segment buffer).  ``compact()``
+folds segment truth into the store (``append_rows``) and rebuilds the
+CSR by a stable re-sort of the EXISTING assignments — no k-means
+rebuild — bumping the sidecar version.  With ``rerank >= candidate
+count`` the merge is bit-for-rank invisible (property-tested).
+
+Persistence: ``ivf.npz`` (shared coarse layer — an IVFIndex can open
+the same store), ``quant.npz`` (kind, row-order codes, scales or
+codebooks, version), ``segments.json`` + per-segment npz sidecars.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.data.packed import bucketed_capacity
+from code2vec_tpu.index.ivf import (DEFAULT_ITERS, DEFAULT_NPROBE,
+                                    IVF_NAME, MIN_PROBE_CAPACITY,
+                                    default_clusters, kmeans)
+from code2vec_tpu.index.store import VectorStore, normalize_rows
+from code2vec_tpu.telemetry import core as tele_core
+
+QUANT_NAME = 'quant.npz'
+SEGMENTS_NAME = 'segments.json'
+SEGMENT_PATTERN = 'segment_%05d.npz'
+
+QUANT_KINDS = ('int8', 'pq')
+PQ_CODEBOOK = 256        # codewords per subspace — codes stay uint8
+DEFAULT_PQ_SUBDIM = 4    # dims per subspace when --index-pq-m is 0
+DEFAULT_RERANK = 128
+DEFAULT_SEGMENT_ROWS = 4096
+DEFAULT_COMPACT_SEGMENTS = 8
+TRAIN_SAMPLE = 1 << 16   # codebook/scale training sample cap
+_ENCODE_CHUNK = 2048     # bounds the (chunk, M, 256) distance tensor
+
+
+def resolve_pq_m(dim: int, m: int = 0) -> int:
+    """Subspace count: the requested ``m`` clamped down to a divisor of
+    ``dim`` (subspaces must tile the dim axis exactly); 0 means the
+    default ``dim // 4`` — 1/8 the bytes of f16."""
+    if m <= 0:
+        m = max(1, dim // DEFAULT_PQ_SUBDIM)
+    m = min(m, dim)
+    while dim % m:
+        m -= 1
+    return m
+
+
+# ------------------------------------------------------------ int8 codec
+def train_int8(sample: np.ndarray) -> np.ndarray:
+    """Per-dimension symmetric scales over a training sample:
+    ``scale[d] = maxabs[d] / 127`` (floored so all-zero dims stay
+    finite).  Codes then span the full int8 range per dimension."""
+    sample = np.asarray(sample, np.float32)
+    return np.maximum(np.abs(sample).max(axis=0), 1e-12) / 127.0
+
+
+def encode_int8(vectors: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """(N, D) float -> (N, D) int8 codes (round-to-nearest, clipped)."""
+    vectors = np.asarray(vectors, np.float32)
+    return np.clip(np.rint(vectors / scale[None, :]),
+                   -127, 127).astype(np.int8)
+
+
+# -------------------------------------------------------------- pq codec
+# Shared jitted kernels (module-level identity: jit caches per shape, so
+# assignment/update compile once per codebook geometry, not per call).
+_pq_assign_program = None
+_pq_update_program = None
+
+
+def _pq_assign_chunk(block, codebooks):
+    """(B, M, dsub) f32 x (M, K, dsub) f32 -> (B, M) int32 nearest
+    codeword per subspace (min-L2 via the max of ``x.c - 0.5*|c|^2``)."""
+    global _pq_assign_program
+    if _pq_assign_program is None:
+        import jax
+        import jax.numpy as jnp
+
+        def assign(x, books):
+            scores = (jnp.einsum('bmd,mkd->bmk', x, books)
+                      - 0.5 * jnp.sum(books * books, axis=-1)[None])
+            return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+        _pq_assign_program = jax.jit(assign)
+    return _pq_assign_program(block, codebooks)
+
+
+def _pq_update(x, assign, codebooks):
+    """One batched Lloyd update over ALL subspaces: flattened
+    ``segment_sum`` with per-subspace id offsets — one program, not M.
+    Empty codewords keep their previous centroid (same contract as the
+    coarse k-means)."""
+    global _pq_update_program
+    if _pq_update_program is None:
+        import jax
+        import jax.numpy as jnp
+
+        def update(x_dev, assign_dev, books):
+            n, m, dsub = x_dev.shape
+            k_codebook = books.shape[1]
+            offs = (jnp.arange(m, dtype=jnp.int32)
+                    * k_codebook)[None, :]                 # (1, M)
+            flat_ids = (assign_dev + offs).reshape(-1)
+            flat_x = x_dev.reshape(n * m, dsub)
+            sums = jax.ops.segment_sum(flat_x, flat_ids,
+                                       num_segments=m * k_codebook)
+            counts = jax.ops.segment_sum(
+                jnp.ones((n * m,), jnp.float32), flat_ids,
+                num_segments=m * k_codebook)
+            means = (sums / jnp.maximum(counts, 1.0)[:, None]
+                     ).reshape(m, k_codebook, dsub)
+            occupied = (counts > 0).reshape(m, k_codebook)
+            return jnp.where(occupied[..., None], means, books)
+
+        _pq_update_program = jax.jit(update)
+    return _pq_update_program(x, assign, codebooks)
+
+
+def _assign_chunks(vectors: np.ndarray, codebooks: np.ndarray
+                   ) -> np.ndarray:
+    """(N, D) -> (N, M) int32 codeword assignments, streamed through the
+    fixed ``_ENCODE_CHUNK`` so the (chunk, M, 256) distance tensor stays
+    bounded and the assign kernel keeps ONE warm shape per geometry."""
+    vectors = np.asarray(vectors, np.float32)
+    n, dim = vectors.shape
+    m, _k, dsub = codebooks.shape
+    books = np.asarray(codebooks, np.float32)
+    out = np.empty((n, m), np.int32)
+    for start in range(0, n, _ENCODE_CHUNK):
+        block = vectors[start:start + _ENCODE_CHUNK]
+        rows_here = block.shape[0]
+        if rows_here < _ENCODE_CHUNK:
+            block = np.concatenate(
+                [block, np.zeros((_ENCODE_CHUNK - rows_here, dim),
+                                 np.float32)])
+        codes = np.asarray(_pq_assign_chunk(  # graftlint: disable=recompile-hazard -- (chunk, M, dsub) is one warm shape per index geometry: _ENCODE_CHUNK is a module constant and (M, dsub) are fixed at build
+            block.reshape(_ENCODE_CHUNK, m, dsub), books))
+        out[start:start + rows_here] = codes[:rows_here]
+    return out
+
+
+def train_pq(sample: np.ndarray, m: int, iters: int = DEFAULT_ITERS,
+             seed: int = 0) -> np.ndarray:
+    """Per-subspace codebooks ``(M, K, dsub)`` float32 off the existing
+    k-means substrate: batched Lloyd — chunked assignment + ONE jitted
+    update across all subspaces per iteration."""
+    sample = np.asarray(sample, np.float32)
+    n, dim = sample.shape
+    dsub = dim // m
+    k_codebook = min(PQ_CODEBOOK, n)
+    rng = np.random.default_rng(seed)
+    rows = sample[rng.choice(n, size=k_codebook, replace=False)]
+    codebooks = np.ascontiguousarray(
+        rows.reshape(k_codebook, m, dsub).transpose(1, 0, 2))
+    x = sample.reshape(n, m, dsub)
+    for _ in range(max(1, iters)):
+        assign = _assign_chunks(sample, codebooks)
+        codebooks = np.asarray(_pq_update(x, assign, codebooks),
+                               np.float32)
+    return codebooks
+
+
+def encode_pq(vectors: np.ndarray, codebooks: np.ndarray) -> np.ndarray:
+    """(N, D) float -> (N, M) uint8 codes (nearest codeword per
+    subspace, frozen codebooks)."""
+    return _assign_chunks(vectors, codebooks).astype(np.uint8)
+
+
+def coarse_probe(centroids: np.ndarray, queries: np.ndarray,
+                 nprobe: int, metric: str) -> np.ndarray:
+    """Top-``nprobe`` cluster ids per query (host numpy — C is tiny
+    next to N; same contract as IVFIndex._coarse)."""
+    q = np.asarray(queries, np.float32)
+    if metric == 'cosine':
+        q = normalize_rows(q)
+    scores = q @ centroids.T
+    return np.argsort(-scores, axis=-1, kind='stable')[:, :nprobe]
+
+
+class QuantizedIVFIndex:
+    """nprobe-bounded approximate k-NN over int8/PQ codes, with live
+    inserts and host-exact re-rank.
+
+    Build with ``QuantizedIVFIndex.build(store, kind=...)`` (persists
+    the sidecars) or reopen with ``QuantizedIVFIndex(store)`` when
+    ``quant.npz`` exists.  ``insert`` appends live vectors (queryable
+    immediately, no rebuild); ``compact`` folds segments into the base
+    CSR + store."""
+
+    # graftlint: guard QuantizedIVFIndex._segments,_append_vectors,_append_codes,_append_assign,_append_row_ids,_append_labels,_append_dev,_append_capacity,_base_codes_dev,_base_rows,_store_rows,_programs,version,list_ids,offsets,list_lengths,compactions by _lock
+
+    def __init__(self, store: VectorStore, kind: Optional[str] = None,
+                 nprobe: int = DEFAULT_NPROBE,
+                 rerank: int = DEFAULT_RERANK,
+                 segment_rows: int = DEFAULT_SEGMENT_ROWS,
+                 compact_segments: int = DEFAULT_COMPACT_SEGMENTS,
+                 centroids: Optional[np.ndarray] = None,
+                 list_ids: Optional[np.ndarray] = None,
+                 offsets: Optional[np.ndarray] = None,
+                 codes: Optional[np.ndarray] = None,
+                 quant_const: Optional[np.ndarray] = None,
+                 version: int = 0):
+        import jax
+
+        self.store = store
+        self.metric = store.metric
+        self.dim = store.dim
+        self.nprobe = nprobe
+        self.rerank = max(0, int(rerank))
+        self.segment_rows = max(1, int(segment_rows))
+        self.compact_segments = max(0, int(compact_segments))
+        self._lock = threading.RLock()
+        # arrays handed in = a fresh build: nothing on disk to
+        # rehydrate (build() resets the sidecars it persists); arrays
+        # absent = reopen path, loading sidecars + live segments
+        fresh_build = codes is not None
+        if centroids is None:
+            centroids, list_ids, offsets = self._load_coarse(store.path)
+        if codes is None:
+            kind, codes, quant_const, version = self._load_quant(
+                store.path, kind)
+        if kind not in QUANT_KINDS:
+            raise ValueError('index quant kind must be one of %s, got %r'
+                             % (QUANT_KINDS, kind))
+        self.kind = kind
+        self.version = int(version)
+        self.centroids = np.asarray(centroids, np.float32)
+        self.n_clusters = self.centroids.shape[0]
+        self.list_ids = np.asarray(list_ids, np.int64)
+        self.offsets = np.asarray(offsets, np.int64)
+        self.list_lengths = np.diff(self.offsets)
+        self._quant_const = np.asarray(quant_const, np.float32)
+        if kind == 'pq':
+            self.pq_m, self.pq_k, self.pq_dsub = self._quant_const.shape
+            if self.pq_m * self.pq_dsub != self.dim:
+                raise ValueError(
+                    'pq codebooks (%d subspaces x %d dims) do not tile '
+                    'dim %d' % (self.pq_m, self.pq_dsub, self.dim))
+        else:
+            self.pq_m = self.pq_k = self.pq_dsub = 0
+        codes = np.asarray(codes)
+        self._code_width = int(codes.shape[1])  # bytes/vector on device
+        self._base_rows = int(codes.shape[0])
+        self._store_rows = store.count
+        if self._base_rows != self._store_rows:
+            raise ValueError(
+                'quant sidecar covers %d rows but store `%s` holds %d — '
+                'rebuild or compact before reopening'
+                % (self._base_rows, store.path, self._store_rows))
+        # empty append state (segments reload below)
+        self._segments: List[dict] = []
+        self._append_vectors = np.empty((0, self.dim), store.dtype)
+        self._append_codes = np.empty((0, self._code_width), codes.dtype)
+        self._append_assign = np.empty((0,), np.int32)
+        self._append_row_ids = np.empty((0,), np.int64)
+        self._append_labels: List[str] = []
+        self._append_dev = None
+        self._append_capacity = 0
+        self._seg_entries = 0
+        self.compactions = 0
+        self._programs: Dict[Tuple[int, int, int, int, int], object] = {}
+        # HBM budget gate + per-entry ledger registration
+        # (telemetry/memory.py): same attach-boundary contract as the
+        # f16 tiers, but the `index` bucket is now keyed per segment
+        from code2vec_tpu.telemetry import memory as memory_lib
+        sorted_codes = codes[self.list_ids]
+        base_nbytes = int(sorted_codes.nbytes
+                          + self._quant_const.nbytes)
+        memory_lib.ledger().check_budget(
+            base_nbytes,
+            'index attach (quantized tier: %s, %d vectors x %d '
+            'code bytes, %d clusters)'
+            % (kind, self._base_rows, self._code_width, self.n_clusters))
+        self.device_nbytes = 0
+        self._install_base_locked(sorted_codes)
+        if not fresh_build:
+            self._reload_segments()
+
+    # --------------------------------------------------------- sidecars
+    @staticmethod
+    def _load_coarse(path: str):
+        sidecar = os.path.join(path, IVF_NAME)
+        if not os.path.isfile(sidecar):
+            raise FileNotFoundError(
+                'no IVF sidecar at `%s` — build the quantized tier with '
+                'QuantizedIVFIndex.build(store, kind=...) or '
+                '--build-index --index-quant int8|pq' % sidecar)
+        data = np.load(sidecar)
+        return data['centroids'], data['list_ids'], data['offsets']
+
+    @staticmethod
+    def _load_quant(path: str, kind: Optional[str]):
+        sidecar = os.path.join(path, QUANT_NAME)
+        if not os.path.isfile(sidecar):
+            raise FileNotFoundError(
+                'no quantized sidecar at `%s` — build one with '
+                'QuantizedIVFIndex.build(store, kind=...)' % sidecar)
+        data = np.load(sidecar)
+        disk_kind = str(data['kind'])
+        if kind is not None and kind != disk_kind:
+            raise ValueError(
+                'store `%s` holds %s codes but %s was requested — '
+                'rebuild with --index-quant %s'
+                % (path, disk_kind, kind, kind))
+        return (disk_kind, data['codes'], data['const'],
+                int(data['version']))
+
+    def _persist_quant_locked(self, codes_row_order: np.ndarray) -> None:
+        """quant.npz holds the ROW-ORDER codes (compaction concatenates
+        them without touching the device layout) + the frozen
+        scales/codebooks + the format version; tmp-then-replace like the
+        store meta."""
+        path = os.path.join(self.store.path, QUANT_NAME)
+        tmp = path + '.tmp.npz'
+        np.savez(tmp, kind=np.asarray(self.kind),
+                 codes=codes_row_order, const=self._quant_const,
+                 version=np.asarray(self.version))
+        os.replace(tmp, path)
+
+    def _load_row_codes(self) -> np.ndarray:
+        data = np.load(os.path.join(self.store.path, QUANT_NAME))
+        return np.asarray(data['codes'])
+
+    def _persist_manifest_locked(self) -> None:
+        manifest = {'version': self.version,
+                    'base_count': self._store_rows,
+                    'segments': [dict(seg) for seg in self._segments]}
+        path = os.path.join(self.store.path, SEGMENTS_NAME)
+        tmp = path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+
+    def _reload_segments(self) -> None:
+        """Rehydrate append state from the versioned segment sidecars
+        (manifest + per-segment npz): a reopened index serves inserts
+        that never compacted."""
+        path = os.path.join(self.store.path, SEGMENTS_NAME)
+        if not os.path.isfile(path):
+            return
+        with open(path, 'r') as f:
+            manifest = json.load(f)
+        with self._lock:
+            version = self.version
+        if int(manifest.get('version', 0)) != version:
+            raise ValueError(
+                'segment manifest version %s does not match quant '
+                'sidecar version %d in `%s` — interrupted compaction; '
+                'rebuild the index'
+                % (manifest.get('version'), version, self.store.path))
+        segments = list(manifest.get('segments', []))
+        if not segments:
+            return
+        vec_parts, code_parts, assign_parts, id_parts = [], [], [], []
+        labels: List[str] = []
+        for seg in segments:
+            data = np.load(os.path.join(self.store.path, seg['file']),
+                           allow_pickle=False)
+            vec_parts.append(np.asarray(data['vectors'],
+                                        self.store.dtype))
+            code_parts.append(np.asarray(data['codes']))
+            assign_parts.append(np.asarray(data['assign'], np.int32))
+            id_parts.append(np.asarray(data['row_ids'], np.int64))
+            labels.extend(str(s) for s in data['labels'])
+        with self._lock:
+            self._segments = segments
+            self._append_vectors = (np.concatenate(vec_parts)
+                                    if vec_parts else
+                                    self._append_vectors)
+            self._append_codes = (np.concatenate(code_parts)
+                                  if code_parts else self._append_codes)
+            self._append_assign = np.concatenate(assign_parts)
+            self._append_row_ids = np.concatenate(id_parts)
+            self._append_labels = labels
+            self._refresh_append_device_locked()
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def build(cls, store: VectorStore, kind: str = 'pq',
+              n_clusters: Optional[int] = None,
+              iters: int = DEFAULT_ITERS, seed: int = 0,
+              nprobe: int = DEFAULT_NPROBE,
+              rerank: int = DEFAULT_RERANK, pq_m: int = 0,
+              segment_rows: int = DEFAULT_SEGMENT_ROWS,
+              compact_segments: int = DEFAULT_COMPACT_SEGMENTS,
+              persist: bool = True, log=None) -> 'QuantizedIVFIndex':
+        if kind not in QUANT_KINDS:
+            raise ValueError('index quant kind must be one of %s, got %r'
+                             % (QUANT_KINDS, kind))
+        t0 = time.perf_counter()
+        n_clusters = (n_clusters if n_clusters
+                      else default_clusters(store.count))
+        vectors = np.asarray(store.all_rows(), np.float32)
+        centroids, assign = kmeans(vectors, n_clusters, iters=iters,
+                                   seed=seed)
+        n_clusters = centroids.shape[0]
+        list_ids = np.argsort(assign, kind='stable').astype(np.int64)
+        counts = np.bincount(assign, minlength=n_clusters)
+        offsets = np.concatenate([[0],
+                                  np.cumsum(counts)]).astype(np.int64)
+        rng = np.random.default_rng(seed)
+        pick = None
+        if store.count > TRAIN_SAMPLE:
+            pick = rng.choice(store.count, size=TRAIN_SAMPLE,
+                              replace=False)
+        if kind == 'int8':
+            sample = vectors if pick is None else vectors[pick]
+            quant_const = train_int8(sample)
+            codes = encode_int8(vectors, quant_const)
+        else:
+            # IVFADC: codebooks train on (and codes describe) the
+            # residuals against each row's assigned coarse centroid
+            m = resolve_pq_m(store.dim, pq_m)
+            residuals = vectors - centroids[assign]
+            sample = residuals if pick is None else residuals[pick]
+            quant_const = train_pq(sample, m, iters=iters, seed=seed)
+            codes = encode_pq(residuals, quant_const)
+        build_s = time.perf_counter() - t0
+        if persist:
+            np.savez(os.path.join(store.path, IVF_NAME),
+                     centroids=centroids, list_ids=list_ids,
+                     offsets=offsets)
+        index = cls(store, kind=kind, nprobe=nprobe, rerank=rerank,
+                    segment_rows=segment_rows,
+                    compact_segments=compact_segments,
+                    centroids=centroids, list_ids=list_ids,
+                    offsets=offsets, codes=codes,
+                    quant_const=quant_const, version=0)
+        if persist:
+            index._persist_quant_locked(codes)
+            # a rebuild over a previously-live store resets any stale
+            # segment sidecars along with the manifest
+            for name in sorted(os.listdir(store.path)):
+                if name.startswith('segment_') and name.endswith('.npz'):
+                    os.unlink(os.path.join(store.path, name))
+            index._persist_manifest_locked()
+        if tele_core.enabled():
+            tele_core.registry().gauge('index/build_s').set(build_s)
+        if log is not None:
+            log('index: quantized tier built — %s codes, %d bytes/'
+                'vector (f16 would be %d), %d clusters over %d vectors '
+                'in %.1fs'
+                % (kind, index.bytes_per_vector,
+                   2 * store.dim, n_clusters, store.count, build_s))
+        return index
+
+    # ----------------------------------------------------------- device
+    def _install_base_locked(self, sorted_codes: np.ndarray) -> None:
+        """Place the cluster-sorted codes + codec constants, and account
+        them in the `index` bucket (keyed per resident: base codes and
+        each segment register separately)."""
+        import jax
+
+        from code2vec_tpu.telemetry import memory as memory_lib
+        nbytes = int(sorted_codes.nbytes + self._quant_const.nbytes)
+        try:
+            self._base_codes_dev = jax.device_put(sorted_codes)
+            self._quant_dev = jax.device_put(self._quant_const)
+        except Exception as exc:
+            memory_lib.ledger().note_oom(exc, 'index.attach')
+            raise
+        memory_lib.ledger().register(
+            'index', 'quant:%x:base' % id(self), nbytes, owner=self,
+            attrs={'tier': 'quant', 'kind': self.kind,
+                   'vectors': self._base_rows,
+                   'code_bytes': self._code_width,
+                   'clusters': self.n_clusters,
+                   'version': self.version})
+        self.device_nbytes += nbytes
+
+    def _refresh_append_device_locked(self) -> None:
+        """Rebuild the fixed-shape append buffer after an insert or
+        compaction: codes padded to a ``bucketed_capacity`` rung (warm
+        program shapes), budget-gated BEFORE placement, re-registered
+        per segment so the ledger attributes segment bytes
+        individually."""
+        import jax
+
+        from code2vec_tpu.telemetry import memory as memory_lib
+        ledger = memory_lib.ledger()
+        used = int(self._append_codes.shape[0])
+        old_capacity = self._append_capacity
+        for i in range(self._seg_entries):
+            ledger.release('index', 'quant:%x:seg%05d' % (id(self), i))
+        ledger.release('index', 'quant:%x:segslack' % id(self))
+        self._seg_entries = 0
+        if used == 0:
+            self._append_dev = None
+            self._append_capacity = 0
+            self.device_nbytes -= old_capacity * self._code_width
+            self._export_segment_gauges_locked()
+            return
+        capacity = bucketed_capacity(used, self.segment_rows)
+        padded = self._append_codes
+        if capacity > used:
+            padded = np.concatenate(
+                [padded, np.zeros((capacity - used, self._code_width),
+                                  padded.dtype)])
+        delta = (capacity - old_capacity) * self._code_width
+        if delta > 0:
+            ledger.check_budget(
+                delta, 'index append segment (quantized tier: %d rows '
+                       'x %d code bytes)' % (capacity, self._code_width))
+        try:
+            self._append_dev = jax.device_put(padded)
+        except Exception as exc:
+            ledger.note_oom(exc, 'index.insert')
+            raise
+        self._append_capacity = capacity
+        self.device_nbytes += delta
+        for i, seg in enumerate(self._segments):
+            ledger.register(
+                'index', 'quant:%x:seg%05d' % (id(self), i),
+                int(seg['rows']) * self._code_width, owner=self,
+                attrs={'tier': 'quant', 'segment': seg['file'],
+                       'rows': int(seg['rows']),
+                       'version': self.version})
+        self._seg_entries = len(self._segments)
+        slack = capacity - used
+        if slack:
+            ledger.register(
+                'index', 'quant:%x:segslack' % id(self),
+                slack * self._code_width, owner=self,
+                attrs={'tier': 'quant', 'rows': slack,
+                       'reason': 'append buffer capacity rung padding'})
+        self._export_segment_gauges_locked()
+
+    def _export_segment_gauges_locked(self) -> None:
+        if not tele_core.enabled():
+            return
+        reg = tele_core.registry()
+        reg.gauge('index/segments').set(float(len(self._segments)))
+        reg.gauge('index/append_rows').set(
+            float(self._append_codes.shape[0]))
+
+    # ------------------------------------------------------- properties
+    @property
+    def count(self) -> int:
+        """Total queryable rows: base + uncompacted appends."""
+        with self._lock:
+            return self._base_rows + int(self._append_codes.shape[0])
+
+    @property
+    def bytes_per_vector(self) -> int:
+        """Device-resident code bytes per vector (int8: dim; pq: M)."""
+        return self._code_width
+
+    @property
+    def segment_count(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    @property
+    def labels(self) -> Optional[np.ndarray]:
+        base = self.store.labels
+        if base is None:
+            return None
+        with self._lock:
+            if not self._append_labels:
+                return base
+            return np.concatenate(
+                [base, np.array(self._append_labels, dtype=object)])
+
+    # ----------------------------------------------------------- search
+    def _program(self, q_bucket: int, capacity: int, r_depth: int,
+                 seg_capacity: int, base_rows: int):
+        # nprobe is NOT in the key (host-side fill only, like the IVF
+        # tier); base_rows IS — compaction moves the base/segment
+        # boundary the program bakes in, so post-compaction queries get
+        # fresh programs instead of stale closures
+        key = (q_bucket, capacity, r_depth, seg_capacity, base_rows)
+        with self._lock:
+            program = self._programs.get(key)
+        if program is not None:
+            return program
+        import jax
+        import jax.numpy as jnp
+
+        from code2vec_tpu.ops.topk import padded_local_topk
+
+        cosine = self.metric == 'cosine'
+        kind = self.kind
+        pq_m, pq_k, pq_dsub = self.pq_m, self.pq_k, self.pq_dsub
+
+        def run(queries, quant_const, base_codes, seg_codes, cand,
+                cand_offsets):
+            q = queries.astype(jnp.float32)
+            if cosine:
+                norms = jnp.linalg.norm(q, axis=-1, keepdims=True)
+                q = q / jnp.where(norms > 0, norms, 1.0)
+            base_part = jnp.take(
+                base_codes, jnp.clip(cand, 0, base_rows - 1), axis=0)
+            if seg_capacity:
+                seg_part = jnp.take(
+                    seg_codes,
+                    jnp.clip(cand - base_rows, 0, seg_capacity - 1),
+                    axis=0)
+                rows = jnp.where((cand >= base_rows)[..., None],
+                                 seg_part, base_part)
+            else:
+                rows = base_part                       # (Q, cap, W)
+            if kind == 'int8':
+                scores = jnp.einsum('qd,qcd->qc',
+                                    q * quant_const[None, :],
+                                    rows.astype(jnp.float32))
+            else:
+                # asymmetric distance over residual codes: the coarse
+                # term q.centroid arrives per candidate (cand_offsets,
+                # host-filled from the coarse scores), the residual
+                # term is a per-query LUT (Q, M, 256) built on device
+                # + a flat gather-accumulate — fused with the top-k
+                lut = jnp.einsum(
+                    'qmd,mkd->qmk',
+                    q.reshape(q.shape[0], pq_m, pq_dsub), quant_const)
+                flat_lut = lut.reshape(q.shape[0], pq_m * pq_k)
+                idx = (rows.astype(jnp.int32)
+                       + (jnp.arange(pq_m, dtype=jnp.int32)
+                          * pq_k)[None, None, :])     # (Q, cap, M)
+
+                def gather_one(flat_q, idx_q):
+                    return jnp.take(flat_q, idx_q,
+                                    axis=0).sum(axis=-1)
+
+                scores = cand_offsets + jax.vmap(gather_one)(flat_lut,
+                                                             idx)
+            scores = jnp.where(cand >= 0, scores, -jnp.inf)
+            return padded_local_topk(scores, r_depth)
+
+        program = jax.jit(run)
+        with self._lock:
+            self._programs[key] = program
+        return program
+
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """(Q, D) queries -> ((Q, k) scores, (Q, k) ORIGINAL row ids).
+        Candidates come from the probed base lists PLUS any append
+        segments; scores are quantized unless ``rerank > 0``, in which
+        case the top-R candidates are re-scored exactly from the mmap
+        store.  −inf/−1 sentinels pad queries with fewer than ``k``
+        candidates."""
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        n = queries.shape[0]
+        t0 = time.perf_counter()
+        with self._lock:
+            base_rows = self._base_rows
+            list_ids = self.list_ids
+            offsets = self.offsets
+            lengths = self.list_lengths
+            append_assign = self._append_assign
+            append_row_ids = self._append_row_ids
+            append_used = int(append_assign.shape[0])
+            seg_capacity = self._append_capacity if append_used else 0
+            base_codes_dev = self._base_codes_dev
+            quant_dev = self._quant_dev
+            append_dev = (self._append_dev if seg_capacity
+                          else base_codes_dev)
+        nprobe = min(self.n_clusters,
+                     nprobe if nprobe is not None else self.nprobe)
+        qn = queries
+        if self.metric == 'cosine':
+            qn = normalize_rows(queries)
+        # coarse scores serve double duty: probe selection AND (pq) the
+        # per-candidate q.centroid offset of the residual decomposition
+        coarse = qn @ self.centroids.T                   # (Q, C)
+        probe = np.argsort(-coarse, axis=-1,
+                           kind='stable')[:, :nprobe]
+        starts = offsets[probe]
+        lens = lengths[probe]
+        totals = lens.sum(axis=1)
+        matches: List[np.ndarray] = []
+        if append_used:
+            for row in range(n):
+                matches.append(
+                    np.nonzero(np.isin(append_assign, probe[row]))[0])
+            totals = totals + np.array([m.shape[0] for m in matches],
+                                       totals.dtype)
+        capacity = bucketed_capacity(int(totals.max(initial=1)),
+                                     MIN_PROBE_CAPACITY)
+        cand = np.full((n, capacity), -1, np.int64)
+        cand_offsets = np.zeros((n, capacity), np.float32)
+        for row in range(n):
+            pos = 0
+            for cluster, start, length in zip(probe[row], starts[row],
+                                              lens[row]):
+                cand[row, pos:pos + length] = np.arange(start,
+                                                        start + length)
+                cand_offsets[row, pos:pos + length] = coarse[row,
+                                                             cluster]
+                pos += length
+            if append_used and matches[row].shape[0]:
+                hit = matches[row]
+                cand[row, pos:pos + hit.shape[0]] = base_rows + hit
+                cand_offsets[row, pos:pos + hit.shape[0]] = \
+                    coarse[row, append_assign[hit]]
+        r_depth = min(capacity,
+                      max(k, self.rerank) if self.rerank else k)
+        from code2vec_tpu.index.exact import (DEFAULT_QUERY_BUCKETS,
+                                              _pick_bucket)
+        q_bucket = _pick_bucket(n, DEFAULT_QUERY_BUCKETS)
+        if q_bucket != n:
+            queries_in = np.concatenate(
+                [queries,
+                 np.zeros((q_bucket - n, self.dim), np.float32)])
+            cand = np.concatenate(
+                [cand, np.full((q_bucket - n, capacity), -1, np.int64)])
+            cand_offsets = np.concatenate(
+                [cand_offsets,
+                 np.zeros((q_bucket - n, capacity), np.float32)])
+        else:
+            queries_in = queries
+        program = self._program(q_bucket, capacity, r_depth,
+                                seg_capacity, base_rows)
+        values, positions = program(queries_in, quant_dev,
+                                    base_codes_dev, append_dev,
+                                    cand.astype(np.int32),
+                                    cand_offsets)
+        values = np.asarray(values)[:n]
+        positions = np.asarray(positions)[:n]
+        # positions index the (Q, capacity) candidate axis -> combined
+        # position space: [0, base_rows) is the cluster-sorted base,
+        # [base_rows, base_rows+append) the insert-ordered segments
+        comb = np.take_along_axis(
+            cand[:n], np.maximum(positions, 0).astype(np.int64),
+            axis=-1)
+        base_ids = list_ids[np.clip(comb, 0, base_rows - 1)]
+        if append_used:
+            app_ids = append_row_ids[
+                np.clip(comb - base_rows, 0, append_used - 1)]
+            ids = np.where(comb >= base_rows, app_ids, base_ids)
+        else:
+            ids = base_ids
+        ids = np.where((positions >= 0) & (comb >= 0), ids, -1)
+        if self.rerank:
+            values, ids = self._rerank_exact(queries, values, ids, k)
+        else:
+            values, ids = values[:, :k], ids[:, :k]
+        if values.shape[1] < k:
+            pad = k - values.shape[1]
+            values = np.concatenate(
+                [values, np.full((n, pad), -np.inf, values.dtype)],
+                axis=1)
+            ids = np.concatenate(
+                [ids, np.full((n, pad), -1, ids.dtype)], axis=1)
+        if tele_core.enabled():
+            reg = tele_core.registry()
+            reg.counter('index/queries_total').inc(n)
+            reg.timer('index/query_latency_ms').record(
+                time.perf_counter() - t0)
+            reg.gauge('index/probe_fanout').set(float(totals.mean()))
+        return values, ids
+
+    def _rerank_exact(self, queries: np.ndarray, values: np.ndarray,
+                      ids: np.ndarray, k: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact re-scoring of the quantized top-R: candidate rows come
+        back from the mmap store (``VectorStore.take``) or the host
+        segment copies, scores recompute in f32, and the final order is
+        the deterministic ``(-score, id)`` sort — bit-for-rank
+        reproducible whenever R covers the candidate set."""
+        q = np.asarray(queries, np.float32)
+        if self.metric == 'cosine':
+            q = normalize_rows(q)
+        n, r_depth = ids.shape
+        with self._lock:
+            store_rows = self._store_rows
+            append_vectors = self._append_vectors
+        flat = ids.ravel()
+        vecs = np.zeros((flat.shape[0], self.dim), np.float32)
+        base_sel = (flat >= 0) & (flat < store_rows)
+        app_sel = flat >= store_rows
+        if base_sel.any():
+            vecs[base_sel] = np.asarray(self.store.take(flat[base_sel]),
+                                        np.float32)
+        if app_sel.any():
+            vecs[app_sel] = np.asarray(
+                append_vectors[flat[app_sel] - store_rows], np.float32)
+        scores = np.einsum('qd,qrd->qr', q,
+                           vecs.reshape(n, r_depth, self.dim))
+        scores = np.where(ids >= 0, scores, -np.inf)
+        order = np.lexsort((ids, -scores), axis=-1)[:, :k]
+        return (np.take_along_axis(scores, order, axis=-1),
+                np.take_along_axis(ids, order, axis=-1))
+
+    def warmup(self, k: int, nprobe: Optional[int] = None) -> int:
+        """Eagerly compile the probe program per query bucket at the
+        CURRENT capacity rungs (same warm-ladder contract as the exact
+        tier's warmup).  Returns the number of buckets warmed."""
+        from code2vec_tpu.index.exact import DEFAULT_QUERY_BUCKETS
+        warmed = 0
+        for bucket in DEFAULT_QUERY_BUCKETS:
+            self.search(np.zeros((bucket, self.dim), np.float32), k,
+                        nprobe=nprobe)
+            warmed += 1
+        return warmed
+
+    # ---------------------------------------------------------- inserts
+    def insert(self, vectors: np.ndarray,
+               labels: Optional[Sequence[str]] = None) -> np.ndarray:
+        """Append live vectors: encoded with the FROZEN codecs, assigned
+        to the existing coarse lists, persisted as versioned segment
+        sidecars, and queryable immediately (no rebuild).  Returns the
+        assigned global row ids.  An empty batch records an empty
+        segment (format drills) and allocates nothing.  Triggers
+        ``compact()`` when the segment count passes
+        ``compact_segments`` (0 disables auto-compaction)."""
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        if vectors.size and vectors.shape[1] != self.dim:
+            raise ValueError('inserted vectors must be (n, %d), got %r'
+                             % (self.dim, vectors.shape))
+        n_new = int(vectors.shape[0])
+        if self.store.normalized:
+            vectors = normalize_rows(vectors)
+        canonical = np.ascontiguousarray(vectors, self.store.dtype)
+        # encode from the canonical (store-dtype) rows so pre- and
+        # post-compaction scoring see bit-identical inputs
+        encode_from = np.asarray(canonical, np.float32)
+        if n_new:
+            assign = np.argmax(
+                encode_from @ self.centroids.T, axis=-1).astype(np.int32)
+            if self.kind == 'int8':
+                codes = encode_int8(encode_from, self._quant_const)
+            else:
+                codes = encode_pq(
+                    encode_from - self.centroids[assign],
+                    self._quant_const)
+        else:
+            assign = np.empty((0,), np.int32)
+            codes = np.empty((0, self._code_width),
+                             np.int8 if self.kind == 'int8' else np.uint8)
+        row_labels = ([str(item) for item in labels]
+                      if labels is not None else [''] * n_new)
+        if len(row_labels) != n_new:
+            raise ValueError('%d labels for %d inserted vectors'
+                             % (len(row_labels), n_new))
+        with self._lock:
+            next_id = self._store_rows + self._append_row_ids.shape[0]
+            row_ids = np.arange(next_id, next_id + n_new, dtype=np.int64)
+            # page the batch into fixed-size segments (a batch larger
+            # than segment_rows spans several); an empty batch is one
+            # empty segment
+            cursor = 0
+            while True:
+                rows_here = min(self.segment_rows, n_new - cursor)
+                seg_file = SEGMENT_PATTERN % len(self._segments)
+                seg_path = os.path.join(self.store.path, seg_file)
+                tmp = seg_path + '.tmp.npz'
+                sl = slice(cursor, cursor + rows_here)
+                np.savez(tmp, vectors=canonical[sl], codes=codes[sl],
+                         assign=assign[sl], row_ids=row_ids[sl],
+                         labels=np.asarray(row_labels[sl], dtype=str))
+                os.replace(tmp, seg_path)
+                self._segments.append({'file': seg_file,
+                                       'rows': rows_here})
+                cursor += rows_here
+                if cursor >= n_new:
+                    break
+            self._persist_manifest_locked()
+            self._append_vectors = np.concatenate(
+                [self._append_vectors, canonical])
+            self._append_codes = np.concatenate(
+                [self._append_codes, codes])
+            self._append_assign = np.concatenate(
+                [self._append_assign, assign])
+            self._append_row_ids = np.concatenate(
+                [self._append_row_ids, row_ids])
+            self._append_labels.extend(row_labels)
+            self._refresh_append_device_locked()
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'index/inserts_total').inc(n_new)
+            if (self.compact_segments
+                    and len(self._segments) > self.compact_segments):
+                self.compact()
+        return row_ids
+
+    def compact(self) -> int:
+        """Fold append segments into the base CSR + store: appended
+        vectors land as new store shards (``append_rows``), the
+        inverted lists rebuild by a stable re-sort of the EXISTING
+        assignments (no k-means rebuild), the sidecar version bumps, and
+        the segment files retire.  Returns the rows compacted.  Holds
+        the index lock throughout — concurrent inserts/searches block
+        and land against the compacted index."""
+        t0 = time.perf_counter()
+        with self._lock:
+            compacted = int(self._append_codes.shape[0])
+            from code2vec_tpu.telemetry import memory as memory_lib
+            ledger = memory_lib.ledger()
+            if compacted:
+                has_labels = self.store.labels is not None
+                self.store.append_rows(
+                    self._append_vectors,
+                    labels=(self._append_labels if has_labels
+                            else None),
+                    canonical=True)
+                row_codes = np.concatenate(
+                    [self._load_row_codes(), self._append_codes])
+                base_assign = np.empty((self._base_rows,), np.int64)
+                base_assign[self.list_ids] = np.repeat(
+                    np.arange(self.n_clusters), self.list_lengths)
+                assign_all = np.concatenate(
+                    [base_assign,
+                     self._append_assign.astype(np.int64)])
+                self.list_ids = np.argsort(
+                    assign_all, kind='stable').astype(np.int64)
+                counts = np.bincount(assign_all,
+                                     minlength=self.n_clusters)
+                self.offsets = np.concatenate(
+                    [[0], np.cumsum(counts)]).astype(np.int64)
+                self.list_lengths = np.diff(self.offsets)
+                self._base_rows = int(row_codes.shape[0])
+                self._store_rows = self.store.count
+            else:
+                row_codes = None
+            self.version += 1
+            if row_codes is not None:
+                self._persist_quant_locked(row_codes)
+                np.savez(os.path.join(self.store.path, IVF_NAME),
+                         centroids=self.centroids,
+                         list_ids=self.list_ids, offsets=self.offsets)
+            for seg in self._segments:
+                try:
+                    os.unlink(os.path.join(self.store.path,
+                                           seg['file']))
+                except OSError:
+                    pass
+            self._segments = []
+            self._persist_manifest_locked()
+            self._append_vectors = np.empty((0, self.dim),
+                                            self.store.dtype)
+            self._append_codes = np.empty(
+                (0, self._code_width), self._append_codes.dtype)
+            self._append_assign = np.empty((0,), np.int32)
+            self._append_row_ids = np.empty((0,), np.int64)
+            self._append_labels = []
+            if row_codes is not None:
+                sorted_codes = row_codes[self.list_ids]
+                ledger.release('index', 'quant:%x:base' % id(self))
+                self.device_nbytes = 0
+                ledger.check_budget(
+                    int(sorted_codes.nbytes + self._quant_const.nbytes),
+                    'index compaction (quantized tier: %d vectors x %d '
+                    'code bytes)'
+                    % (self._base_rows, self._code_width))
+                self._install_base_locked(sorted_codes)
+                # the base/segment boundary moved: cached programs bake
+                # the old base_rows into their closures
+                self._programs.clear()
+            self._refresh_append_device_locked()
+            self.compactions += 1
+            if tele_core.enabled():
+                reg = tele_core.registry()
+                reg.counter('index/compactions_total').inc()
+                reg.gauge('index/compact_s').set(
+                    time.perf_counter() - t0)
+        return compacted

@@ -109,7 +109,7 @@ def build_index(model, config, source: Optional[str] = None,
         store = store_lib.build_from_vectors_file(source, out_dir,
                                                   **kwargs)
     index = _open_tier(store, config, model)
-    if isinstance(index, IVFIndex):
+    if isinstance(index, IVFIndex) or config.INDEX_QUANT:
         sample = min(256, store.count)
         rng = np.random.default_rng(0)
         queries = np.asarray(
@@ -117,12 +117,17 @@ def build_index(model, config, source: Optional[str] = None,
                                         replace=False)], np.float32)
         exact = ExactIndex(store, mesh=_mesh_of(model))
         recall = measure_recall(index, exact, queries, k=10)
-        log('index: IVF recall@10 = %.3f vs exact on %d held-out store '
+        tier = config.INDEX_QUANT or 'IVF'
+        log('index: %s recall@10 = %.3f vs exact on %d held-out store '
             'rows (nprobe=%d of %d lists)'
-            % (recall, sample, index.nprobe, index.n_clusters))
+            % (tier, recall, sample, index.nprobe, index.n_clusters))
+        if config.INDEX_QUANT:
+            log('index: quantized tier serves %d bytes/vector on '
+                'device (f16 rows would be %d)'
+                % (index.bytes_per_vector, 2 * store.dim))
     log('index: ready at `%s` (%s, %d vectors, metric=%s, dtype=%s)'
-        % (out_dir, config.INDEX_KIND, store.count, store.metric,
-           store.dtype.name))
+        % (out_dir, config.INDEX_QUANT or config.INDEX_KIND,
+           store.count, store.metric, store.dtype.name))
     return index
 
 
@@ -131,10 +136,27 @@ def _mesh_of(model):
 
 
 def _open_tier(store, config, model=None):
-    """Store -> index object at the configured tier. IVF reuses the
-    persisted sidecar when present, else builds (and persists) one;
-    exact never silently upgrades to IVF."""
+    """Store -> index object at the configured tier. IVF and the
+    quantized tier reuse their persisted sidecars when present, else
+    build (and persist) them; exact never silently upgrades."""
     from code2vec_tpu.index.ivf import DEFAULT_NPROBE, IVF_NAME
+    if config.INDEX_QUANT:
+        from code2vec_tpu.index.quant import (QUANT_NAME,
+                                              QuantizedIVFIndex)
+        nprobe = config.INDEX_NPROBE or DEFAULT_NPROBE
+        kwargs = dict(nprobe=nprobe, rerank=config.INDEX_RERANK,
+                      segment_rows=config.INDEX_SEGMENT_ROWS,
+                      compact_segments=config.INDEX_COMPACT_SEGMENTS)
+        if os.path.isfile(os.path.join(store.path, QUANT_NAME)):
+            index = QuantizedIVFIndex(store, kind=config.INDEX_QUANT,
+                                      **kwargs)
+        else:
+            index = QuantizedIVFIndex.build(
+                store, kind=config.INDEX_QUANT,
+                n_clusters=config.INDEX_CLUSTERS or None,
+                pq_m=config.INDEX_PQ_M, log=config.log, **kwargs)
+        index.warmup(config.INDEX_NEIGHBORS_K)
+        return index
     if config.INDEX_KIND == 'ivf':
         nprobe = config.INDEX_NPROBE or DEFAULT_NPROBE
         if os.path.isfile(os.path.join(store.path, IVF_NAME)):

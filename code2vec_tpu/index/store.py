@@ -132,6 +132,107 @@ class VectorStore:
         labels = self.labels
         return None if labels is None else str(labels[row])
 
+    def take(self, rows: np.ndarray) -> np.ndarray:
+        """Gather arbitrary global row ids out of the mmapped shards:
+        ``(n,)`` int ids -> ``(n, dim)`` in the store dtype. The exact
+        re-rank path of the quantized tier (index/quant.py) — candidate
+        sets are tiny (top-R per query), so a per-shard fancy-index over
+        the mmaps beats materializing ``all_rows()``."""
+        rows = np.asarray(rows, np.int64).ravel()
+        out = np.empty((rows.shape[0], self.dim), self.dtype)
+        if rows.shape[0] == 0:
+            return out
+        if rows.min() < 0 or rows.max() >= self.count:
+            raise IndexError(
+                'row ids out of range [0, %d) for store `%s`'
+                % (self.count, self.path))
+        bounds = np.concatenate([[0], np.cumsum(self.shards)])
+        shard_idx = np.searchsorted(bounds, rows, side='right') - 1
+        for s in np.unique(shard_idx):
+            mask = shard_idx == s
+            out[mask] = self.shard(int(s))[rows[mask] - bounds[s]]
+        return out
+
+    # ---------------------------------------------------------- appending
+    def append_rows(self, vectors: np.ndarray,
+                    labels: Optional[Sequence[str]] = None,
+                    canonical: bool = False) -> Tuple[int, int]:
+        """Append rows as NEW shard files + an atomic meta update — the
+        quantized tier's compaction path: segment truth folds into the
+        store without rewriting existing shards. Returns the
+        ``(start, end)`` global row id range of the appended rows.
+
+        Normalization parity with build(): rows are L2-normalized here
+        iff the store records ``normalized`` (cosine builds). With
+        ``canonical`` the rows are written verbatim — the compaction
+        path, whose segment vectors were already normalized and cast at
+        insert time; re-normalizing would shift last-ulp bytes and break
+        the pre/post-compaction bit-for-rank contract. A labeled store
+        keeps its labels file row-aligned — appends without labels write
+        blank lines; an unlabeled store refuses labels (labeling could
+        not be backfilled for the existing rows)."""
+        vectors = np.asarray(vectors)
+        if vectors.ndim != 2 or (vectors.shape[0] > 0
+                                 and vectors.shape[1] != self.dim):
+            raise ValueError('appended vectors must be (n, %d), got %r'
+                             % (self.dim, vectors.shape))
+        n = int(vectors.shape[0])
+        if n == 0:
+            return (self.count, self.count)
+        if self.normalized and not canonical:
+            vectors = normalize_rows(vectors)
+        vectors = np.ascontiguousarray(vectors, dtype=self.dtype)
+        has_labels = self.labels is not None
+        if labels is not None and not has_labels:
+            raise ValueError(
+                'store `%s` has no labels file — appended labels would '
+                'mis-align with the existing unlabeled rows' % self.path)
+        row_labels: List[str] = []
+        if has_labels:
+            row_labels = ([str(item) for item in labels]
+                          if labels is not None else [''] * n)
+            if len(row_labels) != n:
+                raise ValueError(
+                    '%d labels for %d appended vectors — the label '
+                    'stream must align row-for-row' % (len(row_labels), n))
+        start = self.count
+        new_counts: List[int] = []
+        written = 0
+        while written < n:
+            rows_here = min(self.shard_rows, n - written)
+            shard_path = os.path.join(
+                self.path, SHARD_PATTERN % (len(self.shards)
+                                            + len(new_counts)))
+            with open(shard_path, 'wb') as f:
+                f.write(vectors[written:written + rows_here].tobytes())
+            new_counts.append(rows_here)
+            written += rows_here
+        if has_labels:
+            with open(os.path.join(self.path, LABELS_NAME), 'a',
+                      encoding='utf-8') as f:
+                for item in row_labels:
+                    f.write(str(item).replace('\n', ' ') + '\n')
+        meta = {'count': self.count + n, 'dim': self.dim,
+                'dtype': self.dtype.name, 'metric': self.metric,
+                'normalized': self.normalized,
+                'shard_rows': self.shard_rows,
+                'shards': self.shards + new_counts}
+        # same atomic-ish discipline as build(): shard bytes land first,
+        # meta last — a crash leaves orphan .bin files, never a store
+        # whose meta points past the data
+        meta_tmp = os.path.join(self.path, META_NAME + '.tmp')
+        with open(meta_tmp, 'w') as f:
+            json.dump(meta, f)
+        os.replace(meta_tmp, os.path.join(self.path, META_NAME))
+        self.count += n
+        self.shards.extend(new_counts)
+        self._mmaps.extend([None] * len(new_counts))
+        self._labels = None
+        if tele_core.enabled():
+            tele_core.registry().gauge('index/vectors_total').set(
+                self.count)
+        return (start, self.count)
+
 
 # ---------------------------------------------------------------- builders
 def build(out_dir: str, chunks: Iterable[np.ndarray],

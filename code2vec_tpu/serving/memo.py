@@ -40,6 +40,13 @@ Correctness contract:
   not a per-entry eviction walk; a rolled-BACK canary never calls it,
   so the cache stays warm.  An insert whose request was in flight
   across the swap carries the OLD generation and is refused.
+- **Two generation axes.**  Neighbor results depend on the params AND
+  the attached index, so their entries also record the INDEX
+  generation at insert; a concluded index rollover
+  (``ServingMesh.rollover_index`` → ``bump_index_generation``)
+  invalidates every index-dependent entry and the whole semantic tier
+  while index-independent predict entries survive — the model didn't
+  change, so evicting them would only cost warm hits.
 - **Delivered-good-only inserts.**  The mesh inserts from a
   done-callback on the caller-visible future, so only results that
   were actually delivered (after oversize re-join, after crash-safe
@@ -141,12 +148,16 @@ def copy_results(obj):
 
 
 class _Entry:
-    __slots__ = ('results', 'nbytes', 'generation')
+    __slots__ = ('results', 'nbytes', 'generation', 'index_generation')
 
-    def __init__(self, results, nbytes: int, generation: int):
+    def __init__(self, results, nbytes: int, generation: int,
+                 index_generation: Optional[int] = None):
         self.results = results
         self.nbytes = nbytes
         self.generation = generation
+        #: None = index-independent (predict tiers); an int pins the
+        #: entry to the index version its result was computed against
+        self.index_generation = index_generation
 
 
 class _SemRow:
@@ -171,7 +182,7 @@ class MemoCache:
     conclude callback, ``stats`` on monitors — one lock guards all
     cache state (lock-discipline rule, ANALYSIS.md):
     """
-    # graftlint: guard MemoCache._entries,_bytes,_generation,_params_step,_sem,_sem_bytes,_sem_rows_total,_sem_serves,_sem_samples,_sem_agree by _lock
+    # graftlint: guard MemoCache._entries,_bytes,_generation,_index_generation,_params_step,_sem,_sem_bytes,_sem_rows_total,_sem_serves,_sem_samples,_sem_agree by _lock
 
     def __init__(self, capacity_bytes: int,
                  semantic_epsilon: float = 0.0,
@@ -193,6 +204,7 @@ class MemoCache:
             collections.OrderedDict()
         self._bytes = 0
         self._generation = 0
+        self._index_generation = 0
         self._params_step = params_step
         # semantic tier: per-k row store (a neighbor result is only
         # reusable at the same k)
@@ -222,18 +234,28 @@ class MemoCache:
         with self._lock:
             return self._generation
 
+    @property
+    def index_generation(self) -> int:
+        with self._lock:
+            return self._index_generation
+
     def lookup(self, key: bytes):
         """A fresh copy of the cached result list for ``key``
         (``copy_results`` — hits never share rows or arrays), or None.
-        A hit touches LRU recency; entries from a previous generation
-        never serve (defensive — ``bump_generation`` already cleared
-        them; an eviction here re-exports the gauges and the ledger so
-        they cannot sit stale until the next insert)."""
+        A hit touches LRU recency; entries from a previous params OR
+        index generation never serve (defensive — the bump calls
+        already cleared them; an eviction here re-exports the gauges
+        and the ledger so they cannot sit stale until the next
+        insert)."""
         stale_total = None
         stale_entries = 0
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None and entry.generation != self._generation:
+            if entry is not None and (
+                    entry.generation != self._generation
+                    or (entry.index_generation is not None
+                        and entry.index_generation
+                        != self._index_generation)):
                 self._entries.pop(key, None)
                 self._bytes -= entry.nbytes
                 entry = None
@@ -255,14 +277,18 @@ class MemoCache:
         # mutated, so the reference read above stays safe to copy
         return copy_results(entry.results)
 
-    def insert(self, key: bytes, results, generation: int) -> bool:
-        """Insert a delivered-good result under the generation captured
-        at SUBMIT time — a result in flight across a rollover carries
-        the old generation and is refused (stale results can never
-        enter the post-swap cache).  Stores a private snapshot
-        (``copy_results``) — the delivering caller keeps the original.
-        Evicts LRU entries to fit; a result larger than the whole
-        budget is skipped."""
+    def insert(self, key: bytes, results, generation: int,
+               index_generation: Optional[int] = None) -> bool:
+        """Insert a delivered-good result under the generation(s)
+        captured at SUBMIT time — a result in flight across a params
+        OR index rollover carries the old generation and is refused
+        (stale results can never enter the post-swap cache).
+        ``index_generation`` is None for index-independent results
+        (predict tiers — they survive an index swap) and the submit
+        time ``index_generation`` for neighbor results.  Stores a
+        private snapshot (``copy_results``) — the delivering caller
+        keeps the original.  Evicts LRU entries to fit; a result
+        larger than the whole budget is skipped."""
         nbytes = results_nbytes(results) + len(key) + ENTRY_OVERHEAD
         if nbytes > self.capacity_bytes:
             return False
@@ -270,6 +296,9 @@ class MemoCache:
         evicted = 0
         with self._lock:
             if generation != self._generation:
+                return False
+            if index_generation is not None and \
+                    index_generation != self._index_generation:
                 return False
             old = self._entries.pop(key, None)
             if old is not None:
@@ -279,7 +308,8 @@ class MemoCache:
                 _, victim = self._entries.popitem(last=False)
                 self._bytes -= victim.nbytes
                 evicted += 1
-            self._entries[key] = _Entry(results, nbytes, generation)
+            self._entries[key] = _Entry(results, nbytes, generation,
+                                        index_generation)
             self._bytes += nbytes
             total = self._bytes + self._sem_bytes
             entries = len(self._entries)
@@ -332,18 +362,25 @@ class MemoCache:
         return result, shadow
 
     def semantic_insert(self, vectors, results, k: int,
-                        generation: int) -> int:
+                        generation: int,
+                        index_generation: Optional[int] = None) -> int:
         """Remember each query row's code vector + its neighbor result
         for within-epsilon reuse.  FIFO-bounded at
-        ``semantic_max_rows`` across all ``k``.  No-op while the
-        semantic tier is OFF (epsilon == 0) — a disabled tier stores
-        nothing and costs nothing."""
+        ``semantic_max_rows`` across all ``k``.  Semantic rows cache
+        INDEX lookups, so a row in flight across an index rollover
+        (``index_generation`` captured at submit) is refused exactly
+        like a params-rollover straggler.  No-op while the semantic
+        tier is OFF (epsilon == 0) — a disabled tier stores nothing
+        and costs nothing."""
         if self.semantic_epsilon <= 0:
             return 0
         vectors = np.atleast_2d(np.asarray(vectors, np.float32))
         inserted = 0
         with self._lock:
             if generation != self._generation:
+                return 0
+            if index_generation is not None and \
+                    index_generation != self._index_generation:
                 return 0
             rows = self._sem.setdefault(
                 int(k), collections.deque())
@@ -431,6 +468,38 @@ class MemoCache:
                     'y' if dropped == 1 else 'ies'))
         return generation
 
+    def bump_index_generation(self) -> int:
+        """An INDEX rollover swapped: invalidate every index-dependent
+        entry (neighbor results — ``index_generation`` is not None —
+        and the whole semantic tier, which only ever caches index
+        lookups) while index-independent predict entries SURVIVE —
+        the model didn't change, so their results are still good.
+        A rolled-back index canary never calls this.  Returns the new
+        index generation."""
+        with self._lock:
+            self._index_generation += 1
+            dropped = 0
+            for key in [key for key, entry in self._entries.items()
+                        if entry.index_generation is not None]:
+                victim = self._entries.pop(key)
+                self._bytes -= victim.nbytes
+                dropped += 1
+            sem_dropped = self._sem_rows_total
+            self._sem.clear()
+            self._sem_bytes = 0
+            self._sem_rows_total = 0
+            generation = self._index_generation
+            total = self._bytes + self._sem_bytes
+            entries = len(self._entries)
+        self._export(total, entries)
+        self.log('memo: index generation -> %d; %d neighbor entr%s + '
+                 '%d semantic row(s) invalidated, %d predict entr%s '
+                 'kept'
+                 % (generation, dropped,
+                    'y' if dropped == 1 else 'ies', sem_dropped,
+                    entries, 'y' if entries == 1 else 'ies'))
+        return generation
+
     # --------------------------------------------------------- plumbing
     def _export(self, total_bytes: int, entries: int) -> None:
         self.bytes_gauge.set(total_bytes)
@@ -451,6 +520,7 @@ class MemoCache:
                 'bytes': self._bytes + self._sem_bytes,
                 'capacity_bytes': self.capacity_bytes,
                 'generation': self._generation,
+                'index_generation': self._index_generation,
                 'params_step': self._params_step,
                 'semantic': {
                     'epsilon': self.semantic_epsilon,

@@ -940,7 +940,7 @@ class ServingMesh:
     # decode-completion hooks, the supervisor, the liveness monitor,
     # and control calls (lock-discipline rule, ANALYSIS.md); _cond
     # wraps _lock:
-    # graftlint: guard ServingMesh._closed,_drain,_rollover,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s,_restart_pending,_next_rid by _lock|_cond
+    # graftlint: guard ServingMesh._closed,_drain,_rollover,_index_rollover,_index_version,_params_step,_rows_total,_service_window,_service_window_rows,_service_rows_per_s,_restart_pending,_next_rid by _lock|_cond
     def __init__(self, model, replicas: Optional[int] = None,
                  tiers: Optional[Sequence[str]] = None,
                  mode: Optional[str] = None,
@@ -1069,6 +1069,11 @@ class ServingMesh:
         self._closed = False
         self._drain = False
         self._rollover: Optional[Dict[str, object]] = None
+        # index rollover (canaried index swap — the params-canary
+        # machinery generalized to indexes): candidate + live-traffic
+        # shadow-query agreement state, armed by rollover_index()
+        self._index_rollover: Optional[Dict[str, object]] = None
+        self._index_version = 0
         self._rows_total = 0
         # fleet service window: same estimator the engine runs, fed by
         # EVERY replica's completions — the fleet-wide drain rate
@@ -1113,6 +1118,11 @@ class ServingMesh:
         self.rollover_total = Counter('mesh/rollover_total')
         self.rollover_rollbacks_total = Counter(
             'mesh/rollover_rollbacks_total')
+        self.index_rollover_total = Counter('index/rollovers_total')
+        self.index_rollover_rollbacks_total = Counter(
+            'index/rollover_rollbacks_total')
+        self.index_rollover_agreement = Gauge(
+            'index/rollover_agreement')
         self.breaker_open_total = Counter(
             'mesh/replica_breaker_open_total')
         self.replicas_gauge = Gauge('mesh/replicas')
@@ -2394,8 +2404,11 @@ class ServingMesh:
         # traffic served from cache would starve the canary's shadow
         # scorer of batches and the rollover would never conclude
         # (inserts still happen; the generation check keeps any result
-        # in flight across the swap out)
+        # in flight across the swap out).  An INDEX rollover stands
+        # the neighbor memo down for the same reason: its shadow
+        # queries ride live neighbor traffic
         rolling = self._rollover is not None  # graftlint: disable=lock-discipline -- benign racy read: a stale None serves one more hit, a stale rollover runs one more request live
+        rolling = rolling or self._index_rollover is not None  # graftlint: disable=lock-discipline -- same benign racy read for the index-rollover axis
         if isinstance(context_or_vectors, np.ndarray):
             vectors = np.atleast_2d(context_or_vectors)
             shadow_row = None
@@ -2427,17 +2440,29 @@ class ServingMesh:
                     # cached row's top-1 agreement against the live one
                     shadow_row = sem_row
             sem_gen = memo.generation if memo is not None else None
+            sem_igen = (memo.index_generation if memo is not None
+                        else None)
+            # re-read the index AFTER capturing the generation: a
+            # rollover concluding between the top-of-function read and
+            # here would otherwise search the OLD index yet insert
+            # under the NEW generation — a stale cached answer.  This
+            # order fails safe: old generation + new index is merely a
+            # refused insert
+            index = self._index
 
             def lookup():
                 try:
                     values, indices = index.search(vectors, k)
+                    self._note_index_shadow(vectors, indices, k)
                     results = neighbors_from_search(
                         values, indices, index.labels)
                     if memo is not None:
                         if shadow_row is not None and results:
                             memo.note_semantic_agreement(
                                 shadow_row, results[0])
-                        memo.semantic_insert(vectors, results, k, sem_gen)
+                        memo.semantic_insert(vectors, results, k,
+                                             sem_gen,
+                                             index_generation=sem_igen)
                     _resolve(outer, results)
                 except BaseException as exc:
                     if not outer.done():
@@ -2448,6 +2473,7 @@ class ServingMesh:
                                       self.config.MAX_CONTEXTS)
         nkey = None
         gen = None
+        igen = None
         if memo is not None:
             # exact tier for line-based neighbor queries: keyed per k so
             # a k=5 answer can never serve a k=10 ask; stands down
@@ -2473,6 +2499,11 @@ class ServingMesh:
                 outer.set_result(cached)
                 return outer
             gen = memo.generation
+            igen = memo.index_generation
+            # re-read AFTER igen — same swap-race ordering as the
+            # ndarray path above: never pair the old index with the
+            # new generation
+            index = self._index
         inner = self.submit(lines, tier='vectors')
 
         def chain(done: Future) -> None:
@@ -2483,11 +2514,14 @@ class ServingMesh:
                     return
                 vectors = np.stack([r.code_vector for r in results])
                 values, indices = index.search(vectors, k)
+                self._note_index_shadow(vectors, indices, k)
                 out_results = neighbors_from_search(
                     values, indices, index.labels)
                 if memo is not None:
-                    memo.insert(nkey, out_results, gen)
-                    memo.semantic_insert(vectors, out_results, k, gen)
+                    memo.insert(nkey, out_results, gen,
+                                index_generation=igen)
+                    memo.semantic_insert(vectors, out_results, k, gen,
+                                         index_generation=igen)
                 _resolve(outer, out_results)
             except BaseException as exc:
                 if not outer.done():
@@ -2638,6 +2672,158 @@ class ServingMesh:
             self._cond.notify_all()
         self._queue.kick()
 
+    # --------------------------------------------------- index rollover
+    def rollover_index(self, candidate,
+                       shadow_queries: Optional[int] = None,
+                       min_agreement: Optional[float] = None) -> Future:
+        """Canaried INDEX swap — the params-canary machinery
+        generalized to indexes (SERVING.md rollover runbook, INDEX.md
+        "Quantized tier").  The candidate index (a rebuilt, compacted,
+        or re-quantized tier over the same corpus) attaches in SHADOW:
+        live ``submit_neighbors`` traffic keeps being served by the
+        current index while every query is replayed against the
+        candidate in the aux pool and scored for top-k id agreement.
+        After ``shadow_queries`` scored queries: agreement >= the floor
+        swaps the candidate in atomically (new index version; the memo
+        tier's index generation bumps, invalidating every cached
+        neighbor result while predict entries survive); below the
+        floor rolls back — the candidate never serves a single
+        request.  Returns a Future of the report dict."""
+        n_shadow = (int(shadow_queries) if shadow_queries is not None
+                    else 32)
+        floor = (float(min_agreement) if min_agreement is not None
+                 else self.canary_agreement)
+        if n_shadow < 1:
+            raise ValueError('rollover_index needs shadow_queries >= 1 '
+                             '(got %r)' % shadow_queries)
+        if candidate is None or not hasattr(candidate, 'search'):
+            raise ValueError('rollover_index needs a candidate index '
+                             'with .search (got %r)' % (candidate,))
+        handle: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise EngineClosed('ServingMesh is closed')
+            if self._index is None:
+                raise RuntimeError('no index attached — nothing to '
+                                   'roll over; use attach_index for '
+                                   'the first attach')
+            if self._index_rollover is not None:
+                raise RuntimeError('an index rollover is already in '
+                                   'flight; await its handle first')
+            self._index_rollover = {
+                'candidate': candidate, 'handle': handle,
+                'needed': n_shadow, 'floor': floor,
+                'agree_sum': 0.0, 'count': 0, 'concluding': False,
+            }
+        self.log('mesh: index rollover armed — shadow-querying the '
+                 'candidate on live traffic (%d queries, agreement '
+                 'floor %.2f)' % (n_shadow, floor))
+        return handle
+
+    def _note_index_shadow(self, vectors: np.ndarray,
+                           live_indices: np.ndarray, k: int) -> None:
+        """One live neighbor query completed while an index rollover
+        is armed: replay it against the candidate in the aux pool and
+        accumulate top-k id agreement.  A no-op (one racy None read)
+        when no rollover is in flight — the hot path stays lock-free."""
+        if self._index_rollover is None:  # graftlint: disable=lock-discipline -- benign racy read: a just-armed rollover misses one query, a just-concluded one scores one extra no-op
+            return
+        with self._cond:
+            state = self._index_rollover
+            if state is None or state['concluding']:
+                return
+        vectors = np.array(vectors, np.float32)
+        live_indices = np.array(live_indices)
+
+        def shadow():
+            try:
+                _, cand_idx = state['candidate'].search(vectors, k)
+            except BaseException as exc:
+                self._conclude_index_rollover(
+                    state, error=exc)
+                return
+            per_row: List[float] = []
+            for row in range(live_indices.shape[0]):
+                live = set(int(i) for i in live_indices[row] if i >= 0)
+                if not live:
+                    continue
+                got = set(int(i) for i in cand_idx[row] if i >= 0)
+                per_row.append(len(live & got) / len(live))
+            with self._cond:
+                if self._index_rollover is not state \
+                        or state['concluding']:
+                    return
+                state['agree_sum'] += sum(per_row)
+                state['count'] += len(per_row)
+                running = (state['agree_sum'] / state['count']
+                           if state['count'] else 0.0)
+                done = state['count'] >= state['needed']
+                if done:
+                    state['concluding'] = True
+            self.index_rollover_agreement.set(running)
+            if tele_core.enabled():
+                tele_core.registry().gauge(
+                    'index/rollover_agreement').set(running)
+            if done:
+                self._conclude_index_rollover(state)
+        self._aux_pool.submit(shadow)
+
+    def _conclude_index_rollover(self, state: Dict[str, object],
+                                 error=None) -> None:
+        """Swap-or-rollback decision once the shadow sample is full (or
+        the candidate errored — an index that cannot answer the shadow
+        queries must never be swapped in)."""
+        handle: Future = state['handle']
+        with self._cond:
+            if self._index_rollover is not state:
+                return
+            agreement = (state['agree_sum'] / state['count']
+                         if state['count'] else 0.0)
+            swapped = error is None and agreement >= state['floor']
+            if swapped:
+                self._index = state['candidate']
+                self._index_version += 1
+                version = self._index_version
+            self._index_rollover = None
+            self._cond.notify_all()
+        if swapped:
+            if self._memo is not None:
+                # neighbor results are index-dependent: the index
+                # generation bump invalidates them atomically while
+                # predict entries survive (the model didn't change)
+                self._memo.bump_index_generation()
+            self.index_rollover_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'index/rollovers_total').inc()
+            self.log('mesh: index rollover SWAPPED (version %d): '
+                     'shadow agreement %.3f over %d queries'
+                     % (version, agreement, state['count']))
+        else:
+            self.index_rollover_rollbacks_total.inc()
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'index/rollover_rollbacks_total').inc()
+            self.log('mesh: index rollover ROLLED BACK (%s); the '
+                     'serving index and every cached neighbor result '
+                     'stay live'
+                     % ('candidate error: %r' % error if error
+                        is not None else 'shadow agreement %.3f < '
+                        'floor %.2f over %d queries'
+                        % (agreement, state['floor'], state['count'])))
+        report = {'swapped': swapped, 'agreement': agreement,
+                  'queries': state['count'],
+                  'reason': ('candidate error: %r' % error
+                             if error is not None else None)}
+        if swapped:
+            report['index_version'] = version
+        if error is not None and not handle.done():
+            handle.set_exception(
+                error if isinstance(error, Exception)
+                else RuntimeError(repr(error)))
+            return
+        _resolve(handle, report)
+
     def follow_checkpoints(self, poll_secs: Optional[float] = None
                            ) -> 'ServingMesh':
         """Fleet-level ``--serve-follow-checkpoints``: ONE poller rolls
@@ -2786,6 +2972,7 @@ class ServingMesh:
             } for slot in self._replicas]
             params_step = self._params_step
             fleet_rate = self._service_rows_per_s
+            index_version = self._index_version
         out = {
             'replicas': replicas,
             'mode': self.mode,
@@ -2796,6 +2983,11 @@ class ServingMesh:
             'rollover_total': self.rollover_total.snapshot(),
             'rollover_rollbacks_total':
                 self.rollover_rollbacks_total.snapshot(),
+            'index_version': index_version,
+            'index_rollover_total':
+                self.index_rollover_total.snapshot(),
+            'index_rollover_rollbacks_total':
+                self.index_rollover_rollbacks_total.snapshot(),
             'replica_breaker_open_total':
                 self.breaker_open_total.snapshot(),
             'restarts_total': self.restarts_total.snapshot(),

@@ -336,6 +336,32 @@ CATALOG: Dict[str, Dict[str, str]] = {
     'index/recall_at10': _m(GAUGE, 'fraction', 'Measured IVF recall@10 '
                             'vs the exact tier on a held-out query '
                             'sample.'),
+    'index/segments': _m(GAUGE, 'segments', 'Uncompacted append '
+                         'segments live in the quantized tier.'),
+    'index/append_rows': _m(GAUGE, 'rows', 'Inserted vectors queryable '
+                            'from the append buffer, not yet folded '
+                            'into the base lists.'),
+    'index/inserts_total': _m(COUNTER, 'vectors', 'Vectors inserted '
+                              'live into the quantized tier since '
+                              'load.'),
+    'index/compactions_total': _m(COUNTER, 'compactions', 'Append-'
+                                  'segment compactions folded into the '
+                                  'base CSR (no k-means rebuild).'),
+    'index/compact_s': _m(GAUGE, 's', 'Wall time of the last '
+                          'compaction (lock held: inserts/searches '
+                          'block for this long).'),
+    'index/rollover_agreement': _m(GAUGE, 'fraction', 'Running top-k '
+                                   'id agreement of the candidate '
+                                   'index vs live results during a '
+                                   'canaried index rollover.'),
+    'index/rollovers_total': _m(COUNTER, 'rollovers', 'Index rollovers '
+                                'that concluded with a swap (new index '
+                                'version; memo neighbor entries '
+                                'invalidated).'),
+    'index/rollover_rollbacks_total': _m(COUNTER, 'rollbacks',
+                                         'Index rollovers rolled back '
+                                         'below the agreement floor or '
+                                         'on candidate error.'),
     # ---- training goodput plane (telemetry/goodput.py) ----
     'goodput/productive_s': _m(GAUGE, 's', 'Cumulative wall seconds of '
                                'productive train-step time this run '

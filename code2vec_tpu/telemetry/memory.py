@@ -120,6 +120,7 @@ ALLOC_OWNER_FILES = (
     'code2vec_tpu/serving/engine.py',
     'code2vec_tpu/index/exact.py',
     'code2vec_tpu/index/ivf.py',
+    'code2vec_tpu/index/quant.py',
 )
 
 ALLOC_CATALOG = (
@@ -181,6 +182,18 @@ ALLOC_CATALOG = (
                'centroids, freed when the build returns (transient; '
                'the persistent residents register in IVFIndex '
                '__init__)'},
+    {'file': 'code2vec_tpu/index/quant.py',
+     'func': 'QuantizedIVFIndex._install_base_locked', 'count': 2,
+     'reason': 'cluster-sorted quantized codes + codec constants '
+               '(scales / codebooks / centroids) — budget-checked at '
+               'attach, registered as index quant:<fp>:base'},
+    {'file': 'code2vec_tpu/index/quant.py',
+     'func': 'QuantizedIVFIndex._refresh_append_device_locked',
+     'count': 1,
+     'reason': 'capacity-rung padded append-segment buffer — the '
+               'delta to the next rung is budget-gated before '
+               'placement, re-registered per segment as '
+               'quant:<fp>:seg%05d + quant:<fp>:segslack'},
 )
 
 

@@ -107,6 +107,14 @@ def main() -> int:
                              'up one replica under the kill chaos, '
                              'serve, then drain it back out during a '
                              'partition window (0 disables)')
+    parser.add_argument('--index-rollovers', type=int, default=1,
+                        help='run the canaried INDEX rollover drill: '
+                             'shadow-query a disagreeing candidate on '
+                             'live neighbor traffic (must roll back, '
+                             'memo stays warm), then an agreeing one '
+                             '(must swap: memo index generation bumps, '
+                             'zero stale neighbor serves, predict '
+                             'entries survive) (0 disables)')
     parser.add_argument('--rows', type=int, default=200 if smoke else 1000)
     parser.add_argument('--contexts', type=int, default=6 if smoke else 50)
     parser.add_argument('--tokens', type=int, default=500 if smoke else 5000)
@@ -157,7 +165,9 @@ def main() -> int:
             record['smoke'] = True
         print(json.dumps(record), flush=True)
 
-    mesh = model.serving_mesh(replicas=args.replicas, tiers=('topk',),
+    tiers = (('topk', 'vectors') if args.index_rollovers
+             else ('topk',))  # attach_index needs the vectors tier
+    mesh = model.serving_mesh(replicas=args.replicas, tiers=tiers,
                               mode=args.mode, max_delay_ms=1.0,
                               memo_cache_bytes=args.memo_bytes)
     memo_on = args.memo_bytes > 0
@@ -167,13 +177,141 @@ def main() -> int:
     try:
         import jax.numpy as jnp
 
-        # warm the whole serving path once, then pin the compile mark
+        # warm the whole serving path once
         mesh.predict([lines[0]], tier='topk', timeout=300)
-        warm = compiles.value
         rng = np.random.default_rng(11)
         # the memo tier's traffic shape: half the load replays one hot
         # request, so cache hits ride THROUGH the kill/restart chaos
         hot = [lines[0], lines[1]]
+
+        index_drill = {'rollback_ok': None, 'swap_ok': None,
+                       'agreement': None, 'stale_serves': 0,
+                       'predict_survived': None, 'error': None}
+
+        def index_rollover_drill(attempt: int):
+            """Canaried index rollover (ISSUE 19): shadow-query a
+            DISAGREEING candidate on live neighbor traffic (must roll
+            back; the neighbor memo stays warm), then an AGREEING one
+            (must swap: the memo index generation bumps — zero stale
+            neighbor serves — while predict entries survive, since the
+            model didn't change).  Runs before the compile mark is
+            pinned: index builds/searches compile their own warm
+            programs, which are not the serving path's compiles."""
+            from code2vec_tpu.index import store as store_lib
+            from code2vec_tpu.index.quant import QuantizedIVFIndex
+            index_drill.update(rollback_ok=None, swap_ok=None,
+                               agreement=None, stale_serves=0,
+                               predict_survived=None, error=None)
+            # a prior attempt may have died with a rollover armed;
+            # feed it shadow traffic until it concludes so arming a
+            # fresh one doesn't refuse with 'already in flight'
+            for _ in range(64):
+                if mesh._index_rollover is None:
+                    break
+                try:
+                    mesh.submit_neighbors(hot, k=5).result(timeout=300)
+                except Exception:
+                    time.sleep(0.2)
+            dim = mesh.predict([lines[0]], tier='vectors',
+                               timeout=300)[0].code_vector.shape[0]
+            rng_i = np.random.default_rng(7)
+            corpus = rng_i.normal(size=(512, dim)).astype(np.float32)
+            store = store_lib.build(
+                os.path.join(workdir, 'drill%d.vecindex' % attempt),
+                [corpus], labels=['m%d' % i for i in range(512)])
+            class _Counting:
+                """Search-call counter: a cache-served neighbor answer
+                never touches the index, while a live one always does —
+                unlike .done(), which is also True when the chain
+                resolves synchronously off a warm vectors-tier hit."""
+
+                def __init__(self, inner):
+                    self._inner = inner
+                    self.searches = 0
+
+                def search(self, vectors, k):
+                    self.searches += 1
+                    return self._inner.search(vectors, k)
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+            live_idx = QuantizedIVFIndex.build(store, kind='int8',
+                                               seed=0)
+            live_idx.warmup(5)
+            live = _Counting(live_idx)
+            mesh.attach_index(live)
+            # warm one neighbor memo entry + confirm the duplicate is
+            # served WITHOUT a live index search
+            mesh.submit_neighbors(hot, k=5).result(timeout=300)
+            searches = live.searches
+            mesh.submit_neighbors(hot, k=5).result(timeout=300)
+            if live.searches != searches:
+                index_drill['error'] = 'neighbor memo never warmed'
+                return
+            # predict-tier entry that must SURVIVE the index swap
+            mesh.predict(hot, tier='topk', timeout=300)
+            if not mesh.submit(hot, tier='topk').done():
+                index_drill['error'] = 'predict memo never warmed'
+                return
+            # --- leg 1: disagreeing candidate must ROLL BACK
+            other = rng_i.normal(size=(512, dim)).astype(np.float32)
+            bad_store = store_lib.build(
+                os.path.join(workdir, 'drill%d_bad.vecindex' % attempt),
+                [other], labels=['x%d' % i for i in range(512)])
+            bad = QuantizedIVFIndex.build(bad_store, kind='int8',
+                                          seed=0)
+            bad.warmup(5)
+            # drive the shadow with a DIFFERENT query than the `hot`
+            # probe key: a driver admitted right after a conclusion
+            # re-inserts its own key under the new generation, which
+            # must not turn the staleness probe into a legitimate hit
+            drv = [lines[2], lines[3]]
+            handle = mesh.rollover_index(bad, shadow_queries=2,
+                                         min_agreement=0.9)
+            while not handle.done():  # memo stands down: runs live
+                mesh.submit_neighbors(drv, k=5).result(timeout=300)
+            report = handle.result(timeout=300)
+            index_drill['rollback_ok'] = (report['swapped'] is False)
+            searches = live.searches
+            mesh.submit_neighbors(hot, k=5).result(timeout=300)
+            if live.searches != searches:
+                # rollback must leave the neighbor memo WARM
+                index_drill['rollback_ok'] = False
+            # --- leg 2: agreeing candidate (same sidecars) must SWAP
+            cand_idx = QuantizedIVFIndex(
+                store_lib.VectorStore(store.path))
+            cand_idx.warmup(5)
+            cand = _Counting(cand_idx)
+            handle = mesh.rollover_index(cand, shadow_queries=2,
+                                         min_agreement=0.9)
+            while not handle.done():
+                mesh.submit_neighbors(drv, k=5).result(timeout=300)
+            report = handle.result(timeout=300)
+            index_drill['swap_ok'] = (report['swapped'] is True)
+            index_drill['agreement'] = report['agreement']
+            searches = cand.searches
+            post = mesh.submit_neighbors(hot, k=5)
+            post.result(timeout=300)
+            if cand.searches == searches:
+                # answered WITHOUT touching the new index: a pre-swap
+                # neighbor result was served post-swap
+                index_drill['stale_serves'] += 1
+            index_drill['predict_survived'] = \
+                mesh.submit(hot, tier='topk').done()
+
+        if args.index_rollovers:
+            for attempt in range(5):
+                try:
+                    index_rollover_drill(attempt)
+                    break
+                except Exception as exc:  # worker died mid-drill: retry
+                    index_drill['error'] = repr(exc)
+                    time.sleep(1.0)
+
+        # pin the compile mark AFTER the index drill: the soak loop
+        # below must run compile-free
+        warm = compiles.value
 
         def rollover_drill(i: int):
             """Save the current params at a fresh step, roll the fleet
@@ -436,6 +574,37 @@ def main() -> int:
                         if drill_state['drain_ms'] is not None
                         else None),
               'retired_reason': drill_state['drain_reason']})
+    if args.index_rollovers:
+        if index_drill['rollback_ok'] is not True:
+            violations.append(
+                'index rollover drill: disagreeing candidate did not '
+                'roll back cleanly (%r)'
+                % (index_drill['error'] or index_drill['rollback_ok'],))
+        if index_drill['swap_ok'] is not True:
+            violations.append(
+                'index rollover drill: agreeing candidate did not swap '
+                '(%r)' % (index_drill['error']
+                          or index_drill['swap_ok'],))
+        if index_drill['stale_serves']:
+            violations.append(
+                'STALE: memo served %d pre-swap neighbor result(s) '
+                'after the index rollover'
+                % index_drill['stale_serves'])
+        if index_drill['swap_ok'] and not index_drill['predict_survived']:
+            violations.append(
+                'index rollover drill: predict memo entries did not '
+                'survive the index swap (the model did not change)')
+        emit({'metric': 'mesh_soak_index_rollover',
+              'value': 1 if (index_drill['swap_ok']
+                             and index_drill['rollback_ok']) else 0,
+              'agreement': index_drill['agreement'],
+              'stale_neighbor_serves': index_drill['stale_serves'],
+              'predict_survived': index_drill['predict_survived'],
+              'index_version': stats.get('index_version'),
+              'memo_index_generation':
+                  (stats['memo'].get('index_generation')
+                   if memo_on else None),
+              'error': index_drill['error']})
     if memo_on:
         # memoization-tier soak contract (SERVING.md "Memoization
         # tier"): the cache must actually serve under the duplicate-

@@ -323,7 +323,8 @@ def test_bench_index_smoke_meets_acceptance():
     # weaken the acceptance threshold
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, 'benchmarks',
-                                      'bench_index.py'), '--reps', '4'],
+                                      'bench_index.py'), '--reps', '4',
+         '--arms', 'base'],
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     records = {r['metric']: r for r in
@@ -338,6 +339,37 @@ def test_bench_index_smoke_meets_acceptance():
     curve = records['index_ivf_curve']['points']
     assert curve and all(
         {'nprobe', 'recall', 'queries_per_sec'} <= set(p) for p in curve)
+
+
+def test_bench_index_quant_arms_smoke():
+    """Quantized-tier arms (capture stage ``index_quant``) on the CPU
+    smoke shapes: both kinds hit the recall floor with zero post-warmup
+    compiles, PQ compresses >= 4x vs f16 (the <= 1/4 acceptance), and
+    the insert arm's rows are self-findable (queryable, no rebuild)."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'bench_index.py'), '--reps', '2',
+         '--arms', 'quant'],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(line) for line in proc.stdout.splitlines()
+               if line.strip()]
+    by_kind = {}
+    for rec in records:
+        if 'kind' in rec:
+            by_kind.setdefault(rec['metric'], {})[rec['kind']] = rec
+    for kind in ('int8', 'pq'):
+        recall = by_kind['index_quant_recall_at10'][kind]
+        assert recall['value'] >= 0.95, recall
+        qps = by_kind['index_quant_queries_per_sec'][kind]
+        assert qps['postwarm_compiles'] == 0, qps
+    assert (by_kind['index_quant_queries_per_sec']['pq']
+            ['compression_vs_f16']) >= 4.0
+    insert = by_kind['index_quant_insert_vectors_per_sec']['pq']
+    assert insert['self_hit_at1'] >= 0.9, insert
+    assert insert['segments'] >= 1, insert
 
 
 def test_bench_sigterm_flushes_fallback_line(tmp_path):

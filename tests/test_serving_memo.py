@@ -572,6 +572,286 @@ def test_mesh_neighbors_memo_stands_down_during_canary(model):
         mesh.close()
 
 
+# ------------------------------------------ index-generation keying
+def test_memo_index_generation_two_axes():
+    """ISSUE 19 bugfix: memo generations key on (params step, index
+    version).  An index swap bumps ONLY the index axis — neighbor
+    entries (pinned to an index generation) invalidate atomically while
+    predict entries (index-independent) keep serving; a params bump
+    still clears everything."""
+    cache = memo_lib.MemoCache(1 << 20)
+    try:
+        pkey = memo_lib.request_key(['l a,b,c'], 'topk')
+        nkey = memo_lib.request_key(['l a,b,c'], 'neighbors', k=4)
+        row = [{'s': np.zeros(8)}]
+        assert cache.insert(pkey, row, cache.generation)
+        assert cache.insert(nkey, row, cache.generation,
+                            index_generation=cache.index_generation)
+        before = cache.stats()
+        assert before['entries'] == 2
+        cache.bump_index_generation()
+        after = cache.stats()
+        assert after['index_generation'] == \
+            before['index_generation'] + 1
+        assert after['generation'] == before['generation']
+        assert cache.lookup(nkey) is None       # index-dependent: gone
+        assert cache.lookup(pkey) is not None   # index-independent: warm
+        assert after['entries'] == 1
+        assert after['evictions'] == 0  # version bump, not eviction
+        # byte accounting stays consistent through the selective drop
+        assert memory_lib.ledger().bucket_bytes('memo') == \
+            cache.stats()['bytes'] > 0
+        # an insert carrying a stale index generation is refused (a
+        # neighbor request in flight across an index swap can never
+        # poison the new cache)
+        assert not cache.insert(
+            nkey, row, cache.generation,
+            index_generation=after['index_generation'] - 1)
+        assert cache.insert(nkey, row, cache.generation,
+                            index_generation=cache.index_generation)
+        # the params axis still clears BOTH kinds of entry
+        cache.bump_generation()
+        assert cache.lookup(pkey) is None
+        assert cache.lookup(nkey) is None
+    finally:
+        cache.close()
+
+
+def test_memo_index_bump_drops_semantic_and_refuses_stale():
+    """The semantic tier answers from cached index results, so an index
+    swap drops it wholesale; a stale-index-generation semantic insert
+    is refused."""
+    from code2vec_tpu.index.service import neighbors_from_search
+    cache = memo_lib.MemoCache(1 << 20, semantic_epsilon=0.05,
+                               semantic_shadow_every=100)
+    try:
+        vec = np.array([1.0, 0.0, 0.0, 0.0], np.float32)
+        rows = neighbors_from_search(np.array([[0.9, 0.5]]),
+                                     np.array([[2, 0]]),
+                                     ['a', 'b', 'c'])
+        assert cache.semantic_insert(
+            vec[None, :], rows, 4, cache.generation,
+            index_generation=cache.index_generation) == 1
+        assert cache.semantic_lookup(vec, 4) is not None
+        cache.bump_index_generation()
+        assert cache.semantic_lookup(vec, 4) is None
+        assert cache.semantic_insert(
+            vec[None, :], rows, 4, cache.generation,
+            index_generation=cache.index_generation - 1) == 0
+        assert cache.semantic_lookup(vec, 4) is None
+    finally:
+        cache.close()
+
+
+# ------------------------------------------------ index rollover drills
+class _WorstIndex(_FakeIndex):
+    """Deterministically DISAGREEING candidate: returns the worst-k
+    rows, disjoint from _FakeIndex's top-k when k <= n/2."""
+
+    def search(self, vectors, k):
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        sims = vectors @ self._store.T
+        idx = np.argsort(sims, axis=1)[:, :k]
+        return np.take_along_axis(sims, idx, axis=1), idx
+
+
+class _BoomIndex:
+    def search(self, vectors, k):
+        raise RuntimeError('candidate index cannot answer')
+
+
+class _CountingIndex:
+    """Search-call counter: a cache-served neighbors answer never
+    touches the index, a live one always does.  (.done() alone cannot
+    distinguish them — the chain resolves synchronously whenever the
+    inner vectors-tier submit is itself a legitimate memo hit.)"""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.searches = 0
+
+    def search(self, vectors, k):
+        self.searches += 1
+        return self._inner.search(vectors, k)
+
+    @property
+    def labels(self):
+        return self._inner.labels
+
+
+def test_mesh_index_rollover_swap_invalidates_neighbors_not_predict(
+        model):
+    """Agreeing candidate swaps in: index version + memo index
+    generation bump, every cached neighbor result misses, predict
+    entries survive (the model didn't change)."""
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        live = _CountingIndex(_FakeIndex(dim=vec.shape[0]))
+        mesh.attach_index(live)
+        # warm one neighbor entry and one predict entry
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        searches = live.searches
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert live.searches == searches  # duplicate served from cache
+        mesh.predict(PREDICT_LINES, tier='topk', timeout=60)
+        assert mesh.submit(PREDICT_LINES, tier='topk').done()
+        stats = mesh.stats()
+        version_before = stats['index_version']
+        igen_before = stats['memo']['index_generation']
+        gen_before = stats['memo']['generation']
+        # same seed -> identical store -> agreement 1.0
+        cand = _CountingIndex(_FakeIndex(dim=vec.shape[0]))
+        handle = mesh.rollover_index(cand, shadow_queries=1,
+                                     min_agreement=0.9)
+        # drive the shadow with a DIFFERENT query than the probe key:
+        # a driver admitted right after the conclusion would re-insert
+        # its own key under the new generation, which must not turn
+        # the staleness probe below into a legitimate hit
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.submit_neighbors([PREDICT_LINES[0]], k=4).result(60)
+        report = handle.result(timeout=60)
+        assert report['swapped'] is True
+        assert report['agreement'] == pytest.approx(1.0)
+        assert report['index_version'] == version_before + 1
+        stats = mesh.stats()
+        assert stats['index_version'] == version_before + 1
+        assert stats['index_rollover_total'] >= 1
+        assert stats['memo']['index_generation'] == igen_before + 1
+        assert stats['memo']['generation'] == gen_before  # untouched
+        # the pre-swap neighbor entry can never serve again: the
+        # duplicate must run LIVE against the new index
+        searches = cand.searches
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert cand.searches > searches
+        # ... while the predict entry survives the swap
+        assert mesh.submit(PREDICT_LINES, tier='topk').done()
+    finally:
+        mesh.close()
+
+
+def test_mesh_index_rollover_rollback_keeps_memo_warm(model):
+    """Disagreeing candidate rolls back: the serving index, its
+    version, and every cached neighbor result stay live — the
+    candidate never serves a request."""
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        live = _CountingIndex(_FakeIndex(dim=vec.shape[0]))
+        mesh.attach_index(live)
+        first = mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        stats = mesh.stats()
+        version_before = stats['index_version']
+        igen_before = stats['memo']['index_generation']
+        handle = mesh.rollover_index(_WorstIndex(dim=vec.shape[0]),
+                                     shadow_queries=1,
+                                     min_agreement=0.9)
+        for _ in range(12):
+            if handle.done():
+                break
+            mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        report = handle.result(timeout=60)
+        assert report['swapped'] is False
+        assert report['agreement'] == pytest.approx(0.0)
+        stats = mesh.stats()
+        assert stats['index_version'] == version_before
+        assert stats['index_rollover_rollbacks_total'] >= 1
+        assert stats['memo']['index_generation'] == igen_before
+        # rollback left the neighbor memo warm: the duplicate is
+        # answered without a live index search
+        searches = live.searches
+        warm = mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert live.searches == searches
+        assert [r.labels for r in warm] == [r.labels for r in first]
+    finally:
+        mesh.close()
+
+
+def test_mesh_index_rollover_candidate_error_and_validation(model):
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20)
+    try:
+        # no index attached yet: nothing to roll over
+        with pytest.raises(RuntimeError, match='no index attached'):
+            mesh.rollover_index(_FakeIndex(dim=4))
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        live = _FakeIndex(dim=vec.shape[0])
+        mesh.attach_index(live)
+        with pytest.raises(ValueError, match='shadow_queries'):
+            mesh.rollover_index(_FakeIndex(dim=vec.shape[0]),
+                                shadow_queries=0)
+        with pytest.raises(ValueError, match='candidate index'):
+            mesh.rollover_index(object())
+        # a candidate that cannot answer the shadow queries must never
+        # swap in: the handle raises, the old index keeps serving
+        first = mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        handle = mesh.rollover_index(_BoomIndex(), shadow_queries=1)
+        deadline = 60
+        while not handle.done() and deadline:
+            mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+            deadline -= 1
+        with pytest.raises(RuntimeError, match='cannot answer'):
+            handle.result(timeout=60)
+        stats = mesh.stats()
+        assert stats['index_version'] == 0
+        assert stats['index_rollover_rollbacks_total'] >= 1
+        again = mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert [r.labels for r in again] == [r.labels for r in first]
+    finally:
+        mesh.close()
+
+
+def test_mesh_neighbors_memo_stands_down_during_index_rollover(model):
+    """While an index rollover is armed, submit_neighbors duplicates
+    run LIVE (both the exact nkey and semantic tiers) — cache-served
+    answers would starve the shadow scorer, exactly like the params
+    canary stand-down."""
+    mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
+                              max_delay_ms=0.0,
+                              memo_cache_bytes=32 << 20,
+                              memo_semantic_epsilon=0.05)
+    try:
+        vec = mesh.predict([PREDICT_LINES[0]], tier='vectors',
+                           timeout=60)[0].code_vector
+        live = _CountingIndex(_FakeIndex(dim=vec.shape[0]))
+        mesh.attach_index(live)
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        mesh.submit_neighbors(vec, k=4).result(60)
+        searches = live.searches
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert live.searches == searches  # warm: served from cache
+        serves_before = mesh.stats()['memo']['semantic']['serves']
+        # arm a minimal in-flight rollover state ('concluding' makes
+        # the shadow scorer a no-op, so it never concludes under us)
+        mesh._index_rollover = {'concluding': True}
+        try:
+            mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+            assert live.searches == searches + 1  # exact tier ran live
+            near = vec * np.float32(1.00001)
+            mesh.submit_neighbors(near, k=4).result(60)
+            assert live.searches == searches + 2  # semantic ran live
+            stats = mesh.stats()['memo']
+            assert stats['semantic']['serves'] == serves_before
+        finally:
+            mesh._index_rollover = None
+        # concluded: duplicates serve from cache again
+        searches = live.searches
+        mesh.submit_neighbors(PREDICT_LINES, k=4).result(60)
+        assert live.searches == searches
+    finally:
+        mesh.close()
+
+
 def test_mesh_semantic_tier_defaults_off(model):
     mesh = model.serving_mesh(replicas=1, tiers=('topk', 'vectors'),
                               max_delay_ms=0.0,
