@@ -267,6 +267,247 @@ def majority_baseline(prefix: str) -> dict:
             'f1': round(metric.f1, 4)}
 
 
+def build_mixed_dataset(workdir: str, classes_per_lang: int,
+                        contexts: int) -> str:
+    """Mixed Java+C# dataset for the --scenarios mode: both languages'
+    raw extractions concatenated into ONE preprocess stream, so the
+    trained vocab (and the served model) covers both frontends."""
+    data = os.path.join(workdir, 'data')
+    os.makedirs(data, exist_ok=True)
+    extractor = os.path.join(REPO, 'extractor', 'build', 'c2v-extract')
+    raws = {split: [] for split in ('train', 'val', 'test')}
+    for lang, generator in (('java', 'gen_java_corpus.py'),
+                            ('csharp', 'gen_csharp_corpus.py')):
+        tag = ('%d' % classes_per_lang if lang == 'java'
+               else 'cs_%d' % classes_per_lang)
+        corpus = os.path.join(workdir, 'corpus_%s' % tag)
+        if not os.path.isdir(corpus):
+            run([sys.executable,
+                 os.path.join(REPO, 'scripts', generator),
+                 '-o', corpus, '--classes', str(classes_per_lang)])
+        for split in ('train', 'val', 'test'):
+            raw = os.path.join(data, '%s_%s.raw' % (split, tag))
+            if not os.path.isfile(raw):
+                with open(raw, 'w') as f:
+                    run([extractor, '--dir',
+                         os.path.join(corpus, split),
+                         '--max_path_length', '8',
+                         '--max_path_width', '2',
+                         '--num_threads', '16'], stdout=f)
+            raws[split].append(raw)
+    mixed = {}
+    for split, parts in raws.items():
+        mixed[split] = os.path.join(
+            data, '%s_mix_%d.raw' % (split, classes_per_lang))
+        if not os.path.isfile(mixed[split]):
+            with open(mixed[split], 'w') as out:
+                for part in parts:
+                    with open(part) as f:
+                        out.write(f.read())
+    prefix = os.path.join(data, 'acc_mix_%d_c%d'
+                          % (classes_per_lang, contexts))
+    if not os.path.isfile(prefix + '.train.c2v'):
+        run([sys.executable, '-m', 'code2vec_tpu.data.preprocess',
+             '-trd', mixed['train'], '-vd', mixed['val'],
+             '-ted', mixed['test'], '-mc', str(contexts),
+             '-wvs', str(WORD_VOCAB), '-pvs', str(PATH_VOCAB),
+             '-tvs', str(TARGET_VOCAB), '-o', prefix, '--seed', '0'],
+            cwd=REPO, env=dict(os.environ, PYTHONPATH=_pythonpath()))
+    return prefix
+
+
+def run_scenarios(args) -> None:
+    """--scenarios mode (WORKLOADS.md): train a small mixed Java+C#
+    model in-process, record a mixed traffic profile, replay it
+    against a live mesh under the registered scenarios, and emit
+    per-scenario x per-language quality rows plus the built-in
+    retrieval-vs-softmax A/B and the post-warmup compile count."""
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    sys.path.insert(0, REPO)
+    import numpy as np
+    from code2vec_tpu.config import Config
+    from code2vec_tpu.model_api import Code2VecModel
+    from code2vec_tpu.telemetry import core as tele_core
+    from code2vec_tpu.telemetry.jit_tracker import \
+        install_compile_listener
+    from code2vec_tpu.workloads import profile as profile_lib
+    from code2vec_tpu.workloads import replay as replay_lib
+
+    classes = args.classes or (2 if smoke else 48)
+    epochs = args.epochs or (1 if smoke else 4)
+    contexts = 8 if smoke else 16
+    os.makedirs(args.workdir, exist_ok=True)
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    prefix = build_mixed_dataset(args.workdir, classes, contexts)
+    config = Config(
+        TRAIN_DATA_PATH_PREFIX=prefix, DL_FRAMEWORK='jax',
+        COMPUTE_DTYPE='float32', MAX_CONTEXTS=contexts,
+        TRAIN_BATCH_SIZE=64, TEST_BATCH_SIZE=64,
+        NUM_TRAIN_EPOCHS=epochs, SHUFFLE_BUFFER_SIZE=512,
+        VERBOSE_MODE=0, READER_USE_NATIVE=False,
+        SERVING_BATCH_BUCKETS='8,16',
+        SERVING_SLO_AVAILABILITY=0.99,
+        # the corpus index is built from predict-path code vectors
+        EXPORT_CODE_VECTORS=True,
+        BLEND_NEIGHBOR_WEIGHT=args.blend_weight, **CPU_DIMS)
+    tele_core.enable()
+    install_compile_listener()
+    compiles = tele_core.registry().counter('jit/compiles_total')
+    model = Code2VecModel(config)
+    model.train()
+
+    def emit(record):
+        if smoke:
+            record['smoke'] = True
+        print(json.dumps(record), flush=True)
+
+    mesh = None
+    try:
+        # retrieval index: train-split code vectors labeled with the
+        # TRUE method names — the neighbor votes the blend mixes in
+        with open(prefix + '.train.c2v') as f:
+            train_lines = [line.rstrip('\n') for line in f if line.strip()]
+        cap = 64 if smoke else 512
+        train_lines = train_lines[:cap]
+        vectors, labels = [], []
+        for start in range(0, len(train_lines), 64):
+            chunk = train_lines[start:start + 64]
+            for line, row in zip(chunk, model.predict(chunk)):
+                vectors.append(np.asarray(row.code_vector,
+                                          dtype=np.float32))
+                labels.append(line.split(' ', 1)[0])
+
+        class _CorpusIndex:
+            def __init__(self, rows, names):
+                self.vectors = np.stack(rows)
+                norms = np.linalg.norm(self.vectors, axis=1,
+                                       keepdims=True)
+                self.vectors /= np.maximum(norms, 1e-8)
+                self.labels = np.array(names, dtype=object)
+
+            def search(self, queries, k):
+                q = np.atleast_2d(np.asarray(queries,
+                                             dtype=np.float32))
+                q = q / np.maximum(
+                    np.linalg.norm(q, axis=1, keepdims=True), 1e-8)
+                scores = q @ self.vectors.T
+                idx = np.argsort(-scores, axis=1)[:, :k]
+                return np.take_along_axis(scores, idx, axis=1), idx
+
+        mesh = model.serving_mesh(
+            replicas=1, tiers=('topk', 'vectors'),
+            memo_cache_bytes=8 << 20)
+        mesh.attach_index(_CorpusIndex(vectors, labels))
+
+        profile_dir = os.path.join(args.workdir, 'profile_src')
+        records = profile_lib.build_synthetic_profile(
+            config, profile_dir,
+            classes_per_language=max(1, classes // 4),
+            seed=args.seed, rate_rps=20.0 if smoke else 50.0)
+        profile_path = os.path.join(args.workdir,
+                                    'mixed_profile.jsonl')
+        # round-trip through the durable format: the replayed stream is
+        # exactly what a recorded profile on disk would deliver
+        profile_lib.write_profile(profile_path, records,
+                                  meta={'source': 'synthetic'})
+        _header, records = profile_lib.read_profile(profile_path)
+
+        def relabeled(name, weight=None):
+            out = []
+            for record in records:
+                twin = dict(record)
+                twin['scenario'] = name
+                if weight is not None:
+                    twin['weight'] = weight
+                out.append(twin)
+            return out
+
+        # warm every entry point once, then require ZERO compiles for
+        # the whole mixed-scenario steady state (the acceptance gate)
+        replay_lib.replay(mesh, records, pace=False, seed=args.seed,
+                          limit=min(8, len(records)))
+        replay_lib.replay(
+            mesh, relabeled('retrieval_naming', args.blend_weight),
+            pace=False, seed=args.seed, limit=min(4, len(records)))
+        warm = compiles.value
+
+        mixed = replay_lib.replay(mesh, records,
+                                  rate_scale=args.rate_scale,
+                                  seed=args.seed)
+        softmax = replay_lib.replay(mesh, relabeled('softmax_naming'),
+                                    rate_scale=args.rate_scale,
+                                    seed=args.seed)
+        retrieval = replay_lib.replay(
+            mesh, relabeled('retrieval_naming', args.blend_weight),
+            rate_scale=args.rate_scale, seed=args.seed)
+        postwarm = compiles.value - warm
+
+        rows = []
+        for report in (mixed, softmax, retrieval):
+            for scenario, languages in sorted(
+                    report['scenarios'].items()):
+                for language, cell in sorted(languages.items()):
+                    row = {'measure': 'scenario_quality',
+                           'scenario': scenario,
+                           'language': language, **cell}
+                    rows.append(row)
+                    emit(row)
+        slo = mixed.get('slo') or {}
+        for scenario, share in sorted(
+                (slo.get('scenarios') or {}).items()):
+            emit({'measure': 'scenario_slo', 'scenario': scenario,
+                  **share})
+
+        def aggregate(report, name):
+            scored = exact = 0
+            f1_num = 0.0
+            for cell in (report['scenarios'].get(name) or {}).values():
+                scored += cell['scored']
+                exact += round(cell['exact_match'] * cell['scored'])
+                f1_num += cell['f1'] * cell['scored']
+            return {'scored': scored,
+                    'exact_match': exact / scored if scored else 0.0,
+                    'f1': f1_num / scored if scored else 0.0}
+
+        soft = aggregate(softmax, 'softmax_naming')
+        retr = aggregate(retrieval, 'retrieval_naming')
+        verdict = ('win' if retr['exact_match'] > soft['exact_match']
+                   else 'tie' if retr['exact_match']
+                   >= soft['exact_match'] else 'loss')
+        ab = {'measure': 'retrieval_ab',
+              'blend_weight': args.blend_weight,
+              'softmax_exact': round(soft['exact_match'], 4),
+              'retrieval_exact': round(retr['exact_match'], 4),
+              'softmax_f1': round(soft['f1'], 4),
+              'retrieval_f1': round(retr['f1'], 4),
+              'scored': soft['scored'], 'verdict': verdict}
+        emit(ab)
+        emit({'measure': 'scenario_postwarm_compiles',
+              'value': postwarm})
+        emit({'measure': 'scenario_replay_fingerprint',
+              'value': mixed['fingerprint'],
+              'admitted': mixed['admitted']})
+
+        out = args.out or os.path.join(REPO, 'benchmarks', 'results',
+                                       'accuracy_scenarios.json')
+        with open(out, 'w') as f:
+            json.dump({'profile_records': len(records),
+                       'rows': rows, 'retrieval_ab': ab,
+                       'slo': slo,
+                       'postwarm_compiles': postwarm,
+                       'fingerprint': mixed['fingerprint'],
+                       'smoke': smoke}, f, indent=1)
+        print(json.dumps({'measure': 'scenarios_done',
+                          'out': os.path.relpath(out, REPO)}),
+              flush=True)
+    finally:
+        if mesh is not None:
+            mesh.close()
+        model.close_stores()
+        tele_core.disable()
+        tele_core.reset()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--workdir', default='/tmp/acc_r3')
@@ -278,7 +519,25 @@ def main() -> None:
     parser.add_argument('--out', default=None,
                         help='result JSON path (default: '
                              'benchmarks/results/accuracy_<profile>.json)')
+    parser.add_argument('--scenarios', action='store_true',
+                        help='run the scenario traffic plane mode '
+                             'instead of a learning-curve profile: '
+                             'record a mixed Java+C# profile, replay '
+                             'it against a live mesh, emit '
+                             'per-scenario x per-language quality '
+                             'rows + the retrieval-vs-softmax A/B '
+                             '(WORKLOADS.md)')
+    parser.add_argument('--blend-weight', type=float, default=0.5,
+                        help='retrieval blend weight for the '
+                             '--scenarios A/B arm')
+    parser.add_argument('--rate-scale', type=float, default=4.0,
+                        help='--scenarios replay pacing multiplier '
+                             'over the recorded arrival times')
+    parser.add_argument('--seed', type=int, default=7,
+                        help='--scenarios profile + replay seed')
     args = parser.parse_args()
+    if args.scenarios:
+        return run_scenarios(args)
     prof = dict(PROFILES[args.profile])
     epochs = args.epochs or prof['epochs']
     if args.classes:

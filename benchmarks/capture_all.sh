@@ -169,6 +169,14 @@ probe_or_record "after index_quant" || exit 3
 # fraction, and badput shares of the real hot loop — the healthy
 # baseline a later goodput regression flips against
 run_stage goodput 900 python benchmarks/bench_goodput.py
+probe_or_record "after goodput" || exit 3
+# scenario traffic plane (ISSUE 20): mixed Java+C# recorded profile
+# replayed against a live mesh — per-scenario x per-language
+# exact-match/F1, memo hit-rate, shed, p99, per-scenario SLO budget
+# burn, the retrieval-vs-softmax A/B verdict, and the zero-postwarm-
+# compile check across the mixed-scenario steady state
+run_stage scenarios 900 python benchmarks/accuracy_at_scale.py \
+  --scenarios --workdir /tmp/acc_scenarios
 
 # settle the queued >=2% flip verdicts from everything this round (and
 # prior rounds) captured — durable rows in results/flip_verdicts.json.

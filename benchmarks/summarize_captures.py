@@ -137,7 +137,18 @@ def main() -> None:
                                'f16_bytes_per_vector',
                                'compression_vs_f16', 'rerank',
                                'nprobe', 'rows', 'self_hit_at1',
-                               'segments')}
+                               'segments',
+                               # scenario traffic plane (ISSUE 20):
+                               # per-scenario x per-language replay
+                               # quality, memo hit-rate, shed, and the
+                               # retrieval-vs-softmax A/B columns
+                               'scenario', 'language', 'exact_match',
+                               'f1', 'memo_hit_rate', 'delivered',
+                               'shed', 'blend_weight',
+                               'softmax_exact', 'retrieval_exact',
+                               'softmax_f1', 'retrieval_f1',
+                               'availability_burn_share',
+                               'p99_burn_share', 'admitted')}
             prefix = f'  [{stage}]' if stage else '  '
             flag = '' if not rc else f'  (rc={rc})'
             if label not in ('TPU UNAVAILABLE', 'STAGE FAILED'):

@@ -563,6 +563,14 @@ class Config:
     # exactness for hit rate and must be rolled out gated on the
     # memo/semantic_agreement metric (SERVING.md "Memoization tier").
     MEMO_SEMANTIC_EPSILON: float = 0.0
+    # ---- scenario traffic plane (code2vec_tpu/workloads/, WORKLOADS.md) ----
+    # Retrieval-augmented naming blend weight (--blend-neighbor-weight):
+    # submit_blended scores a candidate label as
+    # (1 - w) * softmax_p + w * neighbor_vote over the union of the
+    # softmax head's top-k and the attached index's top-k neighbor
+    # labels. 0 short-circuits to the plain softmax path (bit-identical
+    # scores); 1 ranks purely on retrieval votes. Must lie in [0, 1].
+    BLEND_NEIGHBOR_WEIGHT: float = 0.5
     # ---- extractor bridge hardening (serving/extractor_bridge.py) ----
     # Per-invocation extractor timeout (--extractor-timeout): a wedged
     # JVM/parser fails the call (typed ExtractorCrash, stderr attached)
@@ -901,6 +909,15 @@ class Config:
                                  'device (MEMO_CACHE_BYTES; 0 '
                                  'disables; SERVING.md "Memoization '
                                  'tier")')
+        parser.add_argument('--blend-neighbor-weight',
+                            dest='blend_neighbor_weight', type=float,
+                            default=None, metavar='W',
+                            help='retrieval-augmented naming blend '
+                                 'weight in [0, 1] — neighbor-vote '
+                                 'share in submit_blended scoring '
+                                 '(BLEND_NEIGHBOR_WEIGHT; 0 = pure '
+                                 'softmax; WORKLOADS.md "Retrieval-'
+                                 'augmented naming")')
         parser.add_argument('--mesh-replica-mode',
                             dest='mesh_replica_mode',
                             choices=['thread', 'process', 'socket'],
@@ -1130,6 +1147,8 @@ class Config:
             self.MESH_QUEUE_BOUND = parsed.mesh_queue_bound
         if parsed.memo_cache_bytes is not None:
             self.MEMO_CACHE_BYTES = parsed.memo_cache_bytes
+        if parsed.blend_neighbor_weight is not None:
+            self.BLEND_NEIGHBOR_WEIGHT = parsed.blend_neighbor_weight
         if parsed.mesh_replica_mode:
             self.MESH_REPLICA_MODE = parsed.mesh_replica_mode
         if parsed.serve_follow_checkpoints is not None:
@@ -1428,6 +1447,9 @@ class Config:
         if not 0.0 <= self.MEMO_SEMANTIC_EPSILON <= 1.0:
             raise ValueError('config.MEMO_SEMANTIC_EPSILON must be in '
                              '[0, 1] (0 keeps the semantic tier off).')
+        if not 0.0 <= self.BLEND_NEIGHBOR_WEIGHT <= 1.0:
+            raise ValueError('config.BLEND_NEIGHBOR_WEIGHT must be in '
+                             '[0, 1] (0 = pure softmax ranking).')
         if self.MESH_MAX_INFLIGHT < 1:
             raise ValueError('config.MESH_MAX_INFLIGHT must be >= 1.')
         if self.MESH_BREAKER_THRESHOLD < 1:

@@ -63,6 +63,19 @@ def find_default_extractor() -> Optional[List[str]]:
     return None
 
 
+#: source extensions the serving stack recognizes — language inference
+#: is BY EXTENSION and is the default everywhere (predict entry point,
+#: extractor invocation); the reference reached C# only via explicit
+#: flags
+_EXT_LANGS = {'.java': 'java', '.cs': 'csharp'}
+
+
+def infer_language(path: str) -> Optional[str]:
+    """'java' / 'csharp' from the file extension; None when unknown
+    (the extractor then falls back to its own default frontend)."""
+    return _EXT_LANGS.get(os.path.splitext(path)[1].lower())
+
+
 def _stderr_of(proc_or_exc) -> str:
     """Best-effort stderr text from a CompletedProcess or a
     TimeoutExpired (whose captured output may be bytes or None)."""
@@ -104,6 +117,14 @@ class Extractor:
             '--max_path_length', str(self.max_path_length),
             '--max_path_width', str(self.max_path_width),
             '--file', input_path, '--no_hash']
+        # language inference from the extension is the DEFAULT: a .cs
+        # input selects the C# frontend without any caller flag.  Only
+        # non-java is made explicit — the reference-JAR fallback
+        # (JavaExtractor.App) rejects --lang, and Java is every
+        # frontend's default anyway.
+        lang = infer_language(input_path)
+        if lang is not None and lang != 'java':
+            command += ['--lang', lang]
         timeout = self.timeout_secs if self.timeout_secs > 0 else None
         try:
             proc = subprocess.run(command, capture_output=True, text=True,

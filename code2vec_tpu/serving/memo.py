@@ -69,6 +69,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from code2vec_tpu.telemetry import catalog
 from code2vec_tpu.telemetry import core as tele_core
 from code2vec_tpu.telemetry import memory as memory_lib
 from code2vec_tpu.telemetry.core import Counter, Gauge
@@ -239,14 +240,16 @@ class MemoCache:
         with self._lock:
             return self._index_generation
 
-    def lookup(self, key: bytes):
+    def lookup(self, key: bytes, scenario: Optional[str] = None):
         """A fresh copy of the cached result list for ``key``
         (``copy_results`` — hits never share rows or arrays), or None.
         A hit touches LRU recency; entries from a previous params OR
         index generation never serve (defensive — the bump calls
         already cleared them; an eviction here re-exports the gauges
         and the ledger so they cannot sit stale until the next
-        insert)."""
+        insert).  ``scenario`` additionally mirrors the hit/miss into
+        scenario-labeled counter instances, so per-scenario hit-rate
+        falls out of the existing ``memo/*`` family (WORKLOADS.md)."""
         stale_total = None
         stale_entries = 0
         with self._lock:
@@ -268,11 +271,20 @@ class MemoCache:
         if entry is None:
             self.misses_total.inc()
             if tele_core.enabled():
-                tele_core.registry().counter('memo/misses_total').inc()
+                reg = tele_core.registry()
+                reg.counter('memo/misses_total').inc()
+                if scenario:
+                    reg.counter(catalog.labeled(
+                        'memo/misses_total', 'scenario',
+                        scenario)).inc()
             return None
         self.hits_total.inc()
         if tele_core.enabled():
-            tele_core.registry().counter('memo/hits_total').inc()
+            reg = tele_core.registry()
+            reg.counter('memo/hits_total').inc()
+            if scenario:
+                reg.counter(catalog.labeled(
+                    'memo/hits_total', 'scenario', scenario)).inc()
         # outside the lock: the snapshot stored at insert is never
         # mutated, so the reference read above stays safe to copy
         return copy_results(entry.results)

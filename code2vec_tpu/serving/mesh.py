@@ -414,9 +414,14 @@ class _WorkerReplica:
         # (None for untraced members — the worker records nothing).
         # Re-parenting happens PARENT-side at adoption (the member's
         # span_parent object), so the context stays minimal.
+        # the scenario tag rides the dispatch trace context so the
+        # worker-side envelope stays attributable per workload after
+        # stitching (WORKLOADS.md; root attrs stamped at submit)
         ctxs = [None if request.trace is None else
                 {'trace_id': request.trace.trace_id,
-                 'sampled': request.trace.sampled}
+                 'sampled': request.trace.sampled,
+                 'scenario': (request.trace.root.attrs
+                              or {}).get('scenario')}
                 for request in taken]
         seq = None
         try:
@@ -1184,6 +1189,12 @@ class ServingMesh:
                                  fleet_rate=self._fleet_rate,
                                  log=self.log)
         self._index = None
+        # scenario traffic plane (workloads/profile.py): optional
+        # ProfileRecorder tapped at admission by submit/submit_neighbors/
+        # submit_blended; armed via record_traffic(), never re-armed
+        # concurrently with traffic in this codebase's use, so reads
+        # need no lock (a racy None just skips one record)
+        self._traffic_recorder = None
         self._aux_pool = ThreadPoolExecutor(max_workers=2,
                                             thread_name_prefix='mesh-aux')
         # memoization tier (serving/memo.py, SERVING.md "Memoization
@@ -2206,11 +2217,23 @@ class ServingMesh:
 
     # ----------------------------------------------------------- submit
     def submit(self, context_lines: Sequence[str], tier: str = 'topk',
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               scenario: Optional[str] = None,
+               language: Optional[str] = None,
+               record: bool = True, observe: bool = True) -> Future:
         """Enqueue one prediction request on the SHARED front queue;
         whichever free replica claims it serves it.  Same contract as
         ``ServingEngine.submit`` (typed sheds, oversize split, Future
-        of one result per line)."""
+        of one result per line).
+
+        ``scenario``/``language`` tag the request for the scenario
+        traffic plane (WORKLOADS.md): the scenario rides the trace root
+        attrs (and from there the dispatch context), labels the memo
+        hit/miss mirrors and the SLO observations.  ``record=False``
+        skips the admission traffic tap, ``observe=False`` skips the
+        SLO observation — both used by composing entry points
+        (``submit_neighbors``/``submit_blended``) that tap and observe
+        once at their own outer future."""
         if tier not in self.tiers:
             raise ValueError('tier %r is not warmed on this mesh '
                              '(tiers=%s)' % (tier, list(self.tiers)))
@@ -2238,6 +2261,9 @@ class ServingMesh:
         if not lines:
             future.set_result([])
             return future
+        if record:
+            self._record_traffic(scenario or 'softmax_naming', lines,
+                                 language=language, tier=tier)
         n = len(lines)
         if deadline_ms is None:
             deadline_s = self.deadline_s
@@ -2248,11 +2274,12 @@ class ServingMesh:
             tele_core.registry().counter('mesh/requests_total').inc()
         trace = None
         if self._tracer is not None:
-            trace = self._tracer.begin(
-                'serving.request',
-                attrs={'tier': tier, 'rows': n, 'mesh': True,
-                       'deadline_ms': (1e3 * deadline_s
-                                       if deadline_s else None)})
+            attrs = {'tier': tier, 'rows': n, 'mesh': True,
+                     'deadline_ms': (1e3 * deadline_s
+                                     if deadline_s else None)}
+            if scenario is not None:
+                attrs['scenario'] = scenario
+            trace = self._tracer.begin('serving.request', attrs=attrs)
         requested_tier = tier
         # memoization tier: content-addressed exact lookup BEFORE
         # tokenize and FrontQueue.admit — a hit resolves the future
@@ -2268,16 +2295,18 @@ class ServingMesh:
             # runs live (inserts still happen; the generation check
             # keeps any result in flight across the swap out)
             rolling = self._rollover is not None  # graftlint: disable=lock-discipline -- benign racy read: a stale None serves one more hit, a stale rollover runs one more request live
-            cached = None if rolling else memo.lookup(memo_key)
+            cached = None if rolling else memo.lookup(memo_key,
+                                                      scenario=scenario)
             if cached is not None:
                 if trace is not None:
                     trace.event('serving.memo_hit',
                                 attrs={'tier': tier, 'rows': n,
                                        'memo': 'exact'})
                     trace.finish(status='ok')
-                if self._slo is not None:
+                if observe and self._slo is not None:
                     self._slo.observe_good(
-                        time.perf_counter() - t_submit0)
+                        time.perf_counter() - t_submit0,
+                        scenario=scenario)
                 # lookup returned a fresh copy (memo_lib.copy_results):
                 # mutating it cannot poison later hits on this key
                 future.set_result(cached)
@@ -2290,8 +2319,8 @@ class ServingMesh:
                 trace.event('serving.shed', attrs={'reason': str(exc)})
                 trace.finish(status='shed')
                 self._tracer.note_shed()
-            if self._slo is not None:
-                self._slo.observe_bad('shed')
+            if observe and self._slo is not None:
+                self._slo.observe_bad('shed', scenario=scenario)
             raise
         except EngineClosed as exc:
             if trace is not None:
@@ -2327,7 +2356,7 @@ class ServingMesh:
                             attrs={'reason': 'ServingMesh is closed'})
                 trace.finish(status='closed')
             raise
-        if self._slo is not None:
+        if observe and self._slo is not None:
             # one SLO event per CALLER-VISIBLE request, observed at its
             # future — an oversize submit's chunk fan-out must not
             # inflate the good count, and one failed chunk fails the
@@ -2335,7 +2364,7 @@ class ServingMesh:
             # admission is counted at the raise above (the future is
             # never returned); a close-time EngineClosed flood is
             # shutdown, not an SLO violation, and stays out.
-            slo, t_admitted = self._slo, t_admit0
+            slo, t_admitted, scen = self._slo, t_admit0, scenario
 
             def _slo_observe(done: Future) -> None:
                 try:
@@ -2343,9 +2372,10 @@ class ServingMesh:
                 except BaseException:
                     return  # caller cancelled: not the server's verdict
                 if exc is None:
-                    slo.observe_good(time.perf_counter() - t_admitted)
+                    slo.observe_good(time.perf_counter() - t_admitted,
+                                     scenario=scen)
                 elif not isinstance(exc, EngineClosed):
-                    slo.observe_bad(type(exc).__name__)
+                    slo.observe_bad(type(exc).__name__, scenario=scen)
 
             future.add_done_callback(_slo_observe)
         if memo is not None:
@@ -2373,6 +2403,36 @@ class ServingMesh:
         """Synchronous ``submit().result()`` convenience."""
         return self.submit(context_lines, tier).result(timeout)
 
+    # ------------------------------------------- scenario traffic plane
+    def record_traffic(self, recorder) -> 'ServingMesh':
+        """Arm (or with ``None`` disarm) the admission traffic tap: a
+        ``workloads.profile.ProfileRecorder`` that sees every caller-
+        visible submit/submit_neighbors/submit_blended with its scenario
+        label, for later durable save + replay (WORKLOADS.md)."""
+        self._traffic_recorder = recorder
+        return self
+
+    def _record_traffic(self, scenario: str, lines=None, vector=None,
+                        language: Optional[str] = None,
+                        tier: Optional[str] = None,
+                        k: Optional[int] = None,
+                        weight: Optional[float] = None) -> None:
+        recorder = self._traffic_recorder
+        if recorder is None:
+            return
+        label = None
+        if lines:
+            # recorded label = the method's true name, recoverable from
+            # the context-line head (extractor output contract); lets a
+            # replay score quality without a separate label channel
+            label = lines[0].split(' ', 1)[0] or None
+        try:
+            recorder.record(scenario, language=language, lines=lines,
+                            vector=vector, label=label, tier=tier,
+                            k=k, weight=weight)
+        except Exception as exc:  # the tap must never fail a request
+            self.log('traffic tap dropped a record: %r' % (exc,))
+
     # -------------------------------------------------------- neighbors
     def attach_index(self, index) -> 'ServingMesh':
         """Arm ``submit_neighbors``: neighbor queries ride the shared
@@ -2386,10 +2446,16 @@ class ServingMesh:
         return self
 
     def submit_neighbors(self, context_or_vectors,
-                         k: Optional[int] = None) -> Future:
+                         k: Optional[int] = None,
+                         scenario: Optional[str] = None,
+                         language: Optional[str] = None,
+                         record: bool = True,
+                         observe: bool = True) -> Future:
         """Mesh analogue of ``ServingEngine.submit_neighbors``: context
         lines ride the micro-batched 'vectors' tier ACROSS the fleet,
-        the resulting code vectors feed the shared index."""
+        the resulting code vectors feed the shared index.  Scenario
+        plumbing as in ``submit``; the inner 'vectors' leg never taps
+        or observes on its own (record/observe gating)."""
         index = self._index
         if index is None:
             raise RuntimeError('no index attached — call '
@@ -2399,6 +2465,7 @@ class ServingMesh:
         t_submit0 = time.perf_counter()
         outer: Future = Future()
         memo = self._memo
+        scenario_name = scenario or 'neighbor_search'
         # BOTH memo tiers stand down while a canary rollover is in
         # flight, exactly as submit() does: duplicate-heavy neighbors
         # traffic served from cache would starve the canary's shadow
@@ -2411,6 +2478,11 @@ class ServingMesh:
         rolling = rolling or self._index_rollover is not None  # graftlint: disable=lock-discipline -- same benign racy read for the index-rollover axis
         if isinstance(context_or_vectors, np.ndarray):
             vectors = np.atleast_2d(context_or_vectors)
+            if record:
+                for row in vectors:
+                    self._record_traffic(
+                        scenario_name, vector=[float(x) for x in row],
+                        language=language, k=k)
             shadow_row = None
             if memo is not None and not rolling and vectors.shape[0] == 1:
                 # semantic tier: serve a within-epsilon single-row query
@@ -2420,10 +2492,12 @@ class ServingMesh:
                     sem_row, shadow = sem
                     if not shadow:
                         if self._tracer is not None:
+                            attrs = {'tier': 'neighbors', 'rows': 1,
+                                     'mesh': True}
+                            if scenario is not None:
+                                attrs['scenario'] = scenario
                             trace = self._tracer.begin(
-                                'serving.request',
-                                attrs={'tier': 'neighbors', 'rows': 1,
-                                       'mesh': True})
+                                'serving.request', attrs=attrs)
                             trace.event('serving.memo_hit',
                                         attrs={'tier': 'neighbors',
                                                'rows': 1,
@@ -2431,9 +2505,10 @@ class ServingMesh:
                             trace.finish(status='ok')
                         # cache-served requests stay in the SLO
                         # good-rate denominator, as in submit()
-                        if self._slo is not None:
+                        if observe and self._slo is not None:
                             self._slo.observe_good(
-                                time.perf_counter() - t_submit0)
+                                time.perf_counter() - t_submit0,
+                                scenario=scenario)
                         outer.set_result([sem_row])
                         return outer
                     # shadow sample: run live anyway, then score the
@@ -2471,6 +2546,9 @@ class ServingMesh:
             return outer
         lines = canonicalize_contexts(context_or_vectors,
                                       self.config.MAX_CONTEXTS)
+        if record:
+            self._record_traffic(scenario_name, lines,
+                                 language=language, k=k)
         nkey = None
         gen = None
         igen = None
@@ -2479,13 +2557,16 @@ class ServingMesh:
             # a k=5 answer can never serve a k=10 ask; stands down
             # during a canary like every other memo serve path
             nkey = memo_lib.request_key(lines, 'neighbors', k=k)
-            cached = None if rolling else memo.lookup(nkey)
+            cached = None if rolling else memo.lookup(nkey,
+                                                      scenario=scenario)
             if cached is not None:
                 if self._tracer is not None:
-                    trace = self._tracer.begin(
-                        'serving.request',
-                        attrs={'tier': 'neighbors', 'rows': len(lines),
-                               'mesh': True})
+                    attrs = {'tier': 'neighbors', 'rows': len(lines),
+                             'mesh': True}
+                    if scenario is not None:
+                        attrs['scenario'] = scenario
+                    trace = self._tracer.begin('serving.request',
+                                               attrs=attrs)
                     trace.event('serving.memo_hit',
                                 attrs={'tier': 'neighbors',
                                        'rows': len(lines),
@@ -2493,9 +2574,10 @@ class ServingMesh:
                     trace.finish(status='ok')
                 # cache-served requests stay in the SLO good-rate
                 # denominator, as in submit()
-                if self._slo is not None:
+                if observe and self._slo is not None:
                     self._slo.observe_good(
-                        time.perf_counter() - t_submit0)
+                        time.perf_counter() - t_submit0,
+                        scenario=scenario)
                 outer.set_result(cached)
                 return outer
             gen = memo.generation
@@ -2504,7 +2586,8 @@ class ServingMesh:
             # ndarray path above: never pair the old index with the
             # new generation
             index = self._index
-        inner = self.submit(lines, tier='vectors')
+        inner = self.submit(lines, tier='vectors', scenario=scenario,
+                            record=False, observe=observe)
 
         def chain(done: Future) -> None:
             try:
@@ -2527,6 +2610,199 @@ class ServingMesh:
                 if not outer.done():
                     outer.set_exception(exc)
         inner.add_done_callback(chain)
+        return outer
+
+    # ------------------------------------------- retrieval-augmented
+    def submit_blended(self, context_lines: Sequence[str],
+                       weight: Optional[float] = None,
+                       k: Optional[int] = None,
+                       deadline_ms: Optional[float] = None,
+                       scenario: Optional[str] = None,
+                       language: Optional[str] = None,
+                       record: bool = True) -> Future:
+        """Retrieval-augmented naming (WORKLOADS.md): blend the softmax
+        head's top-k distribution with similarity votes from the
+        attached index's top-k neighbor labels.  Returns a Future of
+        one ``workloads.blend.BlendResult`` per method.
+
+        Composes the two WARMED paths — ``submit(tier='topk')`` and
+        ``submit_neighbors`` — so a blend costs zero new compiles; the
+        legs run with ``record=False, observe=False`` and the blend
+        registers exactly ONE traffic-tap record and ONE SLO
+        observation at its own future.  ``weight <= 0`` short-circuits
+        to the plain submit path and wraps the UNTOUCHED result
+        (``source='softmax'``, bit-identical scores); no attached
+        index degrades typed (``source='softmax_fallback'``) instead
+        of raising.  Blended results are memoized under a key carrying
+        the weight and k, refused on either a params or an index
+        generation mismatch (both generations taken before the legs
+        launch)."""
+        from code2vec_tpu.workloads import blend as blend_lib
+        if weight is None:
+            weight = self.config.BLEND_NEIGHBOR_WEIGHT
+        weight = float(weight)
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError('blend weight must be in [0, 1], got %r'
+                             % (weight,))
+        k = k if k is not None else self.config.INDEX_NEIGHBORS_K
+        t_submit0 = time.perf_counter()
+        lines = canonicalize_contexts(context_lines,
+                                      self.config.MAX_CONTEXTS)
+        outer: Future = Future()
+        if not lines:
+            outer.set_result([])
+            return outer
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'mesh/blend_requests_total').inc()
+        if record:
+            self._record_traffic(scenario or 'retrieval_naming', lines,
+                                 language=language, k=k, weight=weight)
+
+        def _observe_outer(future: Future) -> None:
+            if self._slo is None:
+                return
+            slo, t0, scen = self._slo, t_submit0, scenario
+
+            def _cb(done: Future) -> None:
+                try:
+                    exc = done.exception()
+                except BaseException:
+                    return  # caller cancelled: not the server's verdict
+                if exc is None:
+                    slo.observe_good(time.perf_counter() - t0,
+                                     scenario=scen)
+                elif not isinstance(exc, EngineClosed):
+                    slo.observe_bad(type(exc).__name__, scenario=scen)
+
+            future.add_done_callback(_cb)
+
+        def _wrap_passthrough(source: str) -> Future:
+            # one warmed leg, scores passed through UNTOUCHED — the
+            # weight=0 parity test asserts bit-identical arrays
+            try:
+                inner = self.submit(lines, tier='topk',
+                                    deadline_ms=deadline_ms,
+                                    scenario=scenario, record=False,
+                                    observe=False)
+            except EngineOverloaded:
+                if self._slo is not None:
+                    self._slo.observe_bad('shed', scenario=scenario)
+                raise
+
+            def _chain(done: Future) -> None:
+                try:
+                    rows = done.result()
+                    _resolve(outer, [blend_lib.BlendResult(
+                        original_name=row.original_name,
+                        predicted_words=list(row.topk_predicted_words),
+                        predicted_scores=row.topk_predicted_words_scores,
+                        source=source, weight=weight, base=row,
+                        neighbors=None) for row in rows])
+                except BaseException as exc:
+                    if not outer.done():
+                        outer.set_exception(exc)
+
+            inner.add_done_callback(_chain)
+            _observe_outer(outer)
+            return outer
+
+        if self._index is None:
+            # typed fallback, not an error: a scenario can be replayed
+            # against a mesh with no index and still answer (pure
+            # softmax), visibly degraded via source + counter
+            if tele_core.enabled():
+                tele_core.registry().counter(
+                    'mesh/blend_fallback_total').inc()
+            return _wrap_passthrough(blend_lib.SOURCE_FALLBACK)
+        if weight <= 0.0:
+            return _wrap_passthrough(blend_lib.SOURCE_SOFTMAX)
+        memo = self._memo
+        bkey = None
+        gen = None
+        igen = None
+        if memo is not None:
+            # keyed on weight AND k: a 0.3-blend answer must never
+            # serve a 0.7-blend ask; stands down during params OR
+            # index rollovers like every other memo serve path
+            bkey = memo_lib.request_key(lines, 'blend@%g' % weight, k=k)
+            rolling = self._rollover is not None  # graftlint: disable=lock-discipline -- benign racy read: a stale None serves one more hit, a stale rollover runs one more request live
+            rolling = rolling or self._index_rollover is not None  # graftlint: disable=lock-discipline -- same benign racy read for the index-rollover axis
+            cached = None if rolling else memo.lookup(bkey,
+                                                      scenario=scenario)
+            if cached is not None:
+                if self._tracer is not None:
+                    attrs = {'tier': 'blend', 'rows': len(lines),
+                             'mesh': True}
+                    if scenario is not None:
+                        attrs['scenario'] = scenario
+                    trace = self._tracer.begin('serving.request',
+                                               attrs=attrs)
+                    trace.event('serving.memo_hit',
+                                attrs={'tier': 'blend',
+                                       'rows': len(lines),
+                                       'memo': 'exact'})
+                    trace.finish(status='ok')
+                if self._slo is not None:
+                    self._slo.observe_good(
+                        time.perf_counter() - t_submit0,
+                        scenario=scenario)
+                outer.set_result(cached)
+                return outer
+            # BOTH generations BEFORE the legs launch: a params or
+            # index rollover concluding mid-flight makes the insert a
+            # refused no-op instead of a stale cached blend
+            gen = memo.generation
+            igen = memo.index_generation
+        try:
+            base_future = self.submit(lines, tier='topk',
+                                      deadline_ms=deadline_ms,
+                                      scenario=scenario, record=False,
+                                      observe=False)
+            nbr_future = self.submit_neighbors(lines, k=k,
+                                               scenario=scenario,
+                                               record=False,
+                                               observe=False)
+        except EngineOverloaded:
+            if self._slo is not None:
+                self._slo.observe_bad('shed', scenario=scenario)
+            raise
+        state: Dict[str, object] = {}
+        state_lock = threading.Lock()
+
+        def _finish() -> None:
+            try:
+                base_rows = state['base']
+                nbr_rows = state['nbr']
+                results = [blend_lib.blend_row(
+                    row, (nbr_rows[i] if i < len(nbr_rows) else None),
+                    weight) for i, row in enumerate(base_rows)]
+                if memo is not None:
+                    memo.insert(bkey, results, gen,
+                                index_generation=igen)
+                _resolve(outer, results)
+            except BaseException as exc:
+                if not outer.done():
+                    outer.set_exception(exc)
+
+        def _arm(name: str):
+            def _cb(done: Future) -> None:
+                try:
+                    value = done.result()
+                except BaseException as exc:
+                    if not outer.done():
+                        outer.set_exception(exc)
+                    return
+                with state_lock:
+                    state[name] = value
+                    ready = len(state) == 2
+                if ready:
+                    _finish()
+            return _cb
+
+        base_future.add_done_callback(_arm('base'))
+        nbr_future.add_done_callback(_arm('nbr'))
+        _observe_outer(outer)
         return outer
 
     # --------------------------------------------------------- rollover

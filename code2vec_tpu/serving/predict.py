@@ -12,17 +12,41 @@ REPL loop, e.g. for one-shot CLI prediction or tests.
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 from code2vec_tpu import common
 from code2vec_tpu.config import Config
-from code2vec_tpu.serving.extractor_bridge import Extractor
+from code2vec_tpu.serving.extractor_bridge import Extractor, infer_language
 
 SHOW_TOP_CONTEXTS = 10           # reference interactive_predict.py:6
 # single source of truth: Config.PREDICT_INPUT_PATH (the --input-file
 # flag's default) — duplicating the literal here let the two drift
 DEFAULT_INPUT_FILENAME = Config.PREDICT_INPUT_PATH
 QUIT_WORDS = frozenset({'exit', 'quit', 'q'})
+
+
+def resolve_input_path(input_filename: str) -> str:
+    """Language inference at the predict entry point.
+
+    ``PREDICT_INPUT_PATH`` defaults to ``Input.java``, which used to
+    leave the C# leg reachable only via ``--input-file Input.cs``.
+    Inference from the file EXTENSION is now the default: when the
+    configured file does not exist but exactly one sibling with a
+    known source extension does (``Input.cs`` next to a missing
+    ``Input.java``), predict over that sibling — the extractor bridge
+    then selects the matching frontend from the extension
+    (``infer_language``).  An existing file, or an ambiguous set of
+    siblings, is returned unchanged."""
+    if os.path.exists(input_filename):
+        return input_filename
+    stem = os.path.splitext(input_filename)[0]
+    candidates = [stem + ext for ext in ('.java', '.cs')
+                  if infer_language(stem + ext) is not None
+                  and os.path.exists(stem + ext)]
+    if len(candidates) == 1:
+        return candidates[0]
+    return input_filename
 
 
 def predict_contexts(model, context_lines, path_unhash,
@@ -93,8 +117,11 @@ class InteractivePredictor:
             try:
                 # Only extraction errors are user-recoverable (bad input
                 # file); model-side failures must surface, not re-prompt.
+                # Re-resolve EVERY turn: creating Input.cs mid-session
+                # switches the REPL to the C# frontend without a flag.
                 context_lines, path_unhash = \
-                    self.path_extractor.extract_paths(self.input_filename)
+                    self.path_extractor.extract_paths(
+                        resolve_input_path(self.input_filename))
             except ValueError as e:
                 print(e)
                 continue

@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from code2vec_tpu.telemetry import catalog
 from code2vec_tpu.telemetry import core as tele_core
 from code2vec_tpu.telemetry.core import Counter
 
@@ -112,7 +113,7 @@ class SloMonitor:
     # the completion stream feeds from submitter threads, replica
     # pullers, and receiver/decode threads concurrently
     # (lock-discipline rule, ANALYSIS.md):
-    # graftlint: guard SloMonitor._fast,_slow,_alerting by _lock
+    # graftlint: guard SloMonitor._fast,_slow,_alerting,_scenarios by _lock
     def __init__(self, availability: float = 0.0, p99_ms: float = 0.0,
                  fast_window_s: float = 60.0,
                  slow_window_s: float = 600.0,
@@ -131,6 +132,11 @@ class SloMonitor:
         self._slow = _Window(slow_window_s)
         #: latched alert state per SLO key ('availability' / 'p99')
         self._alerting: Dict[str, bool] = {}
+        #: scenario -> [good, bad, slow] lifetime tallies — the
+        #: per-scenario error-budget burn attribution the workload
+        #: replayer reads (WORKLOADS.md; scenario labels ride in from
+        #: the mesh submit paths)
+        self._scenarios: Dict[str, list] = {}
         self.good_total = Counter('slo/good_total')
         self.bad_total = Counter('slo/bad_total')
         self.slow_total = Counter('slo/slow_total')
@@ -141,8 +147,10 @@ class SloMonitor:
         return self.availability > 0 or self.p99_s > 0
 
     # ------------------------------------------------------- the stream
-    def observe_good(self, latency_s: Optional[float] = None) -> None:
-        """One delivered request (its latency decides the p99 leg)."""
+    def observe_good(self, latency_s: Optional[float] = None,
+                     scenario: Optional[str] = None) -> None:
+        """One delivered request (its latency decides the p99 leg).
+        ``scenario`` attributes it to a workload (WORKLOADS.md)."""
         slow = (self.p99_s > 0 and latency_s is not None
                 and latency_s > self.p99_s)
         self.good_total.inc()
@@ -153,21 +161,37 @@ class SloMonitor:
             reg.counter('slo/good_total').inc()
             if slow:
                 reg.counter('slo/slow_total').inc()
-        self._observe(bad=False, slow=slow)
+            if scenario:
+                reg.counter(catalog.labeled(
+                    'slo/good_total', 'scenario', scenario)).inc()
+                if slow:
+                    reg.counter(catalog.labeled(
+                        'slo/slow_total', 'scenario', scenario)).inc()
+        self._observe(bad=False, slow=slow, scenario=scenario)
 
-    def observe_bad(self, reason: str = 'failed') -> None:
+    def observe_bad(self, reason: str = 'failed',
+                    scenario: Optional[str] = None) -> None:
         """One request the caller did NOT get an answer for — shed,
         expired, or failed typed — against the availability budget."""
         del reason  # reasons live in the trace log; the budget is one
         self.bad_total.inc()
         if tele_core.enabled():
             tele_core.registry().counter('slo/bad_total').inc()
-        self._observe(bad=True, slow=False)
+            if scenario:
+                tele_core.registry().counter(catalog.labeled(
+                    'slo/bad_total', 'scenario', scenario)).inc()
+        self._observe(bad=True, slow=False, scenario=scenario)
 
-    def _observe(self, bad: bool, slow: bool) -> None:
+    def _observe(self, bad: bool, slow: bool,
+                 scenario: Optional[str] = None) -> None:
         now = time.monotonic()
         fired = []
         with self._lock:
+            if scenario:
+                tally = self._scenarios.setdefault(scenario, [0, 0, 0])
+                tally[0] += not bad
+                tally[1] += bad
+                tally[2] += slow
             self._fast.add(now, bad, slow)
             self._slow.add(now, bad, slow)
             burns = self._burns_locked()
@@ -261,7 +285,22 @@ class SloMonitor:
             burns = self._burns_locked()
             fast_n, slow_n = self._fast.n, self._slow.n
             alerting = dict(self._alerting)
+            scenarios = {name: list(tally) for name, tally
+                         in self._scenarios.items()}
         self._export_burns(burns)  # a stats poll refreshes the export
+        total_bad = sum(tally[1] for tally in scenarios.values())
+        total_slow = sum(tally[2] for tally in scenarios.values())
+        scenario_out = {}
+        for name, (good, bad, slow) in sorted(scenarios.items()):
+            scenario_out[name] = {
+                'good': good, 'bad': bad, 'slow': slow,
+                # which workload is eating the budget: this scenario's
+                # share of all scenario-attributed bad/slow events
+                'availability_burn_share': (bad / total_bad
+                                            if total_bad else 0.0),
+                'p99_burn_share': (slow / total_slow
+                                   if total_slow else 0.0),
+            }
         out = {
             'availability_target': self.availability,
             'p99_target_ms': self.p99_s * 1e3,
@@ -275,6 +314,9 @@ class SloMonitor:
             # latched flags re-arm on the next OBSERVATION (a read
             # never mutates alert state); burns above are current
             'alerting': alerting,
+            # per-scenario error-budget attribution (WORKLOADS.md) —
+            # empty until a caller labels its submits with a scenario
+            'scenarios': scenario_out,
         }
         if self.availability > 0:
             out['availability_burn_fast'] = burns['availability'][0]

@@ -286,10 +286,13 @@ CATALOG: Dict[str, Dict[str, str]] = {
     # ---- memoization tier (code2vec_tpu/serving/memo.py, SERVING.md) ----
     'memo/hits_total': _m(COUNTER, 'requests', 'Requests served from '
                           'the exact memo tier at mesh admission (zero '
-                          'device-seconds, no queue slot).'),
+                          'device-seconds, no queue slot). Scenario-'
+                          'labeled mirrors (memo/hits_total{scenario=s})'
+                          ' give per-workload hit rates (WORKLOADS.md).'),
     'memo/misses_total': _m(COUNTER, 'requests', 'Memo lookups that '
                             'missed and went to the live serving '
-                            'path.'),
+                            'path. Scenario-labeled mirrors as for '
+                            'memo/hits_total.'),
     'memo/inserts_total': _m(COUNTER, 'results', 'Delivered-good '
                              'results inserted into the exact memo '
                              'tier.'),
@@ -426,17 +429,42 @@ CATALOG: Dict[str, Dict[str, str]] = {
                             'burn rate over the slow window.'),
     'slo/good_total': _m(COUNTER, 'requests', 'Requests counted good '
                          'by the SLO monitor (delivered, within the '
-                         'latency target when one is set).'),
+                         'latency target when one is set). Scenario-'
+                         'labeled mirrors (slo/good_total{scenario=s}) '
+                         'attribute budget burn per workload '
+                         '(WORKLOADS.md).'),
     'slo/bad_total': _m(COUNTER, 'requests', 'Requests counted against '
                         'the availability budget (shed, expired, '
-                        'failed).'),
+                        'failed). Scenario-labeled mirrors as for '
+                        'slo/good_total.'),
     'slo/slow_total': _m(COUNTER, 'requests', 'Delivered requests '
                          'slower than SERVING_SLO_P99_MS (counted '
-                         'against the latency budget).'),
+                         'against the latency budget). Scenario-'
+                         'labeled mirrors as for slo/good_total.'),
     'slo/alerts_total': _m(COUNTER, 'alerts', 'SLO burn alerts fired '
                            '(both windows over '
                            'SERVING_SLO_BURN_THRESHOLD; dumps '
                            'flight_slo_burn.jsonl).'),
+    # ---- scenario traffic plane (code2vec_tpu/workloads/, WORKLOADS.md) ----
+    'workloads/recorded_total': _m(COUNTER, 'requests', 'Requests seen '
+                                   'by the admission traffic tap '
+                                   '(ProfileRecorder.record) for later '
+                                   'durable save + replay.'),
+    'workloads/replayed_total': _m(COUNTER, 'requests', 'Recorded '
+                                   'requests re-submitted against a '
+                                   'live mesh by the replay engine '
+                                   '(workloads/replay.py).'),
+    'mesh/blend_requests_total': _m(COUNTER, 'requests', 'Retrieval-'
+                                    'augmented naming requests '
+                                    '(ServingMesh.submit_blended): '
+                                    'softmax top-k blended with '
+                                    'neighbor-label votes at '
+                                    'BLEND_NEIGHBOR_WEIGHT.'),
+    'mesh/blend_fallback_total': _m(COUNTER, 'requests', 'Blend '
+                                    'requests served as pure softmax '
+                                    'because no index was attached '
+                                    '(typed source=softmax_fallback '
+                                    'degradation, not an error).'),
     # ---- device-memory ledger (telemetry/memory.py) ----
     'mem/params_bytes': _m(GAUGE, 'bytes', 'Ledger-attributed device '
                            'bytes held by model parameter sets (one '

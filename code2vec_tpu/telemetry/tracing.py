@@ -536,11 +536,15 @@ class RemoteSpanSink:
         """One member's worker-side trace under the parent's context:
         the root span (``name``, normally ``serving.remote``) becomes a
         child of the parent's member span after adoption."""
+        attrs = {'replica': self.replica, 'pid': os.getpid()}
+        # the dispatch trace context carries the request's workload
+        # scenario (WORKLOADS.md): stamped here so the worker-side
+        # envelope is attributable per scenario after stitching
+        if ctx.get('scenario') is not None:
+            attrs['scenario'] = ctx['scenario']
         trace = Trace(self, str(ctx.get('trace_id', '?')),
                       bool(ctx.get('sampled')), name,
-                      time.perf_counter(),
-                      attrs={'replica': self.replica,
-                             'pid': os.getpid()})
+                      time.perf_counter(), attrs=attrs)
         with self._lock:
             self._open[id(trace)] = (seq, member)
         return trace

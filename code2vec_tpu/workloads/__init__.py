@@ -1,0 +1,18 @@
+"""Scenario traffic plane (WORKLOADS.md).
+
+The serving stack below this package answers ONE request at a time;
+this package is where *workloads* live — named scenarios
+(``scenario.py``), durable recorded traffic (``profile.py``), and the
+paced open-loop replayer that drives the ServingMesh with a mixed
+stream and joins completions back to scenario labels (``replay.py``).
+``blend.py`` holds the pure retrieval-augmented-naming math the mesh's
+``submit_blended`` serves.
+
+Import discipline: this package is imported BY ``serving/mesh.py``
+(for the blend math), so nothing here may import the serving package
+at module scope — replay/profile import mesh types lazily inside
+functions.
+"""
+from code2vec_tpu.workloads.scenario import (  # noqa: F401
+    Scenario, UnknownScenario, get_scenario, register_scenario,
+    scenario_names)

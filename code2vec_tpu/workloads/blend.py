@@ -1,0 +1,122 @@
+"""Retrieval-augmented naming: the pure blend math (WORKLOADS.md
+"Retrieval-augmented naming").
+
+The serve-side path (``ServingMesh.submit_blended``) fetches top-k
+neighbor labels from the attached index and mixes their similarity
+votes with the softmax head's top-k distribution; this module holds
+the math so it is testable without a mesh, a model, or jax — and so
+``serving/mesh.py`` can import it without a cycle (this module must
+never import the serving package).
+
+Semantics:
+
+- the softmax head's ``topk_predicted_words_scores`` are already a
+  distribution over its top-k candidates (``jax.nn.softmax`` over the
+  top-k logits, training/trainer.py) and are used as-is;
+- neighbor similarity scores become votes via a numerically-stable
+  softmax over the returned neighbors, summed per label (the same
+  label retrieved twice votes twice);
+- the blended score of a candidate label is
+  ``(1 - weight) * softmax_p + weight * neighbor_vote``, candidates
+  being the union of both sources, ranked descending (ties broken by
+  softmax rank, then label — deterministic across runs);
+- ``weight=0`` is exact softmax parity BY CONSTRUCTION: the mesh
+  short-circuits to the plain submit path and wraps the untouched
+  result, so the parity test can assert bit-identical scores.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ['BlendResult', 'blend_row', 'neighbor_votes',
+           'SOURCE_BLEND', 'SOURCE_SOFTMAX', 'SOURCE_FALLBACK']
+
+#: BlendResult.source values: a real blend, the weight<=0 passthrough,
+#: and the typed no-index fallback (pure softmax because there was
+#: nothing to retrieve from)
+SOURCE_BLEND = 'blend'
+SOURCE_SOFTMAX = 'softmax'
+SOURCE_FALLBACK = 'softmax_fallback'
+
+
+class BlendResult(NamedTuple):
+    """One blended prediction row.  ``base`` is the untouched softmax
+    ``ModelPredictionResults`` row (its scores are NOT re-ranked);
+    ``predicted_words``/``predicted_scores`` are the blended ranking.
+    Memoizable: ``memo.copy_results`` rebuilds NamedTuples
+    generically, nested rows included."""
+    original_name: str
+    predicted_words: List[str]
+    predicted_scores: np.ndarray
+    source: str
+    weight: float
+    base: object            # ModelPredictionResults
+    neighbors: object = None  # NeighborResult | None
+
+
+def neighbor_votes(labels: Sequence[str],
+                   scores: Sequence[float]) -> dict:
+    """label -> vote mass: a stable softmax over the neighbor
+    similarity scores, summed per label.  Empty input votes for
+    nothing (the blend then degenerates to scaled softmax)."""
+    if len(labels) == 0:
+        return {}
+    arr = np.asarray(scores, dtype=np.float64)
+    with np.errstate(invalid='ignore'):  # all--inf input -> NaN -> uniform
+        arr = np.exp(arr - arr.max())
+    total = float(arr.sum())
+    if total <= 0 or not np.isfinite(total):
+        # degenerate scores (all -inf / NaN): uniform votes keep the
+        # blend defined instead of propagating NaN into the ranking
+        arr = np.ones_like(arr)
+        total = float(arr.sum())
+    votes: dict = {}
+    for label, mass in zip(labels, arr / total):
+        votes[str(label)] = votes.get(str(label), 0.0) + float(mass)
+    return votes
+
+
+def blend_row(base, neighbors, weight: float,
+              top_k: Optional[int] = None) -> BlendResult:
+    """Blend one softmax prediction row with one neighbor result row.
+
+    ``base`` is a ``ModelPredictionResults``; ``neighbors`` a
+    ``NeighborResult`` (``.labels``/``.scores``) or None (typed
+    fallback).  ``top_k`` bounds the blended candidate list (default:
+    the base row's k).
+    """
+    words = list(base.topk_predicted_words)
+    base_scores = (np.asarray(base.topk_predicted_words_scores,
+                              dtype=np.float64)
+                   if base.topk_predicted_words_scores is not None
+                   else np.zeros(len(words)))
+    if neighbors is None:
+        return BlendResult(
+            original_name=base.original_name, predicted_words=words,
+            predicted_scores=base_scores.astype(np.float32),
+            source=SOURCE_FALLBACK, weight=float(weight), base=base,
+            neighbors=None)
+    votes = neighbor_votes(list(neighbors.labels),
+                           list(np.asarray(neighbors.scores).ravel()))
+    weight = float(min(1.0, max(0.0, weight)))
+    #: softmax rank for tie-breaks; unseen-by-softmax labels rank last
+    base_rank = {word: rank for rank, word in enumerate(words)}
+    candidates = list(dict.fromkeys(words + sorted(votes)))
+    blended: List[Tuple[float, int, str]] = []
+    for label in candidates:
+        rank = base_rank.get(label, len(words))
+        p = float(base_scores[rank]) if rank < len(words) else 0.0
+        score = (1.0 - weight) * p + weight * votes.get(label, 0.0)
+        blended.append((-score, rank, label))
+    blended.sort()
+    k = top_k if top_k is not None else len(words)
+    top = blended[:max(1, k)] if blended else []
+    return BlendResult(
+        original_name=base.original_name,
+        predicted_words=[label for _neg, _rank, label in top],
+        predicted_scores=np.asarray(
+            [-neg for neg, _rank, _label in top], dtype=np.float32),
+        source=SOURCE_BLEND, weight=weight, base=base,
+        neighbors=neighbors)

@@ -1,0 +1,267 @@
+"""Paced open-loop traffic replay against a ServingMesh
+(WORKLOADS.md "Replay runbook").
+
+``plan_replay`` turns a profile into a deterministic admission plan:
+same records + same seed + same rate scale => the SAME admitted
+request set in the SAME order (``admitted_fingerprint`` hashes the
+plan so tests assert bit-identity).  ``replay`` drives the mesh
+open-loop — submission times come from the plan, never from
+completion (a slow fleet gets MORE concurrent load, as production
+would) — routes each record through its scenario's entry point
+(submit / submit_neighbors / submit_blended), joins completions back
+to scenario labels, and aggregates per-scenario x per-language:
+
+- quality: exact-match and subtoken-F1 vs the recorded labels
+  (code2vec_tpu/metrics.py semantics);
+- traffic: delivered / shed / error counts, p50/p99 latency;
+- memo hit-rate per scenario (the scenario-labeled ``memo/*``
+  counters, read as before/after deltas);
+- SLO error-budget burn attributed per scenario
+  (``serving/slo.py`` scenario tallies via ``mesh.stats()``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from code2vec_tpu.metrics import SubtokensEvaluationMetric
+from code2vec_tpu.telemetry import catalog
+from code2vec_tpu.telemetry import core as tele_core
+from code2vec_tpu.workloads.scenario import Scenario, get_scenario
+
+__all__ = ['plan_replay', 'admitted_fingerprint', 'replay']
+
+#: subtoken-metric OOV sentinel (vocab.py SPECIAL_WORDS_ONLY_OOV):
+#: replay scores decoded word strings, so only the literal matters
+_OOV = '<OOV>'
+
+#: memo counters the per-scenario hit-rate is read from (scenario-
+#: labeled instances; catalog.labeled)
+_MEMO_COUNTERS = ('memo/hits_total', 'memo/misses_total')
+
+
+def plan_replay(records: Sequence[dict], rate_scale: float = 1.0,
+                seed: int = 0, limit: Optional[int] = None
+                ) -> List[Tuple[float, dict]]:
+    """Deterministic admission plan: ``[(t_submit, record), ...]``.
+
+    Records are stably ordered by (t, input position) — ties keep
+    profile order — and paced at ``t / rate_scale``.  ``limit``
+    subsamples with the seeded rng (the ONLY seed consumer: with no
+    limit the plan is seed-independent, which is what "same profile +
+    seed => identical admitted set" means for full replays too)."""
+    if rate_scale <= 0:
+        raise ValueError('rate_scale must be > 0 (got %r)' % rate_scale)
+    indexed = sorted(enumerate(records),
+                     key=lambda pair: (pair[1].get('t', 0.0), pair[0]))
+    if limit is not None and limit < len(indexed):
+        rng = random.Random(seed)
+        keep = sorted(rng.sample(range(len(indexed)), limit))
+        indexed = [indexed[i] for i in keep]
+    return [(float(record.get('t', 0.0)) / rate_scale, dict(record))
+            for _idx, record in indexed]
+
+
+def admitted_fingerprint(plan: Sequence[Tuple[float, dict]]) -> str:
+    """Content hash of the admitted request set (order-sensitive):
+    the replay-determinism contract is fingerprint equality."""
+    digest = hashlib.sha256()
+    for t_submit, record in plan:
+        digest.update(('%.9f' % t_submit).encode())
+        digest.update(json.dumps(record, sort_keys=True).encode())
+    return digest.hexdigest()
+
+
+def _scenario_counters() -> Dict[str, int]:
+    """Snapshot of every scenario-labeled memo counter (name ->
+    value) from the process registry; empty when telemetry is off."""
+    if not tele_core.enabled():
+        return {}
+    out = {}
+    for name, value in tele_core.registry().snapshot().items():
+        if catalog.base_name(name) in _MEMO_COUNTERS \
+                and name != catalog.base_name(name):
+            out[name] = int(value)
+    return out
+
+
+def _top_words(scenario: Scenario, results) -> List[str]:
+    """Ranked predicted words for one completed request (first row —
+    profile records are one method per request)."""
+    if not results:
+        return []
+    row = results[0]
+    words = getattr(row, 'predicted_words', None)  # BlendResult
+    if words is not None:
+        return list(words)
+    words = getattr(row, 'topk_predicted_words', None)  # predict rows
+    if words is not None:
+        return list(words)
+    labels = getattr(row, 'labels', None)  # NeighborResult
+    if labels is not None:
+        return [str(label) for label in labels]
+    return []
+
+
+class _Arm:
+    """One (scenario, language) aggregation cell."""
+
+    def __init__(self):
+        self.requests = 0
+        self.delivered = 0
+        self.shed = 0
+        self.errors = 0
+        self.scored = 0
+        self.exact = 0
+        self.latencies_ms: List[float] = []
+        self.subtokens = SubtokensEvaluationMetric(_OOV)
+
+    def report(self) -> dict:
+        lat = np.asarray(sorted(self.latencies_ms), dtype=np.float64)
+
+        def pct(q):
+            if lat.size == 0:
+                return 0.0
+            return float(lat[min(lat.size - 1,
+                                 max(0, int(q * lat.size)))])
+        return {
+            'requests': self.requests,
+            'delivered': self.delivered,
+            'shed': self.shed,
+            'errors': self.errors,
+            'scored': self.scored,
+            'exact_match': (self.exact / self.scored
+                            if self.scored else 0.0),
+            'f1': self.subtokens.f1,
+            'precision': self.subtokens.precision,
+            'recall': self.subtokens.recall,
+            'p50_ms': round(pct(0.50), 3),
+            'p99_ms': round(pct(0.99), 3),
+        }
+
+
+def _submit_one(mesh, scenario: Scenario, record: dict):
+    """Route one record through its scenario's mesh entry point."""
+    kwargs = {'scenario': scenario.name,
+              'language': record.get('language')}
+    if scenario.kind == 'neighbors':
+        payload = record.get('lines')
+        if payload is None:
+            payload = np.asarray(record['vector'], dtype=np.float32)
+        return mesh.submit_neighbors(
+            payload, k=record.get('k', scenario.k), **kwargs)
+    if scenario.kind == 'blend':
+        weight = record.get('weight')
+        if weight is None:
+            weight = scenario.blend_weight
+        return mesh.submit_blended(
+            record['lines'], weight=weight,
+            k=record.get('k', scenario.k), **kwargs)
+    return mesh.submit(record['lines'],
+                       tier=record.get('tier', scenario.tier),
+                       **kwargs)
+
+
+def replay(mesh, records: Sequence[dict], rate_scale: float = 1.0,
+           seed: int = 0, limit: Optional[int] = None,
+           pace: bool = True, timeout_s: float = 60.0) -> dict:
+    """Replay a profile against a live mesh; returns the joined
+    per-scenario x per-language report.
+
+    ``pace=False`` submits as fast as the callers can (the
+    deterministic-result drills use it: pacing changes wall time, not
+    the admitted set).  Sheds (``EngineOverloaded``) are an expected
+    open-loop outcome and are aggregated, not raised.
+    """
+    from code2vec_tpu.serving.errors import EngineOverloaded
+    plan = plan_replay(records, rate_scale=rate_scale, seed=seed,
+                       limit=limit)
+    fingerprint = admitted_fingerprint(plan)
+    memo_before = _scenario_counters()
+    arms: Dict[Tuple[str, str], _Arm] = {}
+    inflight: List[tuple] = []
+    t_start = time.perf_counter()
+    for t_submit, record in plan:
+        scenario = get_scenario(record['scenario'])
+        language = record.get('language') or '-'
+        arm = arms.setdefault((scenario.name, language), _Arm())
+        arm.requests += 1
+        if pace:
+            delay = t_submit - (time.perf_counter() - t_start)
+            if delay > 0:
+                time.sleep(delay)
+        t0 = time.perf_counter()
+        try:
+            future = _submit_one(mesh, scenario, record)
+        except EngineOverloaded:
+            arm.shed += 1
+            continue
+        except Exception:
+            arm.errors += 1
+            continue
+        inflight.append((arm, scenario, record, t0, future))
+        if tele_core.enabled():
+            tele_core.registry().counter(
+                'workloads/replayed_total').inc()
+    deadline = time.monotonic() + timeout_s
+    for arm, scenario, record, t0, future in inflight:
+        try:
+            results = future.result(
+                timeout=max(0.1, deadline - time.monotonic()))
+        except EngineOverloaded:
+            arm.shed += 1
+            continue
+        except Exception:
+            arm.errors += 1
+            continue
+        arm.delivered += 1
+        arm.latencies_ms.append((time.perf_counter() - t0) * 1e3)
+        label = record.get('label')
+        if label:
+            words = _top_words(scenario, results)
+            arm.scored += 1
+            if words and words[0] == label:
+                arm.exact += 1
+            arm.subtokens.update_batch([(label, words)])
+    memo_after = _scenario_counters()
+    report: dict = {'fingerprint': fingerprint,
+                    'admitted': len(plan),
+                    'rate_scale': rate_scale, 'seed': seed,
+                    'scenarios': {}}
+    for (name, language), arm in sorted(arms.items()):
+        cell = arm.report()
+        hits = (memo_after.get(
+            catalog.labeled('memo/hits_total', 'scenario', name), 0)
+            - memo_before.get(
+                catalog.labeled('memo/hits_total', 'scenario', name),
+                0))
+        misses = (memo_after.get(
+            catalog.labeled('memo/misses_total', 'scenario', name), 0)
+            - memo_before.get(
+                catalog.labeled('memo/misses_total', 'scenario',
+                                name), 0))
+        cell['memo_hit_rate'] = (hits / (hits + misses)
+                                 if hits + misses else 0.0)
+        report['scenarios'].setdefault(name, {})[language] = cell
+    stats = mesh.stats()
+    slo = stats.get('slo')
+    if slo is not None:
+        report['slo'] = {
+            'good_total': slo.get('good_total'),
+            'bad_total': slo.get('bad_total'),
+            'slow_total': slo.get('slow_total'),
+            'alerting': slo.get('alerting'),
+            # per-scenario error-budget burn attribution
+            # (serving/slo.py scenario tallies)
+            'scenarios': slo.get('scenarios', {}),
+        }
+        for key in ('availability_burn_fast', 'availability_burn_slow',
+                    'p99_burn_fast', 'p99_burn_slow'):
+            if key in slo:
+                report['slo'][key] = slo[key]
+    return report

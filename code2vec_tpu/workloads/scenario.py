@@ -1,0 +1,125 @@
+"""Scenario registry: typed workload definitions (WORKLOADS.md).
+
+A ``Scenario`` names one kind of served traffic — which language(s) it
+carries, which mesh entry point serves it (``kind``), which output
+tier it rides, and the arrival process synthetic builders generate it
+with.  Profiles (``profile.py``) label every record with a scenario
+name; the replayer (``replay.py``) routes each record through the
+mesh call its scenario's ``kind`` selects and aggregates quality and
+latency per scenario.
+
+The registry is a process-global name table so profiles recorded by
+one process replay in another on names alone; ``register_scenario``
+lets benchmarks and tests add their own without touching this module.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = ['Scenario', 'UnknownScenario', 'register_scenario',
+           'get_scenario', 'scenario_names', 'KINDS']
+
+#: mesh entry point a scenario's requests ride:
+#: - 'predict'   -> ServingMesh.submit(tier=...)
+#: - 'neighbors' -> ServingMesh.submit_neighbors(k=...)
+#: - 'blend'     -> ServingMesh.submit_blended(weight=..., k=...)
+KINDS = ('predict', 'neighbors', 'blend')
+
+
+class UnknownScenario(KeyError):
+    """A profile or caller named a scenario the registry does not
+    hold — typed so replay tooling can distinguish a stale profile
+    from a generic KeyError."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One named workload (immutable; safe to share across threads)."""
+
+    name: str
+    #: languages this scenario's requests carry ('java', 'csharp')
+    languages: Tuple[str, ...] = ('java',)
+    #: mesh entry point (KINDS)
+    kind: str = 'predict'
+    #: output tier for 'predict' requests (ignored by other kinds)
+    tier: str = 'topk'
+    #: neighbors per query for 'neighbors'/'blend' (None = config k)
+    k: Optional[int] = None
+    #: neighbor-vs-softmax mix for 'blend' (None = config knob)
+    blend_weight: Optional[float] = None
+    #: default arrival rate for synthetic profile builders (req/s)
+    rate_rps: float = 20.0
+    description: str = ''
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError('Scenario.kind must be one of %s (got %r)'
+                             % (KINDS, self.kind))
+        if not self.languages:
+            raise ValueError('Scenario.languages must be non-empty')
+
+
+_lock = threading.Lock()
+_REGISTRY: Dict[str, Scenario] = {}
+
+
+def register_scenario(scenario: Scenario,
+                      replace: bool = False) -> Scenario:
+    """Add a scenario to the process-global registry.  Re-registering
+    an identical definition is a no-op; a CONFLICTING one raises
+    unless ``replace=True`` — two benchmarks silently disagreeing on
+    what a name means would corrupt every per-scenario number."""
+    with _lock:
+        existing = _REGISTRY.get(scenario.name)
+        if existing is not None and existing != scenario and not replace:
+            raise ValueError(
+                'scenario %r is already registered with a different '
+                'definition (pass replace=True to override)'
+                % scenario.name)
+        _REGISTRY[scenario.name] = scenario
+    return scenario
+
+
+def get_scenario(name: str) -> Scenario:
+    with _lock:
+        scenario = _REGISTRY.get(name)
+    if scenario is None:
+        raise UnknownScenario(
+            'unknown scenario %r (registered: %s) — profiles name '
+            'scenarios by string; register it before replaying'
+            % (name, sorted(_REGISTRY)))
+    return scenario
+
+
+def scenario_names() -> Tuple[str, ...]:
+    with _lock:
+        return tuple(sorted(_REGISTRY))
+
+
+# ---- built-in scenarios (WORKLOADS.md "Scenario registry") ----
+#: single-language method naming over the micro-batched predict path
+JAVA_NAMING = register_scenario(Scenario(
+    'java_naming', languages=('java',), kind='predict', tier='topk',
+    description='Java method naming (softmax top-k).'))
+CSHARP_NAMING = register_scenario(Scenario(
+    'csharp_naming', languages=('csharp',), kind='predict', tier='topk',
+    description='C# method naming (softmax top-k).'))
+#: the mixed-language softmax-only arm the retrieval blend A/Bs against
+SOFTMAX_NAMING = register_scenario(Scenario(
+    'softmax_naming', languages=('java', 'csharp'), kind='predict',
+    tier='topk',
+    description='Mixed-language naming, softmax head only (the '
+                'retrieval A/B baseline).'))
+#: retrieval-augmented naming: softmax distribution blended with top-k
+#: neighbor labels from the attached index (mesh.submit_blended)
+RETRIEVAL_NAMING = register_scenario(Scenario(
+    'retrieval_naming', languages=('java', 'csharp'), kind='blend',
+    description='Mixed-language naming with the softmax distribution '
+                'blended against attached-index neighbor labels.'))
+#: raw nearest-method search over the index (code-search entry path)
+NEIGHBOR_SEARCH = register_scenario(Scenario(
+    'neighbor_search', languages=('java',), kind='neighbors',
+    description='Nearest-method search via the vectors tier + '
+                'attached index.'))

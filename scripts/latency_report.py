@@ -176,6 +176,20 @@ def trace_key(entry: dict) -> Tuple[str, str, str]:
     return tier, bucket, replica
 
 
+def trace_scenario(entry: dict) -> str:
+    """Scenario attribution for one trace: the workload label stamped
+    into the root attrs at mesh admission and carried by the dispatch
+    trace context (WORKLOADS.md); '-' for unlabeled traffic."""
+    root = entry['root'] or {}
+    scenario = (root.get('attrs') or {}).get('scenario')
+    if scenario is None:
+        for rec in entry['spans']:
+            scenario = (rec.get('attrs') or {}).get('scenario')
+            if scenario is not None:
+                break
+    return '-' if scenario is None else str(scenario)
+
+
 def phase_rows(traces: Dict[str, dict]
                ) -> Dict[Tuple[str, str, str, str], List[float]]:
     """(phase, tier, bucket, replica) -> ascending durations (ms)."""
@@ -265,11 +279,15 @@ _PARENT_PHASES = ('serving.admission', 'serving.tokenize')
 
 
 def fleet_decomposition(traces: Dict[str, dict]
-                        ) -> Dict[Tuple[str, str],
+                        ) -> Dict[Tuple[str, str, str],
                                   Dict[str, List[float]]]:
-    """(replica, tier) -> {end_to_end, queue_wait, wire, device,
-    worker_host} (ms, ascending) over delivered traces — the
-    ``--fleet`` view of STITCHED cross-process traces.
+    """(replica, tier, scenario) -> {end_to_end, queue_wait, wire,
+    device, worker_host} (ms, ascending) over delivered traces — the
+    ``--fleet`` view of STITCHED cross-process traces.  The scenario
+    axis rides the spans the stitching already carries: the admission-
+    time workload label lands in the root attrs and the dispatch trace
+    context, so per-scenario fleet latency needs no new span names
+    ('-' buckets unlabeled traffic).
 
     For worker-mode mesh traffic the parent only sees admission,
     tokenize, and queue wait; the grafted ``serving.remote`` envelope
@@ -285,12 +303,13 @@ def fleet_decomposition(traces: Dict[str, dict]
     queue, zero wire, zero device — so the fleet table attributes the
     saved device work to the cache instead of diluting a replica's
     column with sub-ms rows."""
-    out: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
+    out: Dict[Tuple[str, str, str], Dict[str, List[float]]] = {}
     for entry in traces.values():
         root = entry['root']
         if root is None or root.get('status') not in (None, 'ok'):
             continue
         tier, _bucket, replica = trace_key(entry)
+        scenario = trace_scenario(entry)
         if any(rec['name'] == 'serving.memo_hit'
                for rec in entry['spans']):
             replica = 'memo'
@@ -307,7 +326,7 @@ def fleet_decomposition(traces: Dict[str, dict]
             wire = 0.0
             worker_host = 0.0
         parts = out.setdefault(
-            (replica, tier),
+            (replica, tier, scenario),
             {'end_to_end': [], 'queue_wait': [], 'wire': [],
              'device': [], 'worker_host': []})
         parts['end_to_end'].append(total)
@@ -483,14 +502,15 @@ def main(argv=None) -> int:
             print(json.dumps({'measure': 'unstitched_traces',
                               'value': len(unstitched),
                               'traces': unstitched[:32]}))
-            for (replica, tier), parts in sorted(
+            for (replica, tier, scenario), parts in sorted(
                     fleet_decomposition(traces).items()):
                 for part in ('end_to_end', 'queue_wait', 'wire',
                              'device', 'worker_host'):
                     values = parts[part]
                     print(json.dumps({
                         'measure': 'fleet_decomposition_ms',
-                        'replica': replica, 'tier': tier, 'part': part,
+                        'replica': replica, 'tier': tier,
+                        'scenario': scenario, 'part': part,
                         'count': len(values),
                         'p50': round(percentile(values, 0.50), 3),
                         'p99': round(percentile(values, 0.99), 3),
@@ -543,14 +563,16 @@ def main(argv=None) -> int:
                   % len(unstitched))
             fleet = fleet_decomposition(traces)
             if fleet:
-                print('  %-7s %-10s %6s %9s %9s %9s %9s %9s'
-                      % ('replica', 'tier', 'count', 'queue_p99',
-                         'wire_p99', 'dev_p99', 'whost_p99',
-                         'e2e_p99'))
-                for (replica, tier), parts in sorted(fleet.items()):
-                    print('  %-7s %-10s %6d %9.2f %9.2f %9.2f %9.2f '
-                          '%9.2f'
-                          % (replica, tier, len(parts['end_to_end']),
+                print('  %-7s %-10s %-16s %6s %9s %9s %9s %9s %9s'
+                      % ('replica', 'tier', 'scenario', 'count',
+                         'queue_p99', 'wire_p99', 'dev_p99',
+                         'whost_p99', 'e2e_p99'))
+                for (replica, tier, scenario), parts in sorted(
+                        fleet.items()):
+                    print('  %-7s %-10s %-16s %6d %9.2f %9.2f %9.2f '
+                          '%9.2f %9.2f'
+                          % (replica, tier, scenario,
+                             len(parts['end_to_end']),
                              percentile(parts['queue_wait'], 0.99),
                              percentile(parts['wire'], 0.99),
                              percentile(parts['device'], 0.99),

@@ -372,6 +372,82 @@ def test_bench_index_quant_arms_smoke():
     assert insert['segments'] >= 1, insert
 
 
+def test_workloads_files_stay_within_tier1_budget():
+    """ISSUE 20 satellite: the scenario-traffic-plane test files ride
+    tier-1 with TINY in-code profiles — the full replay drills are
+    slow-marked.  The suite sits close to the tier-1 wall-clock cap,
+    so the headroom contract is enforced here: both files, cold
+    interpreter, well under the budget.  A full-corpus replay sneaking
+    into the tier-1 lane fails THIS assert before it blows the cap."""
+    import time
+    env = dict(os.environ, JAX_PLATFORMS='cpu', PYTHONPATH=REPO)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, '-m', 'pytest',
+         os.path.join(REPO, 'tests', 'test_workloads.py'),
+         os.path.join(REPO, 'tests', 'test_workloads_replay.py'),
+         '-q', '-m', 'not slow', '-p', 'no:cacheprovider'],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-500:]
+    # nominal ~6s cold; 120s leaves room for a loaded machine while
+    # still catching a drift into minutes
+    assert elapsed < 120.0, 'workloads tier-1 tests took %.1fs' % elapsed
+
+
+@pytest.mark.slow
+def test_bench_scenarios_smoke_mixed_replay(tmp_path):
+    """ISSUE 20: the --scenarios stage (capture_all.sh ``scenarios``)
+    must survive import/config rot on the CPU smoke shapes: one
+    recorded-then-replayed mixed Java+C# profile reports per-scenario
+    x per-language quality + hit-rate + shed + p99, per-scenario SLO
+    burn, the retrieval-vs-softmax A/B verdict (beats or ties — the
+    acceptance gate), ZERO post-warmup compiles across the whole
+    mixed-scenario steady state, and a stable replay fingerprint."""
+    env = dict(os.environ, BENCH_SMOKE='1', JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO)
+    out = tmp_path / 'scenarios.json'
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'benchmarks',
+                                      'accuracy_at_scale.py'),
+         '--scenarios', '--workdir', str(tmp_path / 'wd'),
+         '--out', str(out)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    records = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            records.append(json.loads(line))
+    quality = [r for r in records if r.get('measure') ==
+               'scenario_quality']
+    cells = {(r['scenario'], r['language']) for r in quality}
+    # the as-labeled arm plus both A/B relabelings, both languages
+    assert {('java_naming', 'java'), ('csharp_naming', 'csharp'),
+            ('softmax_naming', 'java'), ('softmax_naming', 'csharp'),
+            ('retrieval_naming', 'java'),
+            ('retrieval_naming', 'csharp')} <= cells
+    for r in quality:
+        assert r['requests'] == r['delivered'] + r['shed'] + r['errors']
+        assert 0.0 <= r['memo_hit_rate'] <= 1.0
+        assert r['p50_ms'] <= r['p99_ms']
+    slo = [r for r in records if r.get('measure') == 'scenario_slo']
+    assert {r['scenario'] for r in slo} >= {'java_naming',
+                                            'csharp_naming'}
+    (ab,) = [r for r in records if r.get('measure') == 'retrieval_ab']
+    assert ab['verdict'] in ('win', 'tie'), ab  # beats or ties
+    assert ab['scored'] > 0
+    (compiles,) = [r for r in records
+                   if r.get('measure') == 'scenario_postwarm_compiles']
+    assert compiles['value'] == 0, compiles
+    (fp,) = [r for r in records
+             if r.get('measure') == 'scenario_replay_fingerprint']
+    assert fp['admitted'] > 0 and len(fp['value']) == 64
+    saved = json.loads(out.read_text())
+    assert saved['fingerprint'] == fp['value']
+    assert saved['retrieval_ab']['verdict'] == ab['verdict']
+
+
 def test_bench_sigterm_flushes_fallback_line(tmp_path):
     """VERDICT r3 #1: the driver kills bench.py with SIGTERM at its own
     timeout; the supervisor must flush a parseable fallback line and die

@@ -332,14 +332,15 @@ def test_latency_report_fleet_decomposition_and_unstitched(tmp_path,
         latency_report.load_spans(spans))
     assert latency_report.unstitched_traces(traces) == ['T2']
     fleet = latency_report.fleet_decomposition(traces)
-    parts = fleet[('r0', 'topk')]
+    # unlabeled traffic lands under scenario '-' on the new axis
+    parts = fleet[('r0', 'topk', '-')]
     assert parts['end_to_end'] == [100.0]
     assert parts['queue_wait'] == [20.0]
     assert parts['device'] == [40.0]
     assert parts['worker_host'] == [20.0]   # remote 60 - device 40
     assert abs(parts['wire'][0] - 20.0) < 1e-6  # 100 - 20 - 60
     # the truncated trace has no replica attribution: lands under '-'
-    assert fleet[('-', 'topk')]['wire'] == [0.0]
+    assert fleet[('-', 'topk', '-')]['wire'] == [0.0]
     # CLI --fleet --json emits the rows
     assert latency_report.main(
         ['--spans', spans, '--fleet', '--json', '--top', '0']) == 0

@@ -512,10 +512,10 @@ class _SloStub:
         self.good = 0
         self.bad = 0
 
-    def observe_good(self, latency_s=None):
+    def observe_good(self, latency_s=None, scenario=None):
         self.good += 1
 
-    def observe_bad(self, reason='failed'):
+    def observe_bad(self, reason='failed', scenario=None):
         self.bad += 1
 
     def stats(self):
